@@ -1,0 +1,104 @@
+"""Benchmark: BERT-base pretraining train-step throughput on one TPU
+chip (BASELINE config 3 / north-star metric "tokens/sec/chip").
+
+Prints ONE JSON line:
+  {"metric": "tokens_per_sec_per_chip", "value": N, "unit": "tokens/s",
+   "vs_baseline": N}
+
+vs_baseline compares against an A100 BERT-base reference throughput.
+The reference repo publishes no numbers (BASELINE.md), so the A100
+figure is derived from public MLPerf-class results: BERT on 8xA100
+trains ~3000 seq/s at seq 512-ish mixed precision => ~190k tokens/s
+per chip for base-sized models at seq 128. North-star target is >=0.9.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+A100_BASELINE_TOKENS_PER_S = 190_000.0
+
+BATCH = 32
+SEQ = 128
+WARMUP = 3
+STEPS = 20
+
+
+def main():
+    import jax
+
+    import paddle_tpu as fluid
+    from paddle_tpu.models import BertConfig, build_bert_pretrain
+    from paddle_tpu.models.bert import synthetic_batch
+
+    cfg = BertConfig.base()
+    cfg.use_flash_attention = jax.default_backend() == "tpu"
+    opt = fluid.optimizer.Adam(1e-4)
+    main_prog, startup, feeds, fetches = build_bert_pretrain(cfg, SEQ, optimizer=opt)
+
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        batch = synthetic_batch(np.random.RandomState(0), BATCH, SEQ, cfg.vocab_size)
+        fn, args, meta = exe.export_fn(main_prog, batch, [fetches["loss"]], scope=scope)
+
+    feed_n = len(meta["feed_names"])
+    state_names = meta["state_names"]
+    written = meta["written_names"]
+    written_pos = {n: i for i, n in enumerate(written)}
+    n_fetch = 1
+
+    donate = tuple(
+        1 + feed_n + i for i, n in enumerate(state_names) if n in written_pos
+    )
+    step_fn = jax.jit(fn, donate_argnums=donate)
+
+    key = jax.random.PRNGKey(0)
+    feed_vals = list(args[1 : 1 + feed_n])
+    state_vals = list(args[1 + feed_n :])
+
+    def one_step(i, state_vals):
+        k = jax.random.fold_in(key, i)
+        outs = step_fn(k, *feed_vals, *state_vals)
+        new_state = list(outs[n_fetch:])
+        nxt = []
+        for n, old in zip(state_names, state_vals):
+            if n in written_pos:
+                nxt.append(new_state[written_pos[n]])
+            else:
+                nxt.append(old)
+        return outs[0], nxt
+
+    # warmup (incl. compile). NOTE: through the remote TPU tunnel
+    # block_until_ready does not actually block — force a host readback
+    # to synchronize (np.asarray).
+    for i in range(WARMUP):
+        loss, state_vals = one_step(i, state_vals)
+    np.asarray(loss)
+
+    t0 = time.perf_counter()
+    for i in range(WARMUP, WARMUP + STEPS):
+        loss, state_vals = one_step(i, state_vals)
+    final_loss = float(np.asarray(loss))
+    dt = time.perf_counter() - t0
+    assert np.isfinite(final_loss), f"non-finite loss {final_loss}"
+
+    tokens_per_s = BATCH * SEQ * STEPS / dt
+    print(
+        json.dumps(
+            {
+                "metric": "tokens_per_sec_per_chip",
+                "value": round(tokens_per_s, 1),
+                "unit": "tokens/s",
+                "vs_baseline": round(tokens_per_s / A100_BASELINE_TOKENS_PER_S, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,101 @@
+"""paddle_tpu — a TPU-native deep-learning framework with the capability
+surface of PaddlePaddle Fluid (v1.7 era).
+
+The user-facing programming model mirrors the reference
+(/root/reference/python/paddle/fluid/__init__.py): build a declarative
+``Program`` of blocks/ops/vars, then hand it to an ``Executor(place)``.
+The execution substrate is completely different: instead of a per-op
+interpreter dispatching CUDA kernels (reference
+paddle/fluid/framework/executor.cc:195), whole blocks are lowered to a
+single JAX function, compiled once by XLA, and run on TPU.  Distribution
+is expressed as named mesh axes + GSPMD sharding instead of NCCL rings
+and graph-rewriting transpilers.
+"""
+
+from . import core
+from . import ops  # registers all op lowerings
+from . import kernels  # registers Pallas-backed fused ops
+from .core import framework
+from .core.framework import (
+    Program,
+    Block,
+    Operator,
+    Variable,
+    Parameter,
+    program_guard,
+    default_main_program,
+    default_startup_program,
+    unique_name,
+    name_scope,
+    in_dygraph_mode,
+)
+from .core.executor import Executor, Scope, global_scope, scope_guard
+from .core.places import CPUPlace, TPUPlace, CUDAPlace, Place, is_compiled_with_tpu
+from .core.backward import append_backward, gradients
+from .core.compiler import CompiledProgram, BuildStrategy, ExecutionStrategy
+from . import layers
+from . import nets
+from . import initializer
+from . import optimizer
+from . import regularizer
+from . import clip
+from . import metrics
+from . import io
+from . import profiler
+from .param_attr import ParamAttr, WeightNormParamAttr
+from .initializer import (
+    Constant,
+    Uniform,
+    Normal,
+    TruncatedNormal,
+    Xavier,
+    MSRA,
+    Bilinear,
+    NumpyArrayInitializer,
+)
+from .data_feeder import DataFeeder
+from .reader import DataLoader
+from .io import save, load, save_params, load_params, save_persistables, load_persistables
+from .core import dygraph
+from .core.dygraph import dygraph_guard as _dg
+
+# ``fluid``-style alias so reference user code reads naturally:
+#   import paddle_tpu as fluid
+#   fluid.layers.fc(...)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Program",
+    "Block",
+    "Operator",
+    "Variable",
+    "Parameter",
+    "program_guard",
+    "default_main_program",
+    "default_startup_program",
+    "Executor",
+    "Scope",
+    "global_scope",
+    "scope_guard",
+    "CPUPlace",
+    "TPUPlace",
+    "CUDAPlace",
+    "append_backward",
+    "gradients",
+    "CompiledProgram",
+    "BuildStrategy",
+    "ExecutionStrategy",
+    "layers",
+    "nets",
+    "initializer",
+    "optimizer",
+    "regularizer",
+    "clip",
+    "metrics",
+    "io",
+    "profiler",
+    "ParamAttr",
+    "DataFeeder",
+    "DataLoader",
+]

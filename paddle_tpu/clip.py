@@ -1,0 +1,98 @@
+"""Gradient clipping. Reference: python/paddle/fluid/clip.py
+(GradientClipByValue/ByNorm/ByGlobalNorm, set_gradient_clip,
+ErrorClipByValue)."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+_global_clip = None
+
+
+class BaseGradientClipAttr:
+    def _append_clip_op(self, params_grads):
+        raise NotImplementedError
+
+
+class GradientClipByValue(BaseGradientClipAttr):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -float(max)
+
+    def _append_clip_op(self, params_grads):
+        from .layers.nn import clip as clip_layer
+
+        return [(p, clip_layer(g, self.min, self.max)) for p, g in params_grads]
+
+
+class GradientClipByNorm(BaseGradientClipAttr):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _append_clip_op(self, params_grads):
+        from .layers.nn import clip_by_norm
+
+        return [(p, clip_by_norm(g, self.clip_norm)) for p, g in params_grads]
+
+
+class GradientClipByGlobalNorm(BaseGradientClipAttr):
+    def __init__(self, clip_norm, group_name="default_group"):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+
+    def _append_clip_op(self, params_grads):
+        from .layers.nn import (
+            elementwise_div,
+            elementwise_max,
+            elementwise_min,
+            elementwise_mul,
+            scale,
+            sqrt,
+            square,
+            reduce_sum,
+        )
+        from .layers.tensor import fill_constant, sums
+
+        sq_sums = [reduce_sum(square(g)) for _, g in params_grads]
+        total = sums(sq_sums) if len(sq_sums) > 1 else sq_sums[0]
+        global_norm = sqrt(total)
+        max_norm = fill_constant([], "float32", self.clip_norm)
+        denom = elementwise_max(global_norm, max_norm)
+        factor = elementwise_div(max_norm, denom)
+        return [(p, elementwise_mul(g, factor, axis=-1)) for p, g in params_grads]
+
+
+class ErrorClipByValue:
+    """Per-var activation-grad clip (reference clip.py ErrorClipByValue).
+    Attached via Variable.error_clip; applied by append_backward —
+    accepted for parity, enforcement happens in grad lowering."""
+
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -float(max)
+
+
+def set_gradient_clip(clip, param_list=None, program=None):
+    global _global_clip
+    _global_clip = clip
+    if param_list:
+        for p in param_list:
+            p.gradient_clip_attr = clip
+
+
+def append_gradient_clip_ops(params_grads, optimizer_clip=None):
+    clip = optimizer_clip or _global_clip
+    # per-param attrs override the global clip
+    per_attr = [getattr(p, "gradient_clip_attr", None) for p, _ in params_grads]
+    if clip is None and not any(per_attr):
+        return params_grads
+    if clip is not None and not any(per_attr):
+        return clip._append_clip_op(params_grads)
+    out = []
+    for (p, g), attr in zip(params_grads, per_attr):
+        c = attr or clip
+        if c is None:
+            out.append((p, g))
+        else:
+            out.extend(c._append_clip_op([(p, g)]))
+    return out

@@ -1,0 +1,4 @@
+"""Contrib: mixed precision (AMP), slim (compression) — reference
+python/paddle/fluid/contrib/."""
+
+from . import mixed_precision

@@ -1,0 +1,17 @@
+"""Core runtime: program IR, op registry, executor, autodiff.
+
+Reference mapping (all paths under /root/reference/):
+  - framework.py / framework.proto  -> core/framework.py (pure Python IR)
+  - framework/executor.cc           -> core/executor.py (XLA whole-block jit)
+  - backward.py                     -> core/backward.py
+  - framework/op_registry.h         -> core/registry.py
+"""
+
+from . import framework
+from . import registry
+from . import places
+from . import executor
+from . import control_flow
+from . import backward
+from . import compiler
+from . import dygraph

@@ -1,0 +1,32 @@
+"""Imperative (dygraph) mode flag + guard.
+
+Reference: paddle/fluid/imperative/tracer.cc + fluid/dygraph/.
+In this framework eager mode IS jax: the full dygraph layer library
+lives in paddle_tpu/dygraph/ (Layer, to_variable, ...). This module
+only tracks the mode flag used by layers to decide whether to append
+ops to a Program or execute eagerly.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+_in_dygraph = False
+
+
+def in_dygraph_mode() -> bool:
+    return _in_dygraph
+
+
+@contextlib.contextmanager
+def dygraph_guard():
+    global _in_dygraph
+    prev = _in_dygraph
+    _in_dygraph = True
+    try:
+        yield
+    finally:
+        _in_dygraph = prev
+
+
+guard = dygraph_guard

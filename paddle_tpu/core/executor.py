@@ -1,0 +1,441 @@
+"""Executor: compiles whole Program blocks to single XLA executables.
+
+Reference: framework/executor.cc:195 (Executor::Run) interprets a
+ProgramDesc one op at a time, choosing a kernel per op and launching it
+(operator.cc:918-1027 RunImpl), with scope-based GC of dead tensors.
+
+TPU-native redesign: `Executor.run(program, feed, fetch_list)` lowers
+the *entire block* through the op registry into one JAX function
+
+    f(step_key, *feed_values, *state_values) -> (*fetch_values, *new_state)
+
+jit-compiles it (cached on (program, version, feed shapes)), and runs
+it. Consequences, all deliberate:
+  * no per-op dispatch: XLA fuses the whole step (forward, backward,
+    optimizer) into one executable — the interpreter hot loop (CS1 in
+    SURVEY.md) disappears;
+  * no garbage collector: SSA values die by liveness inside XLA;
+  * no data-layout transfer machinery: XLA assigns layouts;
+  * persistable variables (parameters, optimizer state) live in a Scope
+    as device arrays and are donated back to the executable each step
+    (buffer aliasing ≈ the reference's in-place param update).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import itertools
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import framework
+from .framework import Program, Block, Variable, convert_dtype
+from .registry import LoweringContext, get_op_def
+from .places import Place, TPUPlace
+
+
+class Scope:
+    """name -> device array store for persistable variables.
+
+    Reference framework/scope.h:46 is a hierarchical name->Variable map;
+    executor-managed temporaries don't exist here (they are SSA values
+    inside the compiled function), so a flat dict with a parent link
+    suffices.
+    """
+
+    _uid_counter = itertools.count(1)
+
+    def __init__(self, parent: Optional["Scope"] = None):
+        self.vars: Dict[str, Any] = {}
+        self.parent = parent
+        self.uid = next(Scope._uid_counter)
+
+    def find_var(self, name: str):
+        s: Optional[Scope] = self
+        while s is not None:
+            if name in s.vars:
+                return s.vars[name]
+            s = s.parent
+        return None
+
+    def has_var(self, name: str) -> bool:
+        return self.find_var(name) is not None
+
+    def set_var(self, name: str, value):
+        self.vars[name] = value
+
+    def erase(self, name: str):
+        self.vars.pop(name, None)
+
+    def new_scope(self) -> "Scope":
+        return Scope(parent=self)
+
+    def local_var_names(self) -> List[str]:
+        return list(self.vars)
+
+    # numpy convenience for tests / io
+    def get_numpy(self, name: str):
+        v = self.find_var(name)
+        return None if v is None else np.asarray(v)
+
+
+_global_scope = Scope()
+_scope_stack: List[Scope] = [_global_scope]
+
+
+def global_scope() -> Scope:
+    return _scope_stack[-1]
+
+
+@contextlib.contextmanager
+def scope_guard(scope: Scope):
+    _scope_stack.append(scope)
+    try:
+        yield
+    finally:
+        _scope_stack.pop()
+
+
+# --------------------------------------------------------------------------
+
+
+class _CompiledBlock:
+    """One jitted executable for (program version, feed signature)."""
+
+    def __init__(self, fn, feed_names, state_names, fetch_names, written_names, donate):
+        self.fn = fn
+        self.feed_names = feed_names
+        self.state_names = state_names
+        self.fetch_names = fetch_names
+        self.written_names = written_names
+        self.donate = donate
+
+
+def _lower_block(
+    block: Block,
+    env: Dict[str, Any],
+    ctx: LoweringContext,
+):
+    """Interpret ops of a block symbolically, updating env in place."""
+    for op in block.ops:
+        if op.type in ("feed", "fetch"):
+            continue
+        lower_control = _CONTROL_FLOW.get(op.type)
+        if lower_control is not None:
+            lower_control(block, op, env, ctx)
+            continue
+        opdef = get_op_def(op.type)
+        ins: Dict[str, List[Any]] = {}
+        for slot, names in op.inputs.items():
+            vals = []
+            for n in names:
+                if n not in env:
+                    raise KeyError(
+                        f"op {op.type!r} input {slot}={n!r} is not defined; "
+                        "did you run the startup program / feed this var?"
+                    )
+                vals.append(env[n])
+            ins[slot] = vals
+        scope_name = op.attrs.get("name_scope")
+        if scope_name:
+            with jax.named_scope(scope_name):
+                outs = opdef.lower(ctx, op, ins)
+        else:
+            outs = opdef.lower(ctx, op, ins)
+        for slot, names in op.outputs.items():
+            vals = outs.get(slot, [])
+            for i, n in enumerate(names):
+                if i < len(vals):
+                    env[n] = vals[i]
+
+
+def build_block_fn(
+    block: Block,
+    feed_names: Sequence[str],
+    state_names: Sequence[str],
+    fetch_names: Sequence[str],
+    written_names: Sequence[str],
+    mesh=None,
+):
+    """Build the pure function f(step_key, *feeds, *state) ->
+    (*fetches, *new_state) for a block. This is the object XLA
+    compiles; also used directly by __graft_entry__ and the bench."""
+
+    def fn(step_key, *args):
+        env: Dict[str, Any] = {}
+        for i, n in enumerate(feed_names):
+            env[n] = args[i]
+        for i, n in enumerate(state_names):
+            env[n] = args[len(feed_names) + i]
+        ctx = LoweringContext(step_key=step_key, mesh=mesh)
+        _lower_block(block, env, ctx)
+        fetched = []
+        for n in fetch_names:
+            if n not in env:
+                raise KeyError(f"fetch var {n!r} was never produced")
+            fetched.append(env[n])
+        new_state = [env[n] for n in written_names]
+        return tuple(fetched) + tuple(new_state)
+
+    return fn
+
+
+# control-flow ops that need sub-block lowering (registered by
+# core/control_flow.py to avoid a circular import)
+_CONTROL_FLOW: Dict[str, Any] = {}
+
+
+def register_control_flow(op_type: str):
+    def deco(fn):
+        _CONTROL_FLOW[op_type] = fn
+        return fn
+
+    return deco
+
+
+class Executor:
+    """Reference API: python/paddle/fluid/executor.py:432."""
+
+    def __init__(self, place: Optional[Place] = None):
+        self.place = place or TPUPlace()
+        self._cache: Dict[Tuple, _CompiledBlock] = {}
+        self._run_counter = 0
+
+    # -- public API -----------------------------------------------------------
+    def run(
+        self,
+        program=None,
+        feed: Optional[Dict[str, Any]] = None,
+        fetch_list: Optional[Sequence] = None,
+        feed_var_name: str = "feed",
+        fetch_var_name: str = "fetch",
+        scope: Optional[Scope] = None,
+        return_numpy: bool = True,
+        use_program_cache: bool = True,
+    ):
+        from .compiler import CompiledProgram
+
+        mesh = None
+        in_shardings = None
+        if isinstance(program, CompiledProgram):
+            mesh = program._mesh
+            in_shardings = program._in_shardings
+            program = program._program
+        if program is None:
+            program = framework.default_main_program()
+        scope = scope or global_scope()
+        feed = dict(feed or {})
+        fetch_list = list(fetch_list or [])
+        fetch_names = [
+            v.name if isinstance(v, Variable) else str(v) for v in fetch_list
+        ]
+
+        block = program.global_block()
+        feed_vals, feed_sig = self._prepare_feed(block, feed)
+        key = (
+            program.uid,
+            program.version,
+            feed_sig,
+            tuple(fetch_names),
+            scope.uid,
+            mesh is not None,
+        )
+        compiled = self._cache.get(key) if use_program_cache else None
+        if compiled is None:
+            compiled = self._compile(
+                program, block, sorted(feed), fetch_names, scope, mesh, in_shardings
+            )
+            if use_program_cache:
+                self._cache[key] = compiled
+
+        # assemble args in compiled order
+        state_vals = []
+        for n in compiled.state_names:
+            v = scope.find_var(n)
+            if v is None:
+                if block.has_var(n) and block.var(n).is_data:
+                    raise RuntimeError(
+                        f"data var {n!r} was not fed — add it to the feed dict"
+                    )
+                raise RuntimeError(
+                    f"persistable var {n!r} not found in scope — run the "
+                    "startup program first"
+                )
+            state_vals.append(v)
+        self._run_counter += 1
+        step_key = jax.random.PRNGKey(program.random_seed or 0)
+        step_key = jax.random.fold_in(step_key, self._run_counter)
+
+        ordered_feed = [feed_vals[n] for n in compiled.feed_names]
+        outs = compiled.fn(step_key, *ordered_feed, *state_vals)
+        n_fetch = len(compiled.fetch_names)
+        fetched = list(outs[:n_fetch])
+        new_state = outs[n_fetch:]
+        for n, v in zip(compiled.written_names, new_state):
+            scope.set_var(n, v)
+        if return_numpy:
+            fetched = [np.asarray(v) for v in fetched]
+        return fetched
+
+    # -- internals ------------------------------------------------------------
+    def _prepare_feed(self, block: Block, feed: Dict[str, Any]):
+        vals = {}
+        sig = []
+        for name in sorted(feed):
+            v = feed[name]
+            arr = np.asarray(v)
+            # honor declared var dtype (and keep everything x64-free)
+            if block.has_var(name):
+                want = convert_dtype(block.var(name).dtype)
+                if want in ("int64",):
+                    want = "int32" if not jax.config.jax_enable_x64 else "int64"
+                arr = arr.astype(want, copy=False)
+            elif arr.dtype == np.float64:
+                arr = arr.astype(np.float32)
+            elif arr.dtype == np.int64 and not jax.config.jax_enable_x64:
+                arr = arr.astype(np.int32)
+            vals[name] = arr
+            sig.append((name, arr.shape, str(arr.dtype)))
+        return vals, tuple(sig)
+
+    def _analyze_block(self, program: Program, block: Block, feed_names):
+        """Classify vars: produced (by ops), state (persistable inputs),
+        written state (persistable outputs)."""
+        produced = set(feed_names)
+        state_needed: List[str] = []
+        written: List[str] = []
+        seen_state = set()
+        seen_written = set()
+
+        def is_persistable(name: str) -> bool:
+            if block.has_var(name):
+                return block.var(name).persistable
+            return False
+
+        def visit_block(blk: Block):
+            for op in blk.ops:
+                if op.type in ("feed", "fetch"):
+                    continue
+                for names in op.inputs.values():
+                    for n in names:
+                        if n not in produced and n not in seen_state:
+                            # must come from scope
+                            seen_state.add(n)
+                            state_needed.append(n)
+                for names in op.outputs.values():
+                    for n in names:
+                        produced.add(n)
+                        if is_persistable(n) and n not in seen_written:
+                            seen_written.add(n)
+                            written.append(n)
+                for v in op.attrs.values():
+                    if isinstance(v, Block):
+                        visit_block(v)
+
+        visit_block(block)
+        return state_needed, written
+
+    def _compile(
+        self,
+        program: Program,
+        block: Block,
+        feed_names: List[str],
+        fetch_names: List[str],
+        scope: Scope,
+        mesh=None,
+        in_shardings=None,
+    ) -> _CompiledBlock:
+        state_names, written_names = self._analyze_block(program, block, feed_names)
+        fn = build_block_fn(block, feed_names, state_names, fetch_names, written_names, mesh)
+
+        # donate the state args that are rewritten (buffer aliasing for
+        # in-place param update, reference ParamOut=Param convention)
+        donate = tuple(
+            1 + len(feed_names) + i
+            for i, n in enumerate(state_names)
+            if n in set(written_names)
+        )
+        jit_kwargs: Dict[str, Any] = {"donate_argnums": donate}
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            in_shardings = in_shardings or {}
+
+            def _state_sharding(n):
+                # Variables may carry a PartitionSpec-like annotation
+                # (tuple of axis-name-or-None per dim) — the GSPMD
+                # equivalent of the reference's per-device param
+                # placement (multi_devices_graph_pass var scattering).
+                if block.has_var(n):
+                    spec = block.var(n).sharding
+                    if spec is not None:
+                        return NamedSharding(mesh, P(*spec))
+                return NamedSharding(mesh, P())
+
+            shardings = [NamedSharding(mesh, P())]  # step_key replicated
+            for n in feed_names:
+                spec = in_shardings.get(n, P())
+                shardings.append(NamedSharding(mesh, spec))
+            for n in state_names:
+                shardings.append(_state_sharding(n))
+            jit_kwargs["in_shardings"] = tuple(shardings)
+        jitted = jax.jit(fn, **jit_kwargs)
+        return _CompiledBlock(
+            jitted, list(feed_names), state_names, fetch_names, written_names, donate
+        )
+
+    def export_fn(self, program, feed, fetch_list, scope=None, mesh=None):
+        """Return (raw_fn, example_args) for a program — the un-jitted
+        pure step function plus concrete arguments. Used by
+        __graft_entry__ and bench.py."""
+        scope = scope or global_scope()
+        block = program.global_block()
+        feed_vals, _ = self._prepare_feed(block, dict(feed))
+        feed_names = sorted(feed_vals)
+        fetch_names = [
+            v.name if isinstance(v, Variable) else str(v) for v in fetch_list
+        ]
+        state_names, written = self._analyze_block(program, block, feed_names)
+        fn = build_block_fn(block, feed_names, state_names, fetch_names, written, mesh)
+        state_vals = []
+        for n in state_names:
+            v = scope.find_var(n)
+            if v is None:
+                raise RuntimeError(f"state var {n!r} missing; run startup first")
+            state_vals.append(v)
+        key = jax.random.PRNGKey(0)
+        args = (key, *(feed_vals[n] for n in feed_names), *state_vals)
+        meta = {
+            "feed_names": feed_names,
+            "state_names": state_names,
+            "written_names": written,
+            "fetch_names": fetch_names,
+        }
+        return fn, args, meta
+
+    # -- dataset path (reference executor.py:1191 train_from_dataset) ---------
+    def train_from_dataset(
+        self, program=None, dataset=None, scope=None, thread=0, debug=False,
+        fetch_list=None, fetch_info=None, print_period=100,
+    ):
+        from ..dataset_runner import run_from_dataset
+
+        return run_from_dataset(
+            self, program, dataset, scope, fetch_list, fetch_info, print_period, train=True
+        )
+
+    def infer_from_dataset(self, program=None, dataset=None, scope=None, **kw):
+        from ..dataset_runner import run_from_dataset
+
+        return run_from_dataset(
+            self, program, dataset, scope, kw.get("fetch_list"), kw.get("fetch_info"),
+            kw.get("print_period", 100), train=False,
+        )
+
+    def close(self):
+        self._cache.clear()

@@ -1,0 +1,95 @@
+"""Device places.
+
+Reference: platform/place.h:26-81 defines Place =
+variant<CUDAPlace, CPUPlace, CUDAPinnedPlace>; kernels are selected per
+place. Here a Place simply selects a JAX backend + device ordinal — all
+kernel selection is XLA's job.
+"""
+
+from __future__ import annotations
+
+import functools
+
+
+class Place:
+    """Base device identity."""
+
+    _backend = None  # jax platform name
+
+    def __init__(self, device_id: int = 0):
+        self.device_id = int(device_id)
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.device_id == other.device_id
+
+    def __hash__(self):
+        return hash((type(self).__name__, self.device_id))
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.device_id})"
+
+    def jax_device(self):
+        """Resolve to a concrete jax.Device."""
+        import jax
+
+        if self._backend is None:
+            return jax.devices()[self.device_id]
+        try:
+            devs = jax.devices(self._backend)
+        except RuntimeError:
+            # Requested backend not present (e.g. TPUPlace on a CPU-only
+            # test host): fall back to the default backend so programs
+            # remain runnable everywhere.
+            devs = jax.devices()
+        return devs[self.device_id % len(devs)]
+
+
+class CPUPlace(Place):
+    _backend = "cpu"
+
+    def __init__(self):
+        super().__init__(0)
+
+
+class TPUPlace(Place):
+    """The native target. On hosts without TPU it degrades to the default
+    jax backend so the same user program runs in CI."""
+
+    _backend = None  # default backend: tpu when present, else cpu
+
+    def __init__(self, device_id: int = 0):
+        super().__init__(device_id)
+
+
+class CUDAPlace(Place):
+    """API-compatibility alias (reference platform/place.h CUDAPlace).
+
+    Accepted so reference user code runs unchanged; maps to the default
+    accelerator (TPU here).
+    """
+
+    _backend = None
+
+    def __init__(self, device_id: int = 0):
+        super().__init__(device_id)
+
+
+class CUDAPinnedPlace(CPUPlace):
+    pass
+
+
+@functools.lru_cache(maxsize=None)
+def _platform() -> str:
+    import jax
+
+    return jax.default_backend()
+
+
+def is_compiled_with_tpu() -> bool:
+    return _platform() == "tpu"
+
+
+def is_compiled_with_cuda() -> bool:
+    # Reference-API shim (framework.py is_compiled_with_cuda): answers
+    # "is there an accelerator"; used by user code to pick a place.
+    return _platform() != "cpu"

@@ -1,0 +1,45 @@
+"""Dataset-path training driver (reference Executor.train_from_dataset
+-> MultiTrainer/HogwildWorker, framework/multi_trainer.cc:157).
+
+The reference runs per-thread hogwild workers over DataFeed channels
+with no Python in the loop. The TPU equivalent keeps the data pipeline
+multi-threaded on host (dataset.py readers) but funnels batches through
+the single compiled train step — device parallelism comes from the
+mesh, not host threads.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def run_from_dataset(
+    executor,
+    program,
+    dataset,
+    scope,
+    fetch_list=None,
+    fetch_info=None,
+    print_period=100,
+    train=True,
+):
+    if dataset is None:
+        raise ValueError("dataset is required")
+    fetch_list = fetch_list or []
+    fetch_info = fetch_info or [v.name if hasattr(v, "name") else str(v) for v in fetch_list]
+    step = 0
+    results = None
+    for batch in dataset._iter_batches():
+        results = executor.run(
+            program=program,
+            feed=batch,
+            fetch_list=fetch_list,
+            scope=scope,
+        )
+        if fetch_list and step % print_period == 0:
+            msgs = ", ".join(
+                f"{n}={float(r.reshape(-1)[0]):.6f}" for n, r in zip(fetch_info, results)
+            )
+            print(f"[dataset] step {step}: {msgs}")
+        step += 1
+    return results

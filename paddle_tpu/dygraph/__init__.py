@@ -1,0 +1,33 @@
+"""Imperative (dygraph) mode.
+
+Reference: paddle/fluid/imperative/ (Tracer::TraceOp tracer.cc:87,
+VarBase layer.h:61, BasicEngine engine.cc, GradientAccumulator) +
+python/paddle/fluid/dygraph/ (Layer, nn classes, DataParallel).
+
+TPU-native design: eager mode executes each op's JAX lowering on
+concrete device arrays immediately (JAX is already eager), recording a
+tape of (op, inputs, outputs). VarBase.backward() walks the tape in
+reverse applying each op's vjp — the BasicEngine analogue — with
+gradient accumulation for multi-consumer vars. Layers are shared with
+the declarative mode at the op level, so numerics match by
+construction. @to_static / TracedLayer capture a Program from eager
+code via the same op records (reference dygraph_to_static AST pass is
+unnecessary: the tape IS the program).
+"""
+
+from .base import (
+    guard,
+    enabled,
+    enable_dygraph,
+    disable_dygraph,
+    to_variable,
+    VarBase,
+    no_grad,
+)
+from .layers import Layer
+from . import nn
+from .nn import Linear, Conv2D, Pool2D, BatchNorm, Embedding, LayerNorm, Dropout
+from .parallel import DataParallel, prepare_context, ParallelEnv
+from .checkpoint import save_dygraph, load_dygraph
+from .jit import TracedLayer, to_static
+from .container import Sequential, LayerList, ParameterList

@@ -1,0 +1,317 @@
+"""Dygraph core: VarBase + eager tracer + taped autograd.
+
+Reference: imperative/tracer.cc:87 (TraceOp — create op, run kernel,
+record grad node), imperative/layer.h:61 (VarBase),
+imperative/engine.cc (BasicEngine reverse walk),
+imperative/gradient_accumulator.cc (multi-consumer grad sum).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dygraph as _mode
+from ..core.registry import LoweringContext, get_op_def
+
+guard = _mode.dygraph_guard
+in_dygraph_mode = _mode.in_dygraph_mode
+
+
+def enabled():
+    return _mode.in_dygraph_mode()
+
+
+def enable_dygraph(place=None):
+    _mode._in_dygraph = True
+
+
+def disable_dygraph():
+    _mode._in_dygraph = False
+
+
+_no_grad = False
+
+
+@contextlib.contextmanager
+def no_grad():
+    global _no_grad
+    prev = _no_grad
+    _no_grad = True
+    try:
+        yield
+    finally:
+        _no_grad = prev
+
+
+class _TapeEntry:
+    __slots__ = ("op", "opdef", "in_vars", "out_vars", "key")
+
+    def __init__(self, op, opdef, in_vars, out_vars, key=None):
+        self.op = op
+        self.opdef = opdef
+        self.in_vars = in_vars  # slot -> [VarBase]
+        self.out_vars = out_vars  # slot -> [VarBase]
+        self.key = key  # PRNG key used by the eager forward (replayed in vjp)
+
+
+class _PseudoOp:
+    __slots__ = ("type", "attrs", "inputs", "outputs")
+
+    def __init__(self, type, attrs):
+        self.type = type
+        self.attrs = attrs
+        self.inputs = {}
+        self.outputs = {}
+
+
+class VarBase:
+    """Eager tensor: a jax array + autograd metadata."""
+
+    _name_counter = 0
+
+    def __init__(self, value, name=None, stop_gradient=False, persistable=False):
+        self.value = jnp.asarray(value)
+        VarBase._name_counter += 1
+        self.name = name or f"eager_tmp_{VarBase._name_counter}"
+        self.stop_gradient = stop_gradient
+        self.persistable = persistable
+        self.grad: Optional[jax.Array] = None
+        self._producer: Optional[_TapeEntry] = None
+
+    # -- numpy / info ---------------------------------------------------------
+    def numpy(self):
+        return np.asarray(self.value)
+
+    @property
+    def shape(self):
+        return tuple(self.value.shape)
+
+    @property
+    def dtype(self):
+        return str(self.value.dtype)
+
+    def detach(self):
+        return VarBase(self.value, stop_gradient=True)
+
+    def clear_gradient(self):
+        self.grad = None
+
+    @property
+    def gradient(self):
+        return None if self.grad is None else np.asarray(self.grad)
+
+    def set_value(self, v):
+        self.value = jnp.asarray(v if not isinstance(v, VarBase) else v.value)
+
+    def astype(self, dtype):
+        return _trace("cast", {"X": [self]}, ["Out"], {"out_dtype": str(dtype)})[0]
+
+    def __repr__(self):
+        return f"VarBase(name={self.name}, shape={self.shape}, dtype={self.dtype})"
+
+    # -- autograd -------------------------------------------------------------
+    def backward(self, retain_graph=False):
+        run_backward(self)
+
+    # -- operator sugar -------------------------------------------------------
+    def _ew(self, other, op, reverse=False):
+        if not isinstance(other, VarBase):
+            other = VarBase(jnp.asarray(other, self.value.dtype), stop_gradient=True)
+        a, b = (other, self) if reverse else (self, other)
+        return _trace(op, {"X": [a], "Y": [b]}, ["Out"], {"axis": -1})[0]
+
+    def __add__(self, o):
+        return self._ew(o, "elementwise_add")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._ew(o, "elementwise_sub")
+
+    def __rsub__(self, o):
+        return self._ew(o, "elementwise_sub", reverse=True)
+
+    def __mul__(self, o):
+        return self._ew(o, "elementwise_mul")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._ew(o, "elementwise_div")
+
+    def __neg__(self):
+        return _trace("scale", {"X": [self]}, ["Out"], {"scale": -1.0})[0]
+
+    def __getitem__(self, idx):
+        # route simple indexing through the slice/squeeze ops so the
+        # tape records it and gradients flow (a detached copy here
+        # would silently cut autograd)
+        import builtins
+
+        items = idx if isinstance(idx, tuple) else (idx,)
+        axes, starts, ends, squeeze_axes = [], [], [], []
+        simple = True
+        for i, it in enumerate(items):
+            if isinstance(it, int):
+                axes.append(i)
+                starts.append(it)
+                ends.append(it + 1)
+                squeeze_axes.append(i)
+            elif isinstance(it, builtins.slice) and it.step in (None, 1):
+                if it.start is None and it.stop is None:
+                    continue
+                axes.append(i)
+                starts.append(it.start or 0)
+                ends.append(it.stop if it.stop is not None else 10**9)
+            else:
+                simple = False
+                break
+        if not simple:
+            return VarBase(self.value[idx], stop_gradient=True)
+        out = self
+        if axes:
+            (out,) = _trace(
+                "slice", {"Input": [out]}, ["Out"],
+                {"axes": axes, "starts": starts, "ends": ends},
+            )
+        if squeeze_axes:
+            out, _ = _trace(
+                "squeeze2", {"X": [out]}, ["Out", "XShape"], {"axes": squeeze_axes}
+            )
+        return out
+
+
+def to_variable(value, name=None, zero_copy=None):
+    if isinstance(value, VarBase):
+        return value
+    arr = np.asarray(value)
+    if arr.dtype == np.float64:
+        arr = arr.astype(np.float32)
+    if arr.dtype == np.int64 and not jax.config.jax_enable_x64:
+        arr = arr.astype(np.int32)
+    return VarBase(arr, name=name)
+
+
+_eager_rng_counter = 0
+
+
+def _eager_ctx():
+    global _eager_rng_counter
+    _eager_rng_counter += 1
+    return LoweringContext(step_key=jax.random.PRNGKey(_eager_rng_counter))
+
+
+def _trace(op_type: str, ins: Dict[str, List[VarBase]], out_slots: List[str],
+           attrs: Dict[str, Any], n_outs: Optional[Dict[str, int]] = None):
+    """Eager TraceOp: run lowering now, record tape entry (reference
+    imperative/tracer.cc:87-110)."""
+    opdef = get_op_def(op_type)
+    pseudo = _PseudoOp(op_type, dict(attrs))
+    raw_ins = {slot: [v.value for v in vs] for slot, vs in ins.items()}
+    pseudo.inputs = {slot: [v.name for v in vs] for slot, vs in ins.items()}
+    ctx = _eager_ctx()
+    outs = opdef.lower(ctx, pseudo, raw_ins)
+    out_vars: Dict[str, List[VarBase]] = {}
+    flat: List[VarBase] = []
+    stop = _no_grad or all(
+        v.stop_gradient for vs in ins.values() for v in vs
+    ) or opdef.stop_gradient
+    for slot in out_slots:
+        vals = outs.get(slot, [])
+        vbs = [VarBase(v, stop_gradient=stop) for v in vals]
+        out_vars[slot] = vbs
+        flat.extend(vbs)
+    if not stop:
+        entry = _TapeEntry(pseudo, opdef, dict(ins), out_vars, key=ctx.step_key)
+        for vb in flat:
+            vb._producer = entry
+    return flat
+
+
+def run_backward(root: VarBase):
+    """BasicEngine: reverse-topological walk over producer entries,
+    applying per-op vjp and accumulating grads
+    (imperative/engine.cc + gradient_accumulator.cc)."""
+    if root._producer is None and root.stop_gradient:
+        raise RuntimeError("backward() on a leaf with stop_gradient=True")
+    root.grad = jnp.ones_like(root.value)
+
+    # topo-order entries reachable from root (iterative DFS — deep
+    # eager graphs would blow Python's recursion limit)
+    order: List[_TapeEntry] = []
+    seen = set()
+    if root._producer is not None:
+        stack = [(root._producer, False)]
+        while stack:
+            entry, expanded = stack.pop()
+            if entry is None:
+                continue
+            if expanded:
+                order.append(entry)
+                continue
+            if id(entry) in seen:
+                continue
+            seen.add(id(entry))
+            stack.append((entry, True))
+            for vs in entry.in_vars.values():
+                for v in vs:
+                    if v._producer is not None and id(v._producer) not in seen:
+                        stack.append((v._producer, False))
+
+    for entry in reversed(order):
+        op, opdef = entry.op, entry.opdef
+        # cotangents for outputs
+        out_grads = {}
+        any_g = False
+        for slot, vbs in entry.out_vars.items():
+            gs = []
+            for vb in vbs:
+                if vb.grad is not None:
+                    gs.append(vb.grad)
+                    any_g = True
+                else:
+                    gs.append(None)
+            out_grads[slot] = gs
+        if not any_g:
+            continue
+
+        diff_ins = {}
+        aux_ins = {}
+        for slot, vbs in entry.in_vars.items():
+            vals = [v.value for v in vbs]
+            if slot in opdef.no_grad_slots or all(v.stop_gradient for v in vbs):
+                aux_ins[slot] = vals
+            else:
+                diff_ins[slot] = vals
+
+        if not diff_ins:
+            continue
+
+        ctx = LoweringContext(step_key=entry.key)
+
+        def fwd(d_ins, _op=op, _opdef=opdef, _aux=aux_ins):
+            all_ins = {**_aux, **d_ins}
+            outs = _opdef.lower(ctx, _op, all_ins)
+            return {s: list(outs.get(s, [])) for s in _opdef.output_slots}
+
+        primals, vjp_fn = jax.vjp(fwd, diff_ins)
+        cots = {}
+        for s in opdef.output_slots:
+            prim_list = primals.get(s, [])
+            gs = out_grads.get(s, [])
+            cots[s] = [
+                (gs[i].astype(p.dtype) if i < len(gs) and gs[i] is not None else jnp.zeros_like(p))
+                for i, p in enumerate(prim_list)
+            ]
+        (grads,) = vjp_fn(cots)
+        for slot, gvals in grads.items():
+            for vb, g in zip(entry.in_vars[slot], gvals):
+                if vb.stop_gradient:
+                    continue
+                vb.grad = g if vb.grad is None else vb.grad + g

@@ -1,0 +1,22 @@
+"""Dygraph checkpoint save/load. Reference: fluid/dygraph/checkpoint.py
+(save_dygraph/load_dygraph state dicts -> .pdparams)."""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+
+def save_dygraph(state_dict, model_path: str):
+    arrays = {}
+    for k, v in state_dict.items():
+        arrays[k] = v.numpy() if hasattr(v, "numpy") else np.asarray(v)
+    os.makedirs(os.path.dirname(model_path) or ".", exist_ok=True)
+    np.savez(model_path + ".pdparams.npz", **arrays)
+
+
+def load_dygraph(model_path: str):
+    data = np.load(model_path + ".pdparams.npz")
+    state = {k: data[k] for k in data.files}
+    return state, None  # (param_dict, optimizer_dict)

@@ -1,0 +1,94 @@
+"""Dygraph -> static capture.
+
+Reference: fluid/dygraph/jit.py (TracedLayer via Tracer program capture)
+and dygraph_to_static/ (AST transform). TPU-native: eager code already
+runs on jax; capture = jax.jit of a function closing over layer
+parameters. No AST rewriting needed — tracing handles python control
+flow the same way dygraph_to_static's program_translator aimed to.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import numpy as np
+
+from .base import VarBase, to_variable
+
+
+class TracedLayer:
+    """jit-compiled callable over a Layer's forward."""
+
+    def __init__(self, layer, jitted, params):
+        self._layer = layer
+        self._jitted = jitted
+        self._params = params
+
+    @staticmethod
+    def trace(layer, inputs):
+        params = layer.parameters()
+
+        def fn(param_vals, *xs):
+            # temporarily swap parameter values for traced ones
+            saved = [p.value for p in params]
+            for p, v in zip(params, param_vals):
+                p.value = v
+            try:
+                out = layer(*[VarBase(x, stop_gradient=True) for x in xs])
+            finally:
+                for p, s in zip(params, saved):
+                    p.value = s
+            return out.value if isinstance(out, VarBase) else out
+
+        jitted = jax.jit(fn)
+        example = [x.value if isinstance(x, VarBase) else np.asarray(x) for x in inputs]
+        out = jitted([p.value for p in params], *example)
+        traced = TracedLayer(layer, jitted, params)
+        return VarBase(out, stop_gradient=True), traced
+
+    def __call__(self, inputs):
+        xs = [x.value if isinstance(x, VarBase) else np.asarray(x) for x in inputs]
+        out = self._jitted([p.value for p in self._params], *xs)
+        return [VarBase(out, stop_gradient=True)]
+
+    def save_inference_model(self, dirname, feed=None, fetch=None):
+        import os
+
+        import numpy as np
+
+        os.makedirs(dirname, exist_ok=True)
+        np.savez(
+            os.path.join(dirname, "__traced_params__.npz"),
+            **{f"p{i}": np.asarray(p.value) for i, p in enumerate(self._params)},
+        )
+
+
+def to_static(fn: Callable = None):
+    """Decorator: compile an eager function with jax.jit (reference
+    @declarative / dygraph_to_static)."""
+
+    def deco(f):
+        jitted = {}
+
+        @functools.wraps(f)
+        def wrapper(*args):
+            vals = tuple(
+                a.value if isinstance(a, VarBase) else np.asarray(a) for a in args
+            )
+
+            def pure(*xs):
+                out = f(*[VarBase(x, stop_gradient=True) for x in xs])
+                return out.value if isinstance(out, VarBase) else out
+
+            if "fn" not in jitted:
+                jitted["fn"] = jax.jit(pure)
+            return VarBase(jitted["fn"](*vals), stop_gradient=True)
+
+        return wrapper
+
+    return deco(fn) if fn is not None else deco
+
+
+declarative = to_static

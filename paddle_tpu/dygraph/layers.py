@@ -1,0 +1,151 @@
+"""Dygraph Layer base class.
+
+Reference: python/paddle/fluid/dygraph/layers.py — parameter
+registration, sublayers, state_dict, train/eval mode.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Dict, Iterator, Optional
+
+import numpy as np
+
+from ..initializer import XavierInitializer, ConstantInitializer
+from ..param_attr import ParamAttr
+from .base import VarBase, to_variable
+
+
+class Layer:
+    def __init__(self, name_scope: Optional[str] = None, dtype="float32"):
+        self._parameters: "OrderedDict[str, VarBase]" = OrderedDict()
+        self._sub_layers: "OrderedDict[str, Layer]" = OrderedDict()
+        self._buffers: "OrderedDict[str, VarBase]" = OrderedDict()
+        self._dtype = dtype
+        self._full_name = name_scope or self.__class__.__name__.lower()
+        self.training = True
+
+    # -- parameter creation ---------------------------------------------------
+    def create_parameter(self, shape, attr=None, dtype="float32", is_bias=False,
+                         default_initializer=None) -> VarBase:
+        attr = ParamAttr._to_attr(attr)
+        init = attr.initializer or default_initializer or (
+            ConstantInitializer(0.0) if is_bias else XavierInitializer()
+        )
+        value = _materialize_init(init, shape, dtype)
+        p = VarBase(value, name=attr.name, persistable=True)
+        p.stop_gradient = not attr.trainable
+        return p
+
+    # -- attribute magic ------------------------------------------------------
+    def __setattr__(self, name, value):
+        if isinstance(value, VarBase) and getattr(value, "persistable", False):
+            self.__dict__.setdefault("_parameters", OrderedDict())[name] = value
+        elif isinstance(value, Layer):
+            self.__dict__.setdefault("_sub_layers", OrderedDict())[name] = value
+        object.__setattr__(self, name, value)
+
+    # -- traversal ------------------------------------------------------------
+    def parameters(self, include_sublayers=True):
+        out = list(self._parameters.values())
+        if include_sublayers:
+            for l in self._sub_layers.values():
+                out.extend(l.parameters())
+        return out
+
+    def named_parameters(self, prefix=""):
+        for n, p in self._parameters.items():
+            yield (f"{prefix}{n}", p)
+        for ln, l in self._sub_layers.items():
+            yield from l.named_parameters(prefix=f"{prefix}{ln}.")
+
+    def sublayers(self, include_sublayers=True):
+        out = list(self._sub_layers.values())
+        if include_sublayers:
+            for l in self._sub_layers.values():
+                out.extend(l.sublayers())
+        return out
+
+    def add_sublayer(self, name, layer):
+        self._sub_layers[name] = layer
+        object.__setattr__(self, name, layer)
+        return layer
+
+    def add_parameter(self, name, param):
+        self._parameters[name] = param
+        object.__setattr__(self, name, param)
+        return param
+
+    # -- mode -----------------------------------------------------------------
+    def train(self):
+        self.training = True
+        for l in self.sublayers():
+            l.training = True
+        return self
+
+    def eval(self):
+        self.training = False
+        for l in self.sublayers():
+            l.training = False
+        return self
+
+    # -- state dict ------------------------------------------------------------
+    def state_dict(self, destination=None, include_sublayers=True, prefix=""):
+        dest = destination if destination is not None else OrderedDict()
+        for n, p in self._parameters.items():
+            dest[f"{prefix}{n}"] = p.numpy()
+        for n, b in self._buffers.items():
+            dest[f"{prefix}{n}"] = b.numpy()
+        if include_sublayers:
+            for ln, l in self._sub_layers.items():
+                l.state_dict(dest, True, prefix=f"{prefix}{ln}.")
+        return dest
+
+    def set_dict(self, state, include_sublayers=True, prefix=""):
+        for n, p in self._parameters.items():
+            k = f"{prefix}{n}"
+            if k in state:
+                p.set_value(state[k])
+        for n, b in self._buffers.items():
+            k = f"{prefix}{n}"
+            if k in state:
+                b.set_value(state[k])
+        if include_sublayers:
+            for ln, l in self._sub_layers.items():
+                l.set_dict(state, True, prefix=f"{prefix}{ln}.")
+
+    load_dict = set_dict
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_gradient()
+
+    def full_name(self):
+        return self._full_name
+
+    # -- call -----------------------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        ins = [to_variable(a) if not isinstance(a, (VarBase, Layer, type(None))) and not isinstance(a, (str, int, float, bool)) else a for a in args]
+        return self.forward(*ins, **kwargs)
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+
+def _materialize_init(init, shape, dtype):
+    """Run an initializer eagerly: build a one-op startup block and
+    execute it (shares the graph-mode init op lowerings)."""
+    from ..core.framework import Program, program_guard
+    from ..core.executor import Executor, Scope, scope_guard
+
+    prog = Program()
+    with program_guard(prog, prog):
+        var = prog.global_block().create_var(
+            name="__init__", shape=shape, dtype=dtype, persistable=True
+        )
+        init(var, prog.global_block())
+    scope = Scope()
+    with scope_guard(scope):
+        exe = Executor()
+        exe.run(prog)
+        return scope.find_var("__init__")

@@ -1,0 +1,196 @@
+"""Dygraph nn module classes. Reference:
+python/paddle/fluid/dygraph/nn.py (Linear/FC, Conv2D, Pool2D,
+BatchNorm, Embedding, LayerNorm, ...)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..initializer import ConstantInitializer, NormalInitializer
+from .base import VarBase, _trace
+from .layers import Layer
+
+
+class Linear(Layer):
+    def __init__(self, input_dim, output_dim, param_attr=None, bias_attr=None,
+                 act=None, dtype="float32"):
+        super().__init__()
+        self.weight = self.create_parameter([input_dim, output_dim], param_attr, dtype)
+        self.bias = None
+        if bias_attr is not False:
+            self.bias = self.create_parameter([output_dim], bias_attr, dtype, is_bias=True)
+        self._act = act
+
+    def forward(self, x):
+        (out,) = _trace(
+            "mul", {"X": [x], "Y": [self.weight]}, ["Out"],
+            {"x_num_col_dims": len(x.shape) - 1, "y_num_col_dims": 1},
+        )
+        if self.bias is not None:
+            (out,) = _trace(
+                "elementwise_add", {"X": [out], "Y": [self.bias]}, ["Out"],
+                {"axis": len(out.shape) - 1},
+            )
+        if self._act:
+            (out,) = _trace(self._act, {"X": [out]}, ["Out"], {})
+        return out
+
+
+# reference dygraph/nn.py FC alias
+FC = Linear
+
+
+class Conv2D(Layer):
+    def __init__(self, num_channels, num_filters, filter_size, stride=1, padding=0,
+                 dilation=1, groups=1, param_attr=None, bias_attr=None, act=None,
+                 dtype="float32"):
+        super().__init__()
+        fs = filter_size if isinstance(filter_size, (list, tuple)) else [filter_size] * 2
+        std = (2.0 / (fs[0] * fs[1] * num_channels)) ** 0.5
+        self.weight = self.create_parameter(
+            [num_filters, num_channels // groups, fs[0], fs[1]], param_attr, dtype,
+            default_initializer=NormalInitializer(0.0, std),
+        )
+        self.bias = None
+        if bias_attr is not False:
+            self.bias = self.create_parameter([num_filters], bias_attr, dtype, is_bias=True)
+        self._attrs = {
+            "strides": [stride] * 2 if isinstance(stride, int) else list(stride),
+            "paddings": [padding] * 2 if isinstance(padding, int) else list(padding),
+            "dilations": [dilation] * 2 if isinstance(dilation, int) else list(dilation),
+            "groups": groups,
+        }
+        self._act = act
+
+    def forward(self, x):
+        ins = {"Input": [x], "Filter": [self.weight]}
+        if self.bias is not None:
+            ins["Bias"] = [self.bias]
+        (out,) = _trace("conv2d", ins, ["Output"], dict(self._attrs))
+        if self._act:
+            (out,) = _trace(self._act, {"X": [out]}, ["Out"], {})
+        return out
+
+
+class Pool2D(Layer):
+    def __init__(self, pool_size=-1, pool_type="max", pool_stride=1, pool_padding=0,
+                 global_pooling=False, ceil_mode=False, exclusive=True):
+        super().__init__()
+        self._attrs = {
+            "pooling_type": pool_type,
+            "ksize": [pool_size] * 2 if isinstance(pool_size, int) else list(pool_size),
+            "strides": [pool_stride] * 2 if isinstance(pool_stride, int) else list(pool_stride),
+            "paddings": [pool_padding] * 2 if isinstance(pool_padding, int) else list(pool_padding),
+            "global_pooling": global_pooling,
+            "ceil_mode": ceil_mode,
+            "exclusive": exclusive,
+        }
+
+    def forward(self, x):
+        (out,) = _trace("pool2d", {"X": [x]}, ["Out"], dict(self._attrs))
+        return out
+
+
+class BatchNorm(Layer):
+    def __init__(self, num_channels, act=None, is_test=False, momentum=0.9,
+                 epsilon=1e-5, param_attr=None, bias_attr=None, dtype="float32",
+                 data_layout="NCHW", use_global_stats=False):
+        super().__init__()
+        self.weight = self.create_parameter(
+            [num_channels], param_attr, dtype,
+            default_initializer=ConstantInitializer(1.0),
+        )
+        self.bias = self.create_parameter([num_channels], bias_attr, dtype, is_bias=True)
+        self._mean = VarBase(np.zeros(num_channels, dtype), persistable=True,
+                             stop_gradient=True)
+        self._variance = VarBase(np.ones(num_channels, dtype), persistable=True,
+                                 stop_gradient=True)
+        self._buffers["_mean"] = self._mean
+        self._buffers["_variance"] = self._variance
+        self._attrs = {
+            "momentum": momentum,
+            "epsilon": epsilon,
+            "data_layout": data_layout,
+            "use_global_stats": use_global_stats,
+        }
+        self._act = act
+
+    def forward(self, x):
+        attrs = dict(self._attrs)
+        attrs["is_test"] = not self.training
+        outs = _trace(
+            "batch_norm",
+            {
+                "X": [x], "Scale": [self.weight], "Bias": [self.bias],
+                "Mean": [self._mean], "Variance": [self._variance],
+            },
+            ["Y", "MeanOut", "VarianceOut", "SavedMean", "SavedVariance"],
+            attrs,
+        )
+        y, mean_out, var_out = outs[0], outs[1], outs[2]
+        self._mean.set_value(mean_out)
+        self._variance.set_value(var_out)
+        if self._act:
+            (y,) = _trace(self._act, {"X": [y]}, ["Out"], {})
+        return y
+
+
+class Embedding(Layer):
+    def __init__(self, size, is_sparse=False, padding_idx=None, param_attr=None,
+                 dtype="float32"):
+        super().__init__()
+        self.weight = self.create_parameter(list(size), param_attr, dtype)
+        self._padding_idx = -1 if padding_idx is None else padding_idx
+
+    def forward(self, ids):
+        (out,) = _trace(
+            "lookup_table_v2", {"W": [self.weight], "Ids": [ids]}, ["Out"],
+            {"padding_idx": self._padding_idx},
+        )
+        return out
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, scale=True, shift=True, epsilon=1e-5,
+                 param_attr=None, bias_attr=None, act=None, dtype="float32"):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = [normalized_shape]
+        n = int(np.prod(normalized_shape))
+        self.weight = self.create_parameter(
+            [n], param_attr, dtype, default_initializer=ConstantInitializer(1.0)
+        ) if scale else None
+        self.bias = self.create_parameter([n], bias_attr, dtype, is_bias=True) if shift else None
+        self._epsilon = epsilon
+        self._act = act
+        self._norm_ndim = len(normalized_shape)
+
+    def forward(self, x):
+        ins = {"X": [x]}
+        if self.weight is not None:
+            ins["Scale"] = [self.weight]
+        if self.bias is not None:
+            ins["Bias"] = [self.bias]
+        outs = _trace(
+            "layer_norm", ins, ["Y", "Mean", "Variance"],
+            {"begin_norm_axis": len(x.shape) - self._norm_ndim, "epsilon": self._epsilon},
+        )
+        y = outs[0]
+        if self._act:
+            (y,) = _trace(self._act, {"X": [y]}, ["Out"], {})
+        return y
+
+
+class Dropout(Layer):
+    def __init__(self, p=0.5, dropout_implementation="downgrade_in_infer"):
+        super().__init__()
+        self._p = p
+        self._impl = dropout_implementation
+
+    def forward(self, x):
+        outs = _trace(
+            "dropout", {"X": [x]}, ["Out", "Mask"],
+            {"dropout_prob": self._p, "is_test": not self.training,
+             "dropout_implementation": self._impl},
+        )
+        return outs[0]

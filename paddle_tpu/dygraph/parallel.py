@@ -1,0 +1,72 @@
+"""Dygraph DataParallel.
+
+Reference: fluid/dygraph/parallel.py:84 — wraps a Layer; scale_loss by
+1/nranks; apply_collective_grads allreduces gradients (coalesced,
+imperative/gradient_accumulator.cc + nccl_context.cc).
+
+TPU-native: gradient allreduce = jax psum across processes via a tiny
+jitted collective when jax.distributed is initialized; single-process
+multi-device eager training is better served by the graph mode mesh
+path, so there this is a transparent wrapper.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..parallel.env import ParallelEnv
+from .layers import Layer
+
+
+def prepare_context(strategy=None):
+    from ..parallel.env import init_parallel_env
+
+    init_parallel_env()
+    return ParallelEnv()
+
+
+class DataParallel(Layer):
+    def __init__(self, layers: Layer, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._env = ParallelEnv()
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    @property
+    def nranks(self):
+        return max(self._env.world_size, 1)
+
+    def scale_loss(self, loss):
+        if self.nranks <= 1:
+            return loss
+        return loss * (1.0 / self.nranks)
+
+    def apply_collective_grads(self):
+        if self.nranks <= 1:
+            return
+        import jax
+
+        grads = [p.grad for p in self._layers.parameters() if p.grad is not None]
+        if not grads:
+            return
+        summed = jax.experimental.multihost_utils.process_allgather  # noqa: F841
+        # cross-process psum via pmap-of-1 on each host's devices is not
+        # available single-device; use allgather+sum on host
+        import jax.numpy as jnp
+        from jax.experimental import multihost_utils
+
+        for p in self._layers.parameters():
+            if p.grad is None:
+                continue
+            gathered = multihost_utils.process_allgather(p.grad)
+            p.grad = jnp.sum(gathered, axis=0)
+
+    def state_dict(self, *a, **kw):
+        return self._layers.state_dict(*a, **kw)
+
+    def set_dict(self, *a, **kw):
+        return self._layers.set_dict(*a, **kw)
+
+    load_dict = set_dict

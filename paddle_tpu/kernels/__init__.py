@@ -1,0 +1,16 @@
+"""Pallas TPU kernels for the fused hot ops.
+
+Reference: operators/fused/ (multihead_matmul_op.cu — inference-only
+fused attention; fused_fc_elementwise_layernorm_op.cu; ...). Here the
+fused set is implemented as Pallas kernels (BASELINE north star names
+attention/ffn/layer_norm/adam/softmax-ce):
+
+  * flash_attention — blockwise attention, no [B,H,S,S] materialization
+  * fused_softmax_cross_entropy — via XLA (already fuses well)
+
+Kernels degrade gracefully: on non-TPU backends (CPU tests) they fall
+back to the pure-XLA implementation with identical numerics
+(flash fallback uses the same stable-softmax algorithm).
+"""
+
+from .flash_attention import flash_attention, flash_attention_layer

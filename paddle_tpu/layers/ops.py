@@ -1,0 +1,24 @@
+"""Reference layers.ops module parity: thin re-exports of activation
+layers (reference python/paddle/fluid/layers/ops.py autogenerates these
+from the op registry)."""
+
+from .nn import (  # noqa: F401
+    abs,
+    ceil,
+    cos,
+    exp,
+    floor,
+    hard_shrink,
+    logsigmoid,
+    reciprocal,
+    round,
+    rsqrt,
+    sigmoid,
+    sin,
+    softplus,
+    softsign,
+    sqrt,
+    square,
+    tanh,
+    thresholded_relu,
+)

@@ -1,0 +1,11 @@
+"""Model zoo built on the layers API.
+
+Reference analogue: the "book"/dist test model definitions
+(tests/book/, tests/unittests/dist_mnist.py, dist_se_resnext.py,
+dist_transformer.py) — canonical models exercising the stack, also used
+by bench.py and __graft_entry__.py.
+"""
+
+from .bert import BertConfig, build_bert_pretrain, apply_megatron_sharding
+from .resnet import build_resnet50
+from .mnist import build_lenet

@@ -1,0 +1,202 @@
+"""BERT/ERNIE-style transformer encoder pretraining graph (flagship
+model — BASELINE configs 3/4).
+
+Reference: the fused-attention capability surface
+(operators/fused/multihead_matmul_op.cu is inference-only in the
+reference; training-side attention there is composed op-by-op, which is
+what this builder emits). On TPU the whole encoder compiles to one XLA
+program; paddle_tpu.kernels provides Pallas flash-attention used when
+config.use_flash_attention (bypassing the materialized [B,H,S,S]
+attention matrix).
+
+Megatron-style tensor parallelism (beyond the reference, SURVEY §2f
+P-row "TP absent") comes from param sharding annotations consumed by
+the executor's GSPMD path: column-parallel QKV/FFN-in, row-parallel
+proj/FFN-out.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional
+
+import numpy as np
+
+from .. import layers, nets, optimizer as optim
+from ..core.framework import Program, program_guard
+from ..initializer import NormalInitializer, ConstantInitializer
+from ..param_attr import ParamAttr
+
+
+@dataclasses.dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    ffn_size: int = 3072
+    max_position: int = 512
+    type_vocab_size: int = 2
+    hidden_dropout: float = 0.1
+    attention_dropout: float = 0.1
+    initializer_range: float = 0.02
+    use_flash_attention: bool = False
+
+    @staticmethod
+    def base():
+        return BertConfig()
+
+    @staticmethod
+    def large():
+        return BertConfig(hidden_size=1024, num_layers=24, num_heads=16, ffn_size=4096)
+
+    @staticmethod
+    def tiny():
+        return BertConfig(
+            vocab_size=1024, hidden_size=64, num_layers=2, num_heads=4,
+            ffn_size=128, max_position=128,
+        )
+
+
+def _attr(name, std):
+    return ParamAttr(name=name, initializer=NormalInitializer(0.0, std))
+
+
+def _encoder_layer(x, cfg: BertConfig, idx: int, is_test=False):
+    h = cfg.hidden_size
+    std = cfg.initializer_range
+    pre = f"enc{idx}"
+    # self-attention: fused QKV projection (column-parallel under mp)
+    qkv = layers.fc(
+        x, 3 * h, num_flatten_dims=2,
+        param_attr=_attr(f"{pre}_qkv.w", std), bias_attr=ParamAttr(name=f"{pre}_qkv.b"),
+    )
+    q, k, v = layers.split(qkv, 3, dim=2)
+    if cfg.use_flash_attention:
+        from ..kernels import flash_attention_layer
+
+        ctx = flash_attention_layer(q, k, v, cfg.num_heads)
+    else:
+        ctx = nets.scaled_dot_product_attention(
+            q, k, v, num_heads=cfg.num_heads,
+            dropout_rate=0.0 if is_test else cfg.attention_dropout,
+        )
+    proj = layers.fc(
+        ctx, h, num_flatten_dims=2,
+        param_attr=_attr(f"{pre}_proj.w", std), bias_attr=ParamAttr(name=f"{pre}_proj.b"),
+    )
+    if not is_test and cfg.hidden_dropout:
+        proj = layers.dropout(proj, cfg.hidden_dropout,
+                              dropout_implementation="upscale_in_train")
+    x = layers.layer_norm(
+        layers.elementwise_add(x, proj), begin_norm_axis=2,
+        param_attr=ParamAttr(name=f"{pre}_ln1.scale"),
+        bias_attr=ParamAttr(name=f"{pre}_ln1.bias"),
+    )
+    # FFN (column- then row-parallel under mp)
+    ffn1 = layers.fc(
+        x, cfg.ffn_size, num_flatten_dims=2, act="gelu",
+        param_attr=_attr(f"{pre}_ffn1.w", std), bias_attr=ParamAttr(name=f"{pre}_ffn1.b"),
+    )
+    ffn2 = layers.fc(
+        ffn1, h, num_flatten_dims=2,
+        param_attr=_attr(f"{pre}_ffn2.w", std), bias_attr=ParamAttr(name=f"{pre}_ffn2.b"),
+    )
+    if not is_test and cfg.hidden_dropout:
+        ffn2 = layers.dropout(ffn2, cfg.hidden_dropout,
+                              dropout_implementation="upscale_in_train")
+    x = layers.layer_norm(
+        layers.elementwise_add(x, ffn2), begin_norm_axis=2,
+        param_attr=ParamAttr(name=f"{pre}_ln2.scale"),
+        bias_attr=ParamAttr(name=f"{pre}_ln2.bias"),
+    )
+    return x
+
+
+def build_bert_pretrain(
+    cfg: BertConfig,
+    seq_len: int,
+    optimizer: Optional[object] = None,
+    is_test: bool = False,
+    dtype: str = "float32",
+):
+    """Returns (main_program, startup_program, feeds dict, fetch dict).
+
+    Feeds: src_ids [B,S] int64, pos_ids [B,S] int64, labels [B,S] int64.
+    Loss: full-softmax LM cross-entropy at every position (pretraining
+    FLOPs profile of MLM with dense prediction).
+    """
+    main, startup = Program(), Program()
+    std = cfg.initializer_range
+    with program_guard(main, startup):
+        src = layers.data("src_ids", [seq_len], dtype="int64")
+        pos = layers.data("pos_ids", [seq_len], dtype="int64")
+        labels = layers.data("labels", [seq_len], dtype="int64")
+        word_emb = layers.embedding(
+            src, [cfg.vocab_size, cfg.hidden_size],
+            param_attr=_attr("word_embedding", std),
+        )
+        pos_emb = layers.embedding(
+            pos, [cfg.max_position, cfg.hidden_size],
+            param_attr=_attr("pos_embedding", std),
+        )
+        x = layers.elementwise_add(word_emb, pos_emb)
+        x = layers.layer_norm(
+            x, begin_norm_axis=2,
+            param_attr=ParamAttr(name="emb_ln.scale"),
+            bias_attr=ParamAttr(name="emb_ln.bias"),
+        )
+        if not is_test and cfg.hidden_dropout:
+            x = layers.dropout(x, cfg.hidden_dropout,
+                               dropout_implementation="upscale_in_train")
+        for i in range(cfg.num_layers):
+            x = _encoder_layer(x, cfg, i, is_test)
+        logits = layers.fc(
+            x, cfg.vocab_size, num_flatten_dims=2,
+            param_attr=_attr("lm_head.w", std), bias_attr=ParamAttr(name="lm_head.b"),
+        )
+        lbl = layers.unsqueeze(labels, [2])
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, lbl))
+        if optimizer is not None and not is_test:
+            optimizer.minimize(loss)
+    return main, startup, {"src_ids": src, "pos_ids": pos, "labels": labels}, {
+        "loss": loss, "logits": logits,
+    }
+
+
+def apply_megatron_sharding(program: Program, mp_axis: str = "mp", dp_axis: str = "dp"):
+    """Annotate params with PartitionSpecs: column-parallel QKV/FFN-in
+    (shard output dim), row-parallel proj/FFN-out (shard input dim),
+    vocab-parallel embedding + LM head. GSPMD inserts the collectives
+    megatron does by hand."""
+    gb = program.global_block()
+    for name, var in gb.vars.items():
+        if not getattr(var, "persistable", False) or var.shape is None:
+            continue
+        if name.endswith("_qkv.w") or name.endswith("_ffn1.w"):
+            var.sharding = (None, mp_axis)
+        elif name.endswith("_qkv.b") or name.endswith("_ffn1.b"):
+            var.sharding = (mp_axis,)
+        elif name.endswith("_proj.w") or name.endswith("_ffn2.w"):
+            var.sharding = (mp_axis, None)
+        elif name in ("word_embedding", "lm_head.w"):
+            # vocab dim for the table, hidden->vocab for the head
+            var.sharding = (mp_axis, None) if name == "word_embedding" else (None, mp_axis)
+        # optimizer accumulators inherit their param's sharding
+    for name, var in gb.vars.items():
+        for suffix in ("_moment1_", "_moment2_", "_velocity_"):
+            if suffix in name:
+                base = name.split(suffix)[0]
+                if base in gb.vars and gb.vars[base].sharding is not None and (
+                    var.shape == gb.vars[base].shape
+                ):
+                    var.sharding = gb.vars[base].sharding
+    return program
+
+
+def synthetic_batch(rng: np.random.RandomState, batch: int, seq_len: int, vocab: int):
+    src = rng.randint(0, vocab, (batch, seq_len)).astype("int64")
+    pos = np.tile(np.arange(seq_len, dtype="int64"), (batch, 1))
+    labels = np.roll(src, -1, axis=1)
+    return {"src_ids": src, "pos_ids": pos, "labels": labels}

@@ -1,0 +1,24 @@
+"""LeNet for MNIST (BASELINE config 1). Reference:
+tests/book/test_recognize_digits.py."""
+
+from __future__ import annotations
+
+from .. import layers, nets
+from ..core.framework import Program, program_guard
+
+
+def build_lenet(optimizer=None):
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        img = layers.data("img", [1, 28, 28])
+        label = layers.data("label", [1], dtype="int64")
+        c1 = nets.simple_img_conv_pool(img, 6, 5, 2, 2, conv_padding=2, act="relu")
+        c2 = nets.simple_img_conv_pool(c1, 16, 5, 2, 2, act="relu")
+        f1 = layers.fc(c2, 120, act="relu")
+        f2 = layers.fc(f1, 84, act="relu")
+        logits = layers.fc(f2, 10)
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+        acc = layers.accuracy(layers.softmax(logits), label)
+        if optimizer is not None:
+            optimizer.minimize(loss)
+    return main, startup, {"img": img, "label": label}, {"loss": loss, "acc": acc}

@@ -1,0 +1,56 @@
+"""ResNet-50 (BASELINE config 2). Reference model shape:
+tests/unittests/dist_se_resnext.py + book image-classification tests."""
+
+from __future__ import annotations
+
+from .. import layers
+from ..core.framework import Program, program_guard
+from ..param_attr import ParamAttr
+
+
+def _conv_bn(x, num_filters, filter_size, stride=1, act="relu", name=""):
+    conv = layers.conv2d(
+        x, num_filters, filter_size, stride=stride,
+        padding=(filter_size - 1) // 2, bias_attr=False,
+        param_attr=ParamAttr(name=f"{name}.conv.w"),
+    )
+    return layers.batch_norm(
+        conv, act=act,
+        param_attr=ParamAttr(name=f"{name}.bn.scale"),
+        bias_attr=ParamAttr(name=f"{name}.bn.bias"),
+        moving_mean_name=f"{name}.bn.mean",
+        moving_variance_name=f"{name}.bn.var",
+    )
+
+
+def _bottleneck(x, num_filters, stride, name):
+    conv0 = _conv_bn(x, num_filters, 1, act="relu", name=f"{name}.b0")
+    conv1 = _conv_bn(conv0, num_filters, 3, stride=stride, act="relu", name=f"{name}.b1")
+    conv2 = _conv_bn(conv1, num_filters * 4, 1, act=None, name=f"{name}.b2")
+    if stride != 1 or x.shape[1] != num_filters * 4:
+        short = _conv_bn(x, num_filters * 4, 1, stride=stride, act=None, name=f"{name}.sc")
+    else:
+        short = x
+    return layers.relu(layers.elementwise_add(short, conv2))
+
+
+def build_resnet50(num_classes=1000, image_size=224, optimizer=None):
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        img = layers.data("image", [3, image_size, image_size])
+        label = layers.data("label", [1], dtype="int64")
+        x = _conv_bn(img, 64, 7, stride=2, name="stem")
+        x = layers.pool2d(x, 3, "max", pool_stride=2, pool_padding=1)
+        depth = [3, 4, 6, 3]
+        filters = [64, 128, 256, 512]
+        for stage, (d, f) in enumerate(zip(depth, filters)):
+            for blk in range(d):
+                stride = 2 if blk == 0 and stage > 0 else 1
+                x = _bottleneck(x, f, stride, name=f"s{stage}b{blk}")
+        pool = layers.pool2d(x, 7, "avg", global_pooling=True)
+        logits = layers.fc(pool, num_classes, param_attr=ParamAttr(name="head.w"))
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+        acc = layers.accuracy(layers.softmax(logits), label)
+        if optimizer is not None:
+            optimizer.minimize(loss)
+    return main, startup, {"image": img, "label": label}, {"loss": loss, "acc": acc}

@@ -1,0 +1,17 @@
+"""Op lowerings: each module registers op types into the core registry.
+
+Reference: paddle/fluid/operators/ (~500 op types, C++/CUDA kernels).
+Here each op is a JAX lowering; XLA supplies the per-backend kernels,
+fusion, and layout assignment that the reference hand-writes.
+"""
+
+from . import math  # noqa: F401
+from . import tensor  # noqa: F401
+from . import random  # noqa: F401
+from . import nn  # noqa: F401
+from . import optim  # noqa: F401
+from . import collective  # noqa: F401
+from . import control  # noqa: F401
+from . import sequence  # noqa: F401
+from . import detection  # noqa: F401
+from . import metrics  # noqa: F401

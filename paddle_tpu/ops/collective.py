@@ -1,0 +1,106 @@
+"""Collective communication ops.
+
+Reference: operators/collective/c_allreduce_op.h:33-136, c_broadcast,
+c_allgather, c_reducescatter, c_comm_init / c_gen_nccl_id (NCCL ring
+setup, keyed by ring_id attr).
+
+TPU-native redesign: a ring_id maps to a *named mesh axis*. Inside
+shard_map the lowering emits a lax collective over that axis; under
+plain pjit/GSPMD (where collectives are inserted automatically by XLA
+from shardings) the ops are identity/annotation ops. Comm-setup ops
+(c_gen_nccl_id, c_comm_init, c_sync_*_stream) are no-ops: rendezvous is
+jax.distributed.initialize and XLA orders collectives itself.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+def _axis_for(ctx, op):
+    ring_id = int(op.attrs.get("ring_id", 0))
+    return ctx.axis_env.get(ring_id) or ctx.axis_env.get(str(ring_id))
+
+
+def _register_allreduce(name, red):
+    @register_op(name, inputs=("X",), outputs=("Out",))
+    def _lower(ctx, op, ins, _red=red):
+        x = ins["X"][0]
+        axis = _axis_for(ctx, op)
+        if axis is None:
+            # GSPMD path: gradient summation happens via sharding
+            # propagation; op is identity.
+            return {"Out": [x]}
+        if _red == "sum":
+            return {"Out": [jax.lax.psum(x, axis)]}
+        if _red == "max":
+            return {"Out": [jax.lax.pmax(x, axis)]}
+        if _red == "min":
+            return {"Out": [jax.lax.pmin(x, axis)]}
+        if _red == "prod":
+            return {"Out": [jnp.exp(jax.lax.psum(jnp.log(x), axis))]}
+        raise NotImplementedError(_red)
+
+
+_register_allreduce("c_allreduce_sum", "sum")
+_register_allreduce("c_allreduce_max", "max")
+_register_allreduce("c_allreduce_min", "min")
+_register_allreduce("c_allreduce_prod", "prod")
+_register_allreduce("allreduce", "sum")  # dygraph-friendly variant
+
+
+@register_op("c_broadcast", inputs=("X",), outputs=("Out",))
+def _c_broadcast(ctx, op, ins):
+    x = ins["X"][0]
+    axis = _axis_for(ctx, op)
+    if axis is None:
+        return {"Out": [x]}
+    root = int(op.attrs.get("root", 0))
+    # broadcast = select root's value on every member of the axis
+    idx = jax.lax.axis_index(axis)
+    masked = jnp.where(idx == root, x, jnp.zeros_like(x))
+    return {"Out": [jax.lax.psum(masked, axis)]}
+
+
+@register_op("broadcast", inputs=("X",), outputs=("Out",))
+def _broadcast_op(ctx, op, ins):
+    return _c_broadcast(ctx, op, ins)
+
+
+@register_op("c_allgather", inputs=("X",), outputs=("Out",))
+def _c_allgather(ctx, op, ins):
+    x = ins["X"][0]
+    axis = _axis_for(ctx, op)
+    if axis is None:
+        return {"Out": [x]}
+    g = jax.lax.all_gather(x, axis)  # [axis_size, ...]
+    return {"Out": [g.reshape((-1,) + x.shape[1:])]}
+
+
+@register_op("c_reducescatter", inputs=("X",), outputs=("Out",))
+def _c_reducescatter(ctx, op, ins):
+    x = ins["X"][0]
+    axis = _axis_for(ctx, op)
+    if axis is None:
+        return {"Out": [x]}
+    return {"Out": [jax.lax.psum_scatter(x, axis, scatter_dimension=0, tiled=True)]}
+
+
+def _register_noop(name, slots=("X",)):
+    @register_op(name, inputs=slots, outputs=("Out",), stop_gradient=True)
+    def _lower(ctx, op, ins):
+        vals = ins.get(slots[0], []) if slots else []
+        return {"Out": list(vals)}
+
+
+# comm setup / stream ordering: subsumed by jax.distributed + XLA
+_register_noop("c_comm_init", ())
+_register_noop("c_comm_init_all", ())
+_register_noop("c_gen_nccl_id", ())
+_register_noop("c_sync_calc_stream")
+_register_noop("c_sync_comm_stream")
+_register_noop("c_wait_comm", ())
+_register_noop("c_wait_compute", ())

@@ -1,0 +1,74 @@
+"""Control-flow-adjacent ops.
+
+Reference: operators/controlflow/ (while_op, conditional_block_op),
+print_op.cc, assert (enforce). The structured block ops (while /
+conditional_block / recurrent) are lowered by the executor itself to
+lax.while_loop / lax.cond / lax.scan because they reference sub-blocks
+— see core/executor.py. This module holds the leaf ops.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+@register_op("print", inputs=("In",), outputs=("Out",))
+def _print(ctx, op, ins):
+    x = ins["In"][0]
+    msg = op.attrs.get("message", "")
+    jax.debug.print(msg + " {x}", x=x)
+    return {"Out": [x]}
+
+
+@register_op("logical_print_stub", inputs=("X",), outputs=("Out",))
+def _logical_print_stub(ctx, op, ins):
+    return {"Out": [ins["X"][0]]}
+
+
+@register_op("check_finite_and_unscale", inputs=("X", "Scale"), outputs=("Out", "FoundInfinite"), stop_gradient=True)
+def _check_finite_and_unscale(ctx, op, ins):
+    # AMP support op (reference contrib/mixed_precision): unscale grads,
+    # report whether any is non-finite.
+    scale = ins["Scale"][0].reshape(())
+    outs = []
+    found = jnp.asarray(False)
+    for x in ins["X"]:
+        y = x / scale
+        outs.append(y)
+        found = jnp.logical_or(found, jnp.any(~jnp.isfinite(y)))
+    return {"Out": outs, "FoundInfinite": [found]}
+
+
+@register_op(
+    "update_loss_scaling",
+    inputs=("X", "FoundInfinite", "PrevLossScaling", "InGoodSteps", "InBadSteps"),
+    outputs=("Out", "LossScaling", "OutGoodSteps", "OutBadSteps"),
+    stop_gradient=True,
+)
+def _update_loss_scaling(ctx, op, ins):
+    found = ins["FoundInfinite"][0].reshape(())
+    scale = ins["PrevLossScaling"][0].reshape(())
+    good = ins["InGoodSteps"][0].reshape(())
+    bad = ins["InBadSteps"][0].reshape(())
+    incr_every = int(op.attrs.get("incr_every_n_steps", 1000))
+    decr_every = int(op.attrs.get("decr_every_n_nan_or_inf", 2))
+    incr_ratio = float(op.attrs.get("incr_ratio", 2.0))
+    decr_ratio = float(op.attrs.get("decr_ratio", 0.5))
+
+    good_new = jnp.where(found, 0, good + 1)
+    bad_new = jnp.where(found, bad + 1, 0)
+    scale_up = jnp.where(good_new >= incr_every, scale * incr_ratio, scale)
+    good_new = jnp.where(good_new >= incr_every, 0, good_new)
+    scale_dn = jnp.where(bad_new >= decr_every, jnp.maximum(scale * decr_ratio, 1.0), scale_up)
+    bad_new = jnp.where(bad_new >= decr_every, 0, bad_new)
+    new_scale = scale_dn
+    outs = [jnp.where(found, jnp.zeros_like(x), x) for x in ins["X"]]
+    return {
+        "Out": outs,
+        "LossScaling": [new_scale.reshape(1)],
+        "OutGoodSteps": [good_new.reshape(1).astype(jnp.int32)],
+        "OutBadSteps": [bad_new.reshape(1).astype(jnp.int32)],
+    }

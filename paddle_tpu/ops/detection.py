@@ -1,0 +1,137 @@
+"""Detection ops (subset). Reference: operators/detection/ (~40 ops).
+
+Round-1 coverage: the ops needed by common SSD/YOLO-style heads that
+are pure math (box transforms, iou). NMS-style ops with data-dependent
+output shapes use fixed-size outputs + validity masks (the XLA idiom).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+@register_op("box_coder", inputs=("PriorBox", "PriorBoxVar", "TargetBox"), outputs=("OutputBox",), stop_gradient=True)
+def _box_coder(ctx, op, ins):
+    prior = ins["PriorBox"][0]  # [M, 4] (xmin,ymin,xmax,ymax)
+    target = ins["TargetBox"][0]
+    code_type = op.attrs.get("code_type", "encode_center_size")
+    norm = bool(op.attrs.get("box_normalized", True))
+    off = 0.0 if norm else 1.0
+    pw = prior[:, 2] - prior[:, 0] + off
+    ph = prior[:, 3] - prior[:, 1] + off
+    pcx = prior[:, 0] + pw / 2
+    pcy = prior[:, 1] + ph / 2
+    if ins.get("PriorBoxVar"):
+        pv = ins["PriorBoxVar"][0]
+    else:
+        pv = jnp.ones((4,), prior.dtype)
+    if "encode" in code_type:
+        tw = target[:, 2] - target[:, 0] + off
+        th = target[:, 3] - target[:, 1] + off
+        tcx = target[:, 0] + tw / 2
+        tcy = target[:, 1] + th / 2
+        out = jnp.stack(
+            [
+                (tcx - pcx) / pw / pv[..., 0],
+                (tcy - pcy) / ph / pv[..., 1],
+                jnp.log(tw / pw) / pv[..., 2],
+                jnp.log(th / ph) / pv[..., 3],
+            ],
+            axis=-1,
+        )
+    else:
+        t = target  # [N, M, 4]
+        ocx = pv[..., 0] * t[..., 0] * pw + pcx
+        ocy = pv[..., 1] * t[..., 1] * ph + pcy
+        ow = jnp.exp(pv[..., 2] * t[..., 2]) * pw
+        oh = jnp.exp(pv[..., 3] * t[..., 3]) * ph
+        out = jnp.stack(
+            [ocx - ow / 2, ocy - oh / 2, ocx + ow / 2 - off, ocy + oh / 2 - off],
+            axis=-1,
+        )
+    return {"OutputBox": [out]}
+
+
+@register_op("iou_similarity", inputs=("X", "Y"), outputs=("Out",), stop_gradient=True)
+def _iou_similarity(ctx, op, ins):
+    x, y = ins["X"][0], ins["Y"][0]  # [N,4], [M,4]
+    area_x = (x[:, 2] - x[:, 0]) * (x[:, 3] - x[:, 1])
+    area_y = (y[:, 2] - y[:, 0]) * (y[:, 3] - y[:, 1])
+    lt = jnp.maximum(x[:, None, :2], y[None, :, :2])
+    rb = jnp.minimum(x[:, None, 2:], y[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    return {"Out": [inter / jnp.maximum(area_x[:, None] + area_y[None, :] - inter, 1e-10)]}
+
+
+@register_op("prior_box", inputs=("Input", "Image"), outputs=("Boxes", "Variances"), stop_gradient=True)
+def _prior_box(ctx, op, ins):
+    feat, img = ins["Input"][0], ins["Image"][0]
+    min_sizes = [float(s) for s in op.attrs.get("min_sizes", [])]
+    max_sizes = [float(s) for s in op.attrs.get("max_sizes", [])]
+    ars = [float(a) for a in op.attrs.get("aspect_ratios", [1.0])]
+    flip = bool(op.attrs.get("flip", False))
+    variances = [float(v) for v in op.attrs.get("variances", [0.1, 0.1, 0.2, 0.2])]
+    clip = bool(op.attrs.get("clip", False))
+    offset = float(op.attrs.get("offset", 0.5))
+    h, w = feat.shape[2], feat.shape[3]
+    ih, iw = img.shape[2], img.shape[3]
+    sw, sh = iw / w, ih / h
+    full_ars = []
+    for a in ars:
+        full_ars.append(a)
+        if flip and a != 1.0:
+            full_ars.append(1.0 / a)
+    boxes = []
+    for ms_i, ms in enumerate(min_sizes):
+        sizes = [(ms, ms)]
+        for a in full_ars:
+            if a != 1.0:
+                sizes.append((ms * (a ** 0.5), ms / (a ** 0.5)))
+        if max_sizes:
+            mx = max_sizes[ms_i]
+            sizes.insert(1, ((ms * mx) ** 0.5, (ms * mx) ** 0.5))
+        boxes.append(sizes)
+    import numpy as np
+
+    cy, cx = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+    cx = (cx + offset) * sw
+    cy = (cy + offset) * sh
+    all_boxes = []
+    for sizes in boxes:
+        for bw, bh in sizes:
+            b = np.stack(
+                [
+                    (cx - bw / 2) / iw,
+                    (cy - bh / 2) / ih,
+                    (cx + bw / 2) / iw,
+                    (cy + bh / 2) / ih,
+                ],
+                axis=-1,
+            )
+            all_boxes.append(b)
+    out = np.stack(all_boxes, axis=2).reshape(h, w, -1, 4)
+    if clip:
+        out = np.clip(out, 0.0, 1.0)
+    var = np.tile(np.array(variances, dtype=np.float32), out.shape[:3] + (1,))
+    return {"Boxes": [jnp.asarray(out, jnp.float32)], "Variances": [jnp.asarray(var, jnp.float32)]}
+
+
+@register_op("box_clip", inputs=("Input", "ImInfo"), outputs=("Output",), stop_gradient=True)
+def _box_clip(ctx, op, ins):
+    boxes, im_info = ins["Input"][0], ins["ImInfo"][0]
+    h = im_info[..., 0:1] - 1
+    w = im_info[..., 1:2] - 1
+    x1 = jnp.clip(boxes[..., 0::4], 0, None)
+    out = jnp.stack(
+        [
+            jnp.clip(boxes[..., 0], 0.0, w.reshape(-1)[0]),
+            jnp.clip(boxes[..., 1], 0.0, h.reshape(-1)[0]),
+            jnp.clip(boxes[..., 2], 0.0, w.reshape(-1)[0]),
+            jnp.clip(boxes[..., 3], 0.0, h.reshape(-1)[0]),
+        ],
+        axis=-1,
+    )
+    return {"Output": [out]}

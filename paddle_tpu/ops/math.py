@@ -1,0 +1,282 @@
+"""Math ops: elementwise (+axis broadcast semantics), matmul family,
+reductions, activations, comparisons.
+
+Reference: operators/elementwise/, operators/reduce_ops/,
+operators/activation_op.cc, operators/matmul_op.cc, operators/mul_op.cc.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import register_op
+
+
+# --------------------------------------------------------------------------
+# elementwise with reference `axis` broadcast semantics
+# (operators/elementwise/elementwise_op_function.h): Y is broadcast
+# against X with Y's dims aligned starting at `axis`; axis=-1 means
+# trailing alignment (numpy-style).
+# --------------------------------------------------------------------------
+
+
+def _broadcast_y(x, y, axis):
+    if axis is None or axis == -1 or x.ndim == y.ndim:
+        return y
+    # trim trailing size-1 dims of y (reference does the same)
+    yshape = list(y.shape)
+    while yshape and yshape[-1] == 1:
+        yshape.pop()
+    pad_after = x.ndim - axis - len(yshape)
+    if pad_after < 0:
+        return y
+    newshape = [1] * axis + yshape + [1] * pad_after
+    return y.reshape(newshape)
+
+
+def _register_elementwise(name, fn):
+    @register_op(name, inputs=("X", "Y"), outputs=("Out",))
+    def _lower(ctx, op, ins, _fn=fn):
+        x, y = ins["X"][0], ins["Y"][0]
+        y = _broadcast_y(x, y, int(op.attrs.get("axis", -1)))
+        return {"Out": [_fn(x, y)]}
+
+
+_register_elementwise("elementwise_add", lambda x, y: x + y)
+_register_elementwise("elementwise_sub", lambda x, y: x - y)
+_register_elementwise("elementwise_mul", lambda x, y: x * y)
+_register_elementwise("elementwise_div", lambda x, y: x / y)
+_register_elementwise("elementwise_min", jnp.minimum)
+_register_elementwise("elementwise_max", jnp.maximum)
+_register_elementwise("elementwise_pow", lambda x, y: x**y)
+_register_elementwise("elementwise_mod", jnp.mod)
+_register_elementwise("elementwise_floordiv", jnp.floor_divide)
+
+
+# --------------------------------------------------------------------------
+# matmul / mul (fc inner op)
+# --------------------------------------------------------------------------
+
+
+@register_op("matmul", inputs=("X", "Y"), outputs=("Out",))
+def _matmul(ctx, op, ins):
+    x, y = ins["X"][0], ins["Y"][0]
+    if op.attrs.get("transpose_X", False):
+        x = jnp.swapaxes(x, -1, -2)
+    if op.attrs.get("transpose_Y", False):
+        y = jnp.swapaxes(y, -1, -2)
+    out = jnp.matmul(x, y)
+    alpha = float(op.attrs.get("alpha", 1.0))
+    if alpha != 1.0:
+        out = out * alpha
+    return {"Out": [out]}
+
+
+@register_op("matmul_v2", inputs=("X", "Y"), outputs=("Out",))
+def _matmul_v2(ctx, op, ins):
+    x, y = ins["X"][0], ins["Y"][0]
+    if op.attrs.get("trans_x", False):
+        x = jnp.swapaxes(x, -1, -2)
+    if op.attrs.get("trans_y", False):
+        y = jnp.swapaxes(y, -1, -2)
+    return {"Out": [jnp.matmul(x, y)]}
+
+
+@register_op("mul", inputs=("X", "Y"), outputs=("Out",))
+def _mul(ctx, op, ins):
+    # reference mul_op.cc: flatten X to 2-D at x_num_col_dims, Y at
+    # y_num_col_dims, matmul, then restore X's leading dims.
+    x, y = ins["X"][0], ins["Y"][0]
+    xnc = int(op.attrs.get("x_num_col_dims", 1))
+    ync = int(op.attrs.get("y_num_col_dims", 1))
+    lead = x.shape[:xnc]
+    x2 = x.reshape((int(np.prod(lead or (1,))), -1))
+    y2 = y.reshape((int(np.prod(y.shape[:ync])), -1))
+    out = x2 @ y2
+    return {"Out": [out.reshape(tuple(lead) + (y2.shape[1],))]}
+
+
+# --------------------------------------------------------------------------
+# reductions — operators/reduce_ops/
+# --------------------------------------------------------------------------
+
+
+def _register_reduce(name, fn):
+    @register_op(name, inputs=("X",), outputs=("Out",))
+    def _lower(ctx, op, ins, _fn=fn):
+        x = ins["X"][0]
+        if op.attrs.get("reduce_all", False):
+            axes = None
+        else:
+            dim = op.attrs.get("dim", [0])
+            if isinstance(dim, int):
+                dim = [dim]
+            axes = tuple(int(d) % max(x.ndim, 1) for d in dim) if x.ndim else None
+        keep = bool(op.attrs.get("keep_dim", False))
+        return {"Out": [_fn(x, axis=axes, keepdims=keep)]}
+
+
+_register_reduce("reduce_sum", jnp.sum)
+_register_reduce("reduce_mean", jnp.mean)
+_register_reduce("reduce_max", jnp.max)
+_register_reduce("reduce_min", jnp.min)
+_register_reduce("reduce_prod", jnp.prod)
+_register_reduce("reduce_any", jnp.any)
+_register_reduce("reduce_all", jnp.all)
+
+
+@register_op("mean", inputs=("X",), outputs=("Out",))
+def _mean(ctx, op, ins):
+    return {"Out": [jnp.mean(ins["X"][0])]}
+
+
+@register_op("sum", inputs=("X",), outputs=("Out",))
+def _sum_op(ctx, op, ins):
+    # variadic add (grad accumulation, reference operators/sum_op.cc)
+    xs = ins["X"]
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return {"Out": [out]}
+
+
+# --------------------------------------------------------------------------
+# activations — operators/activation_op.cc
+# --------------------------------------------------------------------------
+
+
+def _register_unary(name, fn):
+    @register_op(name, inputs=("X",), outputs=("Out",))
+    def _lower(ctx, op, ins, _fn=fn):
+        return {"Out": [_fn(ins["X"][0], op.attrs)]}
+
+
+_register_unary("relu", lambda x, a: jax.nn.relu(x))
+_register_unary("sigmoid", lambda x, a: jax.nn.sigmoid(x))
+_register_unary("tanh", lambda x, a: jnp.tanh(x))
+_register_unary("sqrt", lambda x, a: jnp.sqrt(x))
+_register_unary("rsqrt", lambda x, a: jax.lax.rsqrt(x))
+_register_unary("exp", lambda x, a: jnp.exp(x))
+_register_unary("log", lambda x, a: jnp.log(x))
+_register_unary("square", lambda x, a: jnp.square(x))
+_register_unary("abs", lambda x, a: jnp.abs(x))
+_register_unary("floor", lambda x, a: jnp.floor(x))
+_register_unary("ceil", lambda x, a: jnp.ceil(x))
+_register_unary("round", lambda x, a: jnp.round(x))
+_register_unary("reciprocal", lambda x, a: 1.0 / x)
+_register_unary("softplus", lambda x, a: jax.nn.softplus(x))
+_register_unary("softsign", lambda x, a: jax.nn.soft_sign(x))
+_register_unary("relu6", lambda x, a: jnp.clip(x, 0.0, float(a.get("threshold", 6.0))))
+_register_unary("gelu", lambda x, a: jax.nn.gelu(x, approximate=bool(a.get("approximate", False))))
+_register_unary("leaky_relu", lambda x, a: jax.nn.leaky_relu(x, float(a.get("alpha", 0.02))))
+_register_unary("elu", lambda x, a: jax.nn.elu(x, float(a.get("alpha", 1.0))))
+_register_unary("swish", lambda x, a: x * jax.nn.sigmoid(float(a.get("beta", 1.0)) * x))
+_register_unary(
+    "hard_sigmoid",
+    lambda x, a: jnp.clip(
+        float(a.get("slope", 0.2)) * x + float(a.get("offset", 0.5)), 0.0, 1.0
+    ),
+)
+_register_unary(
+    "hard_swish",
+    lambda x, a: x
+    * jnp.clip(x + float(a.get("offset", 3.0)), 0.0, float(a.get("threshold", 6.0)))
+    / float(a.get("scale", 6.0)),
+)
+_register_unary("logsigmoid", lambda x, a: jax.nn.log_sigmoid(x))
+_register_unary("sin", lambda x, a: jnp.sin(x))
+_register_unary("cos", lambda x, a: jnp.cos(x))
+_register_unary("erf", lambda x, a: jax.scipy.special.erf(x))
+_register_unary("pow", lambda x, a: x ** float(a.get("factor", 1.0)))
+_register_unary(
+    "stanh",
+    lambda x, a: float(a.get("scale_b", 1.7159))
+    * jnp.tanh(float(a.get("scale_a", 0.67)) * x),
+)
+_register_unary(
+    "thresholded_relu",
+    lambda x, a: jnp.where(x > float(a.get("threshold", 1.0)), x, 0.0),
+)
+_register_unary(
+    "hard_shrink",
+    lambda x, a: jnp.where(jnp.abs(x) > float(a.get("threshold", 0.5)), x, 0.0),
+)
+_register_unary(
+    "soft_relu",
+    lambda x, a: jnp.log1p(
+        jnp.exp(jnp.clip(x, -float(a.get("threshold", 40.0)), float(a.get("threshold", 40.0))))
+    ),
+)
+
+
+@register_op("scale", inputs=("X",), outputs=("Out",))
+def _scale(ctx, op, ins):
+    x = ins["X"][0]
+    s = op.attrs.get("scale", 1.0)
+    b = op.attrs.get("bias", 0.0)
+    if op.attrs.get("bias_after_scale", True):
+        out = x * s + jnp.asarray(b, x.dtype)
+    else:
+        out = (x + jnp.asarray(b, x.dtype)) * s
+    return {"Out": [out]}
+
+
+@register_op("clip", inputs=("X",), outputs=("Out",))
+def _clip(ctx, op, ins):
+    x = ins["X"][0]
+    return {"Out": [jnp.clip(x, op.attrs.get("min"), op.attrs.get("max"))]}
+
+
+@register_op("cast", inputs=("X",), outputs=("Out",), no_grad=())
+def _cast(ctx, op, ins):
+    from ..core.framework import convert_dtype
+
+    dt = convert_dtype(op.attrs.get("out_dtype", "float32"))
+    return {"Out": [ins["X"][0].astype(dt)]}
+
+
+@register_op("log_softmax", inputs=("X",), outputs=("Out",))
+def _log_softmax(ctx, op, ins):
+    return {"Out": [jax.nn.log_softmax(ins["X"][0], axis=int(op.attrs.get("axis", -1)))]}
+
+
+# --------------------------------------------------------------------------
+# comparisons / logical — operators/controlflow/compare_op.cc, logical_op.cc
+# --------------------------------------------------------------------------
+
+
+def _register_compare(name, fn):
+    @register_op(name, inputs=("X", "Y"), outputs=("Out",), stop_gradient=True)
+    def _lower(ctx, op, ins, _fn=fn):
+        x, y = ins["X"][0], ins["Y"][0]
+        y = _broadcast_y(x, y, int(op.attrs.get("axis", -1)))
+        return {"Out": [_fn(x, y)]}
+
+
+_register_compare("equal", lambda x, y: x == y)
+_register_compare("not_equal", lambda x, y: x != y)
+_register_compare("less_than", lambda x, y: x < y)
+_register_compare("less_equal", lambda x, y: x <= y)
+_register_compare("greater_than", lambda x, y: x > y)
+_register_compare("greater_equal", lambda x, y: x >= y)
+_register_compare("logical_and", jnp.logical_and)
+_register_compare("logical_or", jnp.logical_or)
+_register_compare("logical_xor", jnp.logical_xor)
+
+
+@register_op("logical_not", inputs=("X",), outputs=("Out",), stop_gradient=True)
+def _logical_not(ctx, op, ins):
+    return {"Out": [jnp.logical_not(ins["X"][0])]}
+
+
+@register_op("isfinite", inputs=("X",), outputs=("Out",), stop_gradient=True)
+def _isfinite(ctx, op, ins):
+    # reference isfinite_op.cc reduces to a single bool
+    return {"Out": [jnp.all(jnp.isfinite(ins["X"][0]))]}
+
+
+@register_op("isfinite_v2", inputs=("X",), outputs=("Out",), stop_gradient=True)
+def _isfinite_v2(ctx, op, ins):
+    return {"Out": [jnp.isfinite(ins["X"][0])]}

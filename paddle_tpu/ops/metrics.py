@@ -1,0 +1,104 @@
+"""Metric ops. Reference: operators/metrics/ (accuracy_op.cu, auc_op.cc)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+@register_op(
+    "accuracy",
+    inputs=("Out", "Indices", "Label"),
+    outputs=("Accuracy", "Correct", "Total"),
+    stop_gradient=True,
+)
+def _accuracy(ctx, op, ins):
+    # Indices: [N, k] top-k predicted classes; Label: [N, 1]
+    idx, label = ins["Indices"][0], ins["Label"][0]
+    if label.ndim == 1:
+        label = label[:, None]
+    correct_mask = jnp.any(idx == label, axis=1)
+    num_correct = jnp.sum(correct_mask.astype(jnp.int32))
+    total = jnp.asarray(idx.shape[0], jnp.int32)
+    acc = num_correct.astype(jnp.float32) / total.astype(jnp.float32)
+    return {
+        "Accuracy": [acc.reshape(1)],
+        "Correct": [num_correct.reshape(1)],
+        "Total": [total.reshape(1)],
+    }
+
+
+@register_op(
+    "auc",
+    inputs=("Predict", "Label", "StatPos", "StatNeg"),
+    outputs=("AUC", "StatPosOut", "StatNegOut"),
+    stop_gradient=True,
+)
+def _auc(ctx, op, ins):
+    # streaming AUC via threshold-bucket histograms, matching the
+    # reference auc_op.h algorithm
+    pred, label = ins["Predict"][0], ins["Label"][0]
+    stat_pos, stat_neg = ins["StatPos"][0], ins["StatNeg"][0]
+    num_thresh = stat_pos.shape[-1] - 1
+    pos_score = pred[:, 1] if pred.ndim == 2 and pred.shape[1] == 2 else pred.reshape(-1)
+    lbl = label.reshape(-1).astype(jnp.float32)
+    bucket = jnp.clip((pos_score * num_thresh).astype(jnp.int32), 0, num_thresh)
+    pos_add = jnp.zeros_like(stat_pos).reshape(-1).at[bucket].add(lbl)
+    neg_add = jnp.zeros_like(stat_neg).reshape(-1).at[bucket].add(1.0 - lbl)
+    sp = stat_pos.reshape(-1) + pos_add
+    sn = stat_neg.reshape(-1) + neg_add
+    # integrate: walk buckets high->low accumulating TP/FP trapezoid
+    pos_rev = jnp.flip(sp)
+    neg_rev = jnp.flip(sn)
+    tp = jnp.cumsum(pos_rev)
+    fp = jnp.cumsum(neg_rev)
+    tp_prev = jnp.concatenate([jnp.zeros(1, tp.dtype), tp[:-1]])
+    fp_prev = jnp.concatenate([jnp.zeros(1, fp.dtype), fp[:-1]])
+    area = jnp.sum((fp - fp_prev) * (tp + tp_prev) / 2.0)
+    denom = tp[-1] * fp[-1]
+    auc = jnp.where(denom > 0, area / jnp.maximum(denom, 1.0), 0.0)
+    return {
+        "AUC": [auc.reshape(())],
+        "StatPosOut": [sp.reshape(stat_pos.shape)],
+        "StatNegOut": [sn.reshape(stat_neg.shape)],
+    }
+
+
+@register_op(
+    "precision_recall",
+    inputs=("MaxProbs", "Indices", "Labels", "Weights", "StatesInfo"),
+    outputs=("BatchMetrics", "AccumMetrics", "AccumStatesInfo"),
+    stop_gradient=True,
+)
+def _precision_recall(ctx, op, ins):
+    idx = ins["Indices"][0].reshape(-1)
+    labels = ins["Labels"][0].reshape(-1)
+    cls = int(op.attrs["class_number"])
+    states = ins["StatesInfo"][0] if ins.get("StatesInfo") else jnp.zeros((cls, 4))
+    oh_pred = jnp.eye(cls)[idx]
+    oh_lbl = jnp.eye(cls)[labels]
+    tp = jnp.sum(oh_pred * oh_lbl, axis=0)
+    fp = jnp.sum(oh_pred * (1 - oh_lbl), axis=0)
+    fn = jnp.sum((1 - oh_pred) * oh_lbl, axis=0)
+    tn = jnp.sum((1 - oh_pred) * (1 - oh_lbl), axis=0)
+    batch_states = jnp.stack([tp, fp, tn, fn], axis=1)
+    acc_states = states + batch_states
+
+    def metrics(st):
+        tp_, fp_, tn_, fn_ = st[:, 0], st[:, 1], st[:, 2], st[:, 3]
+        prec = jnp.where(tp_ + fp_ > 0, tp_ / jnp.maximum(tp_ + fp_, 1.0), 1.0)
+        rec = jnp.where(tp_ + fn_ > 0, tp_ / jnp.maximum(tp_ + fn_, 1.0), 1.0)
+        f1 = jnp.where(prec + rec > 0, 2 * prec * rec / jnp.maximum(prec + rec, 1e-6), 0.0)
+        w = (tp_ + fp_ + fn_ + tn_) > 0
+        macro = jnp.stack([jnp.mean(prec), jnp.mean(rec), jnp.mean(f1)])
+        micro_p = jnp.sum(tp_) / jnp.maximum(jnp.sum(tp_ + fp_), 1.0)
+        micro_r = jnp.sum(tp_) / jnp.maximum(jnp.sum(tp_ + fn_), 1.0)
+        micro_f = 2 * micro_p * micro_r / jnp.maximum(micro_p + micro_r, 1e-6)
+        return jnp.concatenate([macro, jnp.stack([micro_p, micro_r, micro_f])])
+
+    return {
+        "BatchMetrics": [metrics(batch_states)],
+        "AccumMetrics": [metrics(acc_states)],
+        "AccumStatesInfo": [acc_states],
+    }

@@ -1,0 +1,496 @@
+"""NN ops: conv, pool, norms, softmax, losses.
+
+Reference: operators/conv_op.cc (+cudnn), pool_op.cc, batch_norm_op.cc,
+layer_norm_op.cu, group_norm_op.cc, softmax_op.cc,
+softmax_with_cross_entropy_op.cu, cross_entropy_op.cc, etc.
+
+All kernels here are expressed as jax/lax ops in NCHW (the reference's
+native layout); XLA's layout assignment re-tiles for the MXU, so no
+manual NHWC conversion is needed for correctness — perf-critical fused
+variants live in paddle_tpu/kernels/ (Pallas).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import register_op
+
+
+def _pair(v):
+    if isinstance(v, (list, tuple)):
+        return [int(x) for x in v]
+    return [int(v), int(v)]
+
+
+@register_op("conv2d", inputs=("Input", "Filter", "Bias"), outputs=("Output",))
+def _conv2d(ctx, op, ins):
+    x, w = ins["Input"][0], ins["Filter"][0]
+    strides = _pair(op.attrs.get("strides", [1, 1]))
+    paddings = _pair(op.attrs.get("paddings", [0, 0]))
+    dilations = _pair(op.attrs.get("dilations", [1, 1]))
+    groups = int(op.attrs.get("groups", 1))
+    algo = op.attrs.get("padding_algorithm", "EXPLICIT")
+    if algo == "SAME":
+        pad = "SAME"
+    elif algo == "VALID":
+        pad = "VALID"
+    else:
+        if len(paddings) == 2:
+            pad = [(paddings[0], paddings[0]), (paddings[1], paddings[1])]
+        else:
+            pad = [(paddings[0], paddings[1]), (paddings[2], paddings[3])]
+    out = jax.lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=strides,
+        padding=pad,
+        rhs_dilation=dilations,
+        feature_group_count=groups,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+    )
+    if ins.get("Bias"):
+        out = out + ins["Bias"][0].reshape((1, -1, 1, 1))
+    return {"Output": [out]}
+
+
+@register_op("depthwise_conv2d", inputs=("Input", "Filter", "Bias"), outputs=("Output",))
+def _depthwise_conv2d(ctx, op, ins):
+    # groups == in_channels; same lowering, XLA handles it
+    return _conv2d.__wrapped__(ctx, op, ins) if hasattr(_conv2d, "__wrapped__") else _conv2d(ctx, op, ins)
+
+
+@register_op(
+    "conv2d_transpose", inputs=("Input", "Filter", "Bias"), outputs=("Output",)
+)
+def _conv2d_transpose(ctx, op, ins):
+    x, w = ins["Input"][0], ins["Filter"][0]
+    strides = _pair(op.attrs.get("strides", [1, 1]))
+    paddings = _pair(op.attrs.get("paddings", [0, 0]))
+    dilations = _pair(op.attrs.get("dilations", [1, 1]))
+    groups = int(op.attrs.get("groups", 1))
+    # reference filter layout for transpose conv: [in_c, out_c/g, kh, kw]
+    pad = [(paddings[0], paddings[0]), (paddings[1], paddings[1])]
+    out = jax.lax.conv_transpose(
+        x,
+        jnp.swapaxes(w, 0, 1) if groups == 1 else w,
+        strides=strides,
+        padding=pad,
+        rhs_dilation=dilations,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        transpose_kernel=True,
+    )
+    if ins.get("Bias"):
+        out = out + ins["Bias"][0].reshape((1, -1, 1, 1))
+    return {"Output": [out]}
+
+
+@register_op("pool2d", inputs=("X",), outputs=("Out",))
+def _pool2d(ctx, op, ins):
+    x = ins["X"][0]
+    ptype = op.attrs.get("pooling_type", "max")
+    ksize = _pair(op.attrs.get("ksize", [2, 2]))
+    strides = _pair(op.attrs.get("strides", [2, 2]))
+    paddings = _pair(op.attrs.get("paddings", [0, 0]))
+    if op.attrs.get("global_pooling", False) or op.attrs.get("adaptive", False) and all(
+        k == 1 for k in _pair(op.attrs.get("ksize", [1, 1]))
+    ):
+        if op.attrs.get("global_pooling", False):
+            ksize = [x.shape[2], x.shape[3]]
+            strides = ksize
+            paddings = [0, 0]
+    if op.attrs.get("adaptive", False):
+        # adaptive pooling: output size = ksize; use exact reshape-mean
+        oh, ow = ksize
+        n, c, h, w = x.shape
+        assert h % oh == 0 and w % ow == 0, "adaptive pool needs divisible sizes"
+        xr = x.reshape(n, c, oh, h // oh, ow, w // ow)
+        out = jnp.max(xr, axis=(3, 5)) if ptype == "max" else jnp.mean(xr, axis=(3, 5))
+        return {"Out": [out]}
+    window = (1, 1, ksize[0], ksize[1])
+    strd = (1, 1, strides[0], strides[1])
+    pads = ((0, 0), (0, 0), (paddings[0], paddings[0]), (paddings[1], paddings[1]))
+    if ptype == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        out = jax.lax.reduce_window(x, init, jax.lax.max, window, strd, pads)
+    else:
+        summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strd, pads)
+        if bool(op.attrs.get("exclusive", True)) and (paddings[0] or paddings[1]):
+            ones = jnp.ones_like(x)
+            counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strd, pads)
+            out = summed / counts
+        else:
+            out = summed / (ksize[0] * ksize[1])
+    return {"Out": [out]}
+
+
+@register_op("softmax", inputs=("X",), outputs=("Out",))
+def _softmax(ctx, op, ins):
+    axis = int(op.attrs.get("axis", -1))
+    return {"Out": [jax.nn.softmax(ins["X"][0], axis=axis)]}
+
+
+@register_op(
+    "softmax_with_cross_entropy",
+    inputs=("Logits", "Label"),
+    outputs=("Softmax", "Loss"),
+    no_grad=("Label",),
+)
+def _softmax_with_cross_entropy(ctx, op, ins):
+    logits, label = ins["Logits"][0], ins["Label"][0]
+    axis = int(op.attrs.get("axis", -1))
+    soft_label = bool(op.attrs.get("soft_label", False))
+    ignore_index = int(op.attrs.get("ignore_index", -100))
+    logp = jax.nn.log_softmax(logits, axis=axis)
+    softmax = jnp.exp(logp)
+    if soft_label:
+        loss = -jnp.sum(label * logp, axis=axis, keepdims=True)
+    else:
+        lbl = label
+        squeeze = lbl.ndim == logits.ndim and lbl.shape[axis] == 1
+        if squeeze:
+            lbl = jnp.squeeze(lbl, axis=axis)
+        lbl_safe = jnp.where(lbl == ignore_index, 0, lbl)
+        picked = jnp.take_along_axis(
+            logp, jnp.expand_dims(lbl_safe.astype(jnp.int32), axis), axis=axis
+        )
+        loss = -picked
+        mask = jnp.expand_dims(lbl != ignore_index, axis)
+        loss = jnp.where(mask, loss, jnp.zeros((), loss.dtype))
+    return {"Softmax": [softmax], "Loss": [loss]}
+
+
+@register_op("cross_entropy", inputs=("X", "Label"), outputs=("Y",), no_grad=("Label",))
+def _cross_entropy(ctx, op, ins):
+    x, label = ins["X"][0], ins["Label"][0]
+    soft_label = bool(op.attrs.get("soft_label", False))
+    eps = 1e-8
+    logx = jnp.log(jnp.clip(x, eps, 1.0))
+    if soft_label:
+        loss = -jnp.sum(label * logx, axis=-1, keepdims=True)
+    else:
+        lbl = label
+        if lbl.ndim == x.ndim and lbl.shape[-1] == 1:
+            lbl = jnp.squeeze(lbl, -1)
+        picked = jnp.take_along_axis(
+            logx, jnp.expand_dims(lbl.astype(jnp.int32), -1), axis=-1
+        )
+        loss = -picked
+    return {"Y": [loss]}
+
+
+@register_op(
+    "sigmoid_cross_entropy_with_logits",
+    inputs=("X", "Label"),
+    outputs=("Out",),
+    no_grad=("Label",),
+)
+def _sigmoid_ce(ctx, op, ins):
+    x, z = ins["X"][0], ins["Label"][0]
+    ignore_index = int(op.attrs.get("ignore_index", -100))
+    loss = jnp.maximum(x, 0) - x * z + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    mask = z != ignore_index
+    loss = jnp.where(mask, loss, jnp.zeros((), loss.dtype))
+    if op.attrs.get("normalize", False):
+        loss = loss / jnp.maximum(jnp.sum(mask.astype(loss.dtype)), 1.0)
+    return {"Out": [loss]}
+
+
+@register_op(
+    "batch_norm",
+    inputs=("X", "Scale", "Bias", "Mean", "Variance"),
+    outputs=("Y", "MeanOut", "VarianceOut", "SavedMean", "SavedVariance"),
+    no_grad=("Mean", "Variance"),
+)
+def _batch_norm(ctx, op, ins):
+    x = ins["X"][0]
+    scale, bias = ins["Scale"][0], ins["Bias"][0]
+    mean, var = ins["Mean"][0], ins["Variance"][0]
+    eps = float(op.attrs.get("epsilon", 1e-5))
+    momentum = float(op.attrs.get("momentum", 0.9))
+    is_test = bool(op.attrs.get("is_test", False)) or bool(
+        op.attrs.get("use_global_stats", False)
+    )
+    layout = op.attrs.get("data_layout", "NCHW")
+    axes = tuple(i for i in range(x.ndim) if i != (1 if layout == "NCHW" else x.ndim - 1))
+    ch_axis = 1 if layout == "NCHW" else x.ndim - 1
+    bshape = [1] * x.ndim
+    bshape[ch_axis] = x.shape[ch_axis]
+
+    if is_test:
+        use_mean, use_var = mean, var
+        mean_out, var_out = mean, var
+        saved_mean = mean
+        saved_var = var
+    else:
+        use_mean = jnp.mean(x, axis=axes)
+        use_var = jnp.var(x, axis=axes)
+        mean_out = momentum * mean + (1 - momentum) * use_mean
+        var_out = momentum * var + (1 - momentum) * use_var
+        saved_mean = use_mean
+        saved_var = 1.0 / jnp.sqrt(use_var + eps)
+    inv = 1.0 / jnp.sqrt(use_var + eps)
+    y = (x - use_mean.reshape(bshape)) * inv.reshape(bshape) * scale.reshape(
+        bshape
+    ) + bias.reshape(bshape)
+    return {
+        "Y": [y],
+        "MeanOut": [mean_out],
+        "VarianceOut": [var_out],
+        "SavedMean": [saved_mean],
+        "SavedVariance": [saved_var],
+    }
+
+
+@register_op(
+    "sync_batch_norm",
+    inputs=("X", "Scale", "Bias", "Mean", "Variance"),
+    outputs=("Y", "MeanOut", "VarianceOut", "SavedMean", "SavedVariance"),
+    no_grad=("Mean", "Variance"),
+)
+def _sync_batch_norm(ctx, op, ins):
+    # Cross-replica batch norm (reference sync_batch_norm_op.cu uses
+    # ncclAllReduce for the stats). Under pjit/GSPMD, jnp.mean over a
+    # sharded batch axis already produces global statistics — XLA inserts
+    # the collective — so the plain lowering IS the sync lowering. Inside
+    # shard_map the executor provides axis names and we psum explicitly.
+    axis_name = ctx.axis_env.get("sync_bn_axis")
+    if axis_name is None:
+        return _OPDEF_BATCH_NORM.lower(ctx, op, ins)
+    x = ins["X"][0]
+    scale, bias = ins["Scale"][0], ins["Bias"][0]
+    mean, var = ins["Mean"][0], ins["Variance"][0]
+    eps = float(op.attrs.get("epsilon", 1e-5))
+    momentum = float(op.attrs.get("momentum", 0.9))
+    axes = tuple(i for i in range(x.ndim) if i != 1)
+    bshape = [1] * x.ndim
+    bshape[1] = x.shape[1]
+    local_mean = jnp.mean(x, axis=axes)
+    local_sq = jnp.mean(jnp.square(x), axis=axes)
+    g_mean = jax.lax.pmean(local_mean, axis_name)
+    g_sq = jax.lax.pmean(local_sq, axis_name)
+    g_var = g_sq - jnp.square(g_mean)
+    inv = 1.0 / jnp.sqrt(g_var + eps)
+    y = (x - g_mean.reshape(bshape)) * inv.reshape(bshape) * scale.reshape(
+        bshape
+    ) + bias.reshape(bshape)
+    return {
+        "Y": [y],
+        "MeanOut": [momentum * mean + (1 - momentum) * g_mean],
+        "VarianceOut": [momentum * var + (1 - momentum) * g_var],
+        "SavedMean": [g_mean],
+        "SavedVariance": [inv],
+    }
+
+
+@register_op(
+    "layer_norm",
+    inputs=("X", "Scale", "Bias"),
+    outputs=("Y", "Mean", "Variance"),
+)
+def _layer_norm(ctx, op, ins):
+    x = ins["X"][0]
+    eps = float(op.attrs.get("epsilon", 1e-5))
+    bna = int(op.attrs.get("begin_norm_axis", 1))
+    axes = tuple(range(bna, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    y = (x - mean) / jnp.sqrt(var + eps)
+    nshape = x.shape[bna:]
+    if ins.get("Scale"):
+        y = y * ins["Scale"][0].reshape(nshape)
+    if ins.get("Bias"):
+        y = y + ins["Bias"][0].reshape(nshape)
+    lead = int(np.prod(x.shape[:bna]))
+    return {
+        "Y": [y],
+        "Mean": [mean.reshape(lead)],
+        "Variance": [var.reshape(lead)],
+    }
+
+
+@register_op(
+    "group_norm", inputs=("X", "Scale", "Bias"), outputs=("Y", "Mean", "Variance")
+)
+def _group_norm(ctx, op, ins):
+    x = ins["X"][0]  # NCHW
+    g = int(op.attrs.get("groups", 1))
+    eps = float(op.attrs.get("epsilon", 1e-5))
+    n, c = x.shape[0], x.shape[1]
+    xg = x.reshape((n, g, c // g) + x.shape[2:])
+    axes = tuple(range(2, xg.ndim))
+    mean = jnp.mean(xg, axis=axes, keepdims=True)
+    var = jnp.var(xg, axis=axes, keepdims=True)
+    y = ((xg - mean) / jnp.sqrt(var + eps)).reshape(x.shape)
+    bshape = [1, c] + [1] * (x.ndim - 2)
+    if ins.get("Scale"):
+        y = y * ins["Scale"][0].reshape(bshape)
+    if ins.get("Bias"):
+        y = y + ins["Bias"][0].reshape(bshape)
+    return {
+        "Y": [y],
+        "Mean": [mean.reshape(n, g)],
+        "Variance": [var.reshape(n, g)],
+    }
+
+
+@register_op(
+    "instance_norm",
+    inputs=("X", "Scale", "Bias"),
+    outputs=("Y", "SavedMean", "SavedVariance"),
+)
+def _instance_norm(ctx, op, ins):
+    x = ins["X"][0]  # NCHW
+    eps = float(op.attrs.get("epsilon", 1e-5))
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    y = (x - mean) / jnp.sqrt(var + eps)
+    n, c = x.shape[0], x.shape[1]
+    bshape = [1, c] + [1] * (x.ndim - 2)
+    if ins.get("Scale"):
+        y = y * ins["Scale"][0].reshape(bshape)
+    if ins.get("Bias"):
+        y = y + ins["Bias"][0].reshape(bshape)
+    return {
+        "Y": [y],
+        "SavedMean": [mean.reshape(n, c)],
+        "SavedVariance": [(1.0 / jnp.sqrt(var + eps)).reshape(n, c)],
+    }
+
+
+@register_op("l2_normalize", inputs=("X",), outputs=("Out", "Norm"))
+def _l2_normalize(ctx, op, ins):
+    x = ins["X"][0]
+    axis = int(op.attrs.get("axis", -1))
+    eps = float(op.attrs.get("epsilon", 1e-10))
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True) + eps)
+    return {"Out": [x / norm], "Norm": [norm]}
+
+
+@register_op("norm", inputs=("X",), outputs=("Out", "Norm"))
+def _norm(ctx, op, ins):
+    return _l2_normalize.__wrapped__(ctx, op, ins) if hasattr(_l2_normalize, "__wrapped__") else _l2_normalize(ctx, op, ins)
+
+
+@register_op("squared_l2_norm", inputs=("X",), outputs=("Out",))
+def _squared_l2_norm(ctx, op, ins):
+    return {"Out": [jnp.sum(jnp.square(ins["X"][0])).reshape(1)]}
+
+
+@register_op(
+    "squared_l2_distance",
+    inputs=("X", "Y"),
+    outputs=("Out", "sub_result"),
+)
+def _squared_l2_distance(ctx, op, ins):
+    x, y = ins["X"][0], ins["Y"][0]
+    sub = x - y
+    return {
+        "Out": [jnp.sum(jnp.square(sub), axis=tuple(range(1, sub.ndim))).reshape(-1, 1)],
+        "sub_result": [sub],
+    }
+
+
+@register_op("log_loss", inputs=("Predicted", "Labels"), outputs=("Loss",), no_grad=("Labels",))
+def _log_loss(ctx, op, ins):
+    p, y = ins["Predicted"][0], ins["Labels"][0]
+    eps = float(op.attrs.get("epsilon", 1e-4))
+    loss = -y * jnp.log(p + eps) - (1 - y) * jnp.log(1 - p + eps)
+    return {"Loss": [loss]}
+
+
+@register_op("huber_loss", inputs=("X", "Y"), outputs=("Out", "Residual"), no_grad=("Y",))
+def _huber_loss(ctx, op, ins):
+    x, y = ins["X"][0], ins["Y"][0]
+    delta = float(op.attrs.get("delta", 1.0))
+    r = y - x
+    abs_r = jnp.abs(r)
+    loss = jnp.where(
+        abs_r <= delta, 0.5 * jnp.square(r), delta * (abs_r - 0.5 * delta)
+    )
+    return {"Out": [loss], "Residual": [r]}
+
+
+@register_op("smooth_l1_loss", inputs=("X", "Y", "InsideWeight", "OutsideWeight"), outputs=("Out", "Diff"), no_grad=("Y", "InsideWeight", "OutsideWeight"))
+def _smooth_l1(ctx, op, ins):
+    x, y = ins["X"][0], ins["Y"][0]
+    sigma = float(op.attrs.get("sigma", 1.0))
+    s2 = sigma * sigma
+    diff = x - y
+    if ins.get("InsideWeight"):
+        diff = diff * ins["InsideWeight"][0]
+    ad = jnp.abs(diff)
+    loss = jnp.where(ad < 1.0 / s2, 0.5 * s2 * jnp.square(diff), ad - 0.5 / s2)
+    if ins.get("OutsideWeight"):
+        loss = loss * ins["OutsideWeight"][0]
+    loss = jnp.sum(loss, axis=tuple(range(1, loss.ndim))).reshape(-1, 1)
+    return {"Out": [loss], "Diff": [diff]}
+
+
+@register_op("prelu", inputs=("X", "Alpha"), outputs=("Out",))
+def _prelu(ctx, op, ins):
+    x, alpha = ins["X"][0], ins["Alpha"][0]
+    mode = op.attrs.get("mode", "all")
+    if mode == "channel":
+        alpha = alpha.reshape((1, -1) + (1,) * (x.ndim - 2))
+    return {"Out": [jnp.where(x > 0, x, alpha * x)]}
+
+
+@register_op("maxout", inputs=("X",), outputs=("Out",))
+def _maxout(ctx, op, ins):
+    x = ins["X"][0]  # NCHW
+    g = int(op.attrs.get("groups", 1))
+    n, c, h, w = x.shape
+    return {"Out": [jnp.max(x.reshape(n, c // g, g, h, w), axis=2)]}
+
+
+@register_op("kldiv_loss", inputs=("X", "Target"), outputs=("Loss",), no_grad=("Target",))
+def _kldiv_loss(ctx, op, ins):
+    x, t = ins["X"][0], ins["Target"][0]
+    loss = t * (jnp.log(jnp.clip(t, 1e-10)) - x)
+    loss = jnp.where(t > 0, loss, jnp.zeros((), loss.dtype))
+    red = op.attrs.get("reduction", "mean")
+    if red == "mean":
+        loss = jnp.mean(loss)
+    elif red == "sum":
+        loss = jnp.sum(loss)
+    elif red == "batchmean":
+        loss = jnp.sum(loss) / x.shape[0]
+    return {"Loss": [loss]}
+
+
+@register_op("interp_nearest", inputs=("X",), outputs=("Out",))
+@register_op("nearest_interp", inputs=("X",), outputs=("Out",))
+def _nearest_interp(ctx, op, ins):
+    x = ins["X"][0]  # NCHW
+    oh = int(op.attrs.get("out_h", 0))
+    ow = int(op.attrs.get("out_w", 0))
+    scale = op.attrs.get("scale", 0.0)
+    if (not oh or not ow) and scale:
+        oh, ow = int(x.shape[2] * scale), int(x.shape[3] * scale)
+    return {
+        "Out": [
+            jax.image.resize(x, x.shape[:2] + (oh, ow), method="nearest")
+        ]
+    }
+
+
+@register_op("bilinear_interp", inputs=("X",), outputs=("Out",))
+def _bilinear_interp(ctx, op, ins):
+    x = ins["X"][0]
+    oh = int(op.attrs.get("out_h", 0))
+    ow = int(op.attrs.get("out_w", 0))
+    scale = op.attrs.get("scale", 0.0)
+    if (not oh or not ow) and scale:
+        oh, ow = int(x.shape[2] * scale), int(x.shape[3] * scale)
+    return {
+        "Out": [jax.image.resize(x, x.shape[:2] + (oh, ow), method="bilinear")]
+    }
+
+
+from ..core import registry as _registry
+
+_OPDEF_BATCH_NORM = _registry._OP_REGISTRY["batch_norm"]

@@ -1,0 +1,312 @@
+"""Optimizer update ops.
+
+Reference: operators/optimizers/ (sgd_op.cc, momentum_op.cc, adam_op.cc,
+lamb_op.cc, lars_momentum_op.cc, ...). Each op consumes (Param, Grad,
+state...) and produces new values; the executor writes outputs back into
+the Scope (output var names alias the inputs, exactly as the reference's
+in-place ParamOut=Param convention).
+
+These lowerings fuse into the same XLA program as forward+backward, so a
+whole train step is one compiled executable — the reference instead
+launches one CUDA kernel per param per op.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+def _lr(ins):
+    return ins["LearningRate"][0].reshape(())
+
+
+@register_op(
+    "sgd",
+    inputs=("Param", "Grad", "LearningRate"),
+    outputs=("ParamOut",),
+    stop_gradient=True,
+)
+def _sgd(ctx, op, ins):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    return {"ParamOut": [p - _lr(ins) * g.astype(p.dtype)]}
+
+
+@register_op(
+    "momentum",
+    inputs=("Param", "Grad", "Velocity", "LearningRate"),
+    outputs=("ParamOut", "VelocityOut"),
+    stop_gradient=True,
+)
+def _momentum(ctx, op, ins):
+    p, g, v = ins["Param"][0], ins["Grad"][0], ins["Velocity"][0]
+    mu = float(op.attrs.get("mu", 0.9))
+    lr = _lr(ins)
+    v_new = mu * v + g
+    if op.attrs.get("use_nesterov", False):
+        p_new = p - (g + mu * v_new) * lr
+    else:
+        p_new = p - lr * v_new
+    return {"ParamOut": [p_new], "VelocityOut": [v_new]}
+
+
+@register_op(
+    "lars_momentum",
+    inputs=("Param", "Grad", "Velocity", "LearningRate"),
+    outputs=("ParamOut", "VelocityOut"),
+    stop_gradient=True,
+)
+def _lars_momentum(ctx, op, ins):
+    # reference optimizers/lars_momentum_op.cc: layer-adaptive lr scaling
+    p, g, v = ins["Param"][0], ins["Grad"][0], ins["Velocity"][0]
+    mu = float(op.attrs.get("mu", 0.9))
+    coeff = float(op.attrs.get("lars_coeff", 0.001))
+    wd = float(op.attrs.get("lars_weight_decay", 0.0005))
+    eps = 1e-9
+    lr = _lr(ins)
+    p_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+    g_norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+    local_lr = lr * coeff * p_norm / (g_norm + wd * p_norm + eps)
+    v_new = mu * v + local_lr * (g + wd * p)
+    return {"ParamOut": [p - v_new], "VelocityOut": [v_new]}
+
+
+@register_op(
+    "adam",
+    inputs=("Param", "Grad", "LearningRate", "Moment1", "Moment2", "Beta1Pow", "Beta2Pow"),
+    outputs=("ParamOut", "Moment1Out", "Moment2Out", "Beta1PowOut", "Beta2PowOut"),
+    stop_gradient=True,
+)
+def _adam(ctx, op, ins):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    m1, m2 = ins["Moment1"][0], ins["Moment2"][0]
+    b1p, b2p = ins["Beta1Pow"][0], ins["Beta2Pow"][0]
+    beta1 = float(op.attrs.get("beta1", 0.9))
+    beta2 = float(op.attrs.get("beta2", 0.999))
+    eps = float(op.attrs.get("epsilon", 1e-8))
+    lr = _lr(ins)
+    g = g.astype(p.dtype)
+    m1n = beta1 * m1 + (1 - beta1) * g
+    m2n = beta2 * m2 + (1 - beta2) * jnp.square(g)
+    # bias-corrected lr, as in reference adam_op.h
+    lr_t = lr * jnp.sqrt(1 - b2p.reshape(())) / (1 - b1p.reshape(()))
+    p_new = p - lr_t * m1n / (jnp.sqrt(m2n) + eps)
+    return {
+        "ParamOut": [p_new],
+        "Moment1Out": [m1n],
+        "Moment2Out": [m2n],
+        "Beta1PowOut": [b1p * beta1],
+        "Beta2PowOut": [b2p * beta2],
+    }
+
+
+@register_op(
+    "adamw",
+    inputs=("Param", "Grad", "LearningRate", "Moment1", "Moment2", "Beta1Pow", "Beta2Pow"),
+    outputs=("ParamOut", "Moment1Out", "Moment2Out", "Beta1PowOut", "Beta2PowOut"),
+    stop_gradient=True,
+)
+def _adamw(ctx, op, ins):
+    coeff = float(op.attrs.get("coeff", 0.01))
+    p = ins["Param"][0]
+    lr = _lr(ins)
+    out = _adam(ctx, op, ins)
+    out["ParamOut"] = [out["ParamOut"][0] - lr * coeff * p]
+    return out
+
+
+@register_op(
+    "adagrad",
+    inputs=("Param", "Grad", "Moment", "LearningRate"),
+    outputs=("ParamOut", "MomentOut"),
+    stop_gradient=True,
+)
+def _adagrad(ctx, op, ins):
+    p, g, m = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
+    eps = float(op.attrs.get("epsilon", 1e-6))
+    m_new = m + jnp.square(g)
+    return {
+        "ParamOut": [p - _lr(ins) * g / (jnp.sqrt(m_new) + eps)],
+        "MomentOut": [m_new],
+    }
+
+
+@register_op(
+    "decayed_adagrad",
+    inputs=("Param", "Grad", "Moment", "LearningRate"),
+    outputs=("ParamOut", "MomentOut"),
+    stop_gradient=True,
+)
+def _decayed_adagrad(ctx, op, ins):
+    p, g, m = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
+    decay = float(op.attrs.get("decay", 0.95))
+    eps = float(op.attrs.get("epsilon", 1e-6))
+    m_new = decay * m + (1 - decay) * jnp.square(g)
+    return {
+        "ParamOut": [p - _lr(ins) * g / (jnp.sqrt(m_new) + eps)],
+        "MomentOut": [m_new],
+    }
+
+
+@register_op(
+    "adadelta",
+    inputs=("Param", "Grad", "AvgSquaredGrad", "AvgSquaredUpdate"),
+    outputs=("ParamOut", "AvgSquaredGradOut", "AvgSquaredUpdateOut"),
+    stop_gradient=True,
+)
+def _adadelta(ctx, op, ins):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    asg, asu = ins["AvgSquaredGrad"][0], ins["AvgSquaredUpdate"][0]
+    rho = float(op.attrs.get("rho", 0.95))
+    eps = float(op.attrs.get("epsilon", 1e-6))
+    asg_n = rho * asg + (1 - rho) * jnp.square(g)
+    upd = -jnp.sqrt((asu + eps) / (asg_n + eps)) * g
+    asu_n = rho * asu + (1 - rho) * jnp.square(upd)
+    return {
+        "ParamOut": [p + upd],
+        "AvgSquaredGradOut": [asg_n],
+        "AvgSquaredUpdateOut": [asu_n],
+    }
+
+
+@register_op(
+    "adamax",
+    inputs=("Param", "Grad", "LearningRate", "Moment", "InfNorm", "Beta1Pow"),
+    outputs=("ParamOut", "MomentOut", "InfNormOut"),
+    stop_gradient=True,
+)
+def _adamax(ctx, op, ins):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    m, u = ins["Moment"][0], ins["InfNorm"][0]
+    b1p = ins["Beta1Pow"][0]
+    beta1 = float(op.attrs.get("beta1", 0.9))
+    beta2 = float(op.attrs.get("beta2", 0.999))
+    eps = float(op.attrs.get("epsilon", 1e-8))
+    lr = _lr(ins)
+    m_new = beta1 * m + (1 - beta1) * g
+    u_new = jnp.maximum(beta2 * u, jnp.abs(g))
+    lr_t = lr / (1 - b1p.reshape(()))
+    return {
+        "ParamOut": [p - lr_t * m_new / (u_new + eps)],
+        "MomentOut": [m_new],
+        "InfNormOut": [u_new],
+    }
+
+
+@register_op(
+    "rmsprop",
+    inputs=("Param", "Grad", "Moment", "MeanSquare", "MeanGrad", "LearningRate"),
+    outputs=("ParamOut", "MomentOut", "MeanSquareOut", "MeanGradOut"),
+    stop_gradient=True,
+)
+def _rmsprop(ctx, op, ins):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    mom, ms = ins["Moment"][0], ins["MeanSquare"][0]
+    eps = float(op.attrs.get("epsilon", 1e-10))
+    decay = float(op.attrs.get("decay", 0.9))
+    momentum = float(op.attrs.get("momentum", 0.0))
+    centered = bool(op.attrs.get("centered", False))
+    lr = _lr(ins)
+    ms_new = decay * ms + (1 - decay) * jnp.square(g)
+    if centered:
+        mg = ins["MeanGrad"][0]
+        mg_new = decay * mg + (1 - decay) * g
+        denom = jnp.sqrt(ms_new - jnp.square(mg_new) + eps)
+    else:
+        mg_new = ins["MeanGrad"][0] if ins.get("MeanGrad") else jnp.zeros_like(p)
+        denom = jnp.sqrt(ms_new + eps)
+    mom_new = momentum * mom + lr * g / denom
+    return {
+        "ParamOut": [p - mom_new],
+        "MomentOut": [mom_new],
+        "MeanSquareOut": [ms_new],
+        "MeanGradOut": [mg_new],
+    }
+
+
+@register_op(
+    "ftrl",
+    inputs=("Param", "SquaredAccumulator", "LinearAccumulator", "Grad", "LearningRate"),
+    outputs=("ParamOut", "SquaredAccumOut", "LinearAccumOut"),
+    stop_gradient=True,
+)
+def _ftrl(ctx, op, ins):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    sq, lin = ins["SquaredAccumulator"][0], ins["LinearAccumulator"][0]
+    l1 = float(op.attrs.get("l1", 0.0)) + 1e-10
+    l2 = float(op.attrs.get("l2", 0.0)) + 1e-10
+    lr_power = float(op.attrs.get("lr_power", -0.5))
+    lr = _lr(ins)
+    sq_new = sq + jnp.square(g)
+    if lr_power == -0.5:
+        sigma = (jnp.sqrt(sq_new) - jnp.sqrt(sq)) / lr
+    else:
+        sigma = (sq_new**-lr_power - sq**-lr_power) / lr
+    lin_new = lin + g - sigma * p
+    if lr_power == -0.5:
+        denom = jnp.sqrt(sq_new) / lr + 2 * l2
+    else:
+        denom = sq_new**-lr_power / lr + 2 * l2
+    pre = jnp.clip(lin_new, -l1, l1) - lin_new
+    p_new = pre / denom
+    return {
+        "ParamOut": [p_new],
+        "SquaredAccumOut": [sq_new],
+        "LinearAccumOut": [lin_new],
+    }
+
+
+@register_op(
+    "lamb",
+    inputs=("Param", "Grad", "LearningRate", "Moment1", "Moment2", "Beta1Pow", "Beta2Pow"),
+    outputs=("ParamOut", "Moment1Out", "Moment2Out", "Beta1PowOut", "Beta2PowOut"),
+    stop_gradient=True,
+)
+def _lamb(ctx, op, ins):
+    # reference optimizers/lamb_op.cc — layerwise-adaptive large-batch opt
+    p, g = ins["Param"][0], ins["Grad"][0]
+    m1, m2 = ins["Moment1"][0], ins["Moment2"][0]
+    b1p, b2p = ins["Beta1Pow"][0], ins["Beta2Pow"][0]
+    beta1 = float(op.attrs.get("beta1", 0.9))
+    beta2 = float(op.attrs.get("beta2", 0.999))
+    eps = float(op.attrs.get("epsilon", 1e-6))
+    wd = float(op.attrs.get("weight_decay", 0.01))
+    lr = _lr(ins)
+    g = g.astype(p.dtype)
+    m1n = beta1 * m1 + (1 - beta1) * g
+    m2n = beta2 * m2 + (1 - beta2) * jnp.square(g)
+    m1h = m1n / (1 - b1p.reshape(()))
+    m2h = m2n / (1 - b2p.reshape(()))
+    r = m1h / (jnp.sqrt(m2h) + eps) + wd * p
+    p_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+    r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
+    ratio = jnp.where((p_norm > 0) & (r_norm > 0), p_norm / r_norm, 1.0)
+    return {
+        "ParamOut": [p - lr * ratio * r],
+        "Moment1Out": [m1n],
+        "Moment2Out": [m2n],
+        "Beta1PowOut": [b1p * beta1],
+        "Beta2PowOut": [b2p * beta2],
+    }
+
+
+@register_op(
+    "dpsgd",
+    inputs=("Param", "Grad", "LearningRate"),
+    outputs=("ParamOut",),
+    stop_gradient=True,
+)
+def _dpsgd(ctx, op, ins):
+    # differentially-private SGD (reference optimizers/dpsgd_op.cc):
+    # clip grad by norm, add gaussian noise scaled by sigma
+    import jax
+
+    p, g = ins["Param"][0], ins["Grad"][0]
+    clip = float(op.attrs.get("clip", 10.0))
+    batch_size = float(op.attrs.get("batch_size", 16.0))
+    sigma = float(op.attrs.get("sigma", 1.0))
+    g_norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+    g = g * jnp.minimum(1.0, clip / jnp.maximum(g_norm, 1e-12))
+    noise = sigma * clip * jax.random.normal(ctx.op_key(op), g.shape, g.dtype)
+    return {"ParamOut": [p - _lr(ins) * (g + noise / batch_size)]}

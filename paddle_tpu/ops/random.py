@@ -1,0 +1,101 @@
+"""Random ops + dropout.
+
+Reference: operators/uniform_random_op.cc, gaussian_random_op.cc,
+truncated_gaussian_random_op.cc, dropout_op.cc.
+
+RNG design: each op derives a key deterministically from
+(step_key, op_ident) via LoweringContext.op_key — see core/registry.py.
+This keeps startup init reproducible and lets auto-vjp grad ops replay
+the same mask (the reference materializes dropout masks instead).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.framework import convert_dtype
+from ..core.registry import register_op
+
+
+@register_op("uniform_random", inputs=(), outputs=("Out",), stop_gradient=True)
+def _uniform_random(ctx, op, ins):
+    shape = tuple(int(s) for s in op.attrs.get("shape", []))
+    dtype = convert_dtype(op.attrs.get("dtype", "float32"))
+    lo = float(op.attrs.get("min", -1.0))
+    hi = float(op.attrs.get("max", 1.0))
+    return {"Out": [jax.random.uniform(ctx.op_key(op), shape, dtype, lo, hi)]}
+
+
+@register_op(
+    "uniform_random_batch_size_like",
+    inputs=("Input",),
+    outputs=("Out",),
+    stop_gradient=True,
+)
+def _uniform_random_bsl(ctx, op, ins):
+    ref = ins["Input"][0]
+    shape = [int(s) for s in op.attrs.get("shape", [])]
+    shape[int(op.attrs.get("output_dim_idx", 0))] = ref.shape[
+        int(op.attrs.get("input_dim_idx", 0))
+    ]
+    dtype = convert_dtype(op.attrs.get("dtype", "float32"))
+    lo = float(op.attrs.get("min", -1.0))
+    hi = float(op.attrs.get("max", 1.0))
+    return {"Out": [jax.random.uniform(ctx.op_key(op), tuple(shape), dtype, lo, hi)]}
+
+
+@register_op("gaussian_random", inputs=(), outputs=("Out",), stop_gradient=True)
+def _gaussian_random(ctx, op, ins):
+    shape = tuple(int(s) for s in op.attrs.get("shape", []))
+    dtype = convert_dtype(op.attrs.get("dtype", "float32"))
+    mean = float(op.attrs.get("mean", 0.0))
+    std = float(op.attrs.get("std", 1.0))
+    return {"Out": [mean + std * jax.random.normal(ctx.op_key(op), shape, dtype)]}
+
+
+@register_op(
+    "truncated_gaussian_random", inputs=(), outputs=("Out",), stop_gradient=True
+)
+def _truncated_gaussian_random(ctx, op, ins):
+    shape = tuple(int(s) for s in op.attrs.get("shape", []))
+    dtype = convert_dtype(op.attrs.get("dtype", "float32"))
+    mean = float(op.attrs.get("mean", 0.0))
+    std = float(op.attrs.get("std", 1.0))
+    # truncation at 2 sigma, matching the reference op's semantics
+    z = jax.random.truncated_normal(ctx.op_key(op), -2.0, 2.0, shape, dtype)
+    return {"Out": [mean + std * z]}
+
+
+@register_op("randint", inputs=(), outputs=("Out",), stop_gradient=True)
+def _randint(ctx, op, ins):
+    shape = tuple(int(s) for s in op.attrs.get("shape", []))
+    lo = int(op.attrs.get("low", 0))
+    hi = int(op.attrs.get("high", 1))
+    dtype = convert_dtype(op.attrs.get("dtype", "int64"))
+    return {"Out": [jax.random.randint(ctx.op_key(op), shape, lo, hi, dtype)]}
+
+
+@register_op("dropout", inputs=("X",), outputs=("Out", "Mask"))
+def _dropout(ctx, op, ins):
+    x = ins["X"][0]
+    p = float(op.attrs.get("dropout_prob", 0.5))
+    is_test = bool(op.attrs.get("is_test", False))
+    impl = op.attrs.get("dropout_implementation", "downgrade_in_infer")
+    if is_test or p == 0.0:
+        out = x if impl == "upscale_in_train" or p == 0.0 else x * (1.0 - p)
+        return {"Out": [out], "Mask": [jnp.ones_like(x, dtype=jnp.uint8)]}
+    keep = jax.random.bernoulli(ctx.op_key(op), 1.0 - p, x.shape)
+    if impl == "upscale_in_train":
+        out = jnp.where(keep, x / (1.0 - p), jnp.zeros((), x.dtype))
+    else:
+        out = jnp.where(keep, x, jnp.zeros((), x.dtype))
+    return {"Out": [out], "Mask": [keep.astype(jnp.uint8)]}
+
+
+@register_op("shuffle_channel", inputs=("X",), outputs=("Out",))
+def _shuffle_channel(ctx, op, ins):
+    x = ins["X"][0]  # NCHW
+    g = int(op.attrs.get("group", 1))
+    n, c, h, w = x.shape
+    return {"Out": [x.reshape(n, g, c // g, h, w).swapaxes(1, 2).reshape(n, c, h, w)]}

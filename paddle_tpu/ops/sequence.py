@@ -1,0 +1,134 @@
+"""Sequence ops over dense padded batches.
+
+Reference: operators/sequence_ops/ (16 LoD-based ragged ops,
+lod_tensor.h:104). LoD raggedness is runtime-dynamic and does not map to
+XLA static shapes; the TPU-native representation is dense padding
+[batch, max_len, ...] plus an explicit Length tensor / mask — the
+standard JAX idiom. Each op takes an optional "Length" input; absent
+lengths mean fully dense.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+def _mask(x, ins, time_axis=1):
+    if not ins.get("Length"):
+        return None
+    ln = ins["Length"][0]
+    t = x.shape[time_axis]
+    return (jnp.arange(t)[None, :] < ln[:, None]).astype(x.dtype)
+
+
+@register_op("sequence_pool", inputs=("X", "Length"), outputs=("Out", "MaxIndex"), no_grad=("Length",))
+def _sequence_pool(ctx, op, ins):
+    # X: [batch, time, d]; pooltype: AVERAGE/SUM/SQRT/MAX/LAST/FIRST
+    x = ins["X"][0]
+    ptype = op.attrs.get("pooltype", "AVERAGE").upper()
+    m = _mask(x, ins)
+    if m is not None:
+        mm = m[..., None] if x.ndim == 3 else m
+    if ptype == "SUM":
+        out = jnp.sum(x * mm, 1) if m is not None else jnp.sum(x, 1)
+    elif ptype == "AVERAGE":
+        if m is not None:
+            out = jnp.sum(x * mm, 1) / jnp.maximum(jnp.sum(mm, 1), 1.0)
+        else:
+            out = jnp.mean(x, 1)
+    elif ptype == "SQRT":
+        if m is not None:
+            out = jnp.sum(x * mm, 1) / jnp.sqrt(jnp.maximum(jnp.sum(mm, 1), 1.0))
+        else:
+            out = jnp.sum(x, 1) / jnp.sqrt(x.shape[1])
+    elif ptype == "MAX":
+        big_neg = jnp.asarray(-1e38, x.dtype)
+        xm = jnp.where(mm > 0, x, big_neg) if m is not None else x
+        out = jnp.max(xm, 1)
+    elif ptype == "LAST":
+        if ins.get("Length"):
+            idx = jnp.maximum(ins["Length"][0] - 1, 0)
+            out = jnp.take_along_axis(x, idx[:, None, None], axis=1).squeeze(1)
+        else:
+            out = x[:, -1]
+    elif ptype == "FIRST":
+        out = x[:, 0]
+    else:
+        raise NotImplementedError(ptype)
+    return {"Out": [out], "MaxIndex": [jnp.zeros((0,), jnp.int32)]}
+
+
+@register_op("sequence_softmax", inputs=("X", "Length"), outputs=("Out",), no_grad=("Length",))
+def _sequence_softmax(ctx, op, ins):
+    import jax
+
+    x = ins["X"][0]
+    m = _mask(x, ins)
+    if m is None:
+        return {"Out": [jax.nn.softmax(x, axis=1)]}
+    neg = jnp.asarray(-1e38, x.dtype)
+    logits = jnp.where(m > 0, x, neg)
+    return {"Out": [jax.nn.softmax(logits, axis=1) * m]}
+
+
+@register_op("sequence_expand", inputs=("X", "Y"), outputs=("Out",), no_grad=("Y",))
+def _sequence_expand(ctx, op, ins):
+    # dense approximation: broadcast X along Y's time axis
+    x, y = ins["X"][0], ins["Y"][0]
+    if x.ndim < y.ndim:
+        x = jnp.expand_dims(x, 1)
+    reps = [1] * x.ndim
+    reps[1] = y.shape[1] // x.shape[1]
+    return {"Out": [jnp.tile(x, reps)]}
+
+
+@register_op("sequence_reshape", inputs=("X",), outputs=("Out",))
+def _sequence_reshape(ctx, op, ins):
+    x = ins["X"][0]
+    d = int(op.attrs["new_dim"])
+    return {"Out": [x.reshape(x.shape[0], -1, d)]}
+
+
+@register_op("sequence_concat", inputs=("X",), outputs=("Out",))
+def _sequence_concat(ctx, op, ins):
+    return {"Out": [jnp.concatenate(ins["X"], axis=1)]}
+
+
+@register_op("sequence_reverse", inputs=("X", "Length"), outputs=("Y",), no_grad=("Length",))
+def _sequence_reverse(ctx, op, ins):
+    x = ins["X"][0]
+    if ins.get("Length"):
+        ln = ins["Length"][0]
+        t = x.shape[1]
+        idx = jnp.arange(t)[None, :]
+        rev_idx = jnp.where(idx < ln[:, None], ln[:, None] - 1 - idx, idx)
+        out = jnp.take_along_axis(x, rev_idx[..., None].astype(jnp.int32), axis=1) if x.ndim == 3 else jnp.take_along_axis(x, rev_idx.astype(jnp.int32), axis=1)
+        return {"Y": [out]}
+    return {"Y": [jnp.flip(x, axis=1)]}
+
+
+@register_op("sequence_pad", inputs=("X", "PadValue", "Length"), outputs=("Out", "Length"), no_grad=("PadValue", "Length"))
+def _sequence_pad(ctx, op, ins):
+    # dense representation is already padded: identity + passthrough
+    x = ins["X"][0]
+    ln = ins["Length"][0] if ins.get("Length") else jnp.full((x.shape[0],), x.shape[1], jnp.int64)
+    return {"Out": [x], "Length": [ln]}
+
+
+@register_op("sequence_unpad", inputs=("X", "Length"), outputs=("Out",), no_grad=("Length",))
+def _sequence_unpad(ctx, op, ins):
+    return {"Out": [ins["X"][0]]}
+
+
+@register_op("sequence_mask", inputs=("X",), outputs=("Y",), stop_gradient=True)
+def _sequence_mask(ctx, op, ins):
+    ln = ins["X"][0]
+    maxlen = int(op.attrs.get("maxlen", -1))
+    if maxlen <= 0:
+        raise ValueError("sequence_mask on TPU requires a static maxlen attr")
+    m = jnp.arange(maxlen)[None, :] < ln[..., None]
+    from ..core.framework import convert_dtype
+
+    return {"Y": [m.astype(convert_dtype(op.attrs.get("out_dtype", "int64")))]}

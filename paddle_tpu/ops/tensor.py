@@ -1,0 +1,403 @@
+"""Tensor creation / shape-manipulation ops.
+
+Reference: operators/fill_constant_op.cc, reshape_op.cc, transpose_op.cc,
+concat_op.cc, split_op.cc, slice_op.cc, stack_op.cc, gather_op.cc,
+lookup_table_op.cc, one_hot_op.cc, top_k_op.cc, arg_max_op.cc, etc.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.framework import convert_dtype
+from ..core.registry import register_op
+
+
+@register_op("fill_constant", inputs=(), outputs=("Out",), stop_gradient=True)
+def _fill_constant(ctx, op, ins):
+    shape = tuple(int(s) for s in op.attrs.get("shape", []))
+    dtype = convert_dtype(op.attrs.get("dtype", "float32"))
+    value = op.attrs.get("value", 0.0)
+    return {"Out": [jnp.full(shape, value, dtype=dtype)]}
+
+
+@register_op(
+    "fill_constant_batch_size_like",
+    inputs=("Input",),
+    outputs=("Out",),
+    stop_gradient=True,
+)
+def _fill_constant_bsl(ctx, op, ins):
+    ref = ins["Input"][0]
+    shape = [int(s) for s in op.attrs.get("shape", [])]
+    in_idx = int(op.attrs.get("input_dim_idx", 0))
+    out_idx = int(op.attrs.get("output_dim_idx", 0))
+    shape[out_idx] = ref.shape[in_idx]
+    dtype = convert_dtype(op.attrs.get("dtype", "float32"))
+    return {"Out": [jnp.full(tuple(shape), op.attrs.get("value", 0.0), dtype=dtype)]}
+
+
+@register_op("assign", inputs=("X",), outputs=("Out",))
+def _assign(ctx, op, ins):
+    return {"Out": [ins["X"][0]]}
+
+
+@register_op("assign_value", inputs=(), outputs=("Out",), stop_gradient=True)
+def _assign_value(ctx, op, ins):
+    shape = tuple(int(s) for s in op.attrs.get("shape", []))
+    dtype = convert_dtype(op.attrs.get("dtype", "float32"))
+    values = op.attrs.get("values", op.attrs.get("fp32_values", []))
+    return {"Out": [jnp.asarray(np.array(values), dtype=dtype).reshape(shape)]}
+
+
+@register_op("shape", inputs=("Input",), outputs=("Out",), stop_gradient=True)
+def _shape(ctx, op, ins):
+    return {"Out": [jnp.asarray(ins["Input"][0].shape, dtype=jnp.int32)]}
+
+
+def _infer_reshape(x, shape):
+    shape = list(int(s) for s in shape)
+    # reference reshape_op.cc: 0 means "copy this dim from x", -1 infers
+    for i, s in enumerate(shape):
+        if s == 0:
+            shape[i] = x.shape[i]
+    return tuple(shape)
+
+
+@register_op("reshape2", inputs=("X",), outputs=("Out", "XShape"))
+def _reshape2(ctx, op, ins):
+    x = ins["X"][0]
+    out = x.reshape(_infer_reshape(x, op.attrs.get("shape", [])))
+    # XShape is a compile-time bookkeeping output in the reference (for
+    # the grad op); emit a zero-size placeholder.
+    return {"Out": [out], "XShape": [jnp.zeros((0,), x.dtype)]}
+
+
+@register_op("reshape", inputs=("X",), outputs=("Out",))
+def _reshape(ctx, op, ins):
+    x = ins["X"][0]
+    return {"Out": [x.reshape(_infer_reshape(x, op.attrs.get("shape", [])))]}
+
+
+@register_op("flatten2", inputs=("X",), outputs=("Out", "XShape"))
+def _flatten2(ctx, op, ins):
+    x = ins["X"][0]
+    axis = int(op.attrs.get("axis", 1))
+    lead = int(np.prod(x.shape[:axis])) if axis > 0 else 1
+    return {
+        "Out": [x.reshape((lead, -1))],
+        "XShape": [jnp.zeros((0,), x.dtype)],
+    }
+
+
+@register_op("transpose2", inputs=("X",), outputs=("Out", "XShape"))
+def _transpose2(ctx, op, ins):
+    x = ins["X"][0]
+    perm = tuple(int(a) for a in op.attrs.get("axis", []))
+    return {"Out": [jnp.transpose(x, perm)], "XShape": [jnp.zeros((0,), x.dtype)]}
+
+
+@register_op("transpose", inputs=("X",), outputs=("Out",))
+def _transpose(ctx, op, ins):
+    x = ins["X"][0]
+    perm = tuple(int(a) for a in op.attrs.get("axis", []))
+    return {"Out": [jnp.transpose(x, perm)]}
+
+
+@register_op("concat", inputs=("X",), outputs=("Out",))
+def _concat(ctx, op, ins):
+    return {"Out": [jnp.concatenate(ins["X"], axis=int(op.attrs.get("axis", 0)))]}
+
+
+@register_op("split", inputs=("X",), outputs=("Out",))
+def _split(ctx, op, ins):
+    x = ins["X"][0]
+    axis = int(op.attrs.get("axis", 0))
+    sections = op.attrs.get("sections", [])
+    num = int(op.attrs.get("num", 0))
+    if sections:
+        idx = np.cumsum(sections)[:-1].tolist()
+        outs = jnp.split(x, idx, axis=axis)
+    else:
+        outs = jnp.split(x, num, axis=axis)
+    return {"Out": list(outs)}
+
+
+@register_op("slice", inputs=("Input",), outputs=("Out",))
+def _slice(ctx, op, ins):
+    x = ins["Input"][0]
+    axes = [int(a) for a in op.attrs.get("axes", [])]
+    starts = [int(s) for s in op.attrs.get("starts", [])]
+    ends = [int(e) for e in op.attrs.get("ends", [])]
+    idx = [slice(None)] * x.ndim
+    for a, s, e in zip(axes, starts, ends):
+        idx[a] = slice(s, e)
+    out = x[tuple(idx)]
+    if op.attrs.get("decrease_axis"):
+        out = jnp.squeeze(out, axis=tuple(int(a) for a in op.attrs["decrease_axis"]))
+    return {"Out": [out]}
+
+
+@register_op("strided_slice", inputs=("Input",), outputs=("Out",))
+def _strided_slice(ctx, op, ins):
+    x = ins["Input"][0]
+    axes = [int(a) for a in op.attrs.get("axes", [])]
+    starts = [int(s) for s in op.attrs.get("starts", [])]
+    ends = [int(e) for e in op.attrs.get("ends", [])]
+    strides = [int(s) for s in op.attrs.get("strides", [1] * len(axes))]
+    idx = [slice(None)] * x.ndim
+    for a, s, e, st in zip(axes, starts, ends, strides):
+        idx[a] = slice(s, e, st)
+    return {"Out": [x[tuple(idx)]]}
+
+
+@register_op("stack", inputs=("X",), outputs=("Y",))
+def _stack(ctx, op, ins):
+    return {"Y": [jnp.stack(ins["X"], axis=int(op.attrs.get("axis", 0)))]}
+
+
+@register_op("unstack", inputs=("X",), outputs=("Y",))
+def _unstack(ctx, op, ins):
+    x = ins["X"][0]
+    axis = int(op.attrs.get("axis", 0))
+    n = x.shape[axis]
+    return {"Y": [jnp.squeeze(s, axis=axis) for s in jnp.split(x, n, axis=axis)]}
+
+
+@register_op("squeeze2", inputs=("X",), outputs=("Out", "XShape"))
+def _squeeze2(ctx, op, ins):
+    x = ins["X"][0]
+    axes = tuple(int(a) for a in op.attrs.get("axes", []))
+    out = jnp.squeeze(x, axis=axes or None)
+    return {"Out": [out], "XShape": [jnp.zeros((0,), x.dtype)]}
+
+
+@register_op("unsqueeze2", inputs=("X",), outputs=("Out", "XShape"))
+def _unsqueeze2(ctx, op, ins):
+    x = ins["X"][0]
+    for a in sorted(int(a) for a in op.attrs.get("axes", [])):
+        x = jnp.expand_dims(x, a)
+    return {"Out": [x], "XShape": [jnp.zeros((0,), x.dtype)]}
+
+
+@register_op("expand", inputs=("X",), outputs=("Out",))
+def _expand(ctx, op, ins):
+    x = ins["X"][0]
+    times = [int(t) for t in op.attrs.get("expand_times", [])]
+    return {"Out": [jnp.tile(x, times)]}
+
+
+@register_op("expand_as", inputs=("X", "target_tensor"), outputs=("Out",), no_grad=("target_tensor",))
+def _expand_as(ctx, op, ins):
+    x, t = ins["X"][0], ins["target_tensor"][0]
+    reps = [ts // xs for ts, xs in zip(t.shape, x.shape)]
+    return {"Out": [jnp.tile(x, reps)]}
+
+
+@register_op("tile", inputs=("X",), outputs=("Out",))
+def _tile(ctx, op, ins):
+    return {"Out": [jnp.tile(ins["X"][0], [int(t) for t in op.attrs.get("repeat_times", [])])]}
+
+
+@register_op("gather", inputs=("X", "Index"), outputs=("Out",), no_grad=("Index",))
+def _gather(ctx, op, ins):
+    x, idx = ins["X"][0], ins["Index"][0]
+    return {"Out": [jnp.take(x, idx, axis=0)]}
+
+
+@register_op("gather_nd", inputs=("X", "Index"), outputs=("Out",), no_grad=("Index",))
+def _gather_nd(ctx, op, ins):
+    x, idx = ins["X"][0], ins["Index"][0]
+    # idx: [..., k] indexes the first k dims of x
+    k = idx.shape[-1]
+    flat_idx = tuple(jnp.moveaxis(idx, -1, 0))
+    return {"Out": [x[flat_idx]]}
+
+
+@register_op(
+    "scatter", inputs=("X", "Ids", "Updates"), outputs=("Out",), no_grad=("Ids",)
+)
+def _scatter(ctx, op, ins):
+    x, ids, upd = ins["X"][0], ins["Ids"][0], ins["Updates"][0]
+    if op.attrs.get("overwrite", True):
+        return {"Out": [x.at[ids].set(upd)]}
+    return {"Out": [x.at[ids].add(upd)]}
+
+
+@register_op("lookup_table", inputs=("W", "Ids"), outputs=("Out",), no_grad=("Ids",))
+def _lookup_table(ctx, op, ins):
+    # reference lookup_table_op.cc: Ids has trailing dim 1
+    w, ids = ins["W"][0], ins["Ids"][0]
+    ids = ids.squeeze(-1) if ids.ndim > 1 and ids.shape[-1] == 1 else ids
+    out = jnp.take(w, ids, axis=0)
+    pad = op.attrs.get("padding_idx", -1)
+    if pad is not None and pad >= 0:
+        out = jnp.where((ids == pad)[..., None], jnp.zeros((), w.dtype), out)
+    return {"Out": [out]}
+
+
+@register_op("lookup_table_v2", inputs=("W", "Ids"), outputs=("Out",), no_grad=("Ids",))
+def _lookup_table_v2(ctx, op, ins):
+    w, ids = ins["W"][0], ins["Ids"][0]
+    out = jnp.take(w, ids, axis=0)
+    pad = op.attrs.get("padding_idx", -1)
+    if pad is not None and pad >= 0:
+        out = jnp.where((ids == pad)[..., None], jnp.zeros((), w.dtype), out)
+    return {"Out": [out]}
+
+
+@register_op("one_hot", inputs=("X",), outputs=("Out",), stop_gradient=True)
+def _one_hot(ctx, op, ins):
+    x = ins["X"][0]
+    depth = int(op.attrs.get("depth", 1))
+    x = x.squeeze(-1) if x.ndim > 1 and x.shape[-1] == 1 else x
+    return {"Out": [jax.nn.one_hot(x, depth, dtype=jnp.float32)]}
+
+
+@register_op("one_hot_v2", inputs=("X",), outputs=("Out",), stop_gradient=True)
+def _one_hot_v2(ctx, op, ins):
+    x = ins["X"][0]
+    depth = int(op.attrs.get("depth", 1))
+    return {"Out": [jax.nn.one_hot(x, depth, dtype=jnp.float32)]}
+
+
+@register_op("top_k", inputs=("X",), outputs=("Out", "Indices"))
+def _top_k(ctx, op, ins):
+    x = ins["X"][0]
+    k = int(op.attrs.get("k", 1))
+    vals, idx = jax.lax.top_k(x, k)
+    return {"Out": [vals], "Indices": [idx.astype(jnp.int64)]}
+
+
+@register_op("top_k_v2", inputs=("X",), outputs=("Out", "Indices"))
+def _top_k_v2(ctx, op, ins):
+    x = ins["X"][0]
+    k = int(op.attrs.get("k", 1))
+    vals, idx = jax.lax.top_k(x, k)
+    return {"Out": [vals], "Indices": [idx.astype(jnp.int64)]}
+
+
+@register_op("arg_max", inputs=("X",), outputs=("Out",), stop_gradient=True)
+def _arg_max(ctx, op, ins):
+    x = ins["X"][0]
+    axis = int(op.attrs.get("axis", -1))
+    out = jnp.argmax(x, axis=axis)
+    if op.attrs.get("keepdims", False):
+        out = jnp.expand_dims(out, axis)
+    return {"Out": [out.astype(jnp.int64)]}
+
+
+@register_op("arg_min", inputs=("X",), outputs=("Out",), stop_gradient=True)
+def _arg_min(ctx, op, ins):
+    x = ins["X"][0]
+    axis = int(op.attrs.get("axis", -1))
+    return {"Out": [jnp.argmin(x, axis=axis).astype(jnp.int64)]}
+
+
+@register_op("argsort", inputs=("X",), outputs=("Out", "Indices"))
+def _argsort(ctx, op, ins):
+    x = ins["X"][0]
+    axis = int(op.attrs.get("axis", -1))
+    desc = bool(op.attrs.get("descending", False))
+    idx = jnp.argsort(-x if desc else x, axis=axis)
+    out = jnp.take_along_axis(x, idx, axis=axis)
+    return {"Out": [out], "Indices": [idx.astype(jnp.int64)]}
+
+
+@register_op("where", inputs=("Condition", "X", "Y"), outputs=("Out",), no_grad=("Condition",))
+def _where(ctx, op, ins):
+    return {"Out": [jnp.where(ins["Condition"][0], ins["X"][0], ins["Y"][0])]}
+
+
+@register_op("range", inputs=("Start", "End", "Step"), outputs=("Out",), stop_gradient=True)
+def _range(ctx, op, ins):
+    s = ins["Start"][0].reshape(())
+    e = ins["End"][0].reshape(())
+    st = ins["Step"][0].reshape(())
+    # XLA needs static sizes: range ops must have constant inputs; the
+    # executor constant-folds fill_constant feeds. Use numpy values.
+    s, e, st = float(s), float(e), float(st)
+    n = max(int(np.ceil((e - s) / st)), 0)
+    return {"Out": [s + st * jnp.arange(n, dtype=ins["Start"][0].dtype)]}
+
+
+@register_op("increment", inputs=("X",), outputs=("Out",))
+def _increment(ctx, op, ins):
+    x = ins["X"][0]
+    return {"Out": [x + jnp.asarray(op.attrs.get("step", 1.0), x.dtype)]}
+
+
+@register_op("pad", inputs=("X",), outputs=("Out",))
+def _pad(ctx, op, ins):
+    x = ins["X"][0]
+    paddings = [int(p) for p in op.attrs.get("paddings", [])]
+    pairs = list(zip(paddings[::2], paddings[1::2]))
+    return {
+        "Out": [jnp.pad(x, pairs, constant_values=float(op.attrs.get("pad_value", 0.0)))]
+    }
+
+
+@register_op("pad2d", inputs=("X",), outputs=("Out",))
+def _pad2d(ctx, op, ins):
+    x = ins["X"][0]  # NCHW
+    p = [int(v) for v in op.attrs.get("paddings", [0, 0, 0, 0])]
+    mode = op.attrs.get("mode", "constant")
+    pairs = [(0, 0), (0, 0), (p[0], p[1]), (p[2], p[3])]
+    if mode == "constant":
+        out = jnp.pad(x, pairs, constant_values=float(op.attrs.get("pad_value", 0.0)))
+    elif mode == "reflect":
+        out = jnp.pad(x, pairs, mode="reflect")
+    else:
+        out = jnp.pad(x, pairs, mode="edge")
+    return {"Out": [out]}
+
+
+@register_op("cumsum", inputs=("X",), outputs=("Out",))
+def _cumsum(ctx, op, ins):
+    x = ins["X"][0]
+    axis = int(op.attrs.get("axis", -1))
+    out = jnp.cumsum(x, axis=axis)
+    if op.attrs.get("reverse", False):
+        out = jnp.flip(jnp.cumsum(jnp.flip(x, axis), axis=axis), axis)
+    if op.attrs.get("exclusive", False):
+        out = out - x
+    return {"Out": [out]}
+
+
+@register_op("shard_index", inputs=("X",), outputs=("Out",), stop_gradient=True)
+def _shard_index(ctx, op, ins):
+    # reference shard_index_op.cc: map global class index -> local shard
+    # index (for sharded classification heads)
+    x = ins["X"][0]
+    index_num = int(op.attrs["index_num"])
+    nshards = int(op.attrs["nshards"])
+    shard_id = int(op.attrs["shard_id"])
+    ignore = int(op.attrs.get("ignore_value", -1))
+    per = (index_num + nshards - 1) // nshards
+    in_shard = (x // per) == shard_id
+    return {"Out": [jnp.where(in_shard, x % per, ignore)]}
+
+
+@register_op("size", inputs=("Input",), outputs=("Out",), stop_gradient=True)
+def _size(ctx, op, ins):
+    return {"Out": [jnp.asarray(ins["Input"][0].size, dtype=jnp.int64)]}
+
+
+@register_op("fill_zeros_like", inputs=("X",), outputs=("Out",), stop_gradient=True)
+def _fill_zeros_like(ctx, op, ins):
+    return {"Out": [jnp.zeros_like(ins["X"][0])]}
+
+
+@register_op("diag", inputs=("Diagonal",), outputs=("Out",))
+def _diag(ctx, op, ins):
+    return {"Out": [jnp.diag(ins["Diagonal"][0])]}
+
+
+@register_op("linspace", inputs=("Start", "Stop", "Num"), outputs=("Out",), stop_gradient=True)
+def _linspace(ctx, op, ins):
+    s = float(ins["Start"][0].reshape(()))
+    e = float(ins["Stop"][0].reshape(()))
+    n = int(ins["Num"][0].reshape(()))
+    return {"Out": [jnp.linspace(s, e, n, dtype=ins["Start"][0].dtype)]}

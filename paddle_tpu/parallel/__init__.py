@@ -1,0 +1,11 @@
+"""Distributed training: mesh management, fleet API, sharded training.
+
+Reference: SURVEY.md §2f / L5 — transpilers + NCCL rings + RPC
+parameter server. TPU-native: one backend — named mesh axes + GSPMD /
+shard_map collectives over ICI/DCN, rendezvous via
+jax.distributed.initialize.
+"""
+
+from .env import ParallelEnv, get_rank, get_world_size, init_parallel_env
+from .mesh import MeshContext, get_mesh, mesh_guard, ring_registry
+from . import fleet

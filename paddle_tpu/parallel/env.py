@@ -1,0 +1,86 @@
+"""Process-level distributed environment.
+
+Reference env contract (launch.py:105-110, 289-307): the launcher
+exports PADDLE_TRAINER_ID, PADDLE_TRAINERS_NUM, PADDLE_TRAINER_ENDPOINTS,
+PADDLE_CURRENT_ENDPOINT to each worker process. We honor the same
+variables and map them onto jax.distributed.initialize (which replaces
+the reference's gen_nccl_id RPC rendezvous:
+operators/collective/c_gen_nccl_id_op.cc).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+class ParallelEnv:
+    """Reference: fluid/dygraph/parallel.py ParallelEnv."""
+
+    def __init__(self):
+        self._rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        self._world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        self._current_endpoint = os.environ.get("PADDLE_CURRENT_ENDPOINT", "")
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        self._trainer_endpoints = [e for e in eps.split(",") if e]
+
+    @property
+    def rank(self):
+        return self._rank
+
+    # reference aliases
+    local_rank = rank
+
+    @property
+    def nranks(self):
+        return self._world_size
+
+    @property
+    def world_size(self):
+        return self._world_size
+
+    @property
+    def current_endpoint(self):
+        return self._current_endpoint
+
+    @property
+    def trainer_endpoints(self):
+        return self._trainer_endpoints
+
+    @property
+    def dev_id(self):
+        return int(os.environ.get("FLAGS_selected_tpus", os.environ.get("FLAGS_selected_gpus", "0")).split(",")[0])
+
+
+_initialized = False
+
+
+def init_parallel_env(coordinator_address: Optional[str] = None):
+    """Multi-host init: wire the PADDLE_* env contract into
+    jax.distributed (coordination service = the TPU-native replacement
+    for both gen_nccl_id rendezvous and gloo HDFS-file rendezvous)."""
+    global _initialized
+    if _initialized:
+        return ParallelEnv()
+    env = ParallelEnv()
+    if env.world_size > 1:
+        import jax
+
+        addr = coordinator_address
+        if addr is None and env.trainer_endpoints:
+            addr = env.trainer_endpoints[0]
+        jax.distributed.initialize(
+            coordinator_address=addr,
+            num_processes=env.world_size,
+            process_id=env.rank,
+        )
+    _initialized = True
+    return env
+
+
+def get_rank() -> int:
+    return ParallelEnv().rank
+
+
+def get_world_size() -> int:
+    return ParallelEnv().world_size

@@ -1,0 +1,227 @@
+"""Fleet API: distributed training front end.
+
+Reference: python/paddle/fluid/incubate/fleet/base/fleet_base.py
+(fleet.init / distributed_optimizer / minimize), role_maker.py (env
+discovery), collective/__init__.py:45,134,182,378 (Collective fleet +
+DistributedStrategy; applies nccl2 transpile + CompiledProgram).
+
+TPU-native: distributed_optimizer(...).minimize(loss) runs the normal
+graph-level minimize, then attaches a data-parallel mesh to the
+program via CompiledProgram.with_data_parallel — XLA/GSPMD inserts the
+gradient all-reduces that the reference's GradAllReduce transpiler
+(transpiler/collective.py:178) had to write into the graph op by op.
+Multi-host rendezvous is jax.distributed (env contract preserved).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from ..core import framework
+from ..core.compiler import BuildStrategy, CompiledProgram
+from .env import ParallelEnv, init_parallel_env
+
+
+class Mode:
+    TRANSPILER = 1
+    PSLIB = 2
+    COLLECTIVE = 3
+
+
+# --------------------------------------------------------------------------
+# role makers — reference incubate/fleet/base/role_maker.py
+# --------------------------------------------------------------------------
+
+
+class Role:
+    WORKER = 1
+    SERVER = 2
+
+
+class RoleMakerBase:
+    def __init__(self):
+        self._env = ParallelEnv()
+
+    def worker_index(self) -> int:
+        return self._env.rank
+
+    def worker_num(self) -> int:
+        return self._env.world_size
+
+    def is_worker(self) -> bool:
+        return True
+
+    def is_server(self) -> bool:
+        return False
+
+    def is_first_worker(self) -> bool:
+        return self.worker_index() == 0
+
+    def get_trainer_endpoints(self):
+        return self._env.trainer_endpoints
+
+    def generate_role(self):
+        pass
+
+
+class PaddleCloudRoleMaker(RoleMakerBase):
+    """Reads the PADDLE_* env contract (reference role_maker.py:441)."""
+
+    def __init__(self, is_collective: bool = True):
+        super().__init__()
+        self._is_collective = is_collective
+
+
+class UserDefinedRoleMaker(RoleMakerBase):
+    def __init__(self, current_id=0, role=Role.WORKER, worker_num=1, server_endpoints=None):
+        super().__init__()
+        self._env._rank = current_id
+        self._env._world_size = worker_num
+        self._role = role
+
+    def is_worker(self):
+        return self._role == Role.WORKER
+
+    def is_server(self):
+        return self._role == Role.SERVER
+
+
+class MPISymetricRoleMaker(RoleMakerBase):
+    """Reference role_maker.py:150 used MPI rank discovery; here the env
+    contract / jax.distributed supplies ranks, so this is an alias."""
+
+
+# --------------------------------------------------------------------------
+# DistributedStrategy — reference collective/__init__.py:134
+# --------------------------------------------------------------------------
+
+
+class DistributedStrategy:
+    def __init__(self):
+        self.build_strategy = BuildStrategy()
+        self.use_local_sgd = False
+        self.local_sgd_steps = 1
+        self.forward_recompute = False
+        self.recompute_checkpoints = []
+        self.use_amp = False
+        self.amp_loss_scale = 2.0**15
+        self.nccl_comm_num = 1  # advisory; XLA owns comm scheduling
+        self.hierarchical_allreduce = False  # XLA is ICI/DCN-aware natively
+        self.exec_strategy = None
+        self.mode = "collective"
+        # ZeRO-style sharded optimizer states (reference kReduce /
+        # c_reducescatter building blocks)
+        self.sharding = False
+
+
+# --------------------------------------------------------------------------
+# Fleet singleton — reference fleet_base.py Fleet
+# --------------------------------------------------------------------------
+
+
+class _Fleet:
+    def __init__(self):
+        self._role_maker: Optional[RoleMakerBase] = None
+        self._origin_program = None
+        self._compiled_program = None
+        self._strategy: Optional[DistributedStrategy] = None
+
+    def init(self, role_maker: Optional[RoleMakerBase] = None, is_collective: bool = True):
+        self._role_maker = role_maker or PaddleCloudRoleMaker(is_collective)
+        if self._role_maker.worker_num() > 1:
+            init_parallel_env()
+        return self
+
+    # -- info ----------------------------------------------------------------
+    def worker_index(self):
+        return self._role_maker.worker_index()
+
+    def worker_num(self):
+        return self._role_maker.worker_num()
+
+    def is_first_worker(self):
+        return self._role_maker.is_first_worker()
+
+    def is_worker(self):
+        return self._role_maker.is_worker()
+
+    def is_server(self):
+        return self._role_maker.is_server()
+
+    def worker_endpoints(self):
+        return self._role_maker.get_trainer_endpoints()
+
+    def barrier_worker(self):
+        if self.worker_num() > 1:
+            import jax
+
+            # tiny collective as a barrier over the coordination service
+            jax.experimental.multihost_utils.sync_global_devices("fleet_barrier")
+
+    # -- programs ------------------------------------------------------------
+    @property
+    def main_program(self):
+        return self._compiled_program or framework.default_main_program()
+
+    @property
+    def startup_program(self):
+        return framework.default_startup_program()
+
+    def distributed_optimizer(self, optimizer, strategy: Optional[DistributedStrategy] = None):
+        return DistributedOptimizer(self, optimizer, strategy or DistributedStrategy())
+
+    # -- io ------------------------------------------------------------------
+    def save_persistables(self, executor, dirname, main_program=None):
+        from .. import io
+
+        if self.is_first_worker():
+            io.save_persistables(executor, dirname, main_program)
+
+    def save_inference_model(self, executor, dirname, feeded_var_names, target_vars,
+                             main_program=None, export_for_deployment=True):
+        from .. import io
+
+        if self.is_first_worker():
+            io.save_inference_model(dirname, feeded_var_names, target_vars,
+                                    executor, main_program)
+
+
+class DistributedOptimizer:
+    """Reference collective/__init__.py:378 CollectiveOptimizer."""
+
+    def __init__(self, fleet_obj: _Fleet, optimizer, strategy: DistributedStrategy):
+        self._fleet = fleet_obj
+        self._optimizer = optimizer
+        self._strategy = strategy
+
+    def backward(self, loss, **kw):
+        return self._optimizer.backward(loss, **kw)
+
+    def apply_gradients(self, params_grads):
+        return self._optimizer.apply_gradients(params_grads)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None, no_grad_set=None):
+        inner = self._optimizer
+        if self._strategy.forward_recompute:
+            from ..optimizer import RecomputeOptimizer
+
+            inner = RecomputeOptimizer(inner)
+            inner._set_checkpoints(self._strategy.recompute_checkpoints)
+        if self._strategy.use_amp:
+            from ..contrib.mixed_precision import decorate
+
+            inner = decorate(inner, init_loss_scaling=self._strategy.amp_loss_scale)
+        opt_ops, params_grads = inner.minimize(
+            loss, startup_program, parameter_list, no_grad_set
+        )
+        program = loss.block.program
+        self._fleet._origin_program = program
+        compiled = CompiledProgram(program, self._strategy.build_strategy)
+        compiled.with_data_parallel(loss_name=loss.name)
+        self._fleet._compiled_program = compiled
+        self._fleet._strategy = self._strategy
+        return opt_ops, params_grads
+
+
+fleet = _Fleet()

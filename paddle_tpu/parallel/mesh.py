@@ -1,0 +1,75 @@
+"""Device-mesh management.
+
+Reference analogue: platform/collective_helper.h:62 NCCLCommContext — a
+registry of ring_id -> NCCL communicator. TPU-native: a registry of
+ring_id -> named mesh axis on the active jax.sharding.Mesh; collectives
+become lax ops over those names, hierarchical ICI/DCN routing is XLA's
+job (reference had to hand-build inter/exter rings,
+platform/nccl_helper.h:179).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+
+class RingRegistry:
+    """ring_id -> mesh axis name (reference c_comm_init per ring)."""
+
+    def __init__(self):
+        self._rings: Dict[int, str] = {}
+
+    def register(self, ring_id: int, axis_name: str):
+        self._rings[int(ring_id)] = axis_name
+
+    def axis(self, ring_id: int) -> Optional[str]:
+        return self._rings.get(int(ring_id))
+
+    def clear(self):
+        self._rings.clear()
+
+    def as_env(self) -> Dict:
+        return dict(self._rings)
+
+
+ring_registry = RingRegistry()
+
+
+class MeshContext:
+    def __init__(self, mesh):
+        self.mesh = mesh
+
+
+_current_mesh = MeshContext(None)
+
+
+def make_mesh(axis_shapes: Dict[str, int], devices=None):
+    """Build a Mesh with named axes, e.g. {'dp': 4, 'mp': 2}."""
+    import jax
+    from jax.sharding import Mesh
+
+    devs = np.array(devices if devices is not None else jax.devices())
+    names = tuple(axis_shapes)
+    shape = tuple(axis_shapes[n] for n in names)
+    total = int(np.prod(shape))
+    if devs.size < total:
+        raise ValueError(f"need {total} devices for mesh {axis_shapes}, have {devs.size}")
+    return Mesh(devs[:total].reshape(shape), names)
+
+
+def get_mesh():
+    return _current_mesh.mesh
+
+
+@contextlib.contextmanager
+def mesh_guard(mesh):
+    global _current_mesh
+    prev = _current_mesh
+    _current_mesh = MeshContext(mesh)
+    try:
+        yield mesh
+    finally:
+        _current_mesh = prev

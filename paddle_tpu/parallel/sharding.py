@@ -1,0 +1,43 @@
+"""ZeRO-style sharded optimizer state (reference P3: BuildStrategy
+kReduce mode + c_reducescatter/c_allgather building blocks,
+multi_devices_graph_pass.cc:540 — each device owns a param shard's
+update and broadcasts the result).
+
+TPU-native: annotate optimizer accumulator vars (and optionally params)
+with a PartitionSpec over the dp axis; GSPMD then emits exactly the
+reduce-scatter(grad) -> sharded update -> all-gather(param) schedule
+that ZeRO does by hand. One function instead of a graph-rewrite pass.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+_ACCUM_MARKERS = (
+    "_moment1_", "_moment2_", "_velocity_", "_moment_", "_mean_square_",
+    "_mean_grad_", "_squared_", "_linear_", "__avg_squared",
+)
+
+
+def shard_optimizer_states(program, dp_size: int, axis: str = "dp",
+                           shard_params: bool = False):
+    """Annotate accumulators (ZeRO-1) and optionally params (ZeRO-3-ish
+    for memory; params re-gathered by XLA where used) with dim-0
+    sharding over `axis` when divisible."""
+    gb = program.global_block()
+    n_sharded = 0
+    for name, var in gb.vars.items():
+        if not getattr(var, "persistable", False) or not var.shape:
+            continue
+        is_accum = any(m in name for m in _ACCUM_MARKERS)
+        from ..core.framework import Parameter
+
+        is_param = isinstance(var, Parameter)
+        if not (is_accum or (shard_params and is_param)):
+            continue
+        if var.sharding is not None:
+            continue  # respect explicit (e.g. megatron) shardings
+        if len(var.shape) >= 1 and var.shape[0] and var.shape[0] % dp_size == 0 and var.shape[0] >= dp_size:
+            var.sharding = (axis,) + (None,) * (len(var.shape) - 1)
+            n_sharded += 1
+    return n_sharded

@@ -1,0 +1,60 @@
+"""Profiler: wraps jax.profiler with the reference's context-manager
+API and chrome-trace output.
+
+Reference: python/paddle/fluid/profiler.py (profiler context manager),
+platform/profiler.h RecordEvent, tools/timeline.py (chrome trace).
+jax.profiler natively emits xplane/perfetto traces viewable in
+chrome://tracing or TensorBoard — same workflow.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import tempfile
+import time
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key=None, profile_path="/tmp/profile"):
+    import jax
+
+    logdir = profile_path if os.path.isdir(profile_path) else tempfile.mkdtemp(prefix="pt_prof_")
+    jax.profiler.start_trace(logdir)
+    t0 = time.time()
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+        dt = time.time() - t0
+        print(f"[paddle_tpu.profiler] traced {dt:.3f}s -> {logdir} "
+              f"(open with tensorboard --logdir or perfetto)")
+
+
+@contextlib.contextmanager
+def record_event(name: str):
+    """RAII event annotation (reference platform/profiler.h:124
+    RecordEvent). Shows up as a named range in the XLA trace."""
+    import jax
+
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+def start_profiler(state="All"):
+    import jax
+
+    global _trace_dir
+    _trace_dir = tempfile.mkdtemp(prefix="pt_prof_")
+    jax.profiler.start_trace(_trace_dir)
+
+
+def stop_profiler(sorted_key=None, profile_path=None):
+    import jax
+
+    jax.profiler.stop_trace()
+    print(f"[paddle_tpu.profiler] trace in {_trace_dir}")
+
+
+def reset_profiler():
+    pass

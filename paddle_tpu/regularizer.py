@@ -1,0 +1,104 @@
+"""Weight-decay regularizers. Reference:
+python/paddle/fluid/regularizer.py — append_regularization_ops adds
+grad += coeff * penalty'(param) ops before the optimizer update."""
+
+from __future__ import annotations
+
+from .core.framework import OpRole
+
+
+class WeightDecayRegularizer:
+    def __call__(self, param, grad, block):
+        raise NotImplementedError
+
+
+class L2DecayRegularizer(WeightDecayRegularizer):
+    def __init__(self, regularization_coeff=0.0):
+        self._coeff = float(regularization_coeff)
+
+    def __call__(self, param, grad, block):
+        # decay = coeff * param ; grad = grad + decay
+        from .core.framework import unique_name
+
+        decay = block.create_var(
+            name=unique_name.generate(f"{param.name}.l2decay"), stop_gradient=True
+        )
+        block.append_op(
+            type="scale",
+            inputs={"X": [param]},
+            outputs={"Out": [decay]},
+            attrs={"scale": self._coeff, "op_role": OpRole.Backward},
+        )
+        new_grad = block.create_var(
+            name=unique_name.generate(f"{param.name}.grad_reg"), stop_gradient=True
+        )
+        block.append_op(
+            type="sum",
+            inputs={"X": [grad, decay]},
+            outputs={"Out": [new_grad]},
+            attrs={"op_role": OpRole.Backward},
+        )
+        return new_grad
+
+
+class L1DecayRegularizer(WeightDecayRegularizer):
+    def __init__(self, regularization_coeff=0.0):
+        self._coeff = float(regularization_coeff)
+
+    def __call__(self, param, grad, block):
+        from .core.framework import unique_name
+
+        sign = block.create_var(
+            name=unique_name.generate(f"{param.name}.sign"), stop_gradient=True
+        )
+        # sign(x) = x / (|x| + eps) avoids adding a dedicated sign op
+        absx = block.create_var(
+            name=unique_name.generate(f"{param.name}.abs"), stop_gradient=True
+        )
+        block.append_op(
+            type="abs", inputs={"X": [param]}, outputs={"Out": [absx]},
+            attrs={"op_role": OpRole.Backward},
+        )
+        shifted = block.create_var(
+            name=unique_name.generate(f"{param.name}.abs_eps"), stop_gradient=True
+        )
+        block.append_op(
+            type="scale", inputs={"X": [absx]}, outputs={"Out": [shifted]},
+            attrs={"scale": 1.0, "bias": 1e-12, "op_role": OpRole.Backward},
+        )
+        block.append_op(
+            type="elementwise_div", inputs={"X": [param], "Y": [shifted]},
+            outputs={"Out": [sign]}, attrs={"op_role": OpRole.Backward},
+        )
+        decay = block.create_var(
+            name=unique_name.generate(f"{param.name}.l1decay"), stop_gradient=True
+        )
+        block.append_op(
+            type="scale", inputs={"X": [sign]}, outputs={"Out": [decay]},
+            attrs={"scale": self._coeff, "op_role": OpRole.Backward},
+        )
+        new_grad = block.create_var(
+            name=unique_name.generate(f"{param.name}.grad_reg"), stop_gradient=True
+        )
+        block.append_op(
+            type="sum", inputs={"X": [grad, decay]}, outputs={"Out": [new_grad]},
+            attrs={"op_role": OpRole.Backward},
+        )
+        return new_grad
+
+
+# reference aliases
+L1Decay = L1DecayRegularizer
+L2Decay = L2DecayRegularizer
+
+
+def append_regularization_ops(params_grads, regularization=None):
+    out = []
+    for param, grad in params_grads:
+        reg = getattr(param, "regularizer", None) or regularization
+        if reg is None:
+            out.append((param, grad))
+            continue
+        block = param.block.program.global_block()
+        out.append((param, reg(param, grad, block)))
+    return out

@@ -1,0 +1,21 @@
+"""Test env: force CPU backend with 8 virtual devices so mesh/sharding
+tests run anywhere (reference TestDistBase spawns localhost subprocesses
+instead — see SURVEY.md §4.4)."""
+
+import os
+
+# NOTE: with the axon TPU plugin present, JAX_PLATFORMS alone is not
+# honored — set JAX_PLATFORM_NAME as well (verified experimentally).
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("JAX_PLATFORM_NAME", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# numeric tests compare against float64 numpy oracles; keep matmuls at
+# full precision here (TPU bench runs keep the fast bf16 default)
+import jax
+
+jax.config.update("jax_default_matmul_precision", "highest")

@@ -1,0 +1,132 @@
+"""OpTest harness: single-op program vs numpy oracle + numeric-gradient
+checks.
+
+Reference: python/paddle/fluid/tests/unittests/op_test.py:170 —
+check_output builds a one-op program and compares against declared
+numpy outputs; check_grad compares append_backward gradients against
+finite differences (get_numeric_gradient, op_test.py:57).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+class OpTest:
+    """Subclass sets: op_type, inputs, outputs, attrs (numpy dicts)."""
+
+    op_type: str = ""
+    inputs: Dict = {}
+    outputs: Dict = {}
+    attrs: Dict = {}
+
+    def _build(self):
+        main = fluid.Program()
+        startup = fluid.Program()
+        with fluid.program_guard(main, startup):
+            block = main.global_block()
+            in_vars = {}
+            feed = {}
+            for slot, val in self.inputs.items():
+                vals = val if isinstance(val, list) else [val]
+                vs = []
+                for i, v in enumerate(vals):
+                    arr = np.asarray(v)
+                    name = f"{slot}_{i}"
+                    var = block.create_var(
+                        name=name, shape=arr.shape, dtype=str(arr.dtype), is_data=True,
+                        stop_gradient=False,
+                    )
+                    feed[name] = arr
+                    vs.append(var)
+                in_vars[slot] = vs
+            out_vars = {}
+            for slot, val in self.outputs.items():
+                vals = val if isinstance(val, list) else [val]
+                vs = []
+                for i, _ in enumerate(vals):
+                    vs.append(block.create_var(name=f"{slot}_out_{i}", stop_gradient=False))
+                out_vars[slot] = vs
+            block.append_op(
+                type=self.op_type, inputs=in_vars, outputs=out_vars, attrs=dict(self.attrs)
+            )
+        return main, startup, feed, out_vars
+
+    def check_output(self, atol=1e-5, rtol=1e-5, no_check_set=()):
+        main, startup, feed, out_vars = self._build()
+        exe = fluid.Executor(fluid.CPUPlace())
+        fetch = []
+        expect = []
+        for slot, val in self.outputs.items():
+            if slot in no_check_set:
+                continue
+            vals = val if isinstance(val, list) else [val]
+            for var, exp in zip(out_vars[slot], vals):
+                fetch.append(var)
+                expect.append(np.asarray(exp))
+        got = exe.run(main, feed=feed, fetch_list=fetch)
+        for g, e, var in zip(got, expect, fetch):
+            np.testing.assert_allclose(
+                np.asarray(g, dtype=np.float64),
+                np.asarray(e, dtype=np.float64),
+                atol=atol,
+                rtol=rtol,
+                err_msg=f"{self.op_type} output {var.name} mismatch",
+            )
+
+    def check_grad(
+        self,
+        inputs_to_check,
+        output_name: str,
+        max_relative_error=5e-3,
+        delta=1e-3,
+        no_grad_set=None,
+    ):
+        """Compare analytic grad of mean(output) wrt inputs against
+        central finite differences."""
+        main, startup, feed, out_vars = self._build()
+        # choose the first var of the named output slot
+        out_var = out_vars[output_name][0]
+        with fluid.program_guard(main):
+            target = fluid.layers.mean(out_var)
+        grads = fluid.gradients(target, [
+            main.global_block().var(f"{slot}_0") for slot in inputs_to_check
+        ], no_grad_set=no_grad_set)
+        exe = fluid.Executor(fluid.CPUPlace())
+        analytic = exe.run(main, feed=feed, fetch_list=[g for g in grads if g is not None])
+
+        for slot, a_grad in zip(inputs_to_check, analytic):
+            base = np.asarray(self.inputs[slot] if not isinstance(self.inputs[slot], list) else self.inputs[slot][0]).astype(np.float64)
+            num = np.zeros_like(base)
+            it = np.nditer(base, flags=["multi_index"])
+            # numeric gradient of mean(out) wrt this input
+            eval_main, eval_startup, _, eval_outs = self._build()
+            with fluid.program_guard(eval_main):
+                eval_target = fluid.layers.mean(eval_outs[output_name][0])
+            eval_exe = fluid.Executor(fluid.CPUPlace())
+
+            def f(x):
+                fd = dict(feed)
+                fd[f"{slot}_0"] = x.astype(base.dtype if base.dtype != np.float64 else np.float32)
+                (v,) = eval_exe.run(eval_main, feed=fd, fetch_list=[eval_target])
+                return float(v)
+
+            while not it.finished:
+                idx = it.multi_index
+                xp = base.copy()
+                xp[idx] += delta
+                xm = base.copy()
+                xm[idx] -= delta
+                num[idx] = (f(xp) - f(xm)) / (2 * delta)
+                it.iternext()
+            a = np.asarray(a_grad, dtype=np.float64)
+            denom = np.maximum(np.maximum(np.abs(a), np.abs(num)), 1e-3)
+            rel = np.abs(a - num) / denom
+            assert rel.max() <= max_relative_error, (
+                f"{self.op_type} grad wrt {slot}: max rel err {rel.max():.4g} "
+                f"(analytic {a.flat[int(rel.argmax())]:.6g} vs numeric {num.flat[int(rel.argmax())]:.6g})"
+            )

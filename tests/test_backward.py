@@ -1,0 +1,95 @@
+"""append_backward edge cases (regression tests for review findings)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def test_partial_grad_multi_output_slot_alignment():
+    # split -> use only the LAST piece; grads of the unused pieces must
+    # be zero-filled positionally, not compacted
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [6])
+        x.stop_gradient = False
+        a, b, c = fluid.layers.split(x, 3, dim=1)
+        loss = fluid.layers.reduce_sum(fluid.layers.elementwise_mul(c, c))
+        (gx,) = fluid.gradients(loss, [x])
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = np.arange(12, dtype="float32").reshape(2, 6)
+    (g,) = exe.run(main, feed={"x": xv}, fetch_list=[gx])
+    expect = np.zeros_like(xv)
+    expect[:, 4:6] = 2 * xv[:, 4:6]
+    np.testing.assert_allclose(g, expect, rtol=1e-6)
+
+
+def test_partial_grad_middle_output():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [9])
+        x.stop_gradient = False
+        a, b, c = fluid.layers.split(x, 3, dim=1)
+        loss = fluid.layers.reduce_sum(b)
+        (gx,) = fluid.gradients(loss, [x])
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = np.ones((2, 9), dtype="float32")
+    (g,) = exe.run(main, feed={"x": xv}, fetch_list=[gx])
+    expect = np.zeros_like(xv)
+    expect[:, 3:6] = 1.0
+    np.testing.assert_allclose(g, expect, rtol=1e-6)
+
+
+def test_executor_cache_not_fooled_by_program_reuse():
+    # two different programs with identical feed/fetch signatures must
+    # not collide in the executor cache (uid keying)
+    exe = fluid.Executor(fluid.CPUPlace())
+    results = []
+    for scale in (2.0, 5.0):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", [3])
+            out = fluid.layers.scale(x, scale=scale)
+            # force identical fetch name across programs
+            out.name = "out_fixed"
+            main.global_block().vars["out_fixed"] = out
+            main.global_block().ops[-1].outputs["Out"] = ["out_fixed"]
+        (r,) = exe.run(main, feed={"x": np.ones((1, 3), "float32")}, fetch_list=["out_fixed"])
+        results.append(float(r[0][0]))
+    assert results == [2.0, 5.0], results
+
+
+def test_dygraph_getitem_keeps_grad():
+    import paddle_tpu.dygraph as dg
+
+    with fluid.core.dygraph.dygraph_guard():
+        x = dg.to_variable(np.arange(6, dtype="float32").reshape(2, 3))
+        x.stop_gradient = False
+        y = x[0]  # first row
+        from paddle_tpu.dygraph.base import _trace
+
+        s = _trace("reduce_sum", {"X": [y]}, ["Out"], {"reduce_all": True})[0]
+        s.backward()
+        expect = np.zeros((2, 3), "float32")
+        expect[0] = 1.0
+        np.testing.assert_allclose(x.gradient, expect)
+
+
+def test_lookahead_slow_init_equals_param():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4])
+        pred = fluid.layers.fc(x, 1, bias_attr=False)
+        loss = fluid.layers.mean(pred)
+        inner = fluid.optimizer.SGD(0.0)  # lr 0: params must not move
+        la = fluid.optimizer.LookaheadOptimizer(inner, alpha=0.5, k=1)
+        la.minimize(loss)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        wname = main.all_parameters()[0].name
+        w0 = scope.get_numpy(wname).copy()
+        exe.run(main, feed={"x": np.ones((2, 4), "float32")}, fetch_list=[loss])
+        w1 = scope.get_numpy(wname)
+        # with lr=0 and slow initialized to param, sync step is a no-op
+        np.testing.assert_allclose(w0, w1, atol=1e-6)

@@ -1,0 +1,152 @@
+"""Executor semantics: startup init, persistable state, program cache,
+grad accumulation, save/load (reference: executor + io unittests)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _fresh():
+    return fluid.Program(), fluid.Program()
+
+
+def test_startup_initializes_params():
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4])
+        y = fluid.layers.fc(x, 3)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        params = main.all_parameters()
+        assert len(params) == 2  # W + b
+        for p in params:
+            assert scope.find_var(p.name) is not None
+
+
+def test_persistable_state_updates():
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [2])
+        pred = fluid.layers.fc(x, 1, bias_attr=False)
+        loss = fluid.layers.mean(pred)
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        w_name = main.all_parameters()[0].name
+        w0 = scope.get_numpy(w_name).copy()
+        exe.run(main, feed={"x": np.ones((4, 2), "float32")}, fetch_list=[loss])
+        w1 = scope.get_numpy(w_name)
+        assert not np.allclose(w0, w1), "sgd did not update the param"
+
+
+def test_grad_accumulation_var_used_twice():
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [3])
+        x.stop_gradient = False
+        # y = x*x + x  -> dy/dx = 2x + 1 ; two consumers of x
+        y = fluid.layers.elementwise_add(
+            fluid.layers.elementwise_mul(x, x), x
+        )
+        loss = fluid.layers.reduce_sum(y)
+        (gx,) = fluid.gradients(loss, [x])
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = np.array([[1.0, 2.0, -3.0]], dtype="float32")
+    (g,) = exe.run(main, feed={"x": xv}, fetch_list=[gx])
+    np.testing.assert_allclose(g, 2 * xv + 1, rtol=1e-6)
+
+
+def test_program_cache_reuse_and_shape_switch():
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [2])
+        out = fluid.layers.fc(x, 2, bias_attr=False)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        r1 = exe.run(main, feed={"x": np.ones((3, 2), "float32")}, fetch_list=[out])
+        r2 = exe.run(main, feed={"x": np.ones((5, 2), "float32")}, fetch_list=[out])
+        assert r1[0].shape == (3, 2) and r2[0].shape == (5, 2)
+
+
+def test_fetch_without_feed_constant_program():
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        c = fluid.layers.fill_constant([2, 2], "float32", 3.0)
+        d = fluid.layers.scale(c, scale=2.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    (r,) = exe.run(main, fetch_list=[d])
+    np.testing.assert_allclose(r, np.full((2, 2), 6.0))
+
+
+def test_save_load_persistables(tmp_path):
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [2])
+        out = fluid.layers.fc(x, 2)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        wname = main.all_parameters()[0].name
+        w0 = scope.get_numpy(wname).copy()
+        fluid.io.save_persistables(exe, str(tmp_path), main)
+        # clobber, then restore
+        import jax.numpy as jnp
+
+        scope.set_var(wname, jnp.zeros_like(scope.find_var(wname)))
+        fluid.io.load_persistables(exe, str(tmp_path), main)
+        np.testing.assert_allclose(scope.get_numpy(wname), w0)
+
+
+def test_save_load_inference_model(tmp_path):
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4])
+        hidden = fluid.layers.fc(x, 8, act="relu")
+        out = fluid.layers.fc(hidden, 2, act="softmax")
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        xv = np.random.RandomState(0).randn(3, 4).astype("float32")
+        (ref,) = exe.run(main, feed={"x": xv}, fetch_list=[out])
+        fluid.io.save_inference_model(str(tmp_path), ["x"], [out], exe, main)
+        prog2, feed_names, fetch_vars = fluid.io.load_inference_model(str(tmp_path), exe)
+        (got,) = exe.run(prog2, feed={feed_names[0]: xv}, fetch_list=fetch_vars)
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_dropout_rng_varies_between_runs_and_replays_in_grad():
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [1000])
+        x.stop_gradient = False
+        y = fluid.layers.dropout(x, 0.5, dropout_implementation="upscale_in_train")
+        loss = fluid.layers.reduce_sum(y)
+        (gx,) = fluid.gradients(loss, [x])
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = np.ones((2, 1000), "float32")
+    y1, g1 = exe.run(main, feed={"x": xv}, fetch_list=[y, gx])
+    y2, _ = exe.run(main, feed={"x": xv}, fetch_list=[y, gx])
+    assert not np.allclose(y1, y2), "dropout mask must differ between steps"
+    # grad mask must equal forward mask (replay through op_ident keying)
+    np.testing.assert_allclose((y1 != 0), (g1 != 0))
+
+
+def test_clone_for_test_disables_dropout():
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [10])
+        y = fluid.layers.dropout(x, 0.9, dropout_implementation="upscale_in_train")
+    test_prog = main.clone(for_test=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = np.ones((4, 10), "float32")
+    (yt,) = exe.run(test_prog, feed={"x": xv}, fetch_list=[y])
+    np.testing.assert_allclose(yt, xv)

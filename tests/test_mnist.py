@@ -1,0 +1,68 @@
+"""Milestone 1 (BASELINE config 1): LeNet-style convnet trained via the
+Executor API converges on a synthetic 10-class image task.
+
+Reference: python/paddle/fluid/tests/book/test_recognize_digits.py —
+small real model trained for a few iterations to a loss threshold.
+Synthetic data (class-dependent patterns + noise) replaces the MNIST
+download (no network in CI).
+"""
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def make_batch(rng, batch=64, n_cls=10):
+    label = rng.randint(0, n_cls, (batch, 1)).astype("int64")
+    # each class lights a distinct 7x7 quadrant pattern
+    base = np.zeros((batch, 1, 28, 28), dtype="float32")
+    for i, l in enumerate(label.reshape(-1)):
+        r, c = divmod(int(l), 4)
+        base[i, 0, r * 7 : r * 7 + 7, c * 7 : c * 7 + 7] = 1.0
+    img = base + rng.randn(batch, 1, 28, 28).astype("float32") * 0.15
+    return img, label
+
+
+def lenet(img, label):
+    conv1 = fluid.nets.simple_img_conv_pool(
+        input=img, filter_size=5, num_filters=6, pool_size=2, pool_stride=2,
+        conv_padding=2, act="relu",
+    )
+    conv2 = fluid.nets.simple_img_conv_pool(
+        input=conv1, filter_size=5, num_filters=16, pool_size=2, pool_stride=2,
+        act="relu",
+    )
+    fc1 = fluid.layers.fc(conv2, 120, act="relu")
+    fc2 = fluid.layers.fc(fc1, 84, act="relu")
+    logits = fluid.layers.fc(fc2, 10)
+    loss = fluid.layers.mean(
+        fluid.layers.softmax_with_cross_entropy(logits, label)
+    )
+    acc = fluid.layers.accuracy(fluid.layers.softmax(logits), label)
+    return loss, acc
+
+
+def test_mnist_lenet_converges():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", [1, 28, 28])
+        label = fluid.layers.data("label", [1], dtype="int64")
+        loss, acc = lenet(img, label)
+        fluid.optimizer.Adam(1e-3).minimize(loss)
+
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        first_loss = None
+        for step in range(60):
+            img_v, lbl_v = make_batch(rng)
+            l, a = exe.run(
+                main, feed={"img": img_v, "label": lbl_v}, fetch_list=[loss, acc]
+            )
+            if first_loss is None:
+                first_loss = float(l)
+        final_loss, final_acc = float(l), float(a)
+    assert final_loss < first_loss * 0.2, (first_loss, final_loss)
+    assert final_acc > 0.9, final_acc

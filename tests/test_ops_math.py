@@ -1,0 +1,202 @@
+"""Per-op tests vs numpy oracle (reference tests/unittests/test_*_op.py
+pattern)."""
+
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+
+rng = np.random.RandomState(42)
+
+
+class TestElementwiseAdd(OpTest):
+    op_type = "elementwise_add"
+
+    def setup_method(self, _):
+        x = rng.randn(3, 4).astype("float32")
+        y = rng.randn(3, 4).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x + y}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestElementwiseAddBroadcastAxis(OpTest):
+    op_type = "elementwise_add"
+
+    def setup_method(self, _):
+        x = rng.randn(2, 3, 4).astype("float32")
+        y = rng.randn(3).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"axis": 1}
+        self.outputs = {"Out": x + y.reshape(1, 3, 1)}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestMatmul(OpTest):
+    op_type = "matmul"
+
+    def setup_method(self, _):
+        x = rng.randn(4, 5).astype("float32")
+        y = rng.randn(5, 3).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x @ y}
+
+    def test_output(self):
+        self.check_output(atol=1e-4, rtol=1e-4)
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"], "Out", max_relative_error=1e-2)
+
+
+class TestMatmulTranspose(OpTest):
+    op_type = "matmul"
+
+    def setup_method(self, _):
+        x = rng.randn(5, 4).astype("float32")
+        y = rng.randn(3, 5).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"transpose_X": True, "transpose_Y": True}
+        self.outputs = {"Out": x.T @ y.T}
+
+    def test_output(self):
+        self.check_output(atol=1e-4, rtol=1e-4)
+
+
+class TestMul(OpTest):
+    op_type = "mul"
+
+    def setup_method(self, _):
+        x = rng.randn(2, 3, 4).astype("float32")
+        y = rng.randn(12, 5).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"x_num_col_dims": 1}
+        self.outputs = {"Out": x.reshape(2, 12) @ y}
+
+    def test_output(self):
+        self.check_output(atol=1e-4, rtol=1e-4)
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"], "Out", max_relative_error=1e-2)
+
+
+class TestReduceSum(OpTest):
+    op_type = "reduce_sum"
+
+    def setup_method(self, _):
+        x = rng.randn(3, 4, 5).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"dim": [1]}
+        self.outputs = {"Out": x.sum(axis=1)}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestReduceMeanAll(OpTest):
+    op_type = "reduce_mean"
+
+    def setup_method(self, _):
+        x = rng.randn(3, 4).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"reduce_all": True}
+        self.outputs = {"Out": np.asarray(x.mean())}
+
+    def test_output(self):
+        self.check_output()
+
+
+@pytest.mark.parametrize(
+    "op,fn",
+    [
+        ("relu", lambda x: np.maximum(x, 0)),
+        ("sigmoid", lambda x: 1 / (1 + np.exp(-x))),
+        ("tanh", np.tanh),
+        ("exp", np.exp),
+        ("square", np.square),
+        ("abs", np.abs),
+        ("softplus", lambda x: np.log1p(np.exp(x))),
+    ],
+)
+def test_activation_output(op, fn):
+    t = OpTest()
+    t.op_type = op
+    x = rng.randn(3, 7).astype("float32")
+    t.inputs = {"X": x}
+    t.outputs = {"Out": fn(x.astype(np.float64)).astype(np.float32)}
+    t.check_output(atol=1e-5, rtol=1e-4)
+
+
+@pytest.mark.parametrize("op", ["relu", "sigmoid", "tanh", "exp", "square"])
+def test_activation_grad(op):
+    t = OpTest()
+    t.op_type = op
+    x = (rng.randn(3, 5).astype("float32") + np.where(rng.randn(3, 5) > 0, 0.3, -0.3).astype("float32"))
+    t.inputs = {"X": x}
+    t.outputs = {"Out": x}  # unused by check_grad
+    t.check_grad(["X"], "Out", max_relative_error=1e-2)
+
+
+class TestSoftmax(OpTest):
+    op_type = "softmax"
+
+    def setup_method(self, _):
+        x = rng.randn(4, 7).astype("float32")
+        e = np.exp(x - x.max(-1, keepdims=True))
+        self.inputs = {"X": x}
+        self.outputs = {"Out": e / e.sum(-1, keepdims=True)}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+    def test_grad(self):
+        # fp32 finite differences on softmax outputs are noisy
+        self.check_grad(["X"], "Out", max_relative_error=5e-2)
+
+
+class TestScale(OpTest):
+    op_type = "scale"
+
+    def setup_method(self, _):
+        x = rng.randn(3, 4).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"scale": 2.5, "bias": 0.5}
+        self.outputs = {"Out": 2.5 * x + 0.5}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestSum(OpTest):
+    op_type = "sum"
+
+    def setup_method(self, _):
+        xs = [rng.randn(3, 4).astype("float32") for _ in range(3)]
+        self.inputs = {"X": xs}
+        self.outputs = {"Out": xs[0] + xs[1] + xs[2]}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestCast(OpTest):
+    op_type = "cast"
+
+    def setup_method(self, _):
+        x = rng.randn(3, 4).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"out_dtype": "int32"}
+        self.outputs = {"Out": x.astype("int32")}
+
+    def test_output(self):
+        self.check_output()

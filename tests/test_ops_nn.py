@@ -1,0 +1,188 @@
+"""NN op tests vs numpy oracles."""
+
+import numpy as np
+
+from op_test import OpTest
+
+rng = np.random.RandomState(7)
+
+
+def _np_conv2d(x, w, stride, pad):
+    n, c, h, ww = x.shape
+    oc, ic, kh, kw = w.shape
+    xp = np.pad(x, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    oh = (h + 2 * pad - kh) // stride + 1
+    ow = (ww + 2 * pad - kw) // stride + 1
+    out = np.zeros((n, oc, oh, ow), dtype=np.float64)
+    for i in range(oh):
+        for j in range(ow):
+            patch = xp[:, :, i * stride : i * stride + kh, j * stride : j * stride + kw]
+            out[:, :, i, j] = np.einsum("nchw,ochw->no", patch, w)
+    return out
+
+
+class TestConv2d(OpTest):
+    op_type = "conv2d"
+
+    def setup_method(self, _):
+        x = rng.randn(2, 3, 8, 8).astype("float32")
+        w = rng.randn(4, 3, 3, 3).astype("float32")
+        self.inputs = {"Input": x, "Filter": w}
+        self.attrs = {"strides": [1, 1], "paddings": [1, 1]}
+        self.outputs = {
+            "Output": _np_conv2d(x.astype(np.float64), w.astype(np.float64), 1, 1).astype(
+                "float32"
+            )
+        }
+
+    def test_output(self):
+        self.check_output(atol=1e-3, rtol=1e-3)
+
+
+class TestConv2dGrad(OpTest):
+    op_type = "conv2d"
+
+    def setup_method(self, _):
+        # small shapes: numeric grad is O(numel) executor runs
+        x = rng.randn(1, 2, 5, 5).astype("float32")
+        w = rng.randn(2, 2, 3, 3).astype("float32")
+        self.inputs = {"Input": x, "Filter": w}
+        self.attrs = {"strides": [1, 1], "paddings": [1, 1]}
+        self.outputs = {"Output": np.zeros((1, 2, 5, 5), "float32")}
+
+    def test_grad(self):
+        self.check_grad(["Input", "Filter"], "Output", max_relative_error=2e-2, delta=1e-2)
+
+
+class TestPool2dMax(OpTest):
+    op_type = "pool2d"
+
+    def setup_method(self, _):
+        x = rng.randn(2, 3, 4, 4).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"pooling_type": "max", "ksize": [2, 2], "strides": [2, 2]}
+        out = x.reshape(2, 3, 2, 2, 2, 2).max(axis=(3, 5))
+        self.outputs = {"Out": out}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestPool2dAvg(OpTest):
+    op_type = "pool2d"
+
+    def setup_method(self, _):
+        x = rng.randn(2, 3, 4, 4).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"pooling_type": "avg", "ksize": [2, 2], "strides": [2, 2]}
+        out = x.reshape(2, 3, 2, 2, 2, 2).mean(axis=(3, 5))
+        self.outputs = {"Out": out}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+
+class TestLayerNorm(OpTest):
+    op_type = "layer_norm"
+
+    def setup_method(self, _):
+        x = rng.randn(4, 10).astype("float32")
+        scale = rng.rand(10).astype("float32") + 0.5
+        bias = rng.randn(10).astype("float32")
+        mean = x.mean(-1, keepdims=True)
+        var = x.var(-1, keepdims=True)
+        y = (x - mean) / np.sqrt(var + 1e-5) * scale + bias
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias}
+        self.attrs = {"begin_norm_axis": 1, "epsilon": 1e-5}
+        self.outputs = {
+            "Y": y,
+            "Mean": mean.reshape(4),
+            "Variance": var.reshape(4),
+        }
+
+    def test_output(self):
+        self.check_output(atol=1e-4, rtol=1e-4)
+
+    def test_grad(self):
+        # shrink for finite differences
+        x = rng.randn(3, 6).astype("float32")
+        scale = rng.rand(6).astype("float32") + 0.5
+        bias = rng.randn(6).astype("float32")
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias}
+        self.outputs = {"Y": x, "Mean": 0, "Variance": 0}
+        self.check_grad(["X", "Scale", "Bias"], "Y", max_relative_error=2e-2, delta=1e-2)
+
+
+class TestSoftmaxWithCrossEntropy(OpTest):
+    op_type = "softmax_with_cross_entropy"
+
+    def setup_method(self, _):
+        logits = rng.randn(5, 7).astype("float32")
+        label = rng.randint(0, 7, (5, 1)).astype("int64")
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        sm = e / e.sum(-1, keepdims=True)
+        loss = -np.log(sm[np.arange(5), label.reshape(-1)]).reshape(5, 1)
+        self.inputs = {"Logits": logits, "Label": label}
+        self.outputs = {"Softmax": sm, "Loss": loss}
+
+    def test_output(self):
+        self.check_output(atol=1e-5, rtol=1e-4)
+
+    def test_grad(self):
+        # float32 forward evals make the finite difference noisy on a
+        # log-softmax loss; 5% relative tolerance (reference uses
+        # per-op thresholds via op_threshold_white_list.py similarly)
+        self.check_grad(["Logits"], "Loss", max_relative_error=5e-2)
+
+
+class TestBatchNormInfer(OpTest):
+    op_type = "batch_norm"
+
+    def setup_method(self, _):
+        x = rng.randn(2, 3, 4, 4).astype("float32")
+        scale = rng.rand(3).astype("float32") + 0.5
+        bias = rng.randn(3).astype("float32")
+        mean = rng.randn(3).astype("float32") * 0.1
+        var = rng.rand(3).astype("float32") + 0.5
+        y = (x - mean.reshape(1, 3, 1, 1)) / np.sqrt(
+            var.reshape(1, 3, 1, 1) + 1e-5
+        ) * scale.reshape(1, 3, 1, 1) + bias.reshape(1, 3, 1, 1)
+        self.inputs = {
+            "X": x, "Scale": scale, "Bias": bias, "Mean": mean, "Variance": var,
+        }
+        self.attrs = {"is_test": True, "epsilon": 1e-5}
+        self.outputs = {"Y": y}
+
+    def test_output(self):
+        # only Y checked; state outputs pass through in test mode
+        main_outputs = dict(self.outputs)
+        self.outputs = {"Y": main_outputs["Y"], "MeanOut": 0, "VarianceOut": 0,
+                        "SavedMean": 0, "SavedVariance": 0}
+        self.check_output(atol=1e-4, rtol=1e-4,
+                          no_check_set=("MeanOut", "VarianceOut", "SavedMean", "SavedVariance"))
+
+
+class TestDropoutTestMode(OpTest):
+    op_type = "dropout"
+
+    def setup_method(self, _):
+        x = rng.randn(4, 6).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"dropout_prob": 0.3, "is_test": True}
+        self.outputs = {"Out": x * 0.7, "Mask": 0}
+
+    def test_output(self):
+        self.check_output(no_check_set=("Mask",))
+
+
+class TestLookupTable(OpTest):
+    op_type = "lookup_table_v2"
+
+    def setup_method(self, _):
+        w = rng.randn(10, 4).astype("float32")
+        ids = rng.randint(0, 10, (5,)).astype("int64")
+        self.inputs = {"W": w, "Ids": ids}
+        self.outputs = {"Out": w[ids]}
+
+    def test_output(self):
+        self.check_output()
