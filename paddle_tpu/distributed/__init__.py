@@ -1,0 +1,7 @@
+"""Multi-process launchers + distributed utilities.
+
+Reference: python/paddle/distributed/ (launch.py:175,353 multi-proc GPU
+launcher; launch_ps.py pserver launcher).
+"""
+
+from ..parallel.env import ParallelEnv, get_rank, get_world_size, init_parallel_env

@@ -1,0 +1,94 @@
+"""Multi-process trainer launcher.
+
+Reference: python/paddle/distributed/launch.py:175 (proc per selected
+GPU, env contract :105-110 PADDLE_TRAINER_ID / PADDLE_TRAINER_ENDPOINTS
+/ PADDLE_CURRENT_ENDPOINT, log redirect, kill-all-on-failure :169).
+
+TPU-native: one process per HOST (not per chip — a jax process drives
+all its local chips), env contract preserved, rendezvous through
+jax.distributed's coordination service at the rank-0 endpoint.
+
+Usage: python -m paddle_tpu.distributed.launch --nproc_per_node=2 \
+           train.py --args...
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+
+def _parse_args():
+    p = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    p.add_argument("--cluster_node_ips", default="127.0.0.1")
+    p.add_argument("--node_ip", default="127.0.0.1")
+    p.add_argument("--started_port", type=int, default=6170)
+    p.add_argument("--nproc_per_node", type=int, default=1)
+    p.add_argument("--log_dir", default=None)
+    p.add_argument("training_script")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args()
+
+
+def launch(args):
+    node_ips = args.cluster_node_ips.split(",")
+    node_id = node_ips.index(args.node_ip)
+    nproc = args.nproc_per_node
+    world = len(node_ips) * nproc
+    endpoints = [
+        f"{ip}:{args.started_port + i}" for ip in node_ips for i in range(nproc)
+    ]
+    procs = []
+    log_fds = []
+    if args.log_dir:
+        os.makedirs(args.log_dir, exist_ok=True)
+    for local_rank in range(nproc):
+        rank = node_id * nproc + local_rank
+        env = dict(os.environ)
+        env.update(
+            {
+                "PADDLE_TRAINER_ID": str(rank),
+                "PADDLE_TRAINERS_NUM": str(world),
+                "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+                "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
+                "FLAGS_selected_tpus": str(local_rank),
+            }
+        )
+        cmd = [sys.executable, "-u", args.training_script] + args.training_script_args
+        if args.log_dir:
+            fd = open(os.path.join(args.log_dir, f"workerlog.{local_rank}"), "w")
+            log_fds.append(fd)
+            proc = subprocess.Popen(cmd, env=env, stdout=fd, stderr=fd)
+        else:
+            proc = subprocess.Popen(cmd, env=env)
+        procs.append(proc)
+
+    # reference launch.py:169/:342 — if any proc dies, kill the job
+    try:
+        alive = True
+        while alive:
+            alive = False
+            for proc in procs:
+                ret = proc.poll()
+                if ret is None:
+                    alive = True
+                elif ret != 0:
+                    sys.stderr.write(
+                        f"[launch] a worker exited with code {ret}; terminating job\n"
+                    )
+                    for p2 in procs:
+                        if p2.poll() is None:
+                            p2.send_signal(signal.SIGTERM)
+                    sys.exit(ret)
+            time.sleep(1)
+    finally:
+        for fd in log_fds:
+            fd.close()
+
+
+if __name__ == "__main__":
+    launch(_parse_args())

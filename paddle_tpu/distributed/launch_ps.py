@@ -1,0 +1,73 @@
+"""Parameter-server job launcher.
+
+Reference: python/paddle/distributed/launch_ps.py — spawns pserver
+procs + trainer procs on one node with the PADDLE_* PS env contract
+(PADDLE_PSERVERS_IP_PORT_LIST, TRAINING_ROLE, PADDLE_TRAINER_ID).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+
+def _parse_args():
+    p = argparse.ArgumentParser("paddle_tpu.distributed.launch_ps")
+    p.add_argument("--worker_num", type=int, default=2)
+    p.add_argument("--server_num", type=int, default=2)
+    p.add_argument("--started_port", type=int, default=6180)
+    p.add_argument("--log_dir", default=None)
+    p.add_argument("training_script")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args()
+
+
+def launch_ps(args):
+    server_eps = [f"127.0.0.1:{args.started_port + i}" for i in range(args.server_num)]
+    procs = []
+    if args.log_dir:
+        os.makedirs(args.log_dir, exist_ok=True)
+
+    def spawn(role, idx):
+        env = dict(os.environ)
+        env.update(
+            {
+                "PADDLE_PSERVERS_IP_PORT_LIST": ",".join(server_eps),
+                "PADDLE_TRAINERS_NUM": str(args.worker_num),
+                "TRAINING_ROLE": role,
+            }
+        )
+        if role == "PSERVER":
+            env["PADDLE_CURRENT_ENDPOINT"] = server_eps[idx]
+        else:
+            env["PADDLE_TRAINER_ID"] = str(idx)
+        cmd = [sys.executable, "-u", args.training_script] + args.training_script_args
+        if args.log_dir:
+            fd = open(os.path.join(args.log_dir, f"{role.lower()}.{idx}.log"), "w")
+            return subprocess.Popen(cmd, env=env, stdout=fd, stderr=fd)
+        return subprocess.Popen(cmd, env=env)
+
+    for i in range(args.server_num):
+        procs.append(spawn("PSERVER", i))
+    for i in range(args.worker_num):
+        procs.append(spawn("TRAINER", i))
+
+    trainer_procs = procs[args.server_num :]
+    try:
+        while any(p.poll() is None for p in trainer_procs):
+            for p in trainer_procs:
+                if p.poll() not in (None, 0):
+                    raise SystemExit(p.returncode)
+            time.sleep(1)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+
+
+if __name__ == "__main__":
+    launch_ps(_parse_args())

@@ -1,0 +1,23 @@
+"""Inference engine.
+
+Reference: paddle/fluid/inference/ (~27k LoC) — AnalysisPredictor
+(api/analysis_predictor.cc): load model, run an IR pass pipeline
+(fusion, memory optimize), execute with zero-copy tensors, clone per
+thread; subgraph engines (TensorRT/nGraph/Lite) compile supported
+clusters into single engine ops.
+
+TPU-native: the analysis pass pipeline IS XLA — the whole pruned
+inference program compiles to one executable (the nGraph-engine-op
+pattern generalized to the full graph, which SURVEY.md §7 calls out as
+the in-repo precedent). AOT compilation via jax.jit(...).lower(...)
+.compile() gives the reference's "analysis" ahead-of-time step.
+"""
+
+from .predictor import (
+    AnalysisConfig,
+    Config,
+    PaddlePredictor,
+    Predictor,
+    create_paddle_predictor,
+    create_predictor,
+)

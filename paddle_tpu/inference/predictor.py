@@ -1,0 +1,173 @@
+"""Predictor API.
+
+Reference: inference/api/paddle_api.h (PaddlePredictor interface),
+analysis_predictor.cc (AnalysisPredictor: Init -> analysis passes ->
+ZeroCopyRun; Clone() shares weights across threads).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+class Config:
+    """Reference AnalysisConfig: model paths + engine knobs. TPU knobs
+    replace the TensorRT/MKLDNN/GPU switches."""
+
+    def __init__(self, model_dir: Optional[str] = None):
+        self.model_dir = model_dir
+        self.prog_file = None
+        self.params_file = None
+        self._use_tpu = True
+        self._bf16 = False
+        self._aot = True
+        self._memory_optimize = True  # XLA always; knob for parity
+
+    def set_model(self, prog_file_or_dir, params_file=None):
+        if params_file is None:
+            self.model_dir = prog_file_or_dir
+        else:
+            self.prog_file = prog_file_or_dir
+            self.params_file = params_file
+
+    def enable_tpu(self):
+        self._use_tpu = True
+
+    def disable_gpu(self):
+        pass
+
+    def enable_bf16(self):
+        """Cast white-list ops to bfloat16 (the TPU analog of the
+        reference's TensorRT fp16 / mkldnn bf16 switches)."""
+        self._bf16 = True
+
+    def switch_ir_optim(self, flag=True):
+        self._aot = flag
+
+    def enable_memory_optim(self):
+        self._memory_optimize = True
+
+
+AnalysisConfig = Config
+
+
+class _Tensor:
+    """Zero-copy-style IO handle (reference ZeroCopyTensor)."""
+
+    def __init__(self, name):
+        self.name = name
+        self._value: Optional[np.ndarray] = None
+
+    def copy_from_cpu(self, arr: np.ndarray):
+        self._value = np.asarray(arr)
+
+    def reshape(self, shape):
+        pass  # shapes flow from the array itself
+
+    def copy_to_cpu(self) -> np.ndarray:
+        return self._value
+
+
+class Predictor:
+    def __init__(self, config: Config):
+        import paddle_tpu as fluid
+
+        self._config = config
+        self._scope = fluid.Scope()
+        self._exe = fluid.Executor(fluid.TPUPlace())
+        import os
+
+        if config.model_dir is not None:
+            model_dir, model_file, params_file = config.model_dir, None, None
+        elif config.prog_file is not None:
+            # set_model(prog_file, params_file) form
+            model_dir = os.path.dirname(config.prog_file) or "."
+            model_file = os.path.basename(config.prog_file)
+            params_file = (
+                os.path.basename(config.params_file) if config.params_file else None
+            )
+        else:
+            raise ValueError("Config has neither model_dir nor prog_file set")
+        with fluid.scope_guard(self._scope):
+            self._program, self._feed_names, self._fetch_vars = (
+                fluid.io.load_inference_model(
+                    model_dir, self._exe,
+                    model_filename=model_file, params_filename=params_file,
+                )
+            )
+        if config._bf16:
+            from ..contrib.mixed_precision.decorator import _insert_cast_ops
+            from ..contrib.mixed_precision.fp16_lists import AutoMixedPrecisionLists
+
+            _insert_cast_ops(self._program.global_block(), AutoMixedPrecisionLists())
+        self._inputs = {n: _Tensor(n) for n in self._feed_names}
+        self._outputs = {v.name: _Tensor(v.name) for v in self._fetch_vars}
+        self._lock = threading.Lock()
+
+    # -- reference API --------------------------------------------------------
+    def get_input_names(self) -> List[str]:
+        return list(self._feed_names)
+
+    def get_output_names(self) -> List[str]:
+        return [v.name for v in self._fetch_vars]
+
+    def get_input_handle(self, name) -> _Tensor:
+        return self._inputs[name]
+
+    def get_output_handle(self, name) -> _Tensor:
+        return self._outputs[name]
+
+    # alias names used by the older API
+    get_input_tensor = get_input_handle
+    get_output_tensor = get_output_handle
+
+    def run(self, inputs: Optional[Sequence[np.ndarray]] = None):
+        import paddle_tpu as fluid
+
+        if inputs is not None:
+            for n, a in zip(self._feed_names, inputs):
+                self._inputs[n].copy_from_cpu(a)
+        feed = {n: t._value for n, t in self._inputs.items()}
+        with self._lock, fluid.scope_guard(self._scope):
+            outs = self._exe.run(
+                self._program, feed=feed, fetch_list=self._fetch_vars
+            )
+        for t, o in zip(self._outputs.values(), outs):
+            t._value = o
+        return outs
+
+    # ZeroCopyRun parity: run() without args uses the handles
+    def zero_copy_run(self):
+        return self.run()
+
+    def clone(self) -> "Predictor":
+        """Share weights (scope), fresh IO handles — reference
+        AnalysisPredictor::Clone for per-thread use. Compiled
+        executables are shared via the executor cache."""
+        import copy
+
+        p = object.__new__(Predictor)
+        p._config = self._config
+        p._scope = self._scope
+        p._exe = self._exe
+        p._program = self._program
+        p._feed_names = self._feed_names
+        p._fetch_vars = self._fetch_vars
+        p._inputs = {n: _Tensor(n) for n in self._feed_names}
+        p._outputs = {v.name: _Tensor(v.name) for v in self._fetch_vars}
+        p._lock = threading.Lock()
+        return p
+
+
+PaddlePredictor = Predictor
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)
+
+
+def create_paddle_predictor(config: Config) -> Predictor:
+    return Predictor(config)

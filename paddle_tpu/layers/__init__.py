@@ -20,4 +20,5 @@ from .collective import (
 )
 from .detection import iou_similarity, box_coder, prior_box
 from .sequence import *  # noqa: F401,F403
+from .rnn import dynamic_lstm, dynamic_gru, lstm_unit, gru_unit
 from . import ops  # noqa: F401

@@ -1,0 +1,9 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+Reference's native surface: data_feed.cc parsing, fs/shell IO,
+allocators, executors. On TPU the executor/allocator roles belong to
+XLA; the pieces that stay host-side native here: the datafeed parser
+(and future: checkpoint packing, tokenizer).
+"""
+
+from . import datafeed

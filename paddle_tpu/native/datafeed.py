@@ -1,0 +1,99 @@
+"""ctypes binding for the native MultiSlot parser (datafeed.cpp).
+
+Builds the shared library on first use with g++ (no pybind11 in the
+image; plain C ABI + ctypes). Falls back cleanly when no compiler.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Iterator, List, Optional
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_HERE, "build", "libptfeed.so")
+_SRC = os.path.join(_HERE, "datafeed.cpp")
+
+_lib = None
+_lock = threading.Lock()
+_build_failed = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _build_failed
+    if _lib is not None or _build_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        try:
+            if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+                os.makedirs(os.path.dirname(_SO), exist_ok=True)
+                subprocess.run(
+                    ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-o", _SO, _SRC],
+                    check=True,
+                    capture_output=True,
+                )
+            lib = ctypes.CDLL(_SO)
+            lib.pt_parse_file.restype = ctypes.c_void_p
+            lib.pt_parse_file.argtypes = [
+                ctypes.c_char_p, ctypes.c_int, ctypes.POINTER(ctypes.c_ubyte),
+            ]
+            lib.pt_samples.restype = ctypes.c_int64
+            lib.pt_samples.argtypes = [ctypes.c_void_p]
+            lib.pt_slot_total.restype = ctypes.c_int64
+            lib.pt_slot_total.argtypes = [ctypes.c_void_p, ctypes.c_int]
+            lib.pt_slot_lengths.argtypes = [
+                ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int64),
+            ]
+            lib.pt_slot_values_f.argtypes = [
+                ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_float),
+            ]
+            lib.pt_slot_values_i.argtypes = [
+                ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int64),
+            ]
+            lib.pt_release.argtypes = [ctypes.c_void_p]
+            _lib = lib
+        except Exception:
+            _build_failed = True
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def parse_file(path: str, num_slots: int, dtypes: List[str]) -> Iterator[List[np.ndarray]]:
+    """Parse a MultiSlot file natively; yield per-sample slot arrays."""
+    lib = _load()
+    assert lib is not None
+    is_float = (ctypes.c_ubyte * num_slots)(
+        *[1 if "float" in dt else 0 for dt in dtypes]
+    )
+    h = lib.pt_parse_file(path.encode(), num_slots, is_float)
+    if not h:
+        raise IOError(f"native datafeed failed to open {path}")
+    try:
+        n = lib.pt_samples(h)
+        slots = []
+        for s in range(num_slots):
+            total = lib.pt_slot_total(h, s)
+            lengths = np.empty(n, np.int64)
+            lib.pt_slot_lengths(h, s, lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+            if is_float[s]:
+                vals = np.empty(total, np.float32)
+                lib.pt_slot_values_f(h, s, vals.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+            else:
+                vals = np.empty(total, np.int64)
+                lib.pt_slot_values_i(h, s, vals.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+            offsets = np.zeros(n + 1, np.int64)
+            np.cumsum(lengths, out=offsets[1:])
+            slots.append((offsets, vals))
+        for i in range(n):
+            yield [vals[offs[i] : offs[i + 1]] for offs, vals in slots]
+    finally:
+        lib.pt_release(h)

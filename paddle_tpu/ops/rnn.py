@@ -1,0 +1,158 @@
+"""Recurrent ops via lax.scan.
+
+Reference: operators/gru_op.cc / lstm_op.cc / cudnn_lstm_op.cu.cc and
+the dynamic-RNN machinery (recurrent_op.cc over LoD sequences). The
+reference runs ragged LoD batches through per-timestep kernels; the
+TPU-native form is a dense padded [batch, time, d] lax.scan (mask from
+an optional Length input), which XLA unrolls into a single fused loop
+— and differentiates, so no hand-written grad kernels
+(lstm_grad_op etc.) are needed.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+@register_op(
+    "fused_lstm",
+    inputs=("X", "WeightX", "WeightH", "Bias", "H0", "C0", "Length"),
+    outputs=("Hidden", "Cell", "LastH", "LastC"),
+    no_grad=("Length",),
+)
+def _fused_lstm(ctx, op, ins):
+    x = ins["X"][0]  # [B, T, D]
+    wx = ins["WeightX"][0]  # [D, 4H]
+    wh = ins["WeightH"][0]  # [H, 4H]
+    bias = ins["Bias"][0] if ins.get("Bias") else None  # [4H]
+    B, T, D = x.shape
+    H = wh.shape[0]
+    h0 = ins["H0"][0] if ins.get("H0") else jnp.zeros((B, H), x.dtype)
+    c0 = ins["C0"][0] if ins.get("C0") else jnp.zeros((B, H), x.dtype)
+    ln = ins["Length"][0] if ins.get("Length") else None
+    is_reverse = bool(op.attrs.get("is_reverse", False))
+
+    xs = jnp.swapaxes(x, 0, 1)  # [T, B, D]
+    if is_reverse:
+        xs = jnp.flip(xs, 0)
+    # precompute input projections (one big matmul: MXU-friendly)
+    xproj = xs.reshape(T * B, D) @ wx
+    if bias is not None:
+        xproj = xproj + bias
+    xproj = xproj.reshape(T, B, 4 * H)
+
+    def cell(carry, inputs):
+        h, c, t = carry
+        xp = inputs
+        gates = xp + h @ wh
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i = jax.nn.sigmoid(i)
+        f = jax.nn.sigmoid(f)
+        g = jnp.tanh(g)
+        o = jax.nn.sigmoid(o)
+        c_new = f * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        if ln is not None:
+            step = T - 1 - t if is_reverse else t
+            alive = (step < ln)[:, None]
+            h_new = jnp.where(alive, h_new, h)
+            c_new = jnp.where(alive, c_new, c)
+        return (h_new, c_new, t + 1), (h_new, c_new)
+
+    (h_last, c_last, _), (hs, cs) = jax.lax.scan(cell, (h0, c0, 0), xproj)
+    if is_reverse:
+        hs = jnp.flip(hs, 0)
+        cs = jnp.flip(cs, 0)
+    return {
+        "Hidden": [jnp.swapaxes(hs, 0, 1)],
+        "Cell": [jnp.swapaxes(cs, 0, 1)],
+        "LastH": [h_last],
+        "LastC": [c_last],
+    }
+
+
+@register_op(
+    "fused_gru",
+    inputs=("X", "WeightX", "WeightH", "Bias", "H0", "Length"),
+    outputs=("Hidden", "LastH"),
+    no_grad=("Length",),
+)
+def _fused_gru(ctx, op, ins):
+    x = ins["X"][0]  # [B, T, D]
+    wx = ins["WeightX"][0]  # [D, 3H]
+    wh = ins["WeightH"][0]  # [H, 3H]
+    bias = ins["Bias"][0] if ins.get("Bias") else None
+    B, T, D = x.shape
+    H = wh.shape[0]
+    h0 = ins["H0"][0] if ins.get("H0") else jnp.zeros((B, H), x.dtype)
+    ln = ins["Length"][0] if ins.get("Length") else None
+    is_reverse = bool(op.attrs.get("is_reverse", False))
+
+    xs = jnp.swapaxes(x, 0, 1)
+    if is_reverse:
+        xs = jnp.flip(xs, 0)
+    xproj = xs.reshape(T * B, D) @ wx
+    if bias is not None:
+        xproj = xproj + bias
+    xproj = xproj.reshape(T, B, 3 * H)
+
+    wh_rz = wh[:, : 2 * H]
+    wh_c = wh[:, 2 * H :]
+
+    def cell(carry, xp):
+        h, t = carry
+        rz_x, c_x = xp[:, : 2 * H], xp[:, 2 * H :]
+        rz = jax.nn.sigmoid(rz_x + h @ wh_rz)
+        r, z = jnp.split(rz, 2, axis=-1)
+        c = jnp.tanh(c_x + (r * h) @ wh_c)
+        h_new = (1 - z) * h + z * c
+        if ln is not None:
+            step = T - 1 - t if is_reverse else t
+            alive = (step < ln)[:, None]
+            h_new = jnp.where(alive, h_new, h)
+        return (h_new, t + 1), h_new
+
+    (h_last, _), hs = jax.lax.scan(cell, (h0, 0), xproj)
+    if is_reverse:
+        hs = jnp.flip(hs, 0)
+    return {"Hidden": [jnp.swapaxes(hs, 0, 1)], "LastH": [h_last]}
+
+
+@register_op(
+    "lstm_unit",
+    inputs=("X", "C_prev"),
+    outputs=("C", "H"),
+)
+def _lstm_unit(ctx, op, ins):
+    # single-step cell (reference lstm_unit_op.cc): X = [B, 4H] gates
+    x, c_prev = ins["X"][0], ins["C_prev"][0]
+    forget_bias = float(op.attrs.get("forget_bias", 0.0))
+    i, f, g, o = jnp.split(x, 4, axis=-1)
+    c = jax.nn.sigmoid(f + forget_bias) * c_prev + jax.nn.sigmoid(i) * jnp.tanh(g)
+    h = jax.nn.sigmoid(o) * jnp.tanh(c)
+    return {"C": [c], "H": [h]}
+
+
+@register_op(
+    "gru_unit",
+    inputs=("Input", "HiddenPrev", "Weight", "Bias"),
+    outputs=("Gate", "ResetHiddenPrev", "Hidden"),
+)
+def _gru_unit(ctx, op, ins):
+    # reference gru_unit_op.cc: Input [B,3H] (x proj), Weight [H,3H]
+    xp, hp = ins["Input"][0], ins["HiddenPrev"][0]
+    w = ins["Weight"][0]
+    H = hp.shape[-1]
+    if ins.get("Bias"):
+        xp = xp + ins["Bias"][0]
+    w_rz, w_c = w[:, : 2 * H], w[:, 2 * H :]
+    rz = jax.nn.sigmoid(xp[:, : 2 * H] + hp @ w_rz)
+    r, z = jnp.split(rz, 2, axis=-1)
+    rhp = r * hp
+    c = jnp.tanh(xp[:, 2 * H :] + rhp @ w_c)
+    h = (1 - z) * hp + z * c
+    gate = jnp.concatenate([rz, c], axis=-1)
+    return {"Gate": [gate], "ResetHiddenPrev": [rhp], "Hidden": [h]}

@@ -1,0 +1,19 @@
+"""Host parameter-server runtime.
+
+Reference: operators/distributed/ (gRPC/BRPC RPC stack, Communicator
+send/recv threads communicator.h:176-383), distributed_ops/
+listen_and_serv_op.cc (pserver event loop), transpiler param slicing
+(distribute_transpiler.py slice_var_up).
+
+TPU-native role: dense params live on-device (sharded by GSPMD) — the
+PS path exists for host-RAM-resident giant embedding tables and
+CTR-style async training over DCN. Implementation is a compact
+length-prefixed-msgpack-over-TCP protocol (no gRPC dependency) with
+the same verbs as the reference's send_recv.proto:19-34
+(SendVariable / GetVariable / Barrier / CheckpointNotify).
+"""
+
+from .server import ParameterServer, run_pserver
+from .client import PSClient
+from .transpile import build_ps_programs, PSArtifacts
+from .communicator import Communicator

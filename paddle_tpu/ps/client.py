@@ -1,0 +1,103 @@
+"""PS client: trainer-side send/recv.
+
+Reference: operators/distributed/ RPCClient (grpc_client.cc async
+completion queue), parameter_send.cc / parameter_recv.cc (split a
+param's slices across endpoints and scatter/gather them).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+from . import protocol as P
+
+
+def _addr(endpoint: str) -> Tuple[str, int]:
+    h, p = endpoint.rsplit(":", 1)
+    return (h, int(p))
+
+
+class PSClient:
+    def __init__(self, endpoints: Sequence[str], trainer_id: int = 0):
+        self.endpoints = list(endpoints)
+        self.trainer_id = trainer_id
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=max(4, len(self.endpoints))
+        )
+
+    # shard_map: var name -> list of (endpoint, row_begin, row_end)
+    def send_grad(self, shard_map, name: str, grad: np.ndarray):
+        futs = []
+        for ep, lo, hi in shard_map[name]:
+            piece = grad[lo:hi]
+            futs.append(
+                self._pool.submit(
+                    P.request,
+                    _addr(ep),
+                    {"verb": P.SEND_GRAD, "name": f"{name}@{lo}",
+                     "grad": piece, "trainer_id": self.trainer_id},
+                )
+            )
+        for f in futs:
+            resp = f.result()
+            assert resp.get("ok"), resp
+
+    def get_param(self, shard_map, name: str) -> np.ndarray:
+        futs = [
+            self._pool.submit(
+                P.request, _addr(ep), {"verb": P.GET_PARAM, "name": f"{name}@{lo}"}
+            )
+            for ep, lo, hi in shard_map[name]
+        ]
+        pieces = [f.result()["value"] for f in futs]
+        return np.concatenate(pieces, axis=0) if len(pieces) > 1 else pieces[0]
+
+    def prefetch_rows(self, shard_map, name: str, rows: np.ndarray) -> np.ndarray:
+        """Sparse row fetch for distributed embedding lookup (reference
+        parameter_prefetch.cc + distributed_lookup_table_op)."""
+        segs = shard_map[name]
+        out = None
+        for ep, lo, hi in segs:
+            mask = (rows >= lo) & (rows < hi)
+            if not mask.any():
+                continue
+            local = rows[mask] - lo
+            resp = P.request(
+                _addr(ep), {"verb": P.PREFETCH, "name": f"{name}@{lo}", "rows": local}
+            )
+            vals = resp["value"]
+            if out is None:
+                out = np.zeros((rows.shape[0], vals.shape[1]), vals.dtype)
+            out[mask] = vals
+        return out
+
+    def push_sparse(self, shard_map, name: str, rows: np.ndarray, grad: np.ndarray):
+        for ep, lo, hi in shard_map[name]:
+            mask = (rows >= lo) & (rows < hi)
+            if not mask.any():
+                continue
+            P.request(
+                _addr(ep),
+                {"verb": P.PUSH_SPARSE, "name": f"{name}@{lo}",
+                 "rows": rows[mask] - lo, "grad": grad[mask]},
+            )
+
+    def barrier(self):
+        for ep in self.endpoints:
+            resp = P.request(_addr(ep), {"verb": P.BARRIER, "trainer_id": self.trainer_id})
+            if not resp.get("ok"):
+                raise RuntimeError(f"barrier failed at {ep}: {resp.get('error')}")
+
+    def checkpoint_notify(self, dirname: str):
+        for ep in self.endpoints:
+            P.request(_addr(ep), {"verb": P.CHECKPOINT, "dirname": dirname})
+
+    def shutdown_servers(self):
+        for ep in self.endpoints:
+            try:
+                P.request(_addr(ep), {"verb": P.SHUTDOWN})
+            except ConnectionError:
+                pass

@@ -1,0 +1,203 @@
+"""Parameter server: holds param shards, applies updates.
+
+Reference: operators/distributed_ops/listen_and_serv_op.cc (event loop,
+RunSyncLoop barrier semantics / RunAsyncLoop per-grad), request
+handlers (distributed/request_handler_impl.cc), heartbeat monitor
+(distributed/heart_beat_monitor.h:54).
+
+Implementation: a threaded TCP server. Each shard var has an optimizer
+closure built from its optimizer op spec (same op lowerings as the
+trainer, run via numpy on host — pservers are CPU machines in the
+reference too). Sync mode: grads accumulate per barrier round and the
+update applies when all trainers reported. A heartbeat monitor flags
+trainers silent for > 2x the expected interval (reference behavior).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import socketserver
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from . import protocol as P
+
+
+class _ShardState:
+    def __init__(self, name: str, value: np.ndarray, optimizer_spec: Dict[str, Any]):
+        self.name = name
+        self.value = value.astype(np.float32)
+        self.spec = optimizer_spec
+        self.state: Dict[str, np.ndarray] = {}
+        self.pending: List[np.ndarray] = []
+
+    def apply(self, grad: np.ndarray):
+        kind = self.spec.get("type", "sgd")
+        lr = float(self.spec.get("lr", 0.01))
+        if kind == "sgd":
+            self.value -= lr * grad
+        elif kind == "adam":
+            beta1 = self.spec.get("beta1", 0.9)
+            beta2 = self.spec.get("beta2", 0.999)
+            eps = self.spec.get("epsilon", 1e-8)
+            m1 = self.state.setdefault("m1", np.zeros_like(self.value))
+            m2 = self.state.setdefault("m2", np.zeros_like(self.value))
+            b1p = self.state.setdefault("b1p", np.array(beta1, np.float64))
+            b2p = self.state.setdefault("b2p", np.array(beta2, np.float64))
+            m1[:] = beta1 * m1 + (1 - beta1) * grad
+            m2[:] = beta2 * m2 + (1 - beta2) * grad * grad
+            lr_t = lr * np.sqrt(1 - b2p) / (1 - b1p)
+            self.value -= (lr_t * m1 / (np.sqrt(m2) + eps)).astype(np.float32)
+            self.state["b1p"] = b1p * beta1
+            self.state["b2p"] = b2p * beta2
+        elif kind == "momentum":
+            mu = self.spec.get("mu", 0.9)
+            v = self.state.setdefault("v", np.zeros_like(self.value))
+            v[:] = mu * v + grad
+            self.value -= lr * v
+        else:
+            raise NotImplementedError(f"pserver optimizer {kind!r}")
+
+
+class ParameterServer:
+    def __init__(self, endpoint: str, shards: Dict[str, np.ndarray],
+                 optimizer_specs: Dict[str, Dict[str, Any]], trainers: int = 1,
+                 sync_mode: bool = True):
+        host, port = endpoint.rsplit(":", 1)
+        self._addr = (host, int(port))
+        self._shards = {
+            name: _ShardState(name, val, optimizer_specs.get(name, {"type": "sgd"}))
+            for name, val in shards.items()
+        }
+        self._trainers = trainers
+        self._sync = sync_mode
+        self._lock = threading.Lock()
+        self._barrier_count = 0
+        self._barrier_generation = 0
+        self._barrier_cond = threading.Condition(self._lock)
+        self._last_seen: Dict[int, float] = {}
+        self._shutdown = threading.Event()
+        self._server: Optional[socketserver.ThreadingTCPServer] = None
+        self._checkpoint_dir: Optional[str] = None
+
+    # -- request handling -----------------------------------------------------
+    def _handle(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        verb = msg["verb"]
+        if verb == P.GET_PARAM:
+            with self._lock:
+                sh = self._shards[msg["name"]]
+                # copy under the lock: serialization happens after the
+                # lock is released and must not race in-place updates
+                return {"ok": True, "value": sh.value.copy()}
+        if verb == P.SEND_GRAD:
+            tid = int(msg.get("trainer_id", 0))
+            self._last_seen[tid] = time.time()
+            name = msg["name"]
+            grad = msg["grad"]
+            with self._lock:
+                sh = self._shards[name]
+                if self._sync:
+                    sh.pending.append(grad)
+                    if len(sh.pending) >= self._trainers:
+                        mean_grad = np.mean(sh.pending, axis=0)
+                        sh.apply(mean_grad)
+                        sh.pending.clear()
+                else:
+                    sh.apply(grad)
+            return {"ok": True}
+        if verb == P.PREFETCH:
+            # sparse row lookup (reference parameter_prefetch.cc)
+            with self._lock:
+                sh = self._shards[msg["name"]]
+                rows = msg["rows"].astype(np.int64)
+                return {"ok": True, "value": sh.value[rows]}
+        if verb == P.PUSH_SPARSE:
+            with self._lock:
+                sh = self._shards[msg["name"]]
+                rows = msg["rows"].astype(np.int64)
+                lr = float(sh.spec.get("lr", 0.01))
+                np.subtract.at(sh.value, rows, lr * msg["grad"])
+            return {"ok": True}
+        if verb == P.BARRIER:
+            deadline = time.time() + 300.0
+            with self._barrier_cond:
+                self._barrier_count += 1
+                my_gen = self._barrier_generation
+                if self._barrier_count >= self._trainers:
+                    self._barrier_count = 0
+                    self._barrier_generation += 1
+                    self._barrier_cond.notify_all()
+                    return {"ok": True}
+                # wait on a generation predicate: spurious wakeups and
+                # timeouts must not release the barrier early
+                while self._barrier_generation == my_gen:
+                    remaining = deadline - time.time()
+                    if remaining <= 0:
+                        return {"ok": False, "error": "barrier timeout"}
+                    self._barrier_cond.wait(timeout=remaining)
+            return {"ok": True}
+        if verb == P.CHECKPOINT:
+            self.save(msg["dirname"])
+            return {"ok": True}
+        if verb == P.SHUTDOWN:
+            self._shutdown.set()
+            threading.Thread(target=self._server.shutdown, daemon=True).start()
+            return {"ok": True}
+        return {"ok": False, "error": f"unknown verb {verb}"}
+
+    # -- checkpoint (reference checkpoint_notify_op.cc:28) --------------------
+    def save(self, dirname: str):
+        import os
+
+        os.makedirs(dirname, exist_ok=True)
+        with self._lock:
+            np.savez(
+                os.path.join(dirname, f"pserver_{self._addr[1]}.npz"),
+                **{n: s.value for n, s in self._shards.items()},
+            )
+
+    # -- lifecycle ------------------------------------------------------------
+    def serve_forever(self):
+        ps = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    msg = P.recv_msg(self.request)
+                    resp = ps._handle(msg)
+                    P.send_msg(self.request, resp)
+                except (ConnectionError, OSError):
+                    pass
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server(self._addr, Handler)
+        self._monitor = threading.Thread(target=self._heartbeat_loop, daemon=True)
+        self._monitor.start()
+        self._server.serve_forever()
+
+    def start_background(self):
+        t = threading.Thread(target=self.serve_forever, daemon=True)
+        t.start()
+        time.sleep(0.1)
+        return t
+
+    def _heartbeat_loop(self, interval: float = 10.0):
+        # reference HeartBeatMonitor::LostWorkerMonitor (.cc:57): warn on
+        # workers silent > 2x interval
+        while not self._shutdown.wait(interval):
+            now = time.time()
+            for tid, ts in list(self._last_seen.items()):
+                if now - ts > 2 * interval:
+                    print(f"[pserver {self._addr}] trainer {tid} silent "
+                          f"{now - ts:.0f}s (possible failure)")
+
+
+def run_pserver(endpoint, shards, optimizer_specs, trainers=1, sync_mode=True):
+    ps = ParameterServer(endpoint, shards, optimizer_specs, trainers, sync_mode)
+    ps.serve_forever()
+    return ps

@@ -1,0 +1,187 @@
+"""Program splitting for PS mode.
+
+Reference: transpiler/distribute_transpiler.py:540 — slice_var_up
+splits params into blocks round-robin across pservers; the trainer
+program gets send/recv around its grads; each pserver program holds the
+optimizer sub-blocks for its shard.
+
+TPU-native shape: the trainer keeps ONE compiled XLA step that
+computes gradients (optimizer ops stripped); a PSTrainer wrapper ships
+grads to the servers and writes refreshed params into the scope. The
+"pserver program" here is the (shards, optimizer_specs) pair consumed
+by ps.server — host numpy update loops, like the reference's CPU
+pserver blocks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from ..core.framework import OpRole, Program
+
+
+_OPT_OPS = {
+    "sgd", "momentum", "adam", "adamw", "adagrad", "adamax", "adadelta",
+    "rmsprop", "ftrl", "lamb", "lars_momentum", "decayed_adagrad", "dpsgd",
+}
+
+
+@dataclasses.dataclass
+class PSArtifacts:
+    trainer_program: Program
+    grad_to_param: Dict[str, str]
+    shard_map: Dict[str, List[Tuple[str, int, int]]]  # param -> [(ep, lo, hi)]
+    optimizer_specs: Dict[str, Dict]
+    endpoints: List[str]
+    sync_mode: bool
+    trainers: int
+    # pserver_* kept for reference API parity (get_pserver_program)
+    pserver_programs: Dict[str, Dict] = dataclasses.field(default_factory=dict)
+    pserver_startups: Dict[str, Dict] = dataclasses.field(default_factory=dict)
+
+
+def _slice_rows(n_rows: int, n_shards: int, min_rows: int = 1):
+    """Split [0, n_rows) into <= n_shards contiguous row ranges."""
+    n_shards = max(1, min(n_shards, max(n_rows // max(min_rows, 1), 1)))
+    per = (n_rows + n_shards - 1) // n_shards
+    out = []
+    lo = 0
+    while lo < n_rows:
+        hi = min(lo + per, n_rows)
+        out.append((lo, hi))
+        lo = hi
+    return out
+
+
+def build_ps_programs(
+    main: Program,
+    startup: Program,
+    endpoints: List[str],
+    trainer_id: int,
+    trainers: int,
+    sync_mode: bool,
+    slice_var_up: bool = True,
+    min_block_size: int = 8192,
+):
+    # 1) strip optimizer ops from a trainer copy; collect specs
+    trainer = Program.from_dict(main.to_dict())
+    block = trainer.global_block()
+    kept = []
+    grad_to_param: Dict[str, str] = {}
+    optimizer_specs: Dict[str, Dict] = {}
+    for op in block.ops:
+        if op.type in _OPT_OPS:
+            pname = op.inputs["Param"][0]
+            gname = op.inputs["Grad"][0]
+            grad_to_param[gname] = pname
+            spec = {"type": op.type, "lr": 0.01}
+            lr_inputs = op.inputs.get("LearningRate", [])
+            if lr_inputs:
+                spec["lr_var"] = lr_inputs[0]  # resolved from scope at launch
+            spec.update({k: v for k, v in op.attrs.items()
+                         if k in ("beta1", "beta2", "epsilon", "mu", "use_nesterov")})
+            optimizer_specs[pname] = spec
+            continue
+        kept.append(op)
+    block.ops = kept
+    trainer._bump()
+
+    # 2) shard params across endpoints by rows (reference slice_var_up)
+    shard_map: Dict[str, List[Tuple[str, int, int]]] = {}
+    params = sorted(grad_to_param.values())
+    for i, pname in enumerate(params):
+        var = main.global_block().var(pname)
+        n_rows = int(var.shape[0]) if var.shape else 1
+        if slice_var_up and len(endpoints) > 1:
+            ranges = _slice_rows(n_rows, len(endpoints))
+        else:
+            ranges = [(0, n_rows)]
+        segs = []
+        for j, (lo, hi) in enumerate(ranges):
+            ep = endpoints[(i + j) % len(endpoints)]
+            segs.append((ep, lo, hi))
+        shard_map[pname] = segs
+
+    # 3) per-endpoint shard tables (the "pserver program")
+    pserver_programs: Dict[str, Dict] = {ep: {} for ep in endpoints}
+    for pname, segs in shard_map.items():
+        for ep, lo, hi in segs:
+            pserver_programs[ep][f"{pname}@{lo}"] = (pname, lo, hi)
+
+    return PSArtifacts(
+        trainer_program=trainer,
+        grad_to_param=grad_to_param,
+        shard_map=shard_map,
+        optimizer_specs=optimizer_specs,
+        endpoints=list(endpoints),
+        sync_mode=sync_mode,
+        trainers=trainers,
+        pserver_programs=pserver_programs,
+        pserver_startups={ep: {} for ep in endpoints},
+    )
+
+
+def launch_pservers(artifacts: PSArtifacts, scope) -> List:
+    """Start the pservers for this artifact set in background threads
+    (tests / single-host); real deployments run ps.server per node."""
+    from .server import ParameterServer
+
+    servers = []
+    for ep in artifacts.endpoints:
+        shards = {}
+        specs = {}
+        for shard_name, (pname, lo, hi) in artifacts.pserver_programs[ep].items():
+            val = scope.find_var(pname)
+            assert val is not None, f"run startup before launching pservers ({pname})"
+            shards[shard_name] = np.asarray(val)[lo:hi].copy()
+            spec = dict(artifacts.optimizer_specs.get(pname, {"type": "sgd"}))
+            lr_var = spec.pop("lr_var", None)
+            if lr_var is not None:
+                lr_val = scope.find_var(lr_var)
+                if lr_val is not None:
+                    spec["lr"] = float(np.asarray(lr_val).reshape(-1)[0])
+            specs[shard_name] = spec
+        ps = ParameterServer(ep, shards, specs, artifacts.trainers, artifacts.sync_mode)
+        ps.start_background()
+        servers.append(ps)
+    return servers
+
+
+class PSTrainer:
+    """Trainer-side driver: run the compiled grad step, send grads,
+    pull fresh params (reference Communicator sync path +
+    send_op/recv_op insertion)."""
+
+    def __init__(self, artifacts: PSArtifacts, executor, scope, trainer_id: int = 0):
+        from .client import PSClient
+
+        self.art = artifacts
+        self.exe = executor
+        self.scope = scope
+        self.client = PSClient(artifacts.endpoints, trainer_id)
+
+    def run_step(self, feed, fetch_list):
+        import jax.numpy as jnp
+
+        grads = [g for g in self.art.grad_to_param]
+        outs = self.exe.run(
+            self.art.trainer_program,
+            feed=feed,
+            fetch_list=list(fetch_list) + grads,
+            scope=self.scope,
+        )
+        n = len(fetch_list)
+        fetched, grad_vals = outs[:n], outs[n:]
+        for gname, gval in zip(grads, grad_vals):
+            self.client.send_grad(self.art.shard_map, self.art.grad_to_param[gname],
+                                  np.asarray(gval))
+        if self.art.sync_mode and self.art.trainers > 1:
+            # all trainers' grads must land before the update is visible
+            self.client.barrier()
+        for pname in self.art.shard_map:
+            fresh = self.client.get_param(self.art.shard_map, pname)
+            self.scope.set_var(pname, jnp.asarray(fresh))
+        return fetched

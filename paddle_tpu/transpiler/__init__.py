@@ -1,0 +1,29 @@
+"""Distribution transpilers (API-parity layer).
+
+Reference: python/paddle/fluid/transpiler/ — DistributeTranspiler
+(distribute_transpiler.py:254,540) rewrites programs for pserver /
+nccl2 / collective modes; collective.py:36-377 inserts c_gen_nccl_id /
+c_comm_init / c_allreduce ops; geo_sgd_transpiler.py for geo-async.
+
+TPU-native: graph rewriting for collectives is unnecessary (GSPMD
+inserts them from shardings), so the transpile step's real output is a
+*mesh execution plan* attached to the program. The op-insertion
+entry points still exist and emit real collective ops (lowered via
+named-axis lax collectives) so reference-style user code keeps working.
+"""
+
+from .distribute_transpiler import (
+    DistributeTranspiler,
+    DistributeTranspilerConfig,
+)
+from .collective import GradAllReduce, LocalSGD, SingleProcessMultiThread
+from .geo_sgd_transpiler import GeoSgdTranspiler
+
+__all__ = [
+    "DistributeTranspiler",
+    "DistributeTranspilerConfig",
+    "GradAllReduce",
+    "LocalSGD",
+    "SingleProcessMultiThread",
+    "GeoSgdTranspiler",
+]

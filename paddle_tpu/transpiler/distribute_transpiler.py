@@ -1,0 +1,114 @@
+"""DistributeTranspiler.
+
+Reference: transpiler/distribute_transpiler.py:254 (config :141,
+transpile :540; nccl2 path :598-640; pserver program construction
+:640ff with slice_var_up param splitting).
+
+Modes here:
+  * "collective"/"nccl2": mark the program for mesh data-parallel
+    execution (CompiledProgram.with_data_parallel does the real work;
+    rendezvous = jax.distributed, replacing gen_nccl_id RPC).
+  * "pserver"/"geo": build trainer/pserver programs against the
+    host parameter-server runtime (paddle_tpu/ps/) which replaces the
+    reference's gRPC listen_and_serv stack for sparse/host-resident
+    tables. Dense training on TPU prefers fully-sharded params; the PS
+    path exists for embedding-dominated CTR-style workloads.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..core import framework
+
+
+class DistributeTranspilerConfig:
+    """Reference distribute_transpiler.py:141."""
+
+    slice_var_up = True
+    split_method = None
+    min_block_size = 8192
+    enable_dc_asgd = False
+    mode = "pserver"
+    print_log = False
+    wait_port = True
+    runtime_split_send_recv = False
+    sync_mode = True
+    geo_sgd_mode = False
+    geo_sgd_need_push_nums = 100
+    collective_mode: Optional[str] = None
+    nccl_comm_num = 1
+    use_hierarchical_allreduce = False
+    hierarchical_allreduce_inter_nranks = 0
+
+
+class DistributeTranspiler:
+    def __init__(self, config: Optional[DistributeTranspilerConfig] = None):
+        self.config = config or DistributeTranspilerConfig()
+        self._mode = None
+        self._trainer_id = 0
+        self._trainers = 1
+        self._origin_program = None
+        self._pserver_endpoints: List[str] = []
+
+    def transpile(
+        self,
+        trainer_id: int,
+        program=None,
+        pservers: str = "127.0.0.1:6174",
+        trainers: int = 1,
+        sync_mode: bool = True,
+        startup_program=None,
+        current_endpoint: str = "127.0.0.1:6174",
+    ):
+        program = program or framework.default_main_program()
+        self._origin_program = program
+        self._trainer_id = trainer_id
+        self._pserver_endpoints = [e for e in str(pservers).split(",") if e]
+        if isinstance(trainers, str):
+            # nccl2 mode passes trainer endpoints string (reference :598)
+            self._trainer_endpoints = trainers.split(",")
+            self._trainers = len(self._trainer_endpoints)
+        else:
+            self._trainers = int(trainers)
+        self._sync_mode = sync_mode
+
+        mode = self.config.mode
+        if self.config.collective_mode or mode in ("nccl2", "collective"):
+            # collective DP: attach mesh plan; grads allreduced by GSPMD
+            self._mode = "collective"
+            program._dist_plan = {
+                "mode": "collective",
+                "trainer_id": trainer_id,
+                "trainers": self._trainers,
+            }
+            return
+        self._mode = "pserver"
+        from ..ps.transpile import build_ps_programs
+
+        self._ps_artifacts = build_ps_programs(
+            program,
+            startup_program or framework.default_startup_program(),
+            self._pserver_endpoints,
+            trainer_id,
+            self._trainers,
+            sync_mode,
+            slice_var_up=self.config.slice_var_up,
+            min_block_size=self.config.min_block_size,
+        )
+
+    # -- reference getters ----------------------------------------------------
+    def get_trainer_program(self, wait_port=True):
+        if self._mode == "collective":
+            return self._origin_program
+        return self._ps_artifacts.trainer_program
+
+    def get_pserver_program(self, endpoint: str):
+        assert self._mode == "pserver", "no pserver program in collective mode"
+        return self._ps_artifacts.pserver_programs[endpoint]
+
+    def get_pserver_programs(self, endpoint: str):
+        return self.get_pserver_program(endpoint), self.get_startup_program(endpoint)
+
+    def get_startup_program(self, endpoint: str, pserver_program=None, startup_program=None):
+        return self._ps_artifacts.pserver_startups[endpoint]

@@ -1,0 +1,109 @@
+"""Dataset + native datafeed tests (reference data_feed/dataset
+unittests pattern)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.dataset import DatasetFactory
+
+
+def _write_multislot(path, n=50, dim=4):
+    rng = np.random.RandomState(0)
+    with open(path, "w") as f:
+        for i in range(n):
+            feats = rng.randn(dim)
+            label = rng.randint(0, 2)
+            f.write(
+                f"{dim} " + " ".join(f"{v:.6f}" for v in feats) + f" 1 {label}\n"
+            )
+
+
+def test_native_parser_matches_python(tmp_path):
+    from paddle_tpu.native import datafeed as native_feed
+
+    p = str(tmp_path / "data.txt")
+    _write_multislot(p)
+    if not native_feed.available():
+        pytest.skip("no g++ toolchain")
+    native = list(native_feed.parse_file(p, 2, ["float32", "int64"]))
+    assert len(native) == 50
+    # spot-check against a hand parse of the first line
+    with open(p) as f:
+        first = f.readline().split()
+    np.testing.assert_allclose(
+        native[0][0], np.array(first[1:5], np.float32), rtol=1e-6
+    )
+    assert native[0][1][0] == int(first[6])
+
+
+def test_native_parser_rejects_malformed_lines(tmp_path):
+    from paddle_tpu.native import datafeed as native_feed
+
+    if not native_feed.available():
+        pytest.skip("no g++ toolchain")
+    p = str(tmp_path / "bad.txt")
+    with open(p, "w") as f:
+        f.write("2 1.0 2.0 1 7\n")       # good
+        f.write("2 1.0 abc 1 7\n")       # malformed value -> dropped
+        f.write("3 1.0 2.0\n")           # truncated -> must NOT eat next line
+        f.write("2 5.0 6.0 1 9\n")       # good
+    rows = list(native_feed.parse_file(p, 2, ["float32", "int64"]))
+    assert len(rows) == 2, [r[0] for r in rows]
+    np.testing.assert_allclose(rows[0][0], [1.0, 2.0])
+    assert rows[0][1][0] == 7
+    np.testing.assert_allclose(rows[1][0], [5.0, 6.0])
+    assert rows[1][1][0] == 9
+
+
+def test_queue_dataset_train(tmp_path):
+    files = []
+    for i in range(3):
+        p = str(tmp_path / f"f{i}.txt")
+        _write_multislot(p, n=40)
+        files.append(p)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4])
+        y = fluid.layers.data("y", [1], dtype="int64")
+        logits = fluid.layers.fc(x, 2)
+        loss = fluid.layers.mean(fluid.layers.softmax_with_cross_entropy(logits, y))
+        fluid.optimizer.SGD(0.05).minimize(loss)
+
+    dataset = DatasetFactory().create_dataset("QueueDataset")
+    dataset.set_batch_size(20)
+    dataset.set_thread(2)
+    dataset.set_filelist(files)
+    dataset.set_use_var([x, y])
+
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        res = exe.train_from_dataset(main, dataset, fetch_list=[loss], print_period=100)
+    assert res is not None
+
+
+def test_in_memory_dataset_shuffle(tmp_path):
+    p = str(tmp_path / "d.txt")
+    _write_multislot(p, n=30)
+    ds = DatasetFactory().create_dataset("InMemoryDataset")
+    ds.set_batch_size(10)
+    ds.set_filelist([p])
+
+    class FakeVar:
+        def __init__(self, name, shape, dtype):
+            self.name, self.shape, self.dtype = name, shape, dtype
+
+    ds.set_use_var([FakeVar("x", (4,), "float32"), FakeVar("y", (1,), "int64")])
+    ds.load_into_memory()
+    assert ds.get_memory_data_size() == 30
+    before = [b["x"][0].copy() for b in ds._iter_batches()]
+    ds.local_shuffle(seed=3)
+    after = [b["x"][0].copy() for b in ds._iter_batches()]
+    assert not all(np.allclose(a, b) for a, b in zip(before, after))
+    ds.release_memory()
+    assert ds.get_memory_data_size() == 0

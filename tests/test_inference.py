@@ -1,0 +1,48 @@
+"""Inference predictor tests (reference
+inference/api/analysis_predictor_tester.cc pattern)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.inference import Config, create_predictor
+
+
+def _export_model(tmp_path):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [6])
+        h = fluid.layers.fc(x, 12, act="relu")
+        out = fluid.layers.fc(h, 3, act="softmax")
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        xv = np.random.RandomState(0).randn(4, 6).astype("float32")
+        (ref,) = exe.run(main, feed={"x": xv}, fetch_list=[out])
+        fluid.io.save_inference_model(str(tmp_path), ["x"], [out], exe, main)
+    return xv, ref
+
+
+def test_predictor_matches_training_forward(tmp_path):
+    xv, ref = _export_model(tmp_path)
+    config = Config(str(tmp_path))
+    pred = create_predictor(config)
+    (got,) = pred.run([xv])
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_predictor_handles_and_clone(tmp_path):
+    xv, ref = _export_model(tmp_path)
+    pred = create_predictor(Config(str(tmp_path)))
+    names = pred.get_input_names()
+    assert names == ["x"]
+    pred.get_input_handle("x").copy_from_cpu(xv)
+    pred.zero_copy_run()
+    out_name = pred.get_output_names()[0]
+    np.testing.assert_allclose(
+        pred.get_output_handle(out_name).copy_to_cpu(), ref, rtol=1e-5, atol=1e-6
+    )
+    # clone shares weights, separate IO
+    p2 = pred.clone()
+    (got2,) = p2.run([xv])
+    np.testing.assert_allclose(got2, ref, rtol=1e-5, atol=1e-6)

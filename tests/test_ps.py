@@ -1,0 +1,117 @@
+"""Parameter-server mode tests (reference TestDistBase pattern:
+pservers + trainer on localhost, loss parity vs local run —
+test_dist_base.py:506; here in-process threads instead of subprocesses
+since the PS is a python server)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.transpiler import DistributeTranspiler, DistributeTranspilerConfig
+from paddle_tpu.ps.transpile import launch_pservers, PSTrainer
+
+_PORT = [6290]
+
+
+def _ports(n):
+    base = _PORT[0]
+    _PORT[0] += n
+    return [f"127.0.0.1:{p}" for p in range(base, base + n)]
+
+
+def _build(seed=5):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [8])
+        y = fluid.layers.data("y", [1])
+        pred = fluid.layers.fc(x, 1, bias_attr=False)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    return main, startup, loss
+
+
+def _batches(n=10):
+    rng = np.random.RandomState(2)
+    W = np.array([[1.0], [-2.0], [0.5], [3.0], [0.0], [1.5], [-1.0], [2.0]])
+    out = []
+    for _ in range(n):
+        xb = rng.randn(16, 8).astype("float32")
+        out.append({"x": xb, "y": (xb @ W).astype("float32")})
+    return out
+
+
+def test_pserver_training_matches_local():
+    batches = _batches()
+
+    # local run
+    main, startup, loss = _build()
+    s_local = fluid.Scope()
+    with fluid.scope_guard(s_local):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        local_losses = [float(exe.run(main, feed=b, fetch_list=[loss])[0]) for b in batches]
+
+    # PS run: 2 pservers, 1 trainer, sync
+    main2, startup2, loss2 = _build()
+    eps = _ports(2)
+    config = DistributeTranspilerConfig()
+    config.mode = "pserver"
+    t = DistributeTranspiler(config)
+    t.transpile(0, program=main2, pservers=",".join(eps), trainers=1, sync_mode=True,
+                startup_program=startup2)
+    s_ps = fluid.Scope()
+    with fluid.scope_guard(s_ps):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup2)
+        servers = launch_pservers(t._ps_artifacts, s_ps)
+        trainer = PSTrainer(t._ps_artifacts, exe, s_ps)
+        ps_losses = [float(trainer.run_step(b, [loss2])[0]) for b in batches]
+        trainer.client.shutdown_servers()
+
+    # reference sync tolerance: delta <= 1e-5
+    np.testing.assert_allclose(local_losses, ps_losses, atol=1e-4, rtol=1e-4)
+
+
+def test_pserver_checkpoint_notify(tmp_path):
+    main, startup, loss = _build(seed=9)
+    eps = _ports(1)
+    config = DistributeTranspilerConfig()
+    config.mode = "pserver"
+    t = DistributeTranspiler(config)
+    t.transpile(0, program=main, pservers=eps[0], trainers=1, sync_mode=True,
+                startup_program=startup)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        servers = launch_pservers(t._ps_artifacts, scope)
+        trainer = PSTrainer(t._ps_artifacts, exe, scope)
+        trainer.run_step(_batches(1)[0], [loss])
+        trainer.client.checkpoint_notify(str(tmp_path))
+        trainer.client.shutdown_servers()
+    import os
+
+    files = os.listdir(tmp_path)
+    assert any(f.startswith("pserver_") for f in files), files
+
+
+def test_sparse_prefetch_and_push():
+    from paddle_tpu.ps.server import ParameterServer
+    from paddle_tpu.ps.client import PSClient
+
+    eps = _ports(1)
+    table = np.arange(20, dtype="float32").reshape(10, 2)
+    ps = ParameterServer(eps[0], {"emb@0": table.copy()},
+                         {"emb@0": {"type": "sgd", "lr": 1.0}}, trainers=1)
+    ps.start_background()
+    client = PSClient(eps)
+    shard_map = {"emb": [(eps[0], 0, 10)]}
+    rows = np.array([1, 3, 7])
+    got = client.prefetch_rows(shard_map, "emb", rows)
+    np.testing.assert_allclose(got, table[rows])
+    client.push_sparse(shard_map, "emb", rows, np.ones((3, 2), "float32"))
+    got2 = client.prefetch_rows(shard_map, "emb", rows)
+    np.testing.assert_allclose(got2, table[rows] - 1.0)
+    client.shutdown_servers()

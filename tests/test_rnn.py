@@ -1,0 +1,78 @@
+"""RNN ops vs numpy step-by-step oracles."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def _np_lstm(x, wx, wh, b, ln=None):
+    B, T, D = x.shape
+    H = wh.shape[0]
+    h = np.zeros((B, H))
+    c = np.zeros((B, H))
+    hs = np.zeros((B, T, H))
+    for t in range(T):
+        gates = x[:, t] @ wx + h @ wh + b
+        i, f, g, o = np.split(gates, 4, axis=-1)
+        c_new = _sigmoid(f) * c + _sigmoid(i) * np.tanh(g)
+        h_new = _sigmoid(o) * np.tanh(c_new)
+        if ln is not None:
+            alive = (t < ln)[:, None]
+            h_new = np.where(alive, h_new, h)
+            c_new = np.where(alive, c_new, c)
+        h, c = h_new, c_new
+        hs[:, t] = h
+    return hs, h, c
+
+
+def test_dynamic_lstm_matches_numpy():
+    B, T, D, H = 3, 5, 4, 6
+    rng = np.random.RandomState(0)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [T, D])
+        ln = fluid.layers.data("len", [], dtype="int64", append_batch_size=True)
+        hidden, cell = fluid.layers.dynamic_lstm(x, H, length=ln)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        xv = rng.randn(B, T, D).astype("float32")
+        lnv = np.array([5, 3, 1], "int64")
+        (hs,) = exe.run(main, feed={"x": xv, "len": lnv}, fetch_list=[hidden])
+        params = {p.name: scope.get_numpy(p.name) for p in main.all_parameters()}
+    wx = [v for k, v in params.items() if v.shape == (D, 4 * H)][0]
+    wh = [v for k, v in params.items() if v.shape == (H, 4 * H)][0]
+    b = [v for k, v in params.items() if v.shape == (4 * H,)][0]
+    want, _, _ = _np_lstm(xv.astype(np.float64), wx, wh, b, lnv)
+    np.testing.assert_allclose(hs, want, atol=1e-4, rtol=1e-4)
+
+
+def test_dynamic_gru_trains():
+    B, T, D, H = 4, 6, 3, 5
+    rng = np.random.RandomState(1)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [T, D])
+        y = fluid.layers.data("y", [1])
+        hidden = fluid.layers.dynamic_gru(x, H)
+        last = fluid.layers.slice(hidden, [1], [T - 1], [T])
+        pred = fluid.layers.fc(last, 1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.Adam(0.01).minimize(loss)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        first = None
+        for i in range(40):
+            xv = rng.randn(B, T, D).astype("float32")
+            yv = xv[:, 0, :1].astype("float32")  # predict first-step feature
+            (l,) = exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
+            if first is None:
+                first = float(l)
+    assert float(l) < first, (first, float(l))
