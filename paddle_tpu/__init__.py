@@ -58,6 +58,9 @@ from .reader import DataLoader
 from .io import save, load, save_params, load_params, save_persistables, load_persistables
 from .core import dygraph
 from .core.dygraph import dygraph_guard as _dg
+from .flags import get_flags, set_flags
+from . import debugger
+from . import flags
 
 # ``fluid``-style alias so reference user code reads naturally:
 #   import paddle_tpu as fluid

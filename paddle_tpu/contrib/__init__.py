@@ -2,3 +2,4 @@
 python/paddle/fluid/contrib/."""
 
 from . import mixed_precision
+from . import slim

@@ -1,0 +1,10 @@
+"""Model compression toolkit.
+
+Reference: python/paddle/fluid/contrib/slim/ (~8k LoC): quantization
+(quantization_pass.py QAT graph rewriting), pruning, distillation,
+light-NAS.
+"""
+
+from . import quantization
+from . import prune
+from . import distillation

@@ -1,0 +1,96 @@
+"""Knowledge distillation helpers.
+
+Reference: contrib/slim/distillation/ (merge teacher+student graphs,
+soft-label / fsp losses).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from ...core.framework import Program
+
+
+def merge(teacher_program: Program, student_program: Program,
+          data_name_map: Dict[str, str], scope=None, name_prefix: str = "teacher_"):
+    """Splice the teacher's (inference) graph into the student program
+    with prefixed var names; shared data vars are mapped via
+    data_name_map {teacher_data_name: student_data_name}."""
+    t = Program.from_dict(teacher_program.to_dict())
+    sblock = student_program.global_block()
+    rename = {}
+    for name, var in t.global_block().vars.items():
+        if name in data_name_map:
+            rename[name] = data_name_map[name]
+            continue
+        new = name_prefix + name
+        rename[name] = new
+        if not sblock.has_var(new):
+            if var.persistable and var.trainable:
+                sblock.create_parameter(new, var.shape, var.dtype, trainable=False)
+            else:
+                sblock.create_var(
+                    name=new, shape=var.shape, dtype=var.dtype,
+                    persistable=var.persistable, stop_gradient=True,
+                )
+    for op in t.global_block().ops:
+        op.inputs = {s: [rename.get(n, n) for n in ns] for s, ns in op.inputs.items()}
+        op.outputs = {s: [rename.get(n, n) for n in ns] for s, ns in op.outputs.items()}
+        op.block = sblock
+        op.attrs["op_ident"] = student_program._next_op_ident()
+        sblock.ops.append(op)
+    if scope is not None:
+        # copy teacher weights (stored under original names) to the
+        # prefixed names the merged graph reads
+        for name, new in rename.items():
+            if name in data_name_map:
+                continue
+            val = scope.find_var(name)
+            if val is not None:
+                scope.set_var(new, val)
+    student_program._bump()
+    return student_program
+
+
+def soft_label_loss(teacher_logits_name: str, student_logits_var,
+                    program: Program, teacher_temperature: float = 2.0,
+                    student_temperature: float = 2.0):
+    """KL(teacher||student) on temperature-softened logits."""
+    from ... import layers
+    from ...core.framework import program_guard
+
+    with program_guard(program):
+        t_logits = program.global_block().var(teacher_logits_name)
+        t_soft = layers.softmax(layers.scale(t_logits, 1.0 / teacher_temperature))
+        s_log = layers.log_softmax(
+            layers.scale(student_logits_var, 1.0 / student_temperature)
+        )
+        neg_ce = layers.reduce_sum(
+            layers.elementwise_mul(t_soft, s_log), dim=-1
+        )
+        return layers.mean(layers.scale(neg_ce, -1.0))
+
+
+def fsp_loss(a1_name, a2_name, b1_name, b2_name, program: Program):
+    """Flow-of-solution-procedure loss (reference fsp_loss): match
+    gram matrices between teacher and student feature pairs."""
+    from ... import layers
+    from ...core.framework import program_guard
+
+    with program_guard(program):
+        gb = program.global_block()
+
+        def gram(x_name, y_name):
+            x = gb.var(x_name)
+            y = gb.var(y_name)
+            b, c1 = x.shape[0], x.shape[1]
+            c2 = y.shape[1]
+            xf = layers.reshape(x, [0, c1, -1])
+            yf = layers.reshape(y, [0, c2, -1])
+            g = layers.matmul(xf, layers.transpose(yf, [0, 2, 1]))
+            hw = int(x.shape[2] * x.shape[3])
+            return layers.scale(g, 1.0 / hw)
+
+        gt = gram(a1_name, a2_name)
+        gs = gram(b1_name, b2_name)
+        return layers.mean(layers.square_error_cost(gs, gt))

@@ -1,0 +1,55 @@
+"""Magnitude pruning.
+
+Reference: contrib/slim/prune/ (Pruner, SensitivePruner): zero the
+smallest-magnitude weights per param at a given ratio and keep a mask
+so pruned entries stay zero through training.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+class Pruner:
+    def __init__(self, criterion: str = "l1_norm"):
+        self.criterion = criterion
+        self._masks: Dict[str, np.ndarray] = {}
+
+    def prune(self, program, scope, params: Sequence[str], ratios: Sequence[float]):
+        """Zero the lowest |w| entries of each param at its ratio;
+        returns the masks. Call apply_masks() after each optimizer step
+        (or wire prune_step into the train loop) to keep them pruned."""
+        import jax.numpy as jnp
+
+        for name, ratio in zip(params, ratios):
+            w = scope.find_var(name)
+            assert w is not None, f"param {name} not in scope"
+            arr = np.asarray(w)
+            k = int(arr.size * ratio)
+            if k <= 0:
+                self._masks[name] = np.ones_like(arr)
+                continue
+            # zero exactly k entries by sorted magnitude (a threshold
+            # comparison would zero ALL ties — e.g. every element of a
+            # constant-initialized param)
+            order = np.argsort(np.abs(arr).reshape(-1), kind="stable")
+            mask = np.ones(arr.size, arr.dtype)
+            mask[order[:k]] = 0
+            mask = mask.reshape(arr.shape)
+            self._masks[name] = mask
+            scope.set_var(name, jnp.asarray(arr * mask))
+        return self._masks
+
+    def apply_masks(self, scope):
+        import jax.numpy as jnp
+
+        for name, mask in self._masks.items():
+            w = scope.find_var(name)
+            if w is not None:
+                scope.set_var(name, jnp.asarray(np.asarray(w) * mask))
+
+    def sparsity(self, scope, name: str) -> float:
+        arr = np.asarray(scope.find_var(name))
+        return float((arr == 0).mean())

@@ -26,6 +26,7 @@ from __future__ import annotations
 import contextlib
 import functools
 import itertools
+import time as _time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -152,6 +153,26 @@ def _lower_block(
             for i, n in enumerate(names):
                 if i < len(vals):
                     env[n] = vals[i]
+                    if getattr(ctx, "check_nan_inf", False):
+                        _emit_nan_check(op.type, n, vals[i])
+
+
+def _emit_nan_check(op_type: str, var_name: str, value):
+    """Per-op output nan/inf scan, FLAGS_check_nan_inf (reference
+    details/nan_inf_utils.h:28 scans op outputs after each kernel)."""
+    import jax.numpy as jnp
+
+    if not hasattr(value, "dtype") or not jnp.issubdtype(value.dtype, jnp.floating):
+        return
+    bad = jnp.any(~jnp.isfinite(value))
+    jax.lax.cond(
+        bad,
+        lambda: jax.debug.print(
+            "[check_nan_inf] op {op} output {var}: non-finite values detected",
+            op=op_type, var=var_name,
+        ),
+        lambda: None,
+    )
 
 
 def build_block_fn(
@@ -167,12 +188,15 @@ def build_block_fn(
     compiles; also used directly by __graft_entry__ and the bench."""
 
     def fn(step_key, *args):
+        from ..flags import flag
+
         env: Dict[str, Any] = {}
         for i, n in enumerate(feed_names):
             env[n] = args[i]
         for i, n in enumerate(state_names):
             env[n] = args[len(feed_names) + i]
         ctx = LoweringContext(step_key=step_key, mesh=mesh)
+        ctx.check_nan_inf = flag("check_nan_inf")
         _lower_block(block, env, ctx)
         fetched = []
         for n in fetch_names:
@@ -237,6 +261,8 @@ class Executor:
 
         block = program.global_block()
         feed_vals, feed_sig = self._prepare_feed(block, feed)
+        from ..flags import flag
+
         key = (
             program.uid,
             program.version,
@@ -244,6 +270,7 @@ class Executor:
             tuple(fetch_names),
             scope.uid,
             mesh is not None,
+            flag("check_nan_inf"),
         )
         compiled = self._cache.get(key) if use_program_cache else None
         if compiled is None:
@@ -272,12 +299,20 @@ class Executor:
         step_key = jax.random.fold_in(step_key, self._run_counter)
 
         ordered_feed = [feed_vals[n] for n in compiled.feed_names]
+        benchmark = flag("benchmark")
+        t0 = _time.perf_counter() if benchmark else 0.0
         outs = compiled.fn(step_key, *ordered_feed, *state_vals)
         n_fetch = len(compiled.fetch_names)
         fetched = list(outs[:n_fetch])
         new_state = outs[n_fetch:]
         for n, v in zip(compiled.written_names, new_state):
             scope.set_var(n, v)
+        if benchmark:
+            # FLAGS_benchmark (reference operator.cc:1006 adds per-op
+            # device syncs): force device sync + report wall time
+            for v in list(fetched) + list(new_state[:1]):
+                np.asarray(v)
+            print(f"[benchmark] Executor.run: {(_time.perf_counter() - t0) * 1e3:.3f} ms")
         if return_numpy:
             fetched = [np.asarray(v) for v in fetched]
         return fetched

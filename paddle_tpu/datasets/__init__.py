@@ -1,0 +1,21 @@
+"""Dataset readers with the reference's generator API.
+
+Reference: python/paddle/dataset/ (mnist, cifar, imdb, uci_housing,
+flowers, ...) — each module exposes train()/test() returning sample
+generators, plus paddle.batch/shuffle decorators (reader_decorator).
+
+This environment has no network egress, so the data itself is
+deterministic SYNTHETIC with the real datasets' shapes/vocab/statistics
+(documented per module). Training-loop code written against the
+reference API runs unchanged; for real data, point the Dataset /
+DataLoader pipeline (paddle_tpu.dataset, paddle_tpu.reader) at your
+files instead.
+"""
+
+from . import mnist
+from . import uci_housing
+from . import imdb
+from . import cifar
+from .common import batch, shuffle, cache, firstn, map_readers
+
+__all__ = ["mnist", "uci_housing", "imdb", "cifar", "batch", "shuffle"]

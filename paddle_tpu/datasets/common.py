@@ -1,0 +1,70 @@
+"""Reader decorators. Reference: python/paddle/reader/decorator.py
+(paddle.batch, paddle.reader.shuffle, cache, firstn, map_readers)."""
+
+from __future__ import annotations
+
+import random
+
+
+def batch(reader, batch_size: int, drop_last: bool = False):
+    def batched():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batched
+
+
+def shuffle(reader, buf_size: int, seed=None):
+    rng = random.Random(seed)
+
+    def shuffled():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) >= buf_size:
+                rng.shuffle(buf)
+                yield from buf
+                buf = []
+        rng.shuffle(buf)
+        yield from buf
+
+    return shuffled
+
+
+def cache(reader):
+    # materialize fully on first use: a partially-consumed first pass
+    # must not poison later passes with a truncated dataset
+    data = []
+    loaded = [False]
+
+    def cached():
+        if not loaded[0]:
+            data.extend(reader())
+            loaded[0] = True
+        yield from data
+
+    return cached
+
+
+def firstn(reader, n: int):
+    def limited():
+        for i, s in enumerate(reader()):
+            if i >= n:
+                break
+            yield s
+
+    return limited
+
+
+def map_readers(func, *readers):
+    def mapped():
+        for samples in zip(*[r() for r in readers]):
+            yield func(*samples)
+
+    return mapped

@@ -1,0 +1,85 @@
+"""Global flags.
+
+Reference: platform/flags.cc (26 gflags: memory fractions, cudnn knobs,
+NCCL tuning, GC thresholds) re-exported to Python via
+global_value_getter_setter.cc and the FLAGS_ env contract honored by
+__init__.py.
+
+TPU-native: one typed dict; env vars FLAGS_<name> override defaults at
+import. Memory/allocator/cudnn knobs are accepted-but-inert (XLA owns
+memory and kernels) and documented as such; the live flags control
+debugging behavior.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+_FLAG_DEFS: Dict[str, Any] = {
+    # live flags
+    "check_nan_inf": False,            # per-op nan/inf scan (details/nan_inf_utils.h)
+    "benchmark": False,                # Executor.run sync + wall-time print
+    "eager_delete_tensor_gb": 0.0,     # inert: XLA frees by liveness
+    # accepted-but-inert parity flags (reference platform/flags.cc)
+    "fraction_of_gpu_memory_to_use": 0.92,
+    "allocator_strategy": "naive_best_fit",
+    "cudnn_deterministic": False,
+    "enable_parallel_graph": False,
+    "sync_nccl_allreduce": True,
+    "max_inplace_grad_add": 0,
+    "cpu_deterministic": False,
+    "paddle_num_threads": 1,
+    "use_pinned_memory": True,
+    "init_allocated_mem": False,
+    "free_idle_memory": False,
+    "reader_queue_speed_test_mode": False,
+    "enable_unused_var_check": False,
+    "fuse_parameter_memory_size": -1,
+    "tracer_profile_fname": "",
+}
+
+_flags: Dict[str, Any] = {}
+
+
+def _coerce(default, raw: str):
+    if isinstance(default, bool):
+        return raw.lower() in ("1", "true", "yes")
+    if isinstance(default, int):
+        return int(raw)
+    if isinstance(default, float):
+        return float(raw)
+    return raw
+
+
+def _init():
+    for name, default in _FLAG_DEFS.items():
+        env = os.environ.get(f"FLAGS_{name}")
+        _flags[name] = _coerce(default, env) if env is not None else default
+
+
+_init()
+
+
+def get_flags(names):
+    if isinstance(names, str):
+        names = [names]
+    out = {}
+    for n in names:
+        key = n[len("FLAGS_"):] if n.startswith("FLAGS_") else n
+        if key not in _flags:
+            raise ValueError(f"unknown flag {n!r}")
+        out[n] = _flags[key]
+    return out
+
+
+def set_flags(flag_dict: Dict[str, Any]):
+    for n, v in flag_dict.items():
+        key = n[len("FLAGS_"):] if n.startswith("FLAGS_") else n
+        if key not in _flags:
+            raise ValueError(f"unknown flag {n!r}")
+        _flags[key] = v
+
+
+def flag(name: str):
+    return _flags[name]

@@ -1,0 +1,92 @@
+"""Fake-quantization ops for quantization-aware training.
+
+Reference: operators/fake_quantize_op.cc / fake_dequantize_op.cc —
+quantize to int range and immediately dequantize, with straight-through
+gradients, so training sees quantization error. Scales: abs_max
+(per-tensor, current batch) or moving-average abs_max (running).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+def _ste_round(x):
+    # straight-through estimator: round in fwd, identity grad
+    return x + jax.lax.stop_gradient(jnp.round(x) - x)
+
+
+def _quant_dequant(x, scale, bits):
+    qmax = float(2 ** (bits - 1) - 1)
+    s = jnp.maximum(scale, 1e-8)
+    q = jnp.clip(_ste_round(x / s * qmax), -qmax, qmax)
+    return q * s / qmax
+
+
+@register_op(
+    "fake_quantize_abs_max", inputs=("X",), outputs=("Out", "OutScale")
+)
+def _fake_quantize_abs_max(ctx, op, ins):
+    x = ins["X"][0]
+    bits = int(op.attrs.get("bit_length", 8))
+    scale = jnp.max(jnp.abs(x))
+    return {"Out": [_quant_dequant(x, scale, bits)], "OutScale": [scale.reshape(1)]}
+
+
+@register_op(
+    "fake_quantize_dequantize_moving_average_abs_max",
+    inputs=("X", "InScale", "InAccum", "InState"),
+    outputs=("Out", "OutScale", "OutAccum", "OutState"),
+    no_grad=("InScale", "InAccum", "InState"),
+)
+def _fake_quant_dequant_moving(ctx, op, ins):
+    x = ins["X"][0]
+    bits = int(op.attrs.get("bit_length", 8))
+    rate = float(op.attrs.get("moving_rate", 0.9))
+    is_test = bool(op.attrs.get("is_test", False))
+    in_scale = ins["InScale"][0].reshape(())
+    if is_test:
+        scale = in_scale
+        accum = ins["InAccum"][0] if ins.get("InAccum") else in_scale.reshape(1)
+        state = ins["InState"][0] if ins.get("InState") else jnp.ones((1,), x.dtype)
+    else:
+        cur = jnp.max(jnp.abs(x))
+        accum0 = ins["InAccum"][0].reshape(()) if ins.get("InAccum") else in_scale
+        state0 = ins["InState"][0].reshape(()) if ins.get("InState") else jnp.asarray(1.0, x.dtype)
+        accum = (rate * accum0 + cur).reshape(1)
+        state = (rate * state0 + 1.0).reshape(1)
+        scale = (accum / state).reshape(())
+    return {
+        "Out": [_quant_dequant(x, scale, bits)],
+        "OutScale": [scale.reshape(1)],
+        "OutAccum": [jnp.asarray(accum).reshape(1)],
+        "OutState": [jnp.asarray(state).reshape(1)],
+    }
+
+
+@register_op(
+    "fake_channel_wise_quantize_abs_max", inputs=("X",), outputs=("Out", "OutScale")
+)
+def _fake_channel_wise_quant(ctx, op, ins):
+    x = ins["X"][0]
+    bits = int(op.attrs.get("bit_length", 8))
+    # per-output-channel (dim 0) scales, reference channel-wise op
+    axes = tuple(range(1, x.ndim))
+    scale = jnp.max(jnp.abs(x), axis=axes)
+    bshape = (x.shape[0],) + (1,) * (x.ndim - 1)
+    return {
+        "Out": [_quant_dequant(x, scale.reshape(bshape), bits)],
+        "OutScale": [scale],
+    }
+
+
+@register_op(
+    "fake_dequantize_max_abs", inputs=("X", "Scale"), outputs=("Out",), no_grad=("Scale",)
+)
+def _fake_dequantize_max_abs(ctx, op, ins):
+    x, scale = ins["X"][0], ins["Scale"][0]
+    qmax = float(op.attrs.get("max_range", 127.0))
+    return {"Out": [x * scale.reshape(()) / qmax]}

@@ -1,0 +1,100 @@
+"""Dygraph (eager) mode tests — reference
+tests/unittests/test_imperative_*.py pattern."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+import paddle_tpu.dygraph as dg
+
+
+def test_linear_backward_matches_manual():
+    with fluid.core.dygraph.dygraph_guard():
+        x = dg.to_variable(np.ones((2, 3), "float32"))
+        x.stop_gradient = False
+        layer = dg.Linear(3, 2)
+        out = layer(x)
+        from paddle_tpu.dygraph.base import _trace
+
+        loss = _trace("reduce_sum", {"X": [out]}, ["Out"], {"reduce_all": True})[0]
+        loss.backward()
+        w = layer.weight.numpy()
+        # d loss / dx = sum over output dim of W
+        np.testing.assert_allclose(x.gradient, np.tile(w.sum(1), (2, 1)), rtol=1e-5)
+        # d loss / dW = sum over batch of x outer ones
+        np.testing.assert_allclose(
+            layer.weight.gradient, np.full((3, 2), 2.0), rtol=1e-5
+        )
+
+
+def test_sequential_mnist_style_training():
+    rng = np.random.RandomState(0)
+    W = rng.randn(8, 3)
+    with fluid.core.dygraph.dygraph_guard():
+        model = dg.Sequential(
+            dg.Linear(8, 32, act="relu"),
+            dg.Linear(32, 3),
+        )
+        opt = fluid.optimizer.Adam(1e-2)
+        losses = []
+        from paddle_tpu.dygraph.base import _trace
+
+        for i in range(60):
+            xb = rng.randn(32, 8).astype("float32")
+            yb = np.argmax(xb @ W, 1).reshape(-1, 1).astype("int64")
+            out = model(dg.to_variable(xb))
+            _, l = _trace(
+                "softmax_with_cross_entropy",
+                {"Logits": [out], "Label": [dg.to_variable(yb)]},
+                ["Softmax", "Loss"],
+                {},
+            )
+            loss = _trace("mean", {"X": [l]}, ["Out"], {})[0]
+            loss.backward()
+            opt.minimize(loss, parameter_list=model.parameters())
+            model.clear_gradients()
+            losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_batchnorm_train_eval_modes():
+    with fluid.core.dygraph.dygraph_guard():
+        bn = dg.BatchNorm(3)
+        x = dg.to_variable(np.random.RandomState(0).randn(4, 3, 5, 5).astype("float32"))
+        bn.train()
+        y1 = bn(x)
+        # train mode: output is batch-normalized -> per-channel mean ~ 0
+        m = y1.numpy().mean(axis=(0, 2, 3))
+        np.testing.assert_allclose(m, np.zeros(3), atol=1e-5)
+        bn.eval()
+        y2 = bn(x)
+        assert not np.allclose(y1.numpy(), y2.numpy())
+
+
+def test_state_dict_roundtrip(tmp_path):
+    with fluid.core.dygraph.dygraph_guard():
+        model = dg.Sequential(dg.Linear(4, 5), dg.Linear(5, 2))
+        sd = model.state_dict()
+        dg.save_dygraph(sd, str(tmp_path / "m"))
+        state, _ = dg.load_dygraph(str(tmp_path / "m"))
+        model2 = dg.Sequential(dg.Linear(4, 5), dg.Linear(5, 2))
+        model2.set_dict(state)
+        for p1, p2 in zip(model.parameters(), model2.parameters()):
+            np.testing.assert_allclose(p1.numpy(), p2.numpy())
+
+
+def test_traced_layer_jit():
+    with fluid.core.dygraph.dygraph_guard():
+        model = dg.Linear(3, 2)
+        x = dg.to_variable(np.ones((2, 3), "float32"))
+        out, traced = dg.TracedLayer.trace(model, [x])
+        (out2,) = traced([x])
+        np.testing.assert_allclose(out.numpy(), out2.numpy(), rtol=1e-6)
+
+
+def test_no_grad_blocks_tape():
+    with fluid.core.dygraph.dygraph_guard():
+        layer = dg.Linear(3, 2)
+        x = dg.to_variable(np.ones((2, 3), "float32"))
+        with dg.no_grad():
+            out = layer(x)
+        assert out._producer is None or out.stop_gradient

@@ -1,0 +1,178 @@
+"""Flags, datasets, metrics, lr schedules, AMP, regularizers, EMA."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def test_flags_roundtrip_and_env_contract():
+    v = fluid.get_flags("FLAGS_check_nan_inf")
+    assert v["FLAGS_check_nan_inf"] in (True, False)
+    fluid.set_flags({"FLAGS_check_nan_inf": True})
+    assert fluid.get_flags(["check_nan_inf"])["check_nan_inf"] is True
+    fluid.set_flags({"FLAGS_check_nan_inf": False})
+    with pytest.raises(ValueError):
+        fluid.set_flags({"FLAGS_not_a_flag": 1})
+
+
+def test_dataset_readers_and_decorators():
+    from paddle_tpu import datasets
+
+    samples = list(datasets.firstn(datasets.mnist.train(), 10)())
+    assert len(samples) == 10 and samples[0][0].shape == (784,)
+    batches = list(datasets.batch(datasets.firstn(datasets.uci_housing.train(), 7), 3)())
+    assert [len(b) for b in batches] == [3, 3, 1]
+    sh = list(datasets.shuffle(datasets.firstn(datasets.mnist.train(), 20), 10, seed=1)())
+    assert len(sh) == 20
+    words, label = next(iter(datasets.imdb.train()()))
+    assert isinstance(words, list) and label in (0, 1)
+
+
+def test_uci_housing_linear_regression_converges():
+    from paddle_tpu import datasets
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [13])
+        y = fluid.layers.data("y", [1])
+        pred = fluid.layers.fc(x, 1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(0.01).minimize(loss)
+    scope = fluid.Scope()
+    reader = datasets.batch(datasets.shuffle(datasets.uci_housing.train(), 100, seed=0), 32)
+    feeder = fluid.DataFeeder([x, y])
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        first = last = None
+        for epoch in range(8):
+            for rows in reader():
+                (l,) = exe.run(main, feed=feeder.feed(rows), fetch_list=[loss])
+                if first is None:
+                    first = float(l)
+                last = float(l)
+    assert last < first * 0.1, (first, last)
+
+
+def test_lr_scheduler_exponential_decay():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [2])
+        pred = fluid.layers.fc(x, 1, bias_attr=False)
+        loss = fluid.layers.mean(pred)
+        lr = fluid.layers.exponential_decay(0.1, decay_steps=10, decay_rate=0.5)
+        opt = fluid.optimizer.SGD(lr)
+        opt.minimize(loss)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        lrs = []
+        for i in range(20):
+            (lv,) = exe.run(main, feed={"x": np.ones((2, 2), "float32")}, fetch_list=[lr])
+            lrs.append(float(np.asarray(lv).reshape(-1)[0]))
+    # lr(step) = 0.1 * 0.5^(step/10); step counts executor runs
+    np.testing.assert_allclose(lrs[0], 0.1 * 0.5 ** (1 / 10), rtol=1e-4)
+    np.testing.assert_allclose(lrs[19], 0.1 * 0.5 ** (20 / 10), rtol=1e-4)
+
+
+def test_piecewise_decay():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        lr = fluid.layers.piecewise_decay([3, 6], [0.1, 0.01, 0.001])
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)  # initializes the @LR_DECAY_COUNTER@ var
+        vals = [float(np.asarray(exe.run(main, fetch_list=[lr])[0]).reshape(-1)[0]) for _ in range(8)]
+    assert vals[0] == pytest.approx(0.1)
+    assert vals[3] == pytest.approx(0.01)
+    assert vals[7] == pytest.approx(0.001)
+
+
+def test_amp_decorate_trains():
+    from paddle_tpu.contrib.mixed_precision import decorate
+
+    rng = np.random.RandomState(0)
+    W = rng.randn(8, 3)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [8])
+        y = fluid.layers.data("y", [1], dtype="int64")
+        h = fluid.layers.fc(x, 16, act="relu")
+        logits = fluid.layers.fc(h, 3)
+        loss = fluid.layers.mean(fluid.layers.softmax_with_cross_entropy(logits, y))
+        opt = decorate(fluid.optimizer.Adam(5e-3))
+        opt.minimize(loss)
+    # cast ops inserted
+    assert any(op.type == "cast" for op in main.global_block().ops)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        first = None
+        for i in range(50):
+            xb = rng.randn(64, 8).astype("float32")
+            yb = np.argmax(xb @ W, 1).reshape(-1, 1).astype("int64")
+            (l,) = exe.run(main, feed={"x": xb, "y": yb}, fetch_list=[loss])
+            if first is None:
+                first = float(l)
+    assert float(l) < first * 0.6, (first, float(l))
+
+
+def test_l2_regularizer_shrinks_weights():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4])
+        pred = fluid.layers.fc(x, 1, bias_attr=False)
+        loss = fluid.layers.mean(pred) * 0.0  # zero task loss
+        opt = fluid.optimizer.SGD(
+            0.1, regularization=fluid.regularizer.L2Decay(0.5)
+        )
+        opt.minimize(loss)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        wname = main.all_parameters()[0].name
+        w0 = np.abs(scope.get_numpy(wname)).sum()
+        for _ in range(5):
+            exe.run(main, feed={"x": np.ones((2, 4), "float32")}, fetch_list=[loss])
+        w5 = np.abs(scope.get_numpy(wname)).sum()
+    # pure decay: w *= (1 - lr*coeff) per step
+    assert w5 < w0 * 0.9, (w0, w5)
+
+
+def test_gradient_clip_by_global_norm():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4])
+        pred = fluid.layers.fc(x, 1, bias_attr=False)
+        loss = fluid.layers.mean(pred) * 1000.0  # huge grads
+        fluid.clip.set_gradient_clip(fluid.clip.GradientClipByGlobalNorm(1.0))
+        opt = fluid.optimizer.SGD(1.0)
+        opt.minimize(loss)
+        fluid.clip.set_gradient_clip(None)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        wname = main.all_parameters()[0].name
+        w0 = scope.get_numpy(wname).copy()
+        exe.run(main, feed={"x": np.ones((2, 4), "float32")}, fetch_list=[loss])
+        w1 = scope.get_numpy(wname)
+    # update norm bounded by lr * clip_norm = 1
+    assert np.linalg.norm(w1 - w0) <= 1.0 + 1e-5
+
+
+def test_metrics_accuracy_and_auc():
+    m = fluid.metrics.Accuracy()
+    m.update(0.75, 100)
+    m.update(0.25, 100)
+    assert m.eval() == pytest.approx(0.5)
+    auc = fluid.metrics.Auc()
+    preds = np.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7], [0.6, 0.4]])
+    labels = np.array([1, 0, 1, 0])
+    auc.update(preds, labels)
+    assert auc.eval() == pytest.approx(1.0)
