@@ -100,5 +100,42 @@ def main():
     )
 
 
+def _run_with_retries(attempts: int = 4):
+    """The TPU tunnel (axon relay) intermittently fails registration
+    right after another process released it ("Backend 'axon' is not in
+    the list of known backends"). Registration happens at interpreter
+    start, so retry in fresh subprocesses."""
+    import subprocess
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    # APPEND to PYTHONPATH — replacing it would drop the TPU plugin's
+    # sitecustomize dir and silently break backend registration
+    pypath = here + (os.pathsep + os.environ["PYTHONPATH"]
+                     if os.environ.get("PYTHONPATH") else "")
+    for i in range(attempts):
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env={**os.environ, "PT_BENCH_CHILD": "1", "PYTHONPATH": pypath},
+            capture_output=True,
+            text=True,
+            timeout=900,
+        )
+        for line in proc.stdout.splitlines():
+            if line.startswith("{"):
+                print(line)
+                return 0
+        sys.stderr.write(
+            f"[bench] attempt {i + 1}/{attempts} failed "
+            f"(rc={proc.returncode}); tail: {proc.stderr[-500:]}\n"
+        )
+        # the relay needs a cooldown after a session drops before a new
+        # claim succeeds (observed ~30-60s)
+        time.sleep(45)
+    return 1
+
+
 if __name__ == "__main__":
-    main()
+    if os.environ.get("PT_BENCH_CHILD"):
+        main()
+    else:
+        sys.exit(_run_with_retries())

@@ -16,7 +16,37 @@ from __future__ import annotations
 from ..core.framework import Variable, default_main_program
 from ..layer_helper import LayerHelper
 
-__all__ = ["increment", "array_write", "array_read", "less_than", "equal", "While", "Switch", "cond"]
+__all__ = [
+    "increment", "array_write", "array_read", "less_than", "less_equal",
+    "greater_than", "greater_equal", "equal", "not_equal", "While",
+    "Switch", "cond",
+]
+
+
+def _compare(op_type, x, y, out=None):
+    helper = LayerHelper(op_type)
+    if out is None:
+        out = helper.create_variable_for_type_inference(
+            dtype="bool", shape=x.shape, stop_gradient=True
+        )
+    helper.append_op(type=op_type, inputs={"X": [x], "Y": [y]}, outputs={"Out": [out]})
+    return out
+
+
+def less_equal(x, y, cond=None):
+    return _compare("less_equal", x, y, cond)
+
+
+def greater_than(x, y, cond=None):
+    return _compare("greater_than", x, y, cond)
+
+
+def greater_equal(x, y, cond=None):
+    return _compare("greater_equal", x, y, cond)
+
+
+def not_equal(x, y, cond=None):
+    return _compare("not_equal", x, y, cond)
 
 
 def increment(x, value=1.0, in_place=True):
@@ -108,15 +138,52 @@ class While:
 
 
 class Switch:
-    """Reference Switch: chained case blocks. Lowered to nested
-    conditional_block ops by the executor."""
+    """Reference Switch: exclusive chained cases — the FIRST matching
+    case runs; default runs only when no case matched. Each case
+    lowers to a conditional_block whose predicate is
+    (cond AND NOT any-earlier-matched)."""
 
     def __init__(self, name=None):
         self.helper = LayerHelper("switch", name=name)
-        self._cases = []
+        self._matched = None  # bool var: any earlier case fired
+
+    def _effective_cond(self, condition):
+        from ..layer_helper import LayerHelper as LH
+
+        helper = LH("switch_case")
+        if self._matched is None:
+            eff = condition
+            self._matched = condition
+            return eff
+        not_prev = helper.create_variable_for_type_inference(
+            dtype="bool", shape=condition.shape, stop_gradient=True
+        )
+        helper.append_op(
+            type="logical_not", inputs={"X": [self._matched]}, outputs={"Out": [not_prev]}
+        )
+        eff = helper.create_variable_for_type_inference(
+            dtype="bool", shape=condition.shape, stop_gradient=True
+        )
+        helper.append_op(
+            type="logical_and",
+            inputs={"X": [condition], "Y": [not_prev]},
+            outputs={"Out": [eff]},
+        )
+        new_matched = helper.create_variable_for_type_inference(
+            dtype="bool", shape=condition.shape, stop_gradient=True
+        )
+        helper.append_op(
+            type="logical_or",
+            inputs={"X": [self._matched], "Y": [condition]},
+            outputs={"Out": [new_matched]},
+        )
+        self._matched = new_matched
+        return eff
 
     def case(self, condition):
         import contextlib
+
+        effective = self._effective_cond(condition)
 
         @contextlib.contextmanager
         def _ctx():
@@ -129,7 +196,7 @@ class Switch:
                 prog._rollback()
                 parent.append_op(
                     type="conditional_block",
-                    inputs={"Cond": [condition]},
+                    inputs={"Cond": [effective]},
                     outputs={},
                     attrs={"sub_block": sub, "is_scalar_condition": True},
                 )

@@ -12,6 +12,7 @@ from . import nn  # noqa: F401
 from . import optim  # noqa: F401
 from . import collective  # noqa: F401
 from . import quant  # noqa: F401
+from . import loss_ext  # noqa: F401
 from . import control  # noqa: F401
 from . import rnn  # noqa: F401
 from . import sequence  # noqa: F401

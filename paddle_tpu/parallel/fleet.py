@@ -42,6 +42,11 @@ class Role:
 class RoleMakerBase:
     def __init__(self):
         self._env = ParallelEnv()
+        # PS env contract (reference role_maker.py PaddleCloudRoleMaker):
+        # TRAINING_ROLE=TRAINER|PSERVER, PADDLE_PSERVERS_IP_PORT_LIST
+        self._training_role = os.environ.get("TRAINING_ROLE", "TRAINER")
+        eps = os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST", "")
+        self._server_endpoints = [e for e in eps.split(",") if e]
 
     def worker_index(self) -> int:
         return self._env.rank
@@ -49,17 +54,26 @@ class RoleMakerBase:
     def worker_num(self) -> int:
         return self._env.world_size
 
+    def server_num(self) -> int:
+        return len(self._server_endpoints)
+
     def is_worker(self) -> bool:
-        return True
+        return self._training_role != "PSERVER"
 
     def is_server(self) -> bool:
-        return False
+        return self._training_role == "PSERVER"
 
     def is_first_worker(self) -> bool:
-        return self.worker_index() == 0
+        return self.is_worker() and self.worker_index() == 0
 
     def get_trainer_endpoints(self):
         return self._env.trainer_endpoints
+
+    def get_pserver_endpoints(self):
+        return self._server_endpoints
+
+    def get_current_endpoint(self):
+        return self._env.current_endpoint
 
     def generate_role(self):
         pass
@@ -169,7 +183,60 @@ class _Fleet:
         return framework.default_startup_program()
 
     def distributed_optimizer(self, optimizer, strategy: Optional[DistributedStrategy] = None):
-        return DistributedOptimizer(self, optimizer, strategy or DistributedStrategy())
+        strategy = strategy or DistributedStrategy()
+        if strategy.mode == "pserver" or (
+            self._role_maker is not None and self._role_maker.server_num() > 0
+            and strategy.mode != "collective"
+        ):
+            return PSDistributedOptimizer(self, optimizer, strategy)
+        return DistributedOptimizer(self, optimizer, strategy)
+
+    # -- PS-mode lifecycle (reference fleet PS: init_server/run_server/
+    #    init_worker/stop_worker) --------------------------------------------
+    def init_server(self, model_dir: Optional[str] = None):
+        pass
+
+    def run_server(self):
+        """Blocking pserver loop for this process's endpoint."""
+        assert self.is_server(), "run_server() called on a non-server role"
+        art = self._ps_artifacts
+        from ..core.executor import global_scope
+        from ..ps.transpile import launch_pservers
+
+        ep = self._role_maker.get_current_endpoint()
+        art_single = art
+        # serve only this endpoint's shards
+        import numpy as np
+        from ..ps.server import ParameterServer
+
+        scope = global_scope()
+        shards, specs = {}, {}
+        for shard_name, (pname, lo, hi) in art.pserver_programs[ep].items():
+            val = scope.find_var(pname)
+            assert val is not None, "run startup program before run_server()"
+            shards[shard_name] = np.asarray(val)[lo:hi].copy()
+            spec = dict(art.optimizer_specs.get(pname, {"type": "sgd"}))
+            lr_var = spec.pop("lr_var", None)
+            if lr_var is not None and scope.find_var(lr_var) is not None:
+                spec["lr"] = float(np.asarray(scope.find_var(lr_var)).reshape(-1)[0])
+            specs[shard_name] = spec
+        ps = ParameterServer(ep, shards, specs, art.trainers, art.sync_mode)
+        ps.serve_forever()
+
+    def init_worker(self):
+        from ..ps.transpile import PSTrainer
+        from ..core.executor import Executor, global_scope
+
+        self._ps_trainer = PSTrainer(
+            self._ps_artifacts, Executor(), global_scope(),
+            trainer_id=self.worker_index(),
+        )
+        return self._ps_trainer
+
+    def stop_worker(self):
+        t = getattr(self, "_ps_trainer", None)
+        if t is not None:
+            t.client.shutdown_servers()
 
     # -- io ------------------------------------------------------------------
     def save_persistables(self, executor, dirname, main_program=None):
@@ -221,6 +288,39 @@ class DistributedOptimizer:
         compiled.with_data_parallel(loss_name=loss.name)
         self._fleet._compiled_program = compiled
         self._fleet._strategy = self._strategy
+        return opt_ops, params_grads
+
+
+class PSDistributedOptimizer:
+    """PS-mode fleet optimizer (reference
+    incubate/fleet/parameter_server/distribute_transpiler/__init__.py:41
+    wraps DistributeTranspiler)."""
+
+    def __init__(self, fleet_obj: _Fleet, optimizer, strategy: DistributedStrategy):
+        self._fleet = fleet_obj
+        self._optimizer = optimizer
+        self._strategy = strategy
+
+    def minimize(self, loss, startup_program=None, parameter_list=None, no_grad_set=None):
+        from ..transpiler import DistributeTranspiler, DistributeTranspilerConfig
+
+        opt_ops, params_grads = self._optimizer.minimize(
+            loss, startup_program, parameter_list, no_grad_set
+        )
+        rm = self._fleet._role_maker
+        cfg = DistributeTranspilerConfig()
+        cfg.mode = "pserver"
+        cfg.sync_mode = self._strategy.mode != "async"
+        t = DistributeTranspiler(cfg)
+        t.transpile(
+            rm.worker_index() if rm.is_worker() else 0,
+            program=loss.block.program,
+            pservers=",".join(rm.get_pserver_endpoints()),
+            trainers=max(rm.worker_num(), 1),
+            sync_mode=cfg.sync_mode,
+        )
+        self._fleet._ps_artifacts = t._ps_artifacts
+        self._fleet._origin_program = loss.block.program
         return opt_ops, params_grads
 
 

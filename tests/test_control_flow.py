@@ -1,0 +1,47 @@
+"""Structured control flow: While / conditional (Switch) lowering to
+lax.while_loop / lax.cond (reference
+tests/unittests/test_while_op.py, test_switch.py)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def test_while_loop_sums_to_ten():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        i = fluid.layers.fill_constant([1], "float32", 0.0)
+        total = fluid.layers.fill_constant([1], "float32", 0.0)
+        limit = fluid.layers.fill_constant([1], "float32", 10.0)
+        cond = fluid.layers.less_than(i, limit)
+        loop = fluid.layers.While(cond)
+        with loop.block():
+            ni = fluid.layers.elementwise_add(i, fluid.layers.fill_constant([1], "float32", 1.0))
+            nt = fluid.layers.elementwise_add(total, ni)
+            fluid.layers.assign(ni, i)
+            fluid.layers.assign(nt, total)
+            fluid.layers.less_than(i, limit, cond=cond)
+    exe = fluid.Executor(fluid.CPUPlace())
+    (res,) = exe.run(main, fetch_list=[total])
+    assert float(np.asarray(res).reshape(-1)[0]) == 55.0  # 1+2+...+10
+
+
+def test_switch_selects_case():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [1])
+        out = fluid.layers.fill_constant([1], "float32", -1.0)
+        zero = fluid.layers.fill_constant([1], "float32", 0.0)
+        cond_pos = fluid.layers.greater_than(x, zero)
+        sw = fluid.layers.Switch()
+        with sw:
+            with sw.case(cond_pos):
+                fluid.layers.assign(fluid.layers.fill_constant([1], "float32", 100.0), out)
+            with sw.default():
+                fluid.layers.assign(fluid.layers.fill_constant([1], "float32", 7.0), out)
+    exe = fluid.Executor(fluid.CPUPlace())
+    # first matching case wins; default only fires when no case matched
+    (pos,) = exe.run(main, feed={"x": np.array([[2.0]], "float32")}, fetch_list=[out])
+    assert float(np.asarray(pos).reshape(-1)[0]) == 100.0
+    (neg,) = exe.run(main, feed={"x": np.array([[-2.0]], "float32")}, fetch_list=[out])
+    assert float(np.asarray(neg).reshape(-1)[0]) == 7.0

@@ -1,0 +1,64 @@
+"""Fleet API tests (reference test_dist_fleet_base pattern, in-process)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.parallel.fleet import (
+    DistributedStrategy,
+    PaddleCloudRoleMaker,
+    UserDefinedRoleMaker,
+    fleet,
+)
+
+
+def test_fleet_collective_minimize_and_info():
+    fleet.init(UserDefinedRoleMaker(current_id=0, worker_num=1))
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4])
+        y = fluid.layers.data("y", [1], dtype="int64")
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(fluid.layers.fc(x, 3), y)
+        )
+        strategy = DistributedStrategy()
+        opt = fleet.distributed_optimizer(fluid.optimizer.SGD(0.1), strategy)
+        opt.minimize(loss)
+    assert fleet.worker_index() == 0
+    assert fleet.worker_num() == 1
+    assert fleet.is_first_worker()
+    compiled = fleet.main_program
+    assert compiled._mesh is not None  # data-parallel mesh attached
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        (l,) = exe.run(
+            compiled,
+            feed={"x": np.ones((8, 4), "float32"), "y": np.zeros((8, 1), "int64")},
+            fetch_list=[loss],
+        )
+    assert np.isfinite(l).all()
+
+
+def test_fleet_ps_mode_transpiles(monkeypatch):
+    monkeypatch.setenv("TRAINING_ROLE", "TRAINER")
+    monkeypatch.setenv("PADDLE_PSERVERS_IP_PORT_LIST", "127.0.0.1:6601,127.0.0.1:6602")
+    fleet.init(PaddleCloudRoleMaker())
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4])
+        y = fluid.layers.data("y", [1])
+        pred = fluid.layers.fc(x, 1, bias_attr=False)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        strategy = DistributedStrategy()
+        strategy.mode = "pserver"
+        opt = fleet.distributed_optimizer(fluid.optimizer.SGD(0.1), strategy)
+        opt.minimize(loss)
+    art = fleet._ps_artifacts
+    assert set(art.endpoints) == {"127.0.0.1:6601", "127.0.0.1:6602"}
+    assert art.grad_to_param  # grads mapped to params
+    # trainer program has no optimizer ops
+    assert not any(op.type == "sgd" for op in art.trainer_program.global_block().ops)

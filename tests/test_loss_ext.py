@@ -1,0 +1,141 @@
+"""CTC / CRF / edit-distance ops vs brute-force oracles."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _run_op(op_type, inputs, out_slots, attrs=None):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        block = main.global_block()
+        in_vars = {}
+        feed = {}
+        for slot, arr in inputs.items():
+            v = block.create_var(name=f"in_{slot}", shape=arr.shape,
+                                 dtype=str(arr.dtype), is_data=True,
+                                 stop_gradient=False)
+            in_vars[slot] = [v]
+            feed[f"in_{slot}"] = arr
+        out_vars = {s: [block.create_var(name=f"out_{s}")] for s in out_slots}
+        block.append_op(type=op_type, inputs=in_vars, outputs=out_vars,
+                        attrs=attrs or {})
+    exe = fluid.Executor(fluid.CPUPlace())
+    return exe.run(main, feed=feed,
+                   fetch_list=[out_vars[s][0] for s in out_slots])
+
+
+def test_edit_distance_matches_bruteforce():
+    def lev(a, b):
+        d = np.zeros((len(a) + 1, len(b) + 1))
+        d[:, 0] = np.arange(len(a) + 1)
+        d[0, :] = np.arange(len(b) + 1)
+        for i in range(1, len(a) + 1):
+            for j in range(1, len(b) + 1):
+                d[i, j] = min(d[i - 1, j] + 1, d[i, j - 1] + 1,
+                              d[i - 1, j - 1] + (a[i - 1] != b[j - 1]))
+        return d[-1, -1]
+
+    rng = np.random.RandomState(0)
+    hyps = rng.randint(0, 5, (4, 6)).astype("int64")
+    refs = rng.randint(0, 5, (4, 7)).astype("int64")
+    hl = np.array([6, 4, 5, 2], "int64")
+    rl = np.array([7, 3, 6, 2], "int64")
+    (out, n) = _run_op(
+        "edit_distance",
+        {"Hyps": hyps, "Refs": refs, "HypsLength": hl, "RefsLength": rl},
+        ["Out", "SequenceNum"],
+    )
+    want = [lev(h[:a], r[:b]) for h, r, a, b in zip(hyps, refs, hl, rl)]
+    np.testing.assert_allclose(out.reshape(-1), want)
+    assert int(n) == 4
+
+
+def test_linear_chain_crf_matches_bruteforce():
+    rng = np.random.RandomState(1)
+    B, T, C = 2, 4, 3
+    em = rng.randn(B, T, C).astype("float32")
+    tr = rng.randn(C + 2, C).astype("float32") * 0.5
+    label = rng.randint(0, C, (B, T)).astype("int64")
+
+    (_, _, _, nll) = _run_op(
+        "linear_chain_crf",
+        {"Emission": em, "Transition": tr, "Label": label},
+        ["Alpha", "EmissionExps", "TransitionExps", "LogLikelihood"],
+    )
+
+    # brute force: enumerate all paths
+    start, stop, pair = tr[0], tr[1], tr[2:]
+    for b in range(B):
+        scores = []
+        for path in itertools.product(range(C), repeat=T):
+            s = start[path[0]] + em[b, 0, path[0]]
+            for t in range(1, T):
+                s += pair[path[t - 1], path[t]] + em[b, t, path[t]]
+            s += stop[path[-1]]
+            scores.append(s)
+        logz = np.log(np.sum(np.exp(np.array(scores) - max(scores)))) + max(scores)
+        gold = [p for p in [tuple(label[b])]][0]
+        gs = start[gold[0]] + em[b, 0, gold[0]]
+        for t in range(1, T):
+            gs += pair[gold[t - 1], gold[t]] + em[b, t, gold[t]]
+        gs += stop[gold[-1]]
+        want = -(gs - logz)
+        np.testing.assert_allclose(nll[b, 0], want, rtol=1e-4, atol=1e-4)
+
+
+def test_crf_decoding_matches_bruteforce():
+    rng = np.random.RandomState(2)
+    B, T, C = 2, 4, 3
+    em = rng.randn(B, T, C).astype("float32")
+    tr = rng.randn(C + 2, C).astype("float32") * 0.5
+    (path,) = _run_op(
+        "crf_decoding", {"Emission": em, "Transition": tr}, ["ViterbiPath"]
+    )
+    start, stop, pair = tr[0], tr[1], tr[2:]
+    for b in range(B):
+        best, best_s = None, -1e30
+        for p in itertools.product(range(C), repeat=T):
+            s = start[p[0]] + em[b, 0, p[0]]
+            for t in range(1, T):
+                s += pair[p[t - 1], p[t]] + em[b, t, p[t]]
+            s += stop[p[-1]]
+            if s > best_s:
+                best, best_s = p, s
+        np.testing.assert_array_equal(path[b], np.array(best))
+
+
+def test_ctc_loss_runs_and_trains():
+    B, T, C, L = 2, 8, 5, 3
+    rng = np.random.RandomState(3)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [T, 4])
+        labels = fluid.layers.data("labels", [L], dtype="int64")
+        logits = fluid.layers.fc(x, C, num_flatten_dims=2)
+        block = main.global_block()
+        loss_var = block.create_var(name="ctc_loss")
+        grad_var = block.create_var(name="ctc_grad", stop_gradient=True)
+        block.append_op(
+            type="warpctc",
+            inputs={"Logits": [logits], "Label": [labels]},
+            outputs={"Loss": [loss_var], "WarpCTCGrad": [grad_var]},
+            attrs={"blank": 0},
+        )
+        mean_loss = fluid.layers.mean(loss_var)
+        fluid.optimizer.Adam(0.05).minimize(mean_loss)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        xv = rng.randn(B, T, 4).astype("float32")
+        lv = np.tile(np.array([[1, 2, 3]], "int64"), (B, 1))
+        first = None
+        for i in range(40):
+            (l,) = exe.run(main, feed={"x": xv, "labels": lv}, fetch_list=[mean_loss])
+            if first is None:
+                first = float(l)
+    assert float(l) < first * 0.5, (first, float(l))
