@@ -1,15 +1,33 @@
-"""Benchmark: BERT-base pretraining train-step throughput on one TPU
-chip (BASELINE config 3 / north-star metric "tokens/sec/chip").
+"""Benchmark: BERT pretraining train-step throughput on one TPU chip
+(BASELINE config 3 / north-star metric "tokens/sec/chip").
 
 Prints ONE JSON line:
   {"metric": "tokens_per_sec_per_chip", "value": N, "unit": "tokens/s",
-   "vs_baseline": N}
+   "vs_baseline": N, ...extra diagnostic fields}
 
 vs_baseline compares against an A100 BERT-base reference throughput.
 The reference repo publishes no numbers (BASELINE.md), so the A100
 figure is derived from public MLPerf-class results: BERT on 8xA100
 trains ~3000 seq/s at seq 512-ish mixed precision => ~190k tokens/s
 per chip for base-sized models at seq 128. North-star target is >=0.9.
+
+Process architecture (why three process roles exist):
+
+The axon TPU relay is SINGLE-CLAIM and every python interpreter whose
+env carries PALLAS_AXON_POOL_IPS registers the axon PJRT backend at
+startup (/root/.axon_site/sitecustomize.py on PYTHONPATH). A parent
+that holds/contends the claim deadlocks its own child (round-1
+failure: bare `import jax` in the child hung past the 900s timeout).
+
+  role 1  driver runs `python bench.py` with the axon env
+          -> immediately re-execs itself with PALLAS_AXON_POOL_IPS
+             moved aside to PT_BENCH_AXON_IPS (never touches jax)
+  role 2  re-exec'd orchestrator: no axon env, no jax import; spawns
+          one child per stage with the axon env RESTORED, catches
+          TimeoutExpired, steps down a ladder of smaller configs so a
+          number is always produced (config recorded in the output)
+  role 3  child (PT_BENCH_CHILD=1): the only process that claims the
+          TPU; builds + times the model, prints the JSON line
 """
 
 import json
@@ -17,34 +35,56 @@ import os
 import sys
 import time
 
-import numpy as np
-
 A100_BASELINE_TOKENS_PER_S = 190_000.0
 
-BATCH = 32
-SEQ = 128
-WARMUP = 3
-STEPS = 20
+# Staged fallback ladder: try the headline config first; on timeout or
+# crash step down so the round always records *a* number with its
+# config. `backend=cpu` is the last resort (relay dead) and is labeled
+# as such so it is never mistaken for a TPU measurement.
+STAGES = [
+    dict(model="base", batch=32, seq=128, steps=20, warmup=3,
+         backend="tpu", timeout=600),
+    dict(model="base", batch=32, seq=128, steps=20, warmup=3,
+         backend="tpu", timeout=480),  # straight retry: relay cooldown
+    dict(model="base", batch=16, seq=128, steps=10, warmup=2,
+         backend="tpu", timeout=360),
+    dict(model="tiny", batch=32, seq=128, steps=10, warmup=2,
+         backend="cpu", timeout=300),
+]
+COOLDOWN_S = 45  # relay needs ~30-60s after a dropped session
 
 
 def main():
+    """Child: claims the TPU, measures, prints the JSON line."""
+    import numpy as np
     import jax
 
     import paddle_tpu as fluid
+    from paddle_tpu.contrib.mixed_precision import decorate
     from paddle_tpu.models import BertConfig, build_bert_pretrain
     from paddle_tpu.models.bert import synthetic_batch
 
-    cfg = BertConfig.base()
-    cfg.use_flash_attention = jax.default_backend() == "tpu"
-    opt = fluid.optimizer.Adam(1e-4)
-    main_prog, startup, feeds, fetches = build_bert_pretrain(cfg, SEQ, optimizer=opt)
+    model = os.environ.get("PT_BENCH_MODEL", "base")
+    batch = int(os.environ.get("PT_BENCH_BATCH", "32"))
+    seq = int(os.environ.get("PT_BENCH_SEQ", "128"))
+    steps = int(os.environ.get("PT_BENCH_STEPS", "20"))
+    warmup = int(os.environ.get("PT_BENCH_WARMUP", "3"))
+
+    on_tpu = jax.default_backend() == "tpu"
+    cfg = getattr(BertConfig, model)()
+    cfg.use_flash_attention = on_tpu
+    # bf16 compute via the AMP decorator (master weights stay fp32);
+    # bf16 is MXU-native so no loss scaling is needed.
+    opt = decorate(fluid.optimizer.Adam(1e-4), init_loss_scaling=1.0,
+                   use_dynamic_loss_scaling=False, dest_dtype="bfloat16")
+    main_prog, startup, feeds, fetches = build_bert_pretrain(cfg, seq, optimizer=opt)
 
     scope = fluid.Scope()
     with fluid.scope_guard(scope):
         exe = fluid.Executor(fluid.TPUPlace())
         exe.run(startup)
-        batch = synthetic_batch(np.random.RandomState(0), BATCH, SEQ, cfg.vocab_size)
-        fn, args, meta = exe.export_fn(main_prog, batch, [fetches["loss"]], scope=scope)
+        batch_data = synthetic_batch(np.random.RandomState(0), batch, seq, cfg.vocab_size)
+        fn, args, meta = exe.export_fn(main_prog, batch_data, [fetches["loss"]], scope=scope)
 
     feed_n = len(meta["feed_names"])
     state_names = meta["state_names"]
@@ -76,18 +116,34 @@ def main():
     # warmup (incl. compile). NOTE: through the remote TPU tunnel
     # block_until_ready does not actually block — force a host readback
     # to synchronize (np.asarray).
-    for i in range(WARMUP):
+    for i in range(warmup):
         loss, state_vals = one_step(i, state_vals)
     np.asarray(loss)
 
     t0 = time.perf_counter()
-    for i in range(WARMUP, WARMUP + STEPS):
+    for i in range(warmup, warmup + steps):
         loss, state_vals = one_step(i, state_vals)
     final_loss = float(np.asarray(loss))
     dt = time.perf_counter() - t0
     assert np.isfinite(final_loss), f"non-finite loss {final_loss}"
 
-    tokens_per_s = BATCH * SEQ * STEPS / dt
+    tokens_per_s = batch * seq * steps / dt
+
+    # Approx model FLOPs utilisation: 6*N*T for fwd+bwd. Count only
+    # trainable Parameters — optimizer moments/AMP state in state_names
+    # would inflate N ~3x.
+    from paddle_tpu.core.framework import Parameter
+
+    block = main_prog.global_block()
+    n_params = sum(
+        int(np.prod(block.var(n).shape))
+        for n in state_names
+        if block.has_var(n) and isinstance(block.var(n), Parameter)
+    )
+    flops_per_tok = 6.0 * n_params
+    peak = 197e12 if on_tpu else float("nan")  # v5e bf16 peak
+    mfu = tokens_per_s * flops_per_tok / peak if on_tpu else None
+
     print(
         json.dumps(
             {
@@ -95,16 +151,18 @@ def main():
                 "value": round(tokens_per_s, 1),
                 "unit": "tokens/s",
                 "vs_baseline": round(tokens_per_s / A100_BASELINE_TOKENS_PER_S, 4),
+                "config": {"model": model, "batch": batch, "seq": seq,
+                           "steps": steps, "amp": "bfloat16"},
+                "backend": jax.default_backend(),
+                "mfu": round(mfu, 4) if mfu is not None else None,
+                "final_loss": round(final_loss, 4),
             }
         )
     )
 
 
-def _run_with_retries(attempts: int = 4):
-    """The TPU tunnel (axon relay) intermittently fails registration
-    right after another process released it ("Backend 'axon' is not in
-    the list of known backends"). Registration happens at interpreter
-    start, so retry in fresh subprocesses."""
+def _orchestrate():
+    """Role 2: no jax anywhere in this process. Walk the stage ladder."""
     import subprocess
 
     here = os.path.dirname(os.path.abspath(__file__))
@@ -112,30 +170,60 @@ def _run_with_retries(attempts: int = 4):
     # sitecustomize dir and silently break backend registration
     pypath = here + (os.pathsep + os.environ["PYTHONPATH"]
                      if os.environ.get("PYTHONPATH") else "")
-    for i in range(attempts):
-        proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__)],
-            env={**os.environ, "PT_BENCH_CHILD": "1", "PYTHONPATH": pypath},
-            capture_output=True,
-            text=True,
-            timeout=900,
-        )
-        for line in proc.stdout.splitlines():
+    axon_ips = os.environ.get("PT_BENCH_AXON_IPS", "")
+
+    for i, stage in enumerate(STAGES):
+        env = {**os.environ,
+               "PT_BENCH_CHILD": "1",
+               "PYTHONPATH": pypath,
+               "PT_BENCH_MODEL": stage["model"],
+               "PT_BENCH_BATCH": str(stage["batch"]),
+               "PT_BENCH_SEQ": str(stage["seq"]),
+               "PT_BENCH_STEPS": str(stage["steps"]),
+               "PT_BENCH_WARMUP": str(stage["warmup"])}
+        env.pop("PT_BENCH_AXON_IPS", None)
+        if stage["backend"] == "tpu" and axon_ips:
+            env["PALLAS_AXON_POOL_IPS"] = axon_ips  # child claims the relay
+        else:
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+            env["JAX_PLATFORMS"] = "cpu"
+            env["JAX_PLATFORM_NAME"] = "cpu"
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=env, capture_output=True, text=True,
+                timeout=stage["timeout"],
+            )
+            rc, out, err = proc.returncode, proc.stdout, proc.stderr
+        except subprocess.TimeoutExpired as e:
+            rc = -1
+            out = (e.stdout or b"").decode() if isinstance(e.stdout, bytes) else (e.stdout or "")
+            err = f"timeout after {stage['timeout']}s"
+        for line in out.splitlines():
             if line.startswith("{"):
                 print(line)
                 return 0
         sys.stderr.write(
-            f"[bench] attempt {i + 1}/{attempts} failed "
-            f"(rc={proc.returncode}); tail: {proc.stderr[-500:]}\n"
+            f"[bench] stage {i + 1}/{len(STAGES)} {stage} failed "
+            f"(rc={rc}); tail: {str(err)[-500:]}\n"
         )
-        # the relay needs a cooldown after a session drops before a new
-        # claim succeeds (observed ~30-60s)
-        time.sleep(45)
+        if stage["backend"] == "tpu":
+            time.sleep(COOLDOWN_S)
     return 1
 
 
 if __name__ == "__main__":
     if os.environ.get("PT_BENCH_CHILD"):
         main()
+    elif os.environ.get("PT_BENCH_REEXEC"):
+        sys.exit(_orchestrate())
     else:
-        sys.exit(_run_with_retries())
+        # Role 1: strip the axon claim env and re-exec so THIS process
+        # never contends the single-claim relay its children need.
+        env = dict(os.environ)
+        ips = env.pop("PALLAS_AXON_POOL_IPS", "")
+        if ips:
+            env["PT_BENCH_AXON_IPS"] = ips
+        env["PT_BENCH_REEXEC"] = "1"
+        os.execve(sys.executable,
+                  [sys.executable, os.path.abspath(__file__)], env)

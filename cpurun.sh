@@ -1,0 +1,8 @@
+#!/bin/bash
+# Run python work on the CPU backend WITHOUT contending the single-claim
+# axon TPU relay: with PALLAS_AXON_POOL_IPS set, sitecustomize dials the
+# relay at EVERY interpreter start, which deadlocks against any other
+# claimant. Strip it for all CPU-side work (tests, scripts).
+exec env -u PALLAS_AXON_POOL_IPS -u PALLAS_AXON_REMOTE_COMPILE \
+  JAX_PLATFORMS=cpu JAX_PLATFORM_NAME=cpu \
+  XLA_FLAGS="--xla_force_host_platform_device_count=8" "$@"
