@@ -209,6 +209,17 @@ def build_block_fn(
     return fn
 
 
+def _fetch_to_host(v):
+    """numpy-ify a fetched value; SelectedRows fetches (sparse grads,
+    e.g. the PS trainer fetching embedding grads) come back as a host
+    SelectedRows instead of being densified."""
+    from .selected_rows import SelectedRows
+
+    if isinstance(v, SelectedRows):
+        return SelectedRows(np.asarray(v.rows), np.asarray(v.values), v.height)
+    return np.asarray(v)
+
+
 # control-flow ops that need sub-block lowering (registered by
 # core/control_flow.py to avoid a circular import)
 _CONTROL_FLOW: Dict[str, Any] = {}
@@ -314,7 +325,7 @@ class Executor:
                 np.asarray(v)
             print(f"[benchmark] Executor.run: {(_time.perf_counter() - t0) * 1e3:.3f} ms")
         if return_numpy:
-            fetched = [np.asarray(v) for v in fetched]
+            fetched = [_fetch_to_host(v) for v in fetched]
         return fetched
 
     # -- internals ------------------------------------------------------------

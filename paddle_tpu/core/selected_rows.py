@@ -1,0 +1,121 @@
+"""SelectedRows: sparse row-slice tensor for embedding gradients.
+
+Reference: framework/selected_rows.h:32 — a (rows, value, height) triple
+where `rows` are the touched row indices of a height-row dense tensor and
+`value` holds one slice per entry. Produced by lookup_table_grad when
+is_sparse=True (operators/lookup_table_op.cc grad kernel), consumed by
+the sparse kernels of sgd/momentum/adam/adagrad
+(operators/optimizers/sgd_op.cc etc.) and by the PS sparse push path
+(operators/distributed/parameter_prefetch.cc).
+
+TPU-native redesign: a registered pytree of two arrays — ``rows`` int32
+[N] and ``values`` [N, *dims] — with the dense height as static
+aux-data, so it flows through jit like any other value. All shapes are
+static (N = number of looked-up ids, duplicates allowed), which keeps
+XLA happy; deduplication (`merge`, the reference merge_selected_rows op)
+uses ``jnp.unique(size=N)`` with out-of-range padding rows: XLA scatter
+DROPS out-of-bounds updates, so padded slots are naturally inert.
+
+The win this type exists for: an embedding update touches O(N·D) memory
+instead of O(vocab·D). On TPU that means the optimizer's
+gather/compute/scatter stays in VMEM-sized tiles instead of streaming
+the whole table through HBM every step.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_pytree_node_class
+class SelectedRows:
+    """rows: int array [N] (duplicates allowed); values: [N, *dims];
+    height: static int (the dense dim-0 extent)."""
+
+    __slots__ = ("rows", "values", "height")
+
+    def __init__(self, rows, values, height: int):
+        self.rows = rows
+        self.values = values
+        self.height = int(height)
+
+    def tree_flatten(self):
+        return (self.rows, self.values), self.height
+
+    @classmethod
+    def tree_unflatten(cls, height, children):
+        rows, values = children
+        return cls(rows, values, height)
+
+    # -- tensor-protocol conveniences (duck-typed like jax arrays) --------
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    @property
+    def shape(self):
+        return (self.height,) + tuple(self.values.shape[1:])
+
+    @property
+    def ndim(self):
+        return 1 + (self.values.ndim - 1)
+
+    def astype(self, dtype):
+        return SelectedRows(self.rows, self.values.astype(dtype), self.height)
+
+    def __mul__(self, s):
+        return SelectedRows(self.rows, self.values * s, self.height)
+
+    __rmul__ = __mul__
+
+    def __neg__(self):
+        return SelectedRows(self.rows, -self.values, self.height)
+
+    def __repr__(self):
+        return (
+            f"SelectedRows(rows={self.rows.shape}, values={self.values.shape}, "
+            f"height={self.height})"
+        )
+
+    # -- conversions ------------------------------------------------------
+    def to_dense(self):
+        """Materialize the dense [height, *dims] gradient (scatter-add).
+        Only reached by consumers with no sparse path — the sparse
+        optimizer kernels never call this."""
+        out = jnp.zeros((self.height,) + tuple(self.values.shape[1:]),
+                        self.values.dtype)
+        return out.at[self.rows].add(self.values)
+
+    def merge(self) -> "SelectedRows":
+        """Dedup rows, summing duplicate slices (reference
+        operators/merge_selected_rows_op.cc / math::scatter::MergeAdd).
+
+        Static-shape friendly: output keeps length N; slots beyond the
+        number of distinct rows get row index == height (out of bounds,
+        so any scatter through them is dropped) and zero values.
+        """
+        n = int(self.rows.shape[0])
+        rows = self.rows.reshape(-1)
+        uniq, inv = jnp.unique(
+            rows, size=n, fill_value=self.height, return_inverse=True
+        )
+        vals = jax.ops.segment_sum(
+            self.values, inv.reshape(-1), num_segments=n
+        )
+        return SelectedRows(uniq, vals.astype(self.values.dtype), self.height)
+
+    def concat(self, other: "SelectedRows") -> "SelectedRows":
+        """Stack two SelectedRows over the same dense tensor (gradient
+        aggregation: the reference sum_op accepts SelectedRows inputs and
+        concatenates their rows — operators/sum_op.h SelectedRows branch)."""
+        assert self.height == other.height, "height mismatch in sparse sum"
+        return SelectedRows(
+            jnp.concatenate([self.rows, other.rows]),
+            jnp.concatenate([self.values, other.values]),
+            self.height,
+        )
+
+
+def is_selected_rows(x) -> bool:
+    return isinstance(x, SelectedRows)

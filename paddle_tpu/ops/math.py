@@ -134,8 +134,18 @@ def _mean(ctx, op, ins):
 
 @register_op("sum", inputs=("X",), outputs=("Out",))
 def _sum_op(ctx, op, ins):
-    # variadic add (grad accumulation, reference operators/sum_op.cc)
+    # variadic add (grad accumulation, reference operators/sum_op.cc).
+    # SelectedRows inputs concatenate rows (sum_op.h SelectedRows
+    # branch); a mix of sparse and dense densifies the sparse ones.
+    from ..core.selected_rows import SelectedRows
+
     xs = ins["X"]
+    if all(isinstance(x, SelectedRows) for x in xs):
+        out = xs[0]
+        for x in xs[1:]:
+            out = out.concat(x)
+        return {"Out": [out]}
+    xs = [x.to_dense() if isinstance(x, SelectedRows) else x for x in xs]
     out = xs[0]
     for x in xs[1:]:
         out = out + x
@@ -213,9 +223,16 @@ _register_unary(
 
 @register_op("scale", inputs=("X",), outputs=("Out",))
 def _scale(ctx, op, ins):
+    from ..core.selected_rows import SelectedRows
+
     x = ins["X"][0]
     s = op.attrs.get("scale", 1.0)
     b = op.attrs.get("bias", 0.0)
+    if isinstance(x, SelectedRows):
+        # sparse grads scale their slices (reference scale_op.h
+        # SelectedRows kernel); bias on a sparse grad is undefined
+        assert not b, "scale with bias is undefined for SelectedRows"
+        return {"Out": [x * s]}
     if op.attrs.get("bias_after_scale", True):
         out = x * s + jnp.asarray(b, x.dtype)
     else:

@@ -16,10 +16,52 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from ..core.registry import register_op
+from ..core.selected_rows import SelectedRows
 
 
 def _lr(ins):
     return ins["LearningRate"][0].reshape(())
+
+
+# --------------------------------------------------------------------------
+# Sparse (SelectedRows) update paths.
+#
+# Reference: the optimizer ops each carry a second kernel specialized for
+# SelectedRows grads (operators/optimizers/sgd_op.cc SparseSGDFunctor,
+# adam_op.h SparseAdamFunctor w/ lazy_mode, momentum_op.h
+# SparseMomentumFunctor, adagrad_op.cc SparseAdagradFunctor). The TPU
+# shape: merge duplicate rows (static-shape unique+segment_sum), gather
+# the touched param/state rows, update them, scatter back. Out-of-range
+# padding rows from merge() are dropped by XLA scatter, so the padded
+# slots cost FLOPs but never touch memory. Cost scales with #touched
+# rows, not vocab.
+#
+# Note on semantics: for stateful optimizers this implements the
+# reference's `lazy_mode` (adam_op.cc attr): untouched rows' moments are
+# NOT decayed. That is the only memory-sane choice on sparse updates and
+# matches how the reference's PS path behaves.
+# --------------------------------------------------------------------------
+
+
+def _gather_rows(dense, rows):
+    # gather clamps OOB indices (padding rows read the last row; results
+    # are discarded because the matching scatter drops OOB writes)
+    return dense[rows]
+
+
+def _densify_grad(ins):
+    """Fallback for optimizers without a sparse kernel (reference ops
+    without a SelectedRows specialization densify the same way, via
+    framework/operator.cc data transform)."""
+    if ins.get("Grad") and isinstance(ins["Grad"][0], SelectedRows):
+        ins = dict(ins)
+        ins["Grad"] = [ins["Grad"][0].to_dense()]
+    return ins
+
+
+def _sgd_sparse(p, g: SelectedRows, lr):
+    # no merge needed: scatter-add is correct under duplicate rows
+    return p.at[g.rows].add((-lr * g.values).astype(p.dtype))
 
 
 @register_op(
@@ -30,6 +72,8 @@ def _lr(ins):
 )
 def _sgd(ctx, op, ins):
     p, g = ins["Param"][0], ins["Grad"][0]
+    if isinstance(g, SelectedRows):
+        return {"ParamOut": [_sgd_sparse(p, g, _lr(ins))]}
     return {"ParamOut": [p - _lr(ins) * g.astype(p.dtype)]}
 
 
@@ -43,6 +87,19 @@ def _momentum(ctx, op, ins):
     p, g, v = ins["Param"][0], ins["Grad"][0], ins["Velocity"][0]
     mu = float(op.attrs.get("mu", 0.9))
     lr = _lr(ins)
+    if isinstance(g, SelectedRows):
+        g = g.merge()
+        rows, gv = g.rows, g.values.astype(p.dtype)
+        v_r = _gather_rows(v, rows)
+        v_new_r = mu * v_r + gv
+        if op.attrs.get("use_nesterov", False):
+            p_new_r = _gather_rows(p, rows) - (gv + mu * v_new_r) * lr
+        else:
+            p_new_r = _gather_rows(p, rows) - lr * v_new_r
+        return {
+            "ParamOut": [p.at[rows].set(p_new_r)],
+            "VelocityOut": [v.at[rows].set(v_new_r)],
+        }
     v_new = mu * v + g
     if op.attrs.get("use_nesterov", False):
         p_new = p - (g + mu * v_new) * lr
@@ -58,6 +115,7 @@ def _momentum(ctx, op, ins):
     stop_gradient=True,
 )
 def _lars_momentum(ctx, op, ins):
+    ins = _densify_grad(ins)
     # reference optimizers/lars_momentum_op.cc: layer-adaptive lr scaling
     p, g, v = ins["Param"][0], ins["Grad"][0], ins["Velocity"][0]
     mu = float(op.attrs.get("mu", 0.9))
@@ -86,11 +144,27 @@ def _adam(ctx, op, ins):
     beta2 = float(op.attrs.get("beta2", 0.999))
     eps = float(op.attrs.get("epsilon", 1e-8))
     lr = _lr(ins)
+    lr_t = lr * jnp.sqrt(1 - b2p.reshape(())) / (1 - b1p.reshape(()))
+    if isinstance(g, SelectedRows):
+        # reference adam_op.h SparseAdamFunctor, lazy_mode semantics:
+        # only touched rows' moments update
+        g = g.merge()
+        rows, gv = g.rows, g.values.astype(p.dtype)
+        m1_r, m2_r = _gather_rows(m1, rows), _gather_rows(m2, rows)
+        m1n_r = beta1 * m1_r + (1 - beta1) * gv
+        m2n_r = beta2 * m2_r + (1 - beta2) * jnp.square(gv)
+        p_new_r = _gather_rows(p, rows) - lr_t * m1n_r / (jnp.sqrt(m2n_r) + eps)
+        return {
+            "ParamOut": [p.at[rows].set(p_new_r)],
+            "Moment1Out": [m1.at[rows].set(m1n_r)],
+            "Moment2Out": [m2.at[rows].set(m2n_r)],
+            "Beta1PowOut": [b1p * beta1],
+            "Beta2PowOut": [b2p * beta2],
+        }
     g = g.astype(p.dtype)
     m1n = beta1 * m1 + (1 - beta1) * g
     m2n = beta2 * m2 + (1 - beta2) * jnp.square(g)
     # bias-corrected lr, as in reference adam_op.h
-    lr_t = lr * jnp.sqrt(1 - b2p.reshape(())) / (1 - b1p.reshape(()))
     p_new = p - lr_t * m1n / (jnp.sqrt(m2n) + eps)
     return {
         "ParamOut": [p_new],
@@ -125,6 +199,16 @@ def _adamw(ctx, op, ins):
 def _adagrad(ctx, op, ins):
     p, g, m = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
     eps = float(op.attrs.get("epsilon", 1e-6))
+    if isinstance(g, SelectedRows):
+        # reference adagrad_op.cc SparseAdagradFunctor
+        g = g.merge()
+        rows, gv = g.rows, g.values.astype(p.dtype)
+        m_new_r = _gather_rows(m, rows) + jnp.square(gv)
+        p_new_r = _gather_rows(p, rows) - _lr(ins) * gv / (jnp.sqrt(m_new_r) + eps)
+        return {
+            "ParamOut": [p.at[rows].set(p_new_r)],
+            "MomentOut": [m.at[rows].set(m_new_r)],
+        }
     m_new = m + jnp.square(g)
     return {
         "ParamOut": [p - _lr(ins) * g / (jnp.sqrt(m_new) + eps)],
@@ -139,6 +223,7 @@ def _adagrad(ctx, op, ins):
     stop_gradient=True,
 )
 def _decayed_adagrad(ctx, op, ins):
+    ins = _densify_grad(ins)
     p, g, m = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
     decay = float(op.attrs.get("decay", 0.95))
     eps = float(op.attrs.get("epsilon", 1e-6))
@@ -156,6 +241,7 @@ def _decayed_adagrad(ctx, op, ins):
     stop_gradient=True,
 )
 def _adadelta(ctx, op, ins):
+    ins = _densify_grad(ins)
     p, g = ins["Param"][0], ins["Grad"][0]
     asg, asu = ins["AvgSquaredGrad"][0], ins["AvgSquaredUpdate"][0]
     rho = float(op.attrs.get("rho", 0.95))
@@ -177,6 +263,7 @@ def _adadelta(ctx, op, ins):
     stop_gradient=True,
 )
 def _adamax(ctx, op, ins):
+    ins = _densify_grad(ins)
     p, g = ins["Param"][0], ins["Grad"][0]
     m, u = ins["Moment"][0], ins["InfNorm"][0]
     b1p = ins["Beta1Pow"][0]
@@ -201,6 +288,7 @@ def _adamax(ctx, op, ins):
     stop_gradient=True,
 )
 def _rmsprop(ctx, op, ins):
+    ins = _densify_grad(ins)
     p, g = ins["Param"][0], ins["Grad"][0]
     mom, ms = ins["Moment"][0], ins["MeanSquare"][0]
     eps = float(op.attrs.get("epsilon", 1e-10))
@@ -232,6 +320,7 @@ def _rmsprop(ctx, op, ins):
     stop_gradient=True,
 )
 def _ftrl(ctx, op, ins):
+    ins = _densify_grad(ins)
     p, g = ins["Param"][0], ins["Grad"][0]
     sq, lin = ins["SquaredAccumulator"][0], ins["LinearAccumulator"][0]
     l1 = float(op.attrs.get("l1", 0.0)) + 1e-10
@@ -264,6 +353,7 @@ def _ftrl(ctx, op, ins):
     stop_gradient=True,
 )
 def _lamb(ctx, op, ins):
+    ins = _densify_grad(ins)
     # reference optimizers/lamb_op.cc — layerwise-adaptive large-batch opt
     p, g = ins["Param"][0], ins["Grad"][0]
     m1, m2 = ins["Moment1"][0], ins["Moment2"][0]
@@ -298,6 +388,7 @@ def _lamb(ctx, op, ins):
     stop_gradient=True,
 )
 def _dpsgd(ctx, op, ins):
+    ins = _densify_grad(ins)
     # differentially-private SGD (reference optimizers/dpsgd_op.cc):
     # clip grad by norm, add gaussian noise scaled by sigma
     import jax

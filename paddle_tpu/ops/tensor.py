@@ -248,6 +248,70 @@ def _lookup_table_v2(ctx, op, ins):
     return {"Out": [out]}
 
 
+def _embedding_grad(op, ins, squeeze_trailing):
+    """Shared grad kernel for lookup_table / lookup_table_v2.
+
+    is_sparse=True -> SelectedRows (reference lookup_table_op.cc grad
+    kernel emits SelectedRows; framework/selected_rows.h:32): O(N*D)
+    memory, no vocab-sized materialization. Else dense scatter-add.
+    """
+    from ..core.selected_rows import SelectedRows
+
+    w, ids, og = ins["W"][0], ins["Ids"][0], ins["Out@GRAD"][0]
+    if squeeze_trailing and ids.ndim > 1 and ids.shape[-1] == 1:
+        ids = ids.squeeze(-1)
+    pad = op.attrs.get("padding_idx", -1)
+    flat_ids = ids.reshape(-1)
+    flat_g = og.reshape(-1, og.shape[-1])
+    if pad is not None and pad >= 0:
+        flat_g = jnp.where((flat_ids == pad)[:, None], jnp.zeros((), flat_g.dtype), flat_g)
+    if op.attrs.get("is_sparse", False):
+        wg = SelectedRows(flat_ids, flat_g.astype(w.dtype), height=w.shape[0])
+    else:
+        wg = jnp.zeros(w.shape, w.dtype).at[flat_ids].add(flat_g.astype(w.dtype))
+    return {"W@GRAD": [wg]}
+
+
+@register_op(
+    "lookup_table_grad",
+    inputs=("W", "Ids", "Out@GRAD"),
+    outputs=("W@GRAD",),
+    stop_gradient=True,
+)
+def _lookup_table_grad(ctx, op, ins):
+    return _embedding_grad(op, ins, squeeze_trailing=True)
+
+
+@register_op(
+    "lookup_table_v2_grad",
+    inputs=("W", "Ids", "Out@GRAD"),
+    outputs=("W@GRAD",),
+    stop_gradient=True,
+)
+def _lookup_table_v2_grad(ctx, op, ins):
+    return _embedding_grad(op, ins, squeeze_trailing=False)
+
+
+@register_op("merge_selected_rows", inputs=("X",), outputs=("Out",), stop_gradient=True)
+def _merge_selected_rows(ctx, op, ins):
+    # reference operators/merge_selected_rows_op.cc: dedup rows, sum slices
+    from ..core.selected_rows import SelectedRows
+
+    x = ins["X"][0]
+    assert isinstance(x, SelectedRows), "merge_selected_rows needs a SelectedRows input"
+    return {"Out": [x.merge()]}
+
+
+@register_op("get_tensor_from_selected_rows", inputs=("X",), outputs=("Out",),
+             stop_gradient=True)
+def _get_tensor_from_selected_rows(ctx, op, ins):
+    # reference operators/get_tensor_from_selected_rows_op.cc
+    from ..core.selected_rows import SelectedRows
+
+    x = ins["X"][0]
+    return {"Out": [x.to_dense() if isinstance(x, SelectedRows) else x]}
+
+
 @register_op("one_hot", inputs=("X",), outputs=("Out",), stop_gradient=True)
 def _one_hot(ctx, op, ins):
     x = ins["X"][0]

@@ -82,7 +82,8 @@ class PSClient:
             P.request(
                 _addr(ep),
                 {"verb": P.PUSH_SPARSE, "name": f"{name}@{lo}",
-                 "rows": rows[mask] - lo, "grad": grad[mask]},
+                 "rows": rows[mask] - lo, "grad": grad[mask],
+                 "trainer_id": self.trainer_id},
             )
 
     def barrier(self):

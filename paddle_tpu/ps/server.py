@@ -60,6 +60,47 @@ class _ShardState:
         else:
             raise NotImplementedError(f"pserver optimizer {kind!r}")
 
+    def apply_sparse(self, rows: np.ndarray, grad: np.ndarray):
+        """Row-sliced update (reference sparse optimizer kernels,
+        operators/optimizers/*_op.cc SelectedRows specializations; adam
+        uses lazy_mode semantics — untouched rows' moments stay put)."""
+        kind = self.spec.get("type", "sgd")
+        lr = float(self.spec.get("lr", 0.01))
+        # dedup rows so stateful updates see each row once
+        uniq, inv = np.unique(rows, return_inverse=True)
+        merged = np.zeros((len(uniq),) + grad.shape[1:], grad.dtype)
+        np.add.at(merged, inv, grad)
+        if kind == "sgd":
+            self.value[uniq] -= lr * merged
+        elif kind == "adam":
+            beta1 = self.spec.get("beta1", 0.9)
+            beta2 = self.spec.get("beta2", 0.999)
+            eps = self.spec.get("epsilon", 1e-8)
+            m1 = self.state.setdefault("m1", np.zeros_like(self.value))
+            m2 = self.state.setdefault("m2", np.zeros_like(self.value))
+            b1p = self.state.setdefault("b1p", np.array(beta1, np.float64))
+            b2p = self.state.setdefault("b2p", np.array(beta2, np.float64))
+            m1[uniq] = beta1 * m1[uniq] + (1 - beta1) * merged
+            m2[uniq] = beta2 * m2[uniq] + (1 - beta2) * merged * merged
+            lr_t = lr * np.sqrt(1 - b2p) / (1 - b1p)
+            self.value[uniq] -= (lr_t * m1[uniq] / (np.sqrt(m2[uniq]) + eps)).astype(
+                np.float32
+            )
+            self.state["b1p"] = b1p * beta1
+            self.state["b2p"] = b2p * beta2
+        elif kind == "momentum":
+            mu = self.spec.get("mu", 0.9)
+            v = self.state.setdefault("v", np.zeros_like(self.value))
+            v[uniq] = mu * v[uniq] + merged
+            self.value[uniq] -= lr * v[uniq]
+        elif kind == "adagrad":
+            eps = self.spec.get("epsilon", 1e-6)
+            acc = self.state.setdefault("acc", np.zeros_like(self.value))
+            acc[uniq] += merged * merged
+            self.value[uniq] -= lr * merged / (np.sqrt(acc[uniq]) + eps)
+        else:
+            raise NotImplementedError(f"pserver sparse optimizer {kind!r}")
+
 
 class ParameterServer:
     def __init__(self, endpoint: str, shards: Dict[str, np.ndarray],
@@ -114,11 +155,24 @@ class ParameterServer:
                 rows = msg["rows"].astype(np.int64)
                 return {"ok": True, "value": sh.value[rows]}
         if verb == P.PUSH_SPARSE:
+            tid = int(msg.get("trainer_id", 0))
+            self._last_seen[tid] = time.time()
             with self._lock:
                 sh = self._shards[msg["name"]]
                 rows = msg["rows"].astype(np.int64)
-                lr = float(sh.spec.get("lr", 0.01))
-                np.subtract.at(sh.value, rows, lr * msg["grad"])
+                grad = msg["grad"]
+                if self._sync and self._trainers > 1:
+                    # accumulate (rows, grad) per barrier round; apply
+                    # once when every trainer reported (mean semantics,
+                    # matching the dense sync path)
+                    sh.pending.append((rows, grad / self._trainers))
+                    if len(sh.pending) >= self._trainers:
+                        all_rows = np.concatenate([r for r, _ in sh.pending])
+                        all_grads = np.concatenate([g for _, g in sh.pending])
+                        sh.apply_sparse(all_rows, all_grads)
+                        sh.pending.clear()
+                else:
+                    sh.apply_sparse(rows, grad)
             return {"ok": True}
         if verb == P.BARRIER:
             deadline = time.time() + 300.0

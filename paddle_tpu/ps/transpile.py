@@ -38,6 +38,11 @@ class PSArtifacts:
     endpoints: List[str]
     sync_mode: bool
     trainers: int
+    # sparse embedding params (is_sparse lookup_table): param -> ids
+    # feed-var name; their grads travel as SelectedRows row pushes and
+    # only touched rows are prefetched (reference
+    # distributed_lookup_table_op + parameter_prefetch.cc)
+    sparse_params: Dict[str, str] = dataclasses.field(default_factory=dict)
     # pserver_* kept for reference API parity (get_pserver_program)
     pserver_programs: Dict[str, Dict] = dataclasses.field(default_factory=dict)
     pserver_startups: Dict[str, Dict] = dataclasses.field(default_factory=dict)
@@ -89,6 +94,20 @@ def build_ps_programs(
     block.ops = kept
     trainer._bump()
 
+    # 1b) record sparse embedding params: is_sparse lookup_tables whose
+    # ids come STRAIGHT from a feed var — only then can the trainer
+    # prefetch the batch's rows before the step. Ids that are computed
+    # mid-program fall back to the dense param pull (still correct,
+    # just not row-sliced).
+    sparse_params: Dict[str, str] = {}
+    for op in kept:
+        if op.type in ("lookup_table", "lookup_table_v2") and op.attrs.get("is_sparse"):
+            pname = op.inputs["W"][0]
+            ids_name = op.inputs["Ids"][0]
+            ids_var = block.var(ids_name) if block.has_var(ids_name) else None
+            if pname in grad_to_param.values() and ids_var is not None and ids_var.is_data:
+                sparse_params[pname] = ids_name
+
     # 2) shard params across endpoints by rows (reference slice_var_up)
     shard_map: Dict[str, List[Tuple[str, int, int]]] = {}
     params = sorted(grad_to_param.values())
@@ -121,6 +140,7 @@ def build_ps_programs(
         trainers=trainers,
         pserver_programs=pserver_programs,
         pserver_startups={ep: {} for ep in endpoints},
+        sparse_params=sparse_params,
     )
 
 
@@ -163,9 +183,32 @@ class PSTrainer:
         self.scope = scope
         self.client = PSClient(artifacts.endpoints, trainer_id)
 
+    def _refresh_sparse_rows(self, feed):
+        """Prefetch only the embedding rows this batch will touch
+        (reference parameter_prefetch.cc): comm volume scales with the
+        batch, not the vocab."""
+        import jax.numpy as jnp
+
+        for pname, ids_name in self.art.sparse_params.items():
+            if ids_name not in feed:
+                continue
+            rows = np.unique(np.asarray(feed[ids_name]).reshape(-1)).astype(np.int64)
+            fresh = self.client.prefetch_rows(self.art.shard_map, pname, rows)
+            if fresh is None:
+                continue
+            cur = self.scope.find_var(pname)
+            # row-sliced device update — no vocab-sized host round-trip
+            self.scope.set_var(
+                pname,
+                jnp.asarray(cur).at[jnp.asarray(rows)].set(jnp.asarray(fresh)),
+            )
+
     def run_step(self, feed, fetch_list):
         import jax.numpy as jnp
 
+        from ..core.selected_rows import SelectedRows
+
+        self._refresh_sparse_rows(feed)
         grads = [g for g in self.art.grad_to_param]
         outs = self.exe.run(
             self.art.trainer_program,
@@ -176,12 +219,23 @@ class PSTrainer:
         n = len(fetch_list)
         fetched, grad_vals = outs[:n], outs[n:]
         for gname, gval in zip(grads, grad_vals):
-            self.client.send_grad(self.art.shard_map, self.art.grad_to_param[gname],
-                                  np.asarray(gval))
+            pname = self.art.grad_to_param[gname]
+            if isinstance(gval, SelectedRows):
+                # dedup host-side so the wire carries each row once
+                rows = np.asarray(gval.rows)
+                vals = np.asarray(gval.values)
+                uniq, inv = np.unique(rows, return_inverse=True)
+                merged = np.zeros((len(uniq),) + vals.shape[1:], vals.dtype)
+                np.add.at(merged, inv, vals)
+                self.client.push_sparse(self.art.shard_map, pname, uniq, merged)
+            else:
+                self.client.send_grad(self.art.shard_map, pname, np.asarray(gval))
         if self.art.sync_mode and self.art.trainers > 1:
             # all trainers' grads must land before the update is visible
             self.client.barrier()
         for pname in self.art.shard_map:
+            if pname in self.art.sparse_params:
+                continue  # refreshed rows-only at the top of each step
             fresh = self.client.get_param(self.art.shard_map, pname)
             self.scope.set_var(pname, jnp.asarray(fresh))
         return fetched

@@ -27,7 +27,9 @@ _WORKER = textwrap.dedent(
     import jax, jax.numpy as jnp
     x = jnp.ones((jax.local_device_count(), 2)) * (env.rank + 1)
     y = jax.pmap(lambda v: jax.lax.psum(v, "i"), axis_name="i")(x)
-    print("PSUM_RESULT", env.rank, float(np.asarray(y)[0, 0]), flush=True)
+    # per-rank result file: concurrent stdout writes interleave mid-line
+    with open({outdir!r} + f"/rank{{env.rank}}.txt", "w") as f:
+        f.write(str(float(np.asarray(y)[0, 0])))
     """
 )
 
@@ -35,23 +37,28 @@ _WORKER = textwrap.dedent(
 def test_two_process_psum(tmp_path):
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     worker = tmp_path / "worker.py"
-    worker.write_text(_WORKER.format(repo=repo))
+    worker.write_text(_WORKER.format(repo=repo, outdir=str(tmp_path)))
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env["JAX_PLATFORMS"] = "cpu"
     # drop the 8-device virtualization for the children: 1 device/proc
     env["XLA_FLAGS"] = ""
+    import socket
+
+    with socket.socket() as s:  # free port: fixed ports flake on reruns
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
     proc = subprocess.run(
         [sys.executable, "-m", "paddle_tpu.distributed.launch",
-         "--nproc_per_node=2", "--started_port=6810", str(worker)],
+         "--nproc_per_node=2", f"--started_port={port}", str(worker)],
         cwd=repo, env=env, capture_output=True, text=True, timeout=150,
     )
     out = proc.stdout + proc.stderr
     assert proc.returncode == 0, out[-2000:]
-    results = {}
-    for line in out.splitlines():
-        if line.startswith("PSUM_RESULT"):
-            _, rank, val = line.split()
-            results[int(rank)] = float(val)
+    results = {
+        r: float((tmp_path / f"rank{r}.txt").read_text())
+        for r in (0, 1)
+        if (tmp_path / f"rank{r}.txt").exists()
+    }
     # psum over both processes: 1 + 2 = 3 everywhere
     assert results == {0: 3.0, 1: 3.0}, (results, out[-1000:])

@@ -115,3 +115,61 @@ def test_sparse_prefetch_and_push():
     got2 = client.prefetch_rows(shard_map, "emb", rows)
     np.testing.assert_allclose(got2, table[rows] - 1.0)
     client.shutdown_servers()
+
+
+def test_sparse_embedding_ps_training_matches_local():
+    """End-to-end PS training with an is_sparse embedding: grads travel
+    as SelectedRows row pushes, params refresh rows-only via prefetch.
+    Loss parity vs the local dense run (reference test_dist_fleet_ctr-
+    style sparse PS training, tolerance per test_dist_base.py:506)."""
+    VOCAB, DIM = 50, 4
+
+    def build(seed=11):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = seed
+        startup.random_seed = seed
+        with fluid.program_guard(main, startup):
+            ids = fluid.layers.data("ids", [3], dtype="int64")
+            y = fluid.layers.data("y", [1])
+            emb = fluid.layers.embedding(
+                ids, [VOCAB, DIM], is_sparse=True,
+                param_attr=fluid.ParamAttr(name="sp_emb.w"))
+            pooled = fluid.layers.reduce_mean(emb, dim=1)
+            pred = fluid.layers.fc(pooled, 1, bias_attr=False)
+            loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+            fluid.optimizer.SGD(0.1).minimize(loss)
+        return main, startup, loss
+
+    rng = np.random.RandomState(4)
+    batches = [
+        {"ids": rng.randint(0, VOCAB, (8, 3)).astype("int64"),
+         "y": rng.randn(8, 1).astype("float32")}
+        for _ in range(6)
+    ]
+
+    main, startup, loss = build()
+    s_local = fluid.Scope()
+    with fluid.scope_guard(s_local):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        local_losses = [float(exe.run(main, feed=b, fetch_list=[loss])[0]) for b in batches]
+
+    main2, startup2, loss2 = build()
+    eps = _ports(2)
+    config = DistributeTranspilerConfig()
+    config.mode = "pserver"
+    t = DistributeTranspiler(config)
+    t.transpile(0, program=main2, pservers=",".join(eps), trainers=1, sync_mode=True,
+                startup_program=startup2)
+    art = t._ps_artifacts
+    assert art.sparse_params.get("sp_emb.w") == "ids", art.sparse_params
+    s_ps = fluid.Scope()
+    with fluid.scope_guard(s_ps):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup2)
+        servers = launch_pservers(art, s_ps)
+        trainer = PSTrainer(art, exe, s_ps)
+        ps_losses = [float(trainer.run_step(b, [loss2])[0]) for b in batches]
+        trainer.client.shutdown_servers()
+
+    np.testing.assert_allclose(local_losses, ps_losses, atol=1e-4, rtol=1e-4)
