@@ -79,3 +79,40 @@ def _lower_conditional_block(block, op, env, ctx):
     init = tuple(env[n] for n in carry_names)
     out = jax.lax.cond(pred, true_fn, false_fn, init)
     env.update(zip(carry_names, out))
+
+
+@register_control_flow("recompute_segment_grad")
+def _lower_recompute_segment_grad(block, op, env, ctx):
+    """Segment-level gradient with rematerialization.
+
+    Emitted by backward.append_backward_with_recompute (reference
+    backward.py:618 checkpoint-aware backward). Re-runs the segment's
+    forward lowering under jax.checkpoint and applies the incoming
+    cotangents with jax.vjp. jax.checkpoint's optimization barriers
+    stop XLA from CSE-ing the recompute with the original forward, so
+    the segment's internal activations are actually freed after the
+    forward pass and recomputed here.
+    """
+    sub = op.attrs["sub_block"]
+    in_names = op.inputs["Inputs"]
+    out_names = op.attrs["seg_outputs"]
+    wanted = op.attrs["wanted"]
+    out_grad_names = op.inputs["OutGrads"]
+
+    diff = {n: env[n] for n in wanted}
+    aux = {n: env[n] for n in in_names if n not in set(wanted)}
+
+    def seg_fn(diff_vals):
+        local = dict(aux)
+        local.update(diff_vals)
+        _lower_block(sub, local, ctx)
+        return tuple(local[n] for n in out_names)
+
+    primals, vjp_fn = jax.vjp(jax.checkpoint(seg_fn), diff)
+    cots = tuple(
+        jnp.asarray(env[g], dtype=p.dtype)
+        for g, p in zip(out_grad_names, primals)
+    )
+    (grads,) = vjp_fn(cots)
+    for n, gname in zip(wanted, op.outputs["InGrads"]):
+        env[gname] = grads[n]

@@ -856,9 +856,14 @@ class ModelAverage(Optimizer):
 class RecomputeOptimizer(Optimizer):
     """Reference optimizer.py:3714 — wraps an optimizer, marking
     checkpoint vars; backward recomputes segments between checkpoints
-    instead of storing activations. TPU-native: segment boundaries are
-    recorded and the executor wraps each segment's lowering in
-    jax.checkpoint (remat) — see core/executor.py recompute support."""
+    instead of storing activations.
+
+    TPU-native: backward emits one `recompute_segment_grad` op per
+    checkpoint-delimited forward segment
+    (core/backward.py append_backward_with_recompute); its lowering
+    re-runs the segment under jax.checkpoint, so XLA rematerializes the
+    segment in the backward pass instead of keeping its activations
+    live (reference backward.py:618)."""
 
     def __init__(self, optimizer):
         self._optimizer = optimizer
@@ -869,11 +874,12 @@ class RecomputeOptimizer(Optimizer):
 
     def backward(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None, callbacks=None):
-        program = loss.block.program
         if self._checkpoints:
-            program._recompute_checkpoints = [
-                v.name if isinstance(v, Variable) else str(v) for v in self._checkpoints
-            ]
+            from .core.backward import append_backward_with_recompute
+
+            return append_backward_with_recompute(
+                loss, self._checkpoints, parameter_list, no_grad_set
+            )
         return self._optimizer.backward(loss, startup_program, parameter_list, no_grad_set)
 
     def apply_gradients(self, params_grads):
@@ -959,6 +965,37 @@ def _bcast_cond(cond_var, template):
         raise NotImplementedError("lookahead needs static param shapes")
     b = elementwise_mul(ones_t, c)
     return cast(b, "bool")
+
+
+class GradientMergeOptimizer:
+    """Gradient accumulation over k microbatches with one optimizer
+    apply (reference ir/multi_batch_merge_pass.cc — repeat fwd/bwd k
+    times, single update; exposed as batch_merge_repeat in dist
+    training).
+
+    TPU-native: marks the program; the executor compiles the step as a
+    lax.scan over k microbatch slices of the feeds with a running-mean
+    grad accumulator, then the optimizer ops run once
+    (core/executor.py _build_gradient_merge_fn). The feed batch must be
+    divisible by k."""
+
+    def __init__(self, inner_optimizer, k_steps=1, avg=True):
+        self.inner_optimizer = inner_optimizer
+        self.k_steps = int(k_steps)
+        self.avg = bool(avg)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None, no_grad_set=None):
+        out = self.inner_optimizer.minimize(
+            loss, startup_program, parameter_list, no_grad_set
+        )
+        program = loss.block.program
+        program._gradient_merge_k = self.k_steps
+        program._gradient_merge_avg = self.avg
+        program._bump()
+        return out
+
+    def __getattr__(self, item):
+        return getattr(self.inner_optimizer, item)
 
 
 class PipelineOptimizer:
