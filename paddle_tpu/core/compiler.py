@@ -94,6 +94,28 @@ class CompiledProgram:
         self._in_shardings = shardings
         return self
 
+    def with_pipeline(self, places=None) -> "CompiledProgram":
+        """Attach a `pp` mesh sized to the program's pipeline stages
+        (PipelineOptimizer cut_list). The executor then compiles the
+        step as the SPMD GPipe schedule (core/pipeline_program.py)."""
+        import jax
+        from jax.sharding import Mesh
+        import numpy as np
+
+        cuts = getattr(self._program, "_pipeline_cuts", None)
+        if not cuts:
+            raise ValueError(
+                "program has no pipeline cuts — minimize with "
+                "PipelineOptimizer(cut_list=...) first"
+            )
+        n = len(cuts) + 1
+        devs = places_to_devices(places) if places else jax.devices()
+        if len(devs) < n:
+            raise ValueError(f"pipeline needs {n} devices, have {len(devs)}")
+        self._mesh = Mesh(np.array(devs[:n]), ("pp",))
+        self._in_shardings = {}
+        return self
+
     # graph passthroughs used by reference code
     @property
     def program(self):

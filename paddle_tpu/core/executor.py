@@ -188,6 +188,20 @@ def build_block_fn(
     (*fetches, *new_state) for a block. This is the object XLA
     compiles; also used directly by __graft_entry__ and the bench."""
 
+    cuts = getattr(block.program, "_pipeline_cuts", None)
+    if cuts and mesh is not None and "pp" in getattr(mesh, "shape", {}):
+        if int(getattr(block.program, "_gradient_merge_k", 0) or 0) > 1:
+            raise NotImplementedError(
+                "PipelineOptimizer + GradientMergeOptimizer cannot be "
+                "composed yet — raise num_microbatches instead (the "
+                "pipeline already accumulates over microbatches)"
+            )
+        from .pipeline_program import build_pipeline_fn
+
+        return build_pipeline_fn(
+            block, feed_names, state_names, fetch_names, written_names, mesh
+        )
+
     k = int(getattr(block.program, "_gradient_merge_k", 0) or 0)
     if k > 1:
         return _build_gradient_merge_fn(

@@ -1000,19 +1000,44 @@ class GradientMergeOptimizer:
 
 class PipelineOptimizer:
     """Reference optimizer.py:3414 — splits the program at cut points
-    into pipeline sections run by SectionWorkers over scope queues.
-    TPU-native pipeline parallelism (stage meshes + collective permute
-    with 1F1B) lives in paddle_tpu.parallel.pipeline; this class keeps
-    the reference API and currently trains without pipelining (single
-    fused step), which is numerically identical."""
+    into pipeline sections run by SectionWorker threads over scope
+    queues (section_worker.cc).
+
+    TPU-native: `cut_list` marks the program; when the executor runs it
+    on a mesh with a `pp` axis (CompiledProgram.with_pipeline), the
+    step compiles into ONE SPMD GPipe schedule over that axis
+    (core/pipeline_program.py): stage activations flow by
+    lax.ppermute, jax.grad through the schedule is the pipelined
+    backward, the optimizer ops run once on merged grads. Without a pp
+    mesh the program trains unpipelined (numerically identical).
+    `num_microbatches` replaces the reference's queue/concurrency
+    knobs: the feed batch is split into that many microbatches."""
 
     def __init__(self, optimizer, cut_list=None, place_list=None, concurrency_list=None,
-                 queue_size=30, sync_steps=1, start_cpu_core_id=0):
+                 queue_size=30, sync_steps=1, start_cpu_core_id=0,
+                 num_microbatches=4):
         self._optimizer = optimizer
         self._cut_list = cut_list
+        self._num_microbatches = int(num_microbatches)
 
     def minimize(self, loss, startup_program=None, parameter_list=None, no_grad_set=None):
-        return self._optimizer.minimize(loss, startup_program, parameter_list, no_grad_set)
+        out = self._optimizer.minimize(loss, startup_program, parameter_list, no_grad_set)
+        cuts = []
+        for c in self._cut_list or []:
+            cs = c if isinstance(c, (list, tuple)) else [c]
+            for v in cs:
+                n = v.name if isinstance(v, Variable) else str(v)
+                if n not in cuts:
+                    cuts.append(n)
+        if cuts:
+            program = loss.block.program
+            program._pipeline_cuts = cuts
+            program._pipeline_microbatches = self._num_microbatches
+            program._bump()
+        return out
+
+    def __getattr__(self, item):
+        return getattr(self._optimizer, item)
 
 
 # reference short aliases

@@ -118,6 +118,113 @@ def pipeline_apply(
     )(stage_params, microbatches)
 
 
+def pipeline_schedule(
+    stage_fns,
+    params,
+    feeds_mb,
+    boundary0,
+    aux0,
+    mesh,
+    axis_name: str = "pp",
+    remat: bool = True,
+):
+    """GPipe fill/steady/drain schedule for S *heterogeneous* stage
+    callables on one SPMD mesh axis (the Program-level pipeline path;
+    `pipeline_apply` above is the stacked-weights fast path for
+    identical stages).
+
+    stage_fns: S callables ``f_s(params, boundary_in, mb_feeds, mb_idx)
+        -> (boundary_out, aux)`` (mb_idx: the scalar microbatch index —
+    fold it into any stage-local RNG so microbatches don't share
+    dropout masks). Every stage must produce/consume ONE
+    common boundary pytree structure — the SPMD analogue of the
+    reference's scope-queue payload between SectionWorkers
+    (framework/section_worker.cc). Only the LAST stage's aux is kept
+    (earlier stages return zeros).
+    params: pytree threaded to every stage, replicated. Everything a
+        stage reads from the outer trace MUST come through here or
+    feeds_mb, not lexical closure: closed-over jit arguments carry the
+    caller mesh's Auto shardings, which clash with the Manual context.
+    feeds_mb: pytree of [M, ...] microbatched feeds, replicated — each
+        stage slices the microbatch it is working on.
+    boundary0 / aux0: pytrees of ShapeDtypeStruct-likes (.shape/.dtype)
+        fixing the carry structures; the zeros are materialized inside
+        the per-device body (outside it they would carry the caller
+        mesh's Auto sharding and clash with the Manual context).
+
+    Returns aux summed over the M microbatches, replicated.
+    Differentiable: lax.switch/ppermute transpose cleanly and the
+    static-trip fori_loop unrolls to scan under reverse AD, so
+    `jax.grad` through the schedule yields the pipelined backward
+    (reverse fill/drain) without a hand-written 1F1B transpose.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    n_stages = mesh.shape[axis_name]
+    if len(stage_fns) != n_stages:
+        raise ValueError(
+            f"{len(stage_fns)} pipeline stages but mesh axis {axis_name!r} "
+            f"has {n_stages} devices — they must match"
+        )
+    if remat:
+        stage_fns = [jax.checkpoint(f) for f in stage_fns]
+
+    M = jax.tree_util.tree_leaves(feeds_mb)[0].shape[0]
+    tmap = jax.tree_util.tree_map
+
+    def per_device(prms, feeds):
+        idx = lax.axis_index(axis_name)
+        total = M + n_stages - 1
+        perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+        # make carries device-varying so the loop types check under shard_map
+        vary = lambda a: a + (idx * 0).astype(a.dtype)
+        b0 = tmap(lambda a: vary(jnp.zeros(a.shape, a.dtype)), boundary0)
+        a0 = tmap(lambda a: vary(jnp.zeros(a.shape, a.dtype)), aux0)
+
+        def tick(t, carry):
+            inflight, aux_acc = carry
+            mb_idx = jnp.clip(t - idx, 0, M - 1)
+            mb = tmap(
+                lambda a: lax.dynamic_index_in_dim(a, mb_idx, 0, keepdims=False),
+                feeds,
+            )
+            # every branch's outputs must carry the same varying-over-pp
+            # type, but e.g. the last stage returns constant zeros for
+            # its boundary — mark all outputs varying
+            branches = [
+                (lambda f: lambda p, b, m, i: tmap(vary, f(p, b, m, i)))(f)
+                for f in stage_fns
+            ]
+            b_out, aux = lax.switch(idx, branches, prms, inflight, mb, mb_idx)
+            active = (t - idx >= 0) & (t - idx < M)
+            b_out = tmap(lambda y, old: jnp.where(active, y, old), b_out, inflight)
+            take = active & (idx == n_stages - 1)
+            aux_acc = tmap(
+                lambda acc, a: acc + jnp.where(take, a, jnp.zeros_like(a)),
+                aux_acc,
+                aux,
+            )
+            return (lax.ppermute(b_out, axis_name, perm), aux_acc)
+
+        _, aux_acc = lax.fori_loop(0, total, tick, (b0, a0))
+        # nonzero only on the last stage; psum broadcasts + proves replication
+        return tmap(lambda a: lax.psum(a, axis_name), aux_acc)
+
+    smap = _shard_map()
+    # check_vma=False: with varying-manual-axes checking ON, the
+    # transpose of lax.switch/cond on a device-varying index mis-routes
+    # cotangents (minimal repro: 2-device switch picking p[idx] gives
+    # grad (4,0) instead of (2,5)). The schedule's replication proofs
+    # are handled by the explicit psum above, so the check is safely
+    # dropped.
+    kwargs = {"mesh": mesh, "in_specs": (P(), P()), "out_specs": P()}
+    try:
+        wrapped = smap(per_device, check_vma=False, **kwargs)
+    except TypeError:
+        wrapped = smap(per_device, check_rep=False, **kwargs)
+    return wrapped(params, feeds_mb)
+
+
 def pipeline_train_step(
     stage_fn: Callable,
     loss_fn: Callable,
