@@ -143,12 +143,38 @@ def build_pipeline_fn(
     )
 
     _SUM_OPS = {"reduce_sum", "sum"}
+    _MEAN_OPS = {"mean", "reduce_mean", "accuracy", "auc"}
+    # reduction-preserving unary ops we can see through when walking
+    # back to the real reduction
+    _TRANSPARENT = {"scale", "cast", "reshape", "squeeze", "unsqueeze", "assign"}
 
-    def _aux_is_mean(name: str) -> bool:
+    def _producer(name: str):
         for op in reversed(fwd_ops):
             if any(name in ns for ns in op.outputs.values()):
-                return op.type not in _SUM_OPS
-        return True
+                return op
+        return None
+
+    def _aux_is_mean(name: str) -> bool:
+        n, hops = name, 0
+        while hops < 32:
+            op = _producer(n)
+            if op is None:
+                break
+            if op.type in _MEAN_OPS:
+                return True
+            if op.type in _SUM_OPS:
+                return False
+            if op.type in _TRANSPARENT:
+                n = op.inputs.get("X", [None])[0]
+                hops += 1
+                continue
+            break
+        raise NotImplementedError(
+            f"cannot tell whether {name!r} is a batch mean or sum (producer "
+            f"chain ends at {op.type if op else '<feed>'}); end the loss/"
+            "metric in mean/reduce_mean or reduce_sum so the pipelined "
+            "microbatch aggregation is well-defined"
+        )
     not_last = [n for n in aux_names if n not in last_produced]
     if not_last:
         raise NotImplementedError(

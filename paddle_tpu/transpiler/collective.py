@@ -53,15 +53,46 @@ class GradAllReduce(Collective):
     """Reference collective.py:178."""
 
     def _transpile_main_program(self):
+        from ..core.framework import Parameter
+
         block = self.main_program.global_block()
+
+        def is_param_grad(n):
+            # ONLY parameter grads are averaged (reference keys on
+            # op_role_var param/grad pairs, collective.py:196);
+            # averaging intermediate activation grads would corrupt
+            # the earlier layers' chain rule
+            if not n.endswith("@GRAD"):
+                return False
+            base = n[: -len("@GRAD")]
+            v = block.vars.get(base)
+            return isinstance(v, Parameter) and v.trainable
+
+        # param grads that a later Backward `sum` op re-produces (the
+        # rename-and-sum scheme for multi-consumer params): allreduce
+        # only after the final sum, not after every partial
+        summed_later = {
+            n
+            for op in block.ops
+            if op.type == "sum" and int(op.attrs.get("op_role", 0)) & OpRole.Backward
+            for names in op.outputs.values()
+            for n in names
+            if n.endswith("@GRAD")
+        }
         new_ops = []
         ring = 0
         for op in block.ops:
             new_ops.append(op)
-            if int(op.attrs.get("op_role", 0)) & OpRole.Backward and op.type.endswith("_grad"):
+            # "sum" included: multi-consumer params get their final
+            # @GRAD from the rename-and-sum op, not a *_grad op
+            if int(op.attrs.get("op_role", 0)) & OpRole.Backward and (
+                op.type.endswith("_grad") or op.type == "sum"
+            ):
                 for names in op.outputs.values():
                     for n in names:
-                        if not n.endswith("@GRAD"):
+                        if not is_param_grad(n):
+                            continue
+                        if op.type != "sum" and n in summed_later:
                             continue
                         ar = type(op)(
                             block, "c_allreduce_sum",
