@@ -20,5 +20,13 @@ from .collective import (
 )
 from .detection import iou_similarity, box_coder, prior_box
 from .sequence import *  # noqa: F401,F403
-from .rnn import dynamic_lstm, dynamic_gru, lstm_unit, gru_unit
+from .rnn import (
+    dynamic_lstm,
+    dynamic_gru,
+    lstm_unit,
+    gru_unit,
+    beam_search,
+    beam_search_decode,
+)
 from . import ops  # noqa: F401
+from . import distributions  # noqa: F401

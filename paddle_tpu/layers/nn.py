@@ -1286,3 +1286,16 @@ def _getitem(var, item):
     if squeeze_axes:
         out = squeeze(out, squeeze_axes)
     return out
+
+
+def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="int64"):
+    """Sample a category per row of probs (reference layers/nn.py
+    sampling_id over operators/sampling_id_op.cc)."""
+    helper = LayerHelper("sampling_id")
+    out = _out(helper, x, shape=tuple(x.shape[:-1]) if x.shape else None,
+               dtype=dtype, stop_gradient=True)
+    helper.append_op(
+        type="sampling_id", inputs={"X": [x]}, outputs={"Out": [out]},
+        attrs={"min": min, "max": max, "seed": seed, "dtype": dtype},
+    )
+    return out

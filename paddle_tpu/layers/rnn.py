@@ -129,3 +129,63 @@ def gru_unit(input, hidden, size, param_attr=None, bias_attr=None, name=None):
         outputs={"Gate": [gate], "ResetHiddenPrev": [rhp], "Hidden": [h]},
     )
     return h, rhp, gate
+
+
+def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id,
+                level=0, is_accumulated=True, name=None, return_parent_idx=False):
+    """One beam expansion step (reference layers API over
+    beam_search_op.cc; see ops/beam.py for the dense [batch, beam]
+    redesign). `ids` is accepted for API parity and unused — candidate
+    ids are implicit [0, V)."""
+    from ..layer_helper import LayerHelper
+    from .nn import _out
+
+    helper = LayerHelper("beam_search", name=name)
+    sel_ids = _out(helper, pre_ids, shape=pre_ids.shape, dtype=pre_ids.dtype,
+                   stop_gradient=True)
+    sel_scores = _out(helper, pre_scores, shape=pre_scores.shape,
+                      stop_gradient=True)
+    parent = _out(helper, pre_ids, shape=pre_ids.shape, dtype="int32",
+                  stop_gradient=True)
+    inputs = {"pre_ids": [pre_ids], "pre_scores": [pre_scores], "scores": [scores]}
+    if ids is not None:
+        inputs["ids"] = [ids]
+    helper.append_op(
+        type="beam_search",
+        inputs=inputs,
+        outputs={"selected_ids": [sel_ids], "selected_scores": [sel_scores],
+                 "parent_idx": [parent]},
+        attrs={"beam_size": beam_size, "end_id": end_id, "level": level,
+               "is_accumulated": is_accumulated},
+    )
+    if return_parent_idx:
+        return sel_ids, sel_scores, parent
+    return sel_ids, sel_scores
+
+
+def beam_search_decode(ids, scores, beam_size, end_id, name=None,
+                       parents=None, final_scores=None):
+    """Backtrack stacked beam steps into sentences (reference
+    beam_search_decode_op.cc). Dense form: `ids`/`parents` are the
+    [T, B, beam] stacks of per-step beam_search outputs; `final_scores`
+    the last step's [B, beam] scores (defaults to `scores`)."""
+    from ..layer_helper import LayerHelper
+    from .nn import _out
+
+    helper = LayerHelper("beam_search_decode", name=name)
+    if parents is None:
+        raise ValueError(
+            "beam_search_decode needs the stacked parent_idx steps: pass "
+            "parents=<[T, B, beam] stack of beam_search parent_idx outputs> "
+            "(the dense replacement for the reference's LoD parent levels)"
+        )
+    sent = _out(helper, ids, shape=None, dtype=ids.dtype, stop_gradient=True)
+    sent_scores = _out(helper, scores, shape=None, stop_gradient=True)
+    helper.append_op(
+        type="beam_search_decode",
+        inputs={"Ids": [ids], "Parents": [parents],
+                "Scores": [final_scores if final_scores is not None else scores]},
+        outputs={"SentenceIds": [sent], "SentenceScores": [sent_scores]},
+        attrs={"beam_size": beam_size, "end_id": end_id},
+    )
+    return sent, sent_scores

@@ -18,3 +18,4 @@ from . import rnn  # noqa: F401
 from . import sequence  # noqa: F401
 from . import detection  # noqa: F401
 from . import metrics  # noqa: F401
+from . import beam  # noqa: F401

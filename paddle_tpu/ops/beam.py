@@ -1,0 +1,108 @@
+"""Beam search ops.
+
+Reference: operators/beam_search_op.cc (one expansion step over LoD
+beams) + beam_search_decode_op.cc (backtrack LoDTensorArray into
+sentences), driven from python by layers.beam_search inside a While
+block (python/paddle/fluid/layers/rnn.py machine-translation pattern).
+
+TPU-native redesign: beams are a dense [batch, beam] axis (no LoD, no
+shrinking — finished beams keep emitting end_id with frozen score), so
+every step has one static shape and the whole decode loop compiles into
+a single XLA while loop. parent_idx makes the search differentiable-
+free backtracking data, exactly like the reference's parent LoD levels.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+NEG_INF = -1e9
+
+
+@register_op(
+    "beam_search",
+    inputs=("pre_ids", "pre_scores", "ids", "scores"),
+    outputs=("selected_ids", "selected_scores", "parent_idx"),
+    stop_gradient=True,
+)
+def _beam_search(ctx, op, ins):
+    """One beam expansion step.
+
+    pre_ids, pre_scores: [B, beam]; scores: [B, beam, V] log-probs
+    (accumulated if is_accumulated else per-step, reference attr).
+    Finished beams (pre_id == end_id) contribute exactly one candidate
+    (end_id, frozen pre_score) — the reference's beam shrinking,
+    expressed as masking. Returns the top beam_size of the beam*V
+    candidates per batch row: ids, accumulated scores, and the parent
+    beam index each winner came from.
+    """
+    pre_ids = ins["pre_ids"][0]
+    pre_scores = ins["pre_scores"][0]
+    scores = ins["scores"][0]
+    beam_size = int(op.attrs.get("beam_size", scores.shape[1]))
+    end_id = int(op.attrs.get("end_id", 0))
+    is_accumulated = bool(op.attrs.get("is_accumulated", True))
+
+    squeeze = pre_ids.ndim == 1
+    if squeeze:  # allow [beam] single-batch usage
+        pre_ids, pre_scores, scores = pre_ids[None], pre_scores[None], scores[None]
+    B, beam, V = scores.shape
+
+    acc = scores if is_accumulated else scores + pre_scores[..., None]
+    finished = pre_ids == end_id
+    acc = jnp.where(finished[..., None], NEG_INF, acc)
+    frozen = jnp.where(finished, pre_scores, acc[..., end_id])
+    acc = acc.at[..., end_id].set(frozen)
+
+    flat = acc.reshape(B, beam * V)
+    top_scores, top_idx = jax.lax.top_k(flat, beam_size)
+    parent = (top_idx // V).astype(jnp.int32)
+    sel_ids = (top_idx % V).astype(pre_ids.dtype)
+    if squeeze:
+        top_scores, sel_ids, parent = top_scores[0], sel_ids[0], parent[0]
+    return {
+        "selected_ids": [sel_ids],
+        "selected_scores": [top_scores],
+        "parent_idx": [parent],
+    }
+
+
+@register_op(
+    "beam_search_decode",
+    inputs=("Ids", "Parents", "Scores"),
+    outputs=("SentenceIds", "SentenceScores"),
+    stop_gradient=True,
+)
+def _beam_search_decode(ctx, op, ins):
+    """Backtrack stacked per-step ids/parents into sentences.
+
+    Ids, Parents: [T, B, beam] from T beam_search steps; Scores:
+    [B, beam] final accumulated scores. Returns SentenceIds
+    [B, beam, T] (post-end positions filled with end_id) and the
+    scores. Reference beam_search_decode_op.cc walks the LoD parent
+    chain; here it is a reverse lax.scan over the parent pointers.
+    """
+    ids, parents, scores = ins["Ids"][0], ins["Parents"][0], ins["Scores"][0]
+    end_id = int(op.attrs.get("end_id", 0))
+    T, B, beam = ids.shape
+
+    def back(cur_beam, step):
+        step_ids, step_parents = step
+        tok = jnp.take_along_axis(step_ids, cur_beam, axis=1)        # [B, beam]
+        prev = jnp.take_along_axis(step_parents, cur_beam, axis=1)
+        return prev.astype(jnp.int32), tok
+
+    init = jnp.broadcast_to(jnp.arange(beam, dtype=jnp.int32)[None], (B, beam))
+    _, toks = jax.lax.scan(back, init, (ids, parents), reverse=True)
+    # toks: [T, B, beam] in forward order
+    sent = jnp.transpose(toks, (1, 2, 0))  # [B, beam, T]
+    # freeze everything after the first end_id to end_id
+    seen_end = jnp.cumsum((sent == end_id).astype(jnp.int32), axis=-1) > 0
+    shifted = jnp.concatenate(
+        [jnp.zeros_like(seen_end[..., :1]), seen_end[..., :-1]], axis=-1
+    )
+    sent = jnp.where(shifted, jnp.asarray(end_id, sent.dtype), sent)
+    return {"SentenceIds": [sent], "SentenceScores": [scores]}

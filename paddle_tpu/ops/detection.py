@@ -7,6 +7,7 @@ output shapes use fixed-size outputs + validity masks (the XLA idiom).
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from ..core.registry import register_op
@@ -57,13 +58,8 @@ def _box_coder(ctx, op, ins):
 @register_op("iou_similarity", inputs=("X", "Y"), outputs=("Out",), stop_gradient=True)
 def _iou_similarity(ctx, op, ins):
     x, y = ins["X"][0], ins["Y"][0]  # [N,4], [M,4]
-    area_x = (x[:, 2] - x[:, 0]) * (x[:, 3] - x[:, 1])
-    area_y = (y[:, 2] - y[:, 0]) * (y[:, 3] - y[:, 1])
-    lt = jnp.maximum(x[:, None, :2], y[None, :, :2])
-    rb = jnp.minimum(x[:, None, 2:], y[None, :, 2:])
-    wh = jnp.clip(rb - lt, 0.0)
-    inter = wh[..., 0] * wh[..., 1]
-    return {"Out": [inter / jnp.maximum(area_x[:, None] + area_y[None, :] - inter, 1e-10)]}
+    norm = bool(op.attrs.get("box_normalized", True))
+    return {"Out": [_pairwise_iou(x, y, normalized=norm)]}
 
 
 @register_op("prior_box", inputs=("Input", "Image"), outputs=("Boxes", "Variances"), stop_gradient=True)
@@ -135,3 +131,485 @@ def _box_clip(ctx, op, ins):
         axis=-1,
     )
     return {"Output": [out]}
+
+
+# -- pairwise helpers -------------------------------------------------------
+
+
+def _pairwise_iou(a, b, normalized=True):
+    off = 0.0 if normalized else 1.0
+    area_a = (a[:, 2] - a[:, 0] + off) * (a[:, 3] - a[:, 1] + off)
+    area_b = (b[:, 2] - b[:, 0] + off) * (b[:, 3] - b[:, 1] + off)
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.clip(rb - lt + off, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    return inter / jnp.maximum(area_a[:, None] + area_b[None, :] - inter, 1e-10)
+
+
+def _greedy_nms(boxes, scores, iou_threshold, score_threshold, max_picks,
+                eta=1.0, normalized=True):
+    """Greedy hard-NMS as a bounded lax loop -> picked mask [M].
+    (Reference NMSFast in multiclass_nms_op.cc; XLA form: fixed
+    max_picks iterations, suppression mask instead of index lists.)"""
+    import jax
+
+    M = boxes.shape[0]
+    iou = _pairwise_iou(boxes, boxes, normalized=normalized)
+
+    def body(_, st):
+        sup, picked, thr = st
+        s = jnp.where(sup | (scores < score_threshold), -jnp.inf, scores)
+        j = jnp.argmax(s)
+        ok = s[j] > -jnp.inf
+        sup = sup | (ok & (iou[j] > thr))
+        sup = sup.at[j].set(True)
+        picked = picked.at[j].set(ok | picked[j])
+        thr = jnp.where((eta < 1.0) & (thr > 0.5), thr * eta, thr)
+        return sup, picked, thr
+
+    init = (
+        jnp.zeros((M,), bool),
+        jnp.zeros((M,), bool),
+        jnp.asarray(iou_threshold, jnp.float32),
+    )
+    _, picked, _ = jax.lax.fori_loop(0, int(max_picks), body, init)
+    return picked
+
+
+@register_op("multiclass_nms", inputs=("BBoxes", "Scores"), outputs=("Out", "NmsRoisNum"), stop_gradient=True)
+def _multiclass_nms(ctx, op, ins):
+    """Reference multiclass_nms_op.cc: per-class score filter + NMS,
+    then cross-class keep_top_k. Dense TPU form: BBoxes [B, M, 4],
+    Scores [B, C, M]; Out [B, keep_top_k, 6] rows =
+    (label, score, x1, y1, x2, y2), invalid rows labeled -1;
+    NmsRoisNum [B] = valid detections per image."""
+    import jax
+
+    boxes, scores = ins["BBoxes"][0], ins["Scores"][0]
+    if boxes.ndim == 2:
+        boxes, scores = boxes[None], scores[None]
+    B, M = boxes.shape[0], boxes.shape[1]
+    C = scores.shape[1]
+    bg = int(op.attrs.get("background_label", 0))
+    s_thresh = float(op.attrs.get("score_threshold", 0.0))
+    n_thresh = float(op.attrs.get("nms_threshold", 0.3))
+    eta = float(op.attrs.get("nms_eta", 1.0))
+    nms_top_k = int(op.attrs.get("nms_top_k", -1))
+    keep_top_k = int(op.attrs.get("keep_top_k", -1))
+    normalized = bool(op.attrs.get("normalized", True))
+    max_picks = M if nms_top_k <= 0 else min(nms_top_k, M)
+    K = M * C if keep_top_k <= 0 else min(keep_top_k, M * C)
+
+    def per_image(bx, sc):
+        def per_class(cls_scores):
+            return _greedy_nms(bx, cls_scores, n_thresh, s_thresh, max_picks,
+                               eta, normalized)
+
+        picked = jax.vmap(per_class)(sc)  # [C, M]
+        if 0 <= bg < C:
+            picked = picked.at[bg].set(False)
+        flat_valid = picked.reshape(-1)
+        flat_scores = jnp.where(flat_valid, sc.reshape(-1), -jnp.inf)
+        order = jnp.argsort(-flat_scores)[:K]
+        lbl = (order // M).astype(jnp.float32)
+        s = sc.reshape(-1)[order]
+        box_idx = (order % M).astype(jnp.int32)
+        bsel = bx[box_idx]
+        valid = flat_valid[order]
+        row = jnp.concatenate(
+            [jnp.where(valid, lbl, -1.0)[:, None], (s * valid)[:, None],
+             bsel * valid[:, None]],
+            axis=1,
+        )
+        return row, jnp.where(valid, box_idx, -1), jnp.sum(valid).astype(jnp.int32)
+
+    out, box_idx, num = jax.vmap(per_image)(boxes, scores)
+    return {"Out": [out], "NmsRoisNum": [num], "_BoxIndex": [box_idx]}
+
+
+@register_op("multiclass_nms2", inputs=("BBoxes", "Scores"), outputs=("Out", "Index", "NmsRoisNum"), stop_gradient=True)
+def _multiclass_nms2(ctx, op, ins):
+    r = _multiclass_nms(ctx, op, ins)
+    # Index = each selected detection's row in the input BBoxes (-1 for
+    # padding), the reference's gather handle (multiclass_nms2 op)
+    return {"Out": r["Out"], "Index": [r["_BoxIndex"][0]],
+            "NmsRoisNum": r["NmsRoisNum"]}
+
+
+@register_op("yolo_box", inputs=("X", "ImgSize"), outputs=("Boxes", "Scores"), stop_gradient=True)
+def _yolo_box(ctx, op, ins):
+    """Reference yolo_box_op.cc: decode a YOLOv3 head.
+    X [N, an*(5+cls), H, W] -> Boxes [N, H*W*an, 4], Scores
+    [N, H*W*an, cls]; boxes scaled to ImgSize, conf_thresh zeroing."""
+    x, img_size = ins["X"][0], ins["ImgSize"][0]
+    anchors = [int(a) for a in op.attrs["anchors"]]
+    class_num = int(op.attrs["class_num"])
+    conf_thresh = float(op.attrs.get("conf_thresh", 0.005))
+    downsample = int(op.attrs.get("downsample_ratio", 32))
+    clip_bbox = bool(op.attrs.get("clip_bbox", True))
+    scale_x_y = float(op.attrs.get("scale_x_y", 1.0))
+    an = len(anchors) // 2
+    N, _, H, W = x.shape
+    x = x.reshape(N, an, 5 + class_num, H, W)
+    gx = jnp.arange(W, dtype=jnp.float32)[None, None, None, :]
+    gy = jnp.arange(H, dtype=jnp.float32)[None, None, :, None]
+    aw = jnp.asarray(anchors[0::2], jnp.float32)[None, :, None, None]
+    ah = jnp.asarray(anchors[1::2], jnp.float32)[None, :, None, None]
+    sig = jax.nn.sigmoid
+    bias = (scale_x_y - 1.0) * 0.5
+    cx = (sig(x[:, :, 0]) * scale_x_y - bias + gx) / W
+    cy = (sig(x[:, :, 1]) * scale_x_y - bias + gy) / H
+    bw = jnp.exp(x[:, :, 2]) * aw / (downsample * W)
+    bh = jnp.exp(x[:, :, 3]) * ah / (downsample * H)
+    conf = sig(x[:, :, 4])
+    probs = sig(x[:, :, 5:]) * conf[:, :, None]
+    probs = jnp.where(conf[:, :, None] < conf_thresh, 0.0, probs)
+    imh = img_size[:, 0].astype(jnp.float32)[:, None, None, None]
+    imw = img_size[:, 1].astype(jnp.float32)[:, None, None, None]
+    x1 = (cx - bw / 2) * imw
+    y1 = (cy - bh / 2) * imh
+    x2 = (cx + bw / 2) * imw
+    y2 = (cy + bh / 2) * imh
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0.0, imw - 1)
+        y1 = jnp.clip(y1, 0.0, imh - 1)
+        x2 = jnp.clip(x2, 0.0, imw - 1)
+        y2 = jnp.clip(y2, 0.0, imh - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1)  # [N, an, H, W, 4]
+    boxes = boxes.transpose(0, 2, 3, 1, 4).reshape(N, H * W * an, 4)
+    scores = probs.transpose(0, 3, 4, 1, 2).reshape(N, H * W * an, class_num)
+    return {"Boxes": [boxes], "Scores": [scores]}
+
+
+@register_op("anchor_generator", inputs=("Input",), outputs=("Anchors", "Variances"), stop_gradient=True)
+def _anchor_generator(ctx, op, ins):
+    """Reference detection/anchor_generator_op.cc: dense anchors from
+    anchor_sizes x aspect_ratios at every feature-map cell."""
+    import numpy as np
+
+    feat = ins["Input"][0]
+    sizes = [float(s) for s in op.attrs.get("anchor_sizes", [64.0])]
+    ratios = [float(r) for r in op.attrs.get("aspect_ratios", [1.0])]
+    stride = [float(s) for s in op.attrs.get("stride", [16.0, 16.0])]
+    variances = [float(v) for v in op.attrs.get("variances", [0.1, 0.1, 0.2, 0.2])]
+    offset = float(op.attrs.get("offset", 0.5))
+    H, W = feat.shape[2], feat.shape[3]
+    base = []
+    for r in ratios:
+        for s in sizes:
+            area = stride[0] * stride[1]
+            area_ratios = area / r
+            bw = np.round(np.sqrt(area_ratios))
+            bh = np.round(bw * r)
+            scale_w = s / stride[0]
+            scale_h = s / stride[1]
+            w_half = 0.5 * (scale_w * bw - 1)
+            h_half = 0.5 * (scale_h * bh - 1)
+            base.append((-w_half, -h_half, w_half, h_half))
+    base = np.asarray(base, np.float32)  # [A, 4]
+    cx = (np.arange(W, dtype=np.float32) + offset) * stride[0]
+    cy = (np.arange(H, dtype=np.float32) + offset) * stride[1]
+    cxg, cyg = np.meshgrid(cx, cy)  # [H, W]
+    shift = np.stack([cxg, cyg, cxg, cyg], -1)[:, :, None, :]  # [H,W,1,4]
+    anchors = shift + base[None, None]
+    var = np.tile(np.asarray(variances, np.float32), (H, W, base.shape[0], 1))
+    return {"Anchors": [jnp.asarray(anchors)], "Variances": [jnp.asarray(var)]}
+
+
+@register_op("density_prior_box", inputs=("Input", "Image"), outputs=("Boxes", "Variances"), stop_gradient=True)
+def _density_prior_box(ctx, op, ins):
+    """Reference detection/density_prior_box_op.cc: dense grid of
+    fixed-size priors with per-size densities."""
+    import numpy as np
+
+    feat, img = ins["Input"][0], ins["Image"][0]
+    fixed_sizes = [float(s) for s in op.attrs.get("fixed_sizes", [])]
+    fixed_ratios = [float(r) for r in op.attrs.get("fixed_ratios", [1.0])]
+    densities = [int(d) for d in op.attrs.get("densities", [])]
+    variances = [float(v) for v in op.attrs.get("variances", [0.1, 0.1, 0.2, 0.2])]
+    clip = bool(op.attrs.get("clip", False))
+    offset = float(op.attrs.get("offset", 0.5))
+    H, W = feat.shape[2], feat.shape[3]
+    ih, iw = img.shape[2], img.shape[3]
+    sh = float(op.attrs.get("step_h", 0.0)) or ih / H
+    sw = float(op.attrs.get("step_w", 0.0)) or iw / W
+    boxes = []
+    for k, (fs, dens) in enumerate(zip(fixed_sizes, densities)):
+        for ar in fixed_ratios:
+            bw = fs * np.sqrt(ar)
+            bh = fs / np.sqrt(ar)
+            step = fs / dens
+            for di in range(dens):
+                for dj in range(dens):
+                    sx = -fs / 2.0 + step / 2.0 + dj * step
+                    sy = -fs / 2.0 + step / 2.0 + di * step
+                    boxes.append((sx, sy, bw, bh))
+    cy, cx = np.meshgrid(np.arange(H), np.arange(W), indexing="ij")
+    ccx = (cx + offset) * sw
+    ccy = (cy + offset) * sh
+    out = []
+    for sx, sy, bw, bh in boxes:
+        bx = ccx + sx
+        by = ccy + sy
+        out.append(
+            np.stack(
+                [(bx - bw / 2) / iw, (by - bh / 2) / ih,
+                 (bx + bw / 2) / iw, (by + bh / 2) / ih], -1,
+            )
+        )
+    arr = np.stack(out, 2).astype(np.float32)  # [H, W, A, 4]
+    if clip:
+        arr = np.clip(arr, 0.0, 1.0)
+    var = np.tile(np.asarray(variances, np.float32), arr.shape[:3] + (1,))
+    return {"Boxes": [jnp.asarray(arr)], "Variances": [jnp.asarray(var)]}
+
+
+def _roi_batch_idx(ins, R):
+    if ins.get("RoisNum"):
+        rn = ins["RoisNum"][0]
+        return jnp.searchsorted(jnp.cumsum(rn), jnp.arange(R), side="right")
+    return jnp.zeros((R,), jnp.int32)
+
+
+@register_op("roi_align", inputs=("X", "ROIs", "RoisNum"), outputs=("Out",), no_grad=("ROIs", "RoisNum"))
+def _roi_align(ctx, op, ins):
+    """Reference operators/roi_align_op.cc: average of bilinear samples
+    per output bin. sampling_ratio<=0 (adaptive in the reference) uses
+    a static 2x2 grid — XLA needs static sample counts."""
+    import jax
+
+    x, rois = ins["X"][0], ins["ROIs"][0]
+    scale = float(op.attrs.get("spatial_scale", 1.0))
+    ph = int(op.attrs.get("pooled_height", 1))
+    pw = int(op.attrs.get("pooled_width", 1))
+    sr = int(op.attrs.get("sampling_ratio", -1))
+    n = sr if sr > 0 else 2
+    N, C, H, W = x.shape
+    R = rois.shape[0]
+    bidx = _roi_batch_idx(ins, R)
+
+    def one(roi, bi):
+        x1, y1, x2, y2 = roi[0] * scale, roi[1] * scale, roi[2] * scale, roi[3] * scale
+        rh = jnp.maximum(y2 - y1, 1.0)
+        rw = jnp.maximum(x2 - x1, 1.0)
+        bin_h, bin_w = rh / ph, rw / pw
+        iy = (jnp.arange(ph)[:, None] + (jnp.arange(n)[None, :] + 0.5) / n)  # [ph, n]
+        ix = (jnp.arange(pw)[:, None] + (jnp.arange(n)[None, :] + 0.5) / n)  # [pw, n]
+        ys = y1 + iy * bin_h  # [ph, n]
+        xs = x1 + ix * bin_w  # [pw, n]
+        img = x[bi]  # [C, H, W]
+
+        def bilinear(y, xx):
+            y = jnp.clip(y, 0.0, H - 1.0)
+            xx = jnp.clip(xx, 0.0, W - 1.0)
+            y0 = jnp.floor(y).astype(jnp.int32)
+            x0 = jnp.floor(xx).astype(jnp.int32)
+            y1_ = jnp.minimum(y0 + 1, H - 1)
+            x1_ = jnp.minimum(x0 + 1, W - 1)
+            ly, lx = y - y0, xx - x0
+            v00 = img[:, y0, x0]
+            v01 = img[:, y0, x1_]
+            v10 = img[:, y1_, x0]
+            v11 = img[:, y1_, x1_]
+            return (
+                v00 * (1 - ly) * (1 - lx) + v01 * (1 - ly) * lx
+                + v10 * ly * (1 - lx) + v11 * ly * lx
+            )
+
+        # all (bin, sample) pairs at once: [ph*n] x [pw*n]
+        yy = ys.reshape(-1)
+        xxs = xs.reshape(-1)
+        vals = jax.vmap(lambda y: jax.vmap(lambda xx: bilinear(y, xx))(xxs))(yy)
+        # [ph*n, pw*n, C] -> [ph, n, pw, n, C] -> mean over samples
+        vals = vals.reshape(ph, n, pw, n, C).mean(axis=(1, 3))
+        return vals.transpose(2, 0, 1)  # [C, ph, pw]
+
+    return {"Out": [jax.vmap(one)(rois, bidx)]}
+
+
+@register_op("roi_pool", inputs=("X", "ROIs", "RoisNum"), outputs=("Out", "Argmax"), no_grad=("ROIs", "RoisNum"))
+def _roi_pool(ctx, op, ins):
+    """Reference operators/roi_pool_op.cc: max over each quantized bin.
+    XLA form: max over a static 4x4 nearest-neighbor sample grid per
+    bin (the reference's dynamic per-roi bin extents cannot be static)."""
+    import jax
+
+    x, rois = ins["X"][0], ins["ROIs"][0]
+    scale = float(op.attrs.get("spatial_scale", 1.0))
+    ph = int(op.attrs.get("pooled_height", 1))
+    pw = int(op.attrs.get("pooled_width", 1))
+    n = 4
+    N, C, H, W = x.shape
+    R = rois.shape[0]
+    bidx = _roi_batch_idx(ins, R)
+
+    def one(roi, bi):
+        x1 = jnp.round(roi[0] * scale)
+        y1 = jnp.round(roi[1] * scale)
+        x2 = jnp.round(roi[2] * scale)
+        y2 = jnp.round(roi[3] * scale)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        bin_h, bin_w = rh / ph, rw / pw
+        iy = jnp.arange(ph)[:, None] + (jnp.arange(n)[None, :] + 0.5) / n
+        ix = jnp.arange(pw)[:, None] + (jnp.arange(n)[None, :] + 0.5) / n
+        ys = jnp.clip(y1 + iy * bin_h, 0, H - 1).astype(jnp.int32).reshape(-1)
+        xs = jnp.clip(x1 + ix * bin_w, 0, W - 1).astype(jnp.int32).reshape(-1)
+        img = x[bi]
+        vals = img[:, ys[:, None], xs[None, :]]  # [C, ph*n, pw*n]
+        vals = vals.reshape(C, ph, n, pw, n).max(axis=(2, 4))
+        return vals
+
+    out = jax.vmap(one)(rois, bidx)
+    return {"Out": [out], "Argmax": [jnp.zeros(out.shape, jnp.int32)]}
+
+
+@register_op("sigmoid_focal_loss", inputs=("X", "Label", "FgNum"), outputs=("Out",), no_grad=("Label", "FgNum"))
+def _sigmoid_focal_loss(ctx, op, ins):
+    """Reference detection/sigmoid_focal_loss_op.cc: per-class sigmoid
+    focal loss; Label in [0, C] where 0 = background, normalized by
+    fg_num."""
+    import jax
+
+    x = ins["X"][0]  # [N, C] logits
+    label = ins["Label"][0].reshape(-1)  # [N] in [0, C]
+    fg = jnp.maximum(ins["FgNum"][0].reshape(()).astype(x.dtype), 1.0)
+    gamma = float(op.attrs.get("gamma", 2.0))
+    alpha = float(op.attrs.get("alpha", 0.25))
+    C = x.shape[1]
+    t = (label[:, None] == jnp.arange(1, C + 1)[None, :]).astype(x.dtype)
+    p = jax.nn.sigmoid(x)
+    ce = t * (-jax.nn.log_sigmoid(x)) + (1 - t) * (-jax.nn.log_sigmoid(-x))
+    w = t * alpha * (1 - p) ** gamma + (1 - t) * (1 - alpha) * p ** gamma
+    return {"Out": [w * ce / fg]}
+
+
+@register_op("bipartite_match", inputs=("DistMat",), outputs=("ColToRowMatchIndices", "ColToRowMatchDist"), stop_gradient=True)
+def _bipartite_match(ctx, op, ins):
+    """Reference detection/bipartite_match_op.cc: greedy global
+    bipartite matching on a [N, M] distance matrix (rows=priors/preds,
+    cols=ground truth... reference rows map to cols); match_type
+    'per_prediction' additionally matches leftover rows above
+    dist_threshold. Dense batch form: [B, N, M]."""
+    import jax
+
+    dist = ins["DistMat"][0]
+    batched = dist.ndim == 3
+    if not batched:
+        dist = dist[None]
+    match_type = op.attrs.get("match_type", "bipartite")
+    thresh = float(op.attrs.get("dist_threshold", 0.5))
+    B, N, M = dist.shape
+
+    def one(d):
+        def body(_, st):
+            used_r, used_c, idx, dd = st
+            masked = jnp.where(used_r[:, None] | used_c[None, :], -jnp.inf, d)
+            flat = jnp.argmax(masked)
+            r, c = flat // M, flat % M
+            ok = masked[r, c] > 0
+            used_r = used_r.at[r].set(ok | used_r[r])
+            used_c = used_c.at[c].set(ok | used_c[c])
+            idx = idx.at[c].set(jnp.where(ok, r, idx[c]))
+            dd = dd.at[c].set(jnp.where(ok, d[r, c], dd[c]))
+            return used_r, used_c, idx, dd
+
+        init = (
+            jnp.zeros((N,), bool), jnp.zeros((M,), bool),
+            jnp.full((M,), -1, jnp.int32), jnp.zeros((M,), d.dtype),
+        )
+        used_r, used_c, idx, dd = jax.lax.fori_loop(0, min(N, M), body, init)
+        if match_type == "per_prediction":
+            best_r = jnp.argmax(d, axis=0)
+            best_v = jnp.max(d, axis=0)
+            extra = (idx < 0) & (best_v >= thresh)
+            idx = jnp.where(extra, best_r.astype(jnp.int32), idx)
+            dd = jnp.where(extra, best_v, dd)
+        return idx, dd
+
+    idx, dd = jax.vmap(one)(dist)
+    if not batched:
+        idx, dd = idx[0], dd[0]
+    return {"ColToRowMatchIndices": [idx], "ColToRowMatchDist": [dd]}
+
+
+@register_op("target_assign", inputs=("X", "MatchIndices", "NegIndices"), outputs=("Out", "OutWeight"), stop_gradient=True)
+def _target_assign(ctx, op, ins):
+    """Reference detection/target_assign_op.cc: out[i, j] =
+    X[i, match_indices[i, j]] (mismatch_value where unmatched);
+    NegIndices rows get mismatch_value with weight 1."""
+    x = ins["X"][0]  # [B, M, K] targets
+    mi = ins["MatchIndices"][0]  # [B, P] row indices into M or -1
+    mismatch = op.attrs.get("mismatch_value", 0)
+    B, P = mi.shape
+    K = x.shape[-1]
+    safe = jnp.clip(mi, 0, x.shape[1] - 1)
+    gathered = jnp.take_along_axis(x, safe[..., None].astype(jnp.int32).repeat(K, -1), axis=1)
+    matched = (mi >= 0)[..., None]
+    out = jnp.where(matched, gathered, jnp.asarray(mismatch, x.dtype))
+    w = matched.astype(jnp.float32)
+    if ins.get("NegIndices"):
+        neg = ins["NegIndices"][0]  # [B, P] 0/1 mask (dense form)
+        out = jnp.where(neg[..., None] > 0, jnp.asarray(mismatch, x.dtype), out)
+        w = jnp.maximum(w, (neg > 0)[..., None].astype(jnp.float32))
+    return {"Out": [out], "OutWeight": [w]}
+
+
+@register_op("mine_hard_examples", inputs=("ClsLoss", "MatchIndices", "MatchDist"), outputs=("NegIndices", "UpdatedMatchIndices"), stop_gradient=True)
+def _mine_hard_examples(ctx, op, ins):
+    """Reference detection/mine_hard_examples_op.cc (max_negative
+    mining): per image, negatives = unmatched priors sorted by loss
+    desc, keep neg_pos_ratio * num_pos. Dense NegIndices is a 0/1 mask
+    [B, P] (the LoD index list does not map to static shapes)."""
+    loss = ins["ClsLoss"][0]  # [B, P]
+    mi = ins["MatchIndices"][0]  # [B, P]
+    ratio = float(op.attrs.get("neg_pos_ratio", 3.0))
+    B, P = loss.shape
+    pos = mi >= 0
+    n_pos = jnp.sum(pos, axis=1)
+    n_neg = jnp.minimum((n_pos * ratio).astype(jnp.int32), P - n_pos)
+    neg_loss = jnp.where(pos, -jnp.inf, loss)
+    order = jnp.argsort(-neg_loss, axis=1)
+    rank = jnp.argsort(order, axis=1)  # rank of each prior in the sort
+    neg = (rank < n_neg[:, None]) & ~pos & jnp.isfinite(loss)
+    return {"NegIndices": [neg.astype(jnp.int32)], "UpdatedMatchIndices": [mi]}
+
+
+@register_op("box_decoder_and_assign", inputs=("PriorBox", "PriorBoxVar", "TargetBox", "BoxScore"), outputs=("DecodeBox", "OutputAssignBox"), stop_gradient=True)
+def _box_decoder_and_assign(ctx, op, ins):
+    """Reference detection/box_decoder_and_assign_op.cc: decode
+    per-class deltas against priors, then assign each roi its
+    best-scoring class's box."""
+    prior = ins["PriorBox"][0]  # [R, 4]
+    pv = ins["PriorBoxVar"][0] if ins.get("PriorBoxVar") else jnp.ones((4,), prior.dtype)
+    deltas = ins["TargetBox"][0]  # [R, C*4]
+    scores = ins["BoxScore"][0]  # [R, C]
+    R, C = scores.shape
+    d = deltas.reshape(R, C, 4)
+    pw = prior[:, 2] - prior[:, 0] + 1.0
+    ph = prior[:, 3] - prior[:, 1] + 1.0
+    pcx = prior[:, 0] + pw * 0.5
+    pcy = prior[:, 1] + ph * 0.5
+    ocx = pv[..., 0] * d[..., 0] * pw[:, None] + pcx[:, None]
+    ocy = pv[..., 1] * d[..., 1] * ph[:, None] + pcy[:, None]
+    ow = jnp.exp(pv[..., 2] * d[..., 2]) * pw[:, None]
+    oh = jnp.exp(pv[..., 3] * d[..., 3]) * ph[:, None]
+    dec = jnp.stack(
+        [ocx - ow / 2, ocy - oh / 2, ocx + ow / 2 - 1, ocy + oh / 2 - 1], -1
+    )  # [R, C, 4]
+    best = jnp.argmax(scores, axis=1)
+    assign = jnp.take_along_axis(dec, best[:, None, None].repeat(4, -1), axis=1)[:, 0]
+    return {"DecodeBox": [dec.reshape(R, C * 4)], "OutputAssignBox": [assign]}
+
+
+@register_op("polygon_box_transform", inputs=("Input",), outputs=("Output",), stop_gradient=True)
+def _polygon_box_transform(ctx, op, ins):
+    """Reference detection/polygon_box_transform_op.cc (EAST text):
+    even channels: out = 4*x_grid - in; odd channels: 4*y_grid - in."""
+    x = ins["Input"][0]  # [N, 2k, H, W]
+    N, C, H, W = x.shape
+    gx = jnp.broadcast_to(jnp.arange(W, dtype=x.dtype)[None, None, None, :], x.shape)
+    gy = jnp.broadcast_to(jnp.arange(H, dtype=x.dtype)[None, None, :, None], x.shape)
+    is_x = (jnp.arange(C) % 2 == 0)[None, :, None, None]
+    return {"Output": [jnp.where(is_x, 4 * gx - x, 4 * gy - x)]}

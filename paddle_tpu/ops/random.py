@@ -99,3 +99,14 @@ def _shuffle_channel(ctx, op, ins):
     g = int(op.attrs.get("group", 1))
     n, c, h, w = x.shape
     return {"Out": [x.reshape(n, g, c // g, h, w).swapaxes(1, 2).reshape(n, c, h, w)]}
+
+
+@register_op("sampling_id", inputs=("X",), outputs=("Out",), stop_gradient=True)
+def _sampling_id(ctx, op, ins):
+    """Sample one category id per row of a probability matrix
+    (reference operators/sampling_id_op.cc)."""
+    x = ins["X"][0]  # [batch, num_classes] probs
+    logits = jnp.log(jnp.maximum(x, 1e-20))
+    ids = jax.random.categorical(ctx.op_key(op), logits, axis=-1)
+    dtype = convert_dtype(op.attrs.get("dtype", "int64"))
+    return {"Out": [ids.astype(dtype)]}

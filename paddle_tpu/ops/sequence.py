@@ -132,3 +132,135 @@ def _sequence_mask(ctx, op, ins):
     from ..core.framework import convert_dtype
 
     return {"Y": [m.astype(convert_dtype(op.attrs.get("out_dtype", "int64")))]}
+
+
+@register_op("sequence_conv", inputs=("X", "Filter", "Length"), outputs=("Out",), no_grad=("Length",))
+def _sequence_conv(ctx, op, ins):
+    """Context-window convolution over time (reference
+    operators/sequence_ops/sequence_conv_op.cc): each timestep's
+    context_length rows starting at contextStart are concatenated and
+    multiplied by Filter [context_length*D, num_filters]. Out-of-range
+    (and beyond-Length) context rows are zeros, like the reference's
+    zero PaddingData default."""
+    x, w = ins["X"][0], ins["Filter"][0]  # [B, T, D], [ctx*D, F]
+    clen = int(op.attrs.get("contextLength", op.attrs.get("context_length", 3)))
+    cstart = int(op.attrs.get("contextStart", op.attrs.get("context_start", -1)))
+    B, T, D = x.shape
+    m = _mask(x, ins)
+    if m is not None:
+        x = x * m[..., None]
+    cols = []
+    for j in range(clen):
+        off = cstart + j
+        shifted = jnp.roll(x, -off, axis=1)
+        t_idx = jnp.arange(T) + off
+        valid = ((t_idx >= 0) & (t_idx < T))[None, :, None]
+        cols.append(jnp.where(valid, shifted, 0.0))
+    ctxmat = jnp.concatenate(cols, axis=-1)  # [B, T, ctx*D]
+    return {"Out": [ctxmat @ w]}
+
+
+@register_op("sequence_enumerate", inputs=("X", "Length"), outputs=("Out",), stop_gradient=True)
+def _sequence_enumerate(ctx, op, ins):
+    """All win_size-length sub-sequences per position (reference
+    sequence_enumerate_op.cc); positions past a sequence's end hold
+    pad_value."""
+    x = ins["X"][0]  # [B, T] int ids
+    win = int(op.attrs["win_size"])
+    pad = op.attrs.get("pad_value", 0)
+    B, T = x.shape[0], x.shape[1]
+    ln = ins["Length"][0] if ins.get("Length") else jnp.full((B,), T, jnp.int32)
+    t_idx = jnp.arange(T)[None, :, None] + jnp.arange(win)[None, None, :]
+    gather = jnp.take(x, jnp.clip(t_idx, 0, T - 1)[0], axis=1)  # [B, T, win]
+    valid = t_idx < ln[:, None, None]
+    return {"Out": [jnp.where(valid, gather, jnp.asarray(pad, x.dtype))]}
+
+
+@register_op("sequence_erase", inputs=("X", "Length"), outputs=("Out", "OutLength"), stop_gradient=True)
+def _sequence_erase(ctx, op, ins):
+    """Remove listed tokens, compacting survivors left (reference
+    sequence_erase_op.cc shrinks the LoD; dense form keeps [B, T] and
+    returns the new lengths, padding the tail with 0)."""
+    x = ins["X"][0]  # [B, T] int ids
+    tokens = jnp.asarray(list(op.attrs.get("tokens", [])), x.dtype)
+    B, T = x.shape
+    ln = ins["Length"][0] if ins.get("Length") else jnp.full((B,), T, jnp.int32)
+    in_seq = jnp.arange(T)[None, :] < ln[:, None]
+    keep = in_seq & ~jnp.isin(x, tokens)
+    # stable compaction: argsort on (dropped, position)
+    order = jnp.argsort(jnp.where(keep, 0, 1) * (T + 1) + jnp.arange(T)[None, :], axis=1)
+    compacted = jnp.take_along_axis(x, order, axis=1)
+    new_len = jnp.sum(keep, axis=1).astype(ln.dtype)
+    out = jnp.where(jnp.arange(T)[None, :] < new_len[:, None], compacted, 0)
+    return {"Out": [out], "OutLength": [new_len]}
+
+
+@register_op("sequence_expand_as", inputs=("X", "Y"), outputs=("Out",), no_grad=("Y",))
+def _sequence_expand_as(ctx, op, ins):
+    """Broadcast each batch row of X along Y's time axis (reference
+    sequence_expand_as_op.cc: each x row repeats to its y sequence
+    length; dense = repeat to the padded length)."""
+    x, y = ins["X"][0], ins["Y"][0]
+    if x.ndim == y.ndim:  # [B, t, ...] -> [B, T, ...]: repeat each step
+        # (tile would interleave x0,x1,x0,x1 — reference expands rows
+        # in place: x0,x0,x1,x1)
+        return {"Out": [jnp.repeat(x, y.shape[1] // x.shape[1], axis=1)]}
+    return {"Out": [jnp.broadcast_to(jnp.expand_dims(x, 1), (x.shape[0], y.shape[1]) + x.shape[1:])]}
+
+
+@register_op("sequence_scatter", inputs=("X", "Ids", "Updates", "Length"), outputs=("Out",), no_grad=("Ids", "Length"))
+def _sequence_scatter(ctx, op, ins):
+    """Out = X; Out[b, Ids[b,t]] += Updates[b,t] for t < Length[b]
+    (reference sequence_scatter_op.cc add-scatter semantics)."""
+    x, ids, upd = ins["X"][0], ins["Ids"][0], ins["Updates"][0]
+    B, T = ids.shape[0], ids.shape[1]
+    ln = ins["Length"][0] if ins.get("Length") else jnp.full((B,), T, jnp.int32)
+    valid = jnp.arange(T)[None, :] < ln[:, None]
+    upd = jnp.where(valid, upd.reshape(B, T), 0.0)
+    ids = ids.reshape(B, T).astype(jnp.int32)
+    rows = jnp.broadcast_to(jnp.arange(B)[:, None], (B, T))
+    return {"Out": [x.at[rows, ids].add(upd)]}
+
+
+@register_op("sequence_slice", inputs=("X", "Offset", "Length"), outputs=("Out", "OutLength"), no_grad=("Offset", "Length"))
+def _sequence_slice(ctx, op, ins):
+    """Per-sequence [offset, offset+length) window (reference
+    sequence_slice_op.cc). Dense: values shift to the front of the
+    padded time axis, tail zeroed, new lengths returned."""
+    x = ins["X"][0]  # [B, T, ...]
+    off = ins["Offset"][0].reshape(-1).astype(jnp.int32)
+    ln = ins["Length"][0].reshape(-1).astype(jnp.int32)
+    B, T = x.shape[0], x.shape[1]
+    idx = jnp.arange(T)[None, :] + off[:, None]          # [B, T]
+    gidx = jnp.clip(idx, 0, T - 1)
+    full = gidx.reshape(B, T, *([1] * (x.ndim - 2)))
+    out = jnp.take_along_axis(x, jnp.broadcast_to(full, (B, T) + x.shape[2:]), axis=1)
+    valid = jnp.arange(T)[None, :] < ln[:, None]
+    out = jnp.where(valid.reshape((B, T) + (1,) * (x.ndim - 2)), out, 0)
+    return {"Out": [out], "OutLength": [ln]}
+
+
+@register_op("sequence_topk_avg_pooling", inputs=("X", "Length"), outputs=("Out",), no_grad=("Length",))
+def _sequence_topk_avg_pooling(ctx, op, ins):
+    """Average of the top-k scores per channel for each k in `topks`
+    (reference sequence_topk_avg_pooling_op.cc, used by MatchPyramid-
+    style text matching). Dense redesign: X is [B, C, T] scores; out is
+    [B, C*len(topks)]."""
+    x = ins["X"][0]
+    topks = [int(t) for t in op.attrs["topks"]]
+    B, C, T = x.shape
+    if ins.get("Length"):
+        ln = ins["Length"][0]
+        big_neg = jnp.asarray(-1e38, x.dtype)
+        x = jnp.where(jnp.arange(T)[None, None, :] < ln[:, None, None], x, big_neg)
+    else:
+        ln = jnp.full((B,), T, jnp.int32)
+    sx = jnp.sort(x, axis=-1)[..., ::-1]  # descending
+    outs = []
+    for k in topks:
+        k_eff = jnp.minimum(k, ln)[:, None]  # [B, 1]
+        take = sx[..., :k]
+        valid = jnp.arange(min(k, T))[None, None, :] < k_eff[..., None]
+        s = jnp.sum(jnp.where(valid, take[..., : min(k, T)], 0.0), axis=-1)
+        outs.append(s / jnp.maximum(k_eff, 1).astype(x.dtype))
+    return {"Out": [jnp.stack(outs, -1).reshape(B, C * len(topks))]}

@@ -1,0 +1,246 @@
+"""Beam search ops + distributions (reference beam_search_op.cc,
+beam_search_decode_op.cc, layers/distributions.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _run_single_op(op_type, inputs, attrs, out_slots):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        block = main.global_block()
+        in_vars, feed = {}, {}
+        for slot, arr in inputs.items():
+            arr = np.asarray(arr)
+            v = block.create_var(name=slot, shape=arr.shape, dtype=str(arr.dtype),
+                                 is_data=True)
+            in_vars[slot] = [v]
+            feed[slot] = arr
+        out_vars = {s: [block.create_var(name=f"{s}__o")] for s in out_slots}
+        block.append_op(type=op_type, inputs=in_vars, outputs=out_vars, attrs=attrs)
+    exe = fluid.Executor(fluid.CPUPlace())
+    return exe.run(main, feed=feed,
+                   fetch_list=[out_vars[s][0] for s in out_slots])
+
+
+def test_beam_search_step_topk_and_parents():
+    # B=1, beam=2, V=4; log-prob scores
+    pre_ids = np.array([[3, 1]], "int32")  # no end yet (end_id=0)
+    pre_scores = np.array([[-1.0, -2.0]], "float32")
+    step = np.log(np.array(
+        [[[0.1, 0.5, 0.3, 0.1],
+          [0.05, 0.05, 0.8, 0.1]]], "float32"))
+    acc = pre_scores[..., None] + step
+    ids, scores, parents = _run_single_op(
+        "beam_search",
+        {"pre_ids": pre_ids, "pre_scores": pre_scores, "scores": acc},
+        {"beam_size": 2, "end_id": 0, "is_accumulated": True},
+        ["selected_ids", "selected_scores", "parent_idx"],
+    )
+    flat = acc.reshape(-1)
+    order = np.argsort(-flat)[:2]
+    np.testing.assert_array_equal(ids[0], order % 4)
+    np.testing.assert_array_equal(parents[0], order // 4)
+    np.testing.assert_allclose(scores[0], flat[order], rtol=1e-6)
+
+
+def test_beam_search_finished_beam_freezes():
+    pre_ids = np.array([[0, 2]], "int32")  # beam 0 already ended
+    pre_scores = np.array([[-0.5, -3.0]], "float32")
+    # huge scores for the finished beam must NOT resurrect it
+    scores = np.full((1, 2, 3), 5.0, "float32")
+    ids, sc, parents = _run_single_op(
+        "beam_search",
+        {"pre_ids": pre_ids, "pre_scores": pre_scores, "scores": scores},
+        {"beam_size": 2, "end_id": 0, "is_accumulated": True},
+        ["selected_ids", "selected_scores", "parent_idx"],
+    )
+    # live beam candidates (score 5.0) win; finished beam's single
+    # frozen candidate (end_id, -0.5) comes next — beam picks the two 5.0s
+    assert list(parents[0]) == [1, 1]
+    # now with beam pool where live beam is terrible:
+    scores2 = np.full((1, 2, 3), -10.0, "float32")
+    ids2, sc2, p2 = _run_single_op(
+        "beam_search",
+        {"pre_ids": pre_ids, "pre_scores": pre_scores, "scores": scores2},
+        {"beam_size": 2, "end_id": 0, "is_accumulated": True},
+        ["selected_ids", "selected_scores", "parent_idx"],
+    )
+    assert ids2[0][0] == 0 and p2[0][0] == 0  # frozen (end, -0.5) wins
+    np.testing.assert_allclose(sc2[0][0], -0.5, rtol=1e-6)
+
+
+def test_beam_search_decode_backtracks():
+    # T=3, B=1, beam=2; chain: final beam0 <- parent1 <- parent0
+    ids = np.array([
+        [[4, 7]],
+        [[5, 8]],
+        [[6, 9]],
+    ], "int32")  # [T, B, beam]
+    parents = np.array([
+        [[0, 0]],
+        [[1, 0]],   # t=1: beam0 came from beam1(t=0), beam1 from beam0
+        [[0, 1]],   # t=2: beam0 came from beam0(t=1), beam1 from beam1
+    ], "int32")
+    scores = np.array([[-1.0, -2.0]], "float32")
+    sent, sc = _run_single_op(
+        "beam_search_decode",
+        {"Ids": ids, "Parents": parents, "Scores": scores},
+        {"beam_size": 2, "end_id": 0},
+        ["SentenceIds", "SentenceScores"],
+    )
+    # beam0: t2 tok 6 from t1-beam0 (tok 5, from t0-beam1 tok 7) -> [7,5,6]
+    np.testing.assert_array_equal(sent[0, 0], [7, 5, 6])
+    # beam1: t2 tok 9 from t1-beam1 (tok 8, from t0-beam0 tok 4) -> [4,8,9]
+    np.testing.assert_array_equal(sent[0, 1], [4, 8, 9])
+
+
+def test_beam_search_greedy_decode_toy_lm():
+    """End-to-end: 4-step beam decode over a fixed next-token table;
+    beam must find the highest-probability path (which greedy misses)."""
+    V, beam, T = 4, 2, 3
+    # transition log-probs designed so greedy (argmax first step) is
+    # suboptimal: token 1 looks best at step 0 but leads to a dead end
+    trans = np.log(np.array([
+        [0.05, 0.55, 0.40, 0.0001],   # from 0: greedy picks 1
+        [0.25, 0.25, 0.25, 0.25],     # from 1: flat
+        [0.0001, 0.0001, 0.0001, 0.998],  # from 2: almost surely 3
+        [0.0001, 0.0001, 0.0001, 0.998],
+    ], "float32") + 1e-9)
+    cur_ids = np.zeros((1, beam), "int32")
+    cur_scores = np.array([[0.0, -1e9]], "float32")  # beam1 muted at start
+    all_ids, all_parents = [], []
+    for t in range(T):
+        step_scores = cur_scores[..., None] + trans[cur_ids]  # [1, beam, V]
+        ids, scores, parents = _run_single_op(
+            "beam_search",
+            {"pre_ids": cur_ids, "pre_scores": cur_scores,
+             "scores": step_scores},
+            {"beam_size": beam, "end_id": -1, "is_accumulated": True},
+            ["selected_ids", "selected_scores", "parent_idx"],
+        )
+        all_ids.append(ids)
+        all_parents.append(parents)
+        cur_ids, cur_scores = ids.astype("int32"), scores
+    sent, sc = _run_single_op(
+        "beam_search_decode",
+        {"Ids": np.stack(all_ids).astype("int32"),
+         "Parents": np.stack(all_parents).astype("int32"),
+         "Scores": cur_scores},
+        {"beam_size": beam, "end_id": -1},
+        ["SentenceIds", "SentenceScores"],
+    )
+    # best path: 0 ->2 ->3 ->3 : log(.4)+log(.998)+log(.998)
+    np.testing.assert_array_equal(sent[0, 0], [2, 3, 3])
+    np.testing.assert_allclose(
+        sc[0, 0], np.log(0.4) + 2 * np.log(0.998), rtol=1e-4
+    )
+
+
+# -- distributions ----------------------------------------------------------
+
+
+def _fetch(builders, seed=7):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    with fluid.program_guard(main, startup):
+        outs = builders()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    return exe.run(main, feed={}, fetch_list=list(outs))
+
+
+def test_normal_distribution_stats():
+    from paddle_tpu.layers.distributions import Normal
+
+    def build():
+        d = Normal(1.0, 2.0)
+        d2 = Normal(0.0, 1.0)
+        return [d.sample([20000]), d.entropy(), d.log_prob(
+            fluid.layers.fill_constant([1], "float32", 3.0)),
+            d.kl_divergence(d2)]
+
+    s, ent, lp, kl = _fetch(build)
+    assert abs(np.mean(s) - 1.0) < 0.1 and abs(np.std(s) - 2.0) < 0.1
+    expect_ent = 0.5 + 0.5 * np.log(2 * np.pi) + np.log(2.0)
+    np.testing.assert_allclose(ent, expect_ent, rtol=1e-5)
+
+
+def norm_logpdf(x, loc, scale):
+    return -((x - loc) ** 2) / (2 * scale**2) - np.log(scale) - 0.5 * np.log(2 * np.pi)
+
+
+def test_normal_logprob_and_kl():
+    from paddle_tpu.layers.distributions import Normal
+
+    def build():
+        d = Normal(1.0, 2.0)
+        d2 = Normal(0.0, 1.0)
+        return [d.log_prob(fluid.layers.fill_constant([1], "float32", 3.0)),
+                d.kl_divergence(d2)]
+
+    lp, kl = _fetch(build)
+    np.testing.assert_allclose(lp, norm_logpdf(3.0, 1.0, 2.0), rtol=1e-5)
+    # analytic KL(N(1,2) || N(0,1)) = log(1/2) + (4 + 1)/2 - 0.5
+    expect = np.log(1.0 / 2.0) + (4.0 + 1.0) / 2.0 - 0.5
+    np.testing.assert_allclose(kl, expect, rtol=1e-5)
+
+
+def test_uniform_distribution():
+    from paddle_tpu.layers.distributions import Uniform
+
+    def build():
+        d = Uniform(-1.0, 3.0)
+        return [d.sample([10000]), d.entropy(),
+                d.log_prob(fluid.layers.fill_constant([1], "float32", 0.0))]
+
+    s, ent, lp = _fetch(build)
+    assert s.min() >= -1.0 and s.max() <= 3.0
+    assert abs(np.mean(s) - 1.0) < 0.1
+    np.testing.assert_allclose(ent, np.log(4.0), rtol=1e-5)
+    np.testing.assert_allclose(lp, np.log(1.0 / 4.0), rtol=1e-5)
+
+
+def test_categorical_entropy_kl_logprob_sample():
+    from paddle_tpu.layers.distributions import Categorical
+
+    logits = np.array([[1.0, 2.0, 0.5]], "float32")
+    logits2 = np.array([[0.5, 0.5, 0.5]], "float32")
+
+    def build():
+        c = Categorical(fluid.layers.assign(logits))
+        c2 = Categorical(fluid.layers.assign(logits2))
+        val = fluid.layers.assign(np.array([[1]], "int64"))
+        return [c.entropy(), c.kl_divergence(c2), c.log_prob(val), c.sample()]
+
+    ent, kl, lp, smp = _fetch(build)
+    p = np.exp(logits) / np.exp(logits).sum()
+    np.testing.assert_allclose(ent, -(p * np.log(p)).sum(), rtol=1e-4)
+    q = np.exp(logits2) / np.exp(logits2).sum()
+    np.testing.assert_allclose(kl, (p * np.log(p / q)).sum(), rtol=1e-4)
+    np.testing.assert_allclose(lp, np.log(p[0, 1]), rtol=1e-4)
+    assert smp.shape == (1,) and 0 <= smp[0] < 3
+
+
+def test_multivariate_normal_diag():
+    from paddle_tpu.layers.distributions import MultivariateNormalDiag
+
+    loc1, d1 = np.zeros(2, "float32"), np.array([1.0, 2.0], "float32")
+    loc2, d2 = np.ones(2, "float32"), np.array([2.0, 2.0], "float32")
+
+    def build():
+        a = MultivariateNormalDiag(loc1, np.diag(d1))
+        b = MultivariateNormalDiag(loc2, np.diag(d2))
+        return [a.entropy(), a.kl_divergence(b)]
+
+    ent, kl = _fetch(build)
+    expect_ent = 0.5 * np.log(d1.prod()) + 0.5 * 2 * (1 + np.log(2 * np.pi))
+    np.testing.assert_allclose(ent, expect_ent, rtol=1e-5)
+    expect_kl = 0.5 * (
+        (d1 / d2).sum()
+        + ((loc2 - loc1) ** 2 / d2).sum()
+        - 2 + np.log(d2.prod() / d1.prod())
+    )
+    np.testing.assert_allclose(kl, expect_kl, rtol=1e-5)

@@ -1,0 +1,366 @@
+"""OpTests for the round-2 detection ops (reference
+operators/detection/ + roi_align/roi_pool): numpy oracles, fixed-size
+outputs with validity masks where the reference used LoD."""
+
+import numpy as np
+
+from op_test import OpTest
+
+
+def _np_iou(a, b):
+    area_a = (a[2] - a[0]) * (a[3] - a[1])
+    area_b = (b[2] - b[0]) * (b[3] - b[1])
+    ix = max(0.0, min(a[2], b[2]) - max(a[0], b[0]))
+    iy = max(0.0, min(a[3], b[3]) - max(a[1], b[1]))
+    inter = ix * iy
+    return inter / max(area_a + area_b - inter, 1e-10)
+
+
+class TestMulticlassNMS(OpTest):
+    op_type = "multiclass_nms"
+
+    def setup(self):
+        boxes = np.array(
+            [[0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30], [5, 5, 15, 15]],
+            "float32",
+        )[None]
+        # class 0 = background; classes 1, 2 scored
+        scores = np.zeros((1, 3, 4), "float32")
+        scores[0, 1] = [0.9, 0.8, 0.7, 0.1]
+        scores[0, 2] = [0.05, 0.2, 0.6, 0.3]
+        K = 4
+        self.inputs = {"BBoxes": boxes, "Scores": scores}
+        self.attrs = {
+            "background_label": 0, "score_threshold": 0.1,
+            "nms_threshold": 0.3, "keep_top_k": K, "nms_top_k": 4,
+        }
+        # numpy oracle: greedy per-class nms then global top-K
+        picked = []
+        for c in (1, 2):
+            order = np.argsort(-scores[0, c])
+            sup = np.zeros(4, bool)
+            for i in order:
+                if sup[i] or scores[0, c, i] < 0.1:
+                    continue
+                picked.append((float(c), float(scores[0, c, i]), boxes[0, i]))
+                for j in range(4):
+                    if not sup[j] and _np_iou(boxes[0, i], boxes[0, j]) > 0.3:
+                        sup[j] = True
+        picked.sort(key=lambda t: -t[1])
+        out = np.full((1, K, 6), 0.0, "float32")
+        out[:, :, 0] = -1.0
+        for r, (lbl, sc, bx) in enumerate(picked[:K]):
+            out[0, r] = [lbl, sc, *bx]
+        self.outputs = {
+            "Out": out,
+            "NmsRoisNum": np.array([min(len(picked), K)], "int32"),
+        }
+
+    def test(self):
+        self.setup()
+        self.check_output(atol=1e-5, rtol=1e-4)
+
+
+class TestYoloBox(OpTest):
+    op_type = "yolo_box"
+
+    def setup(self):
+        rng = np.random.RandomState(0)
+        N, an, cls, H, W = 1, 2, 3, 2, 2
+        anchors = [10, 13, 16, 30]
+        down = 32
+        x = rng.randn(N, an * (5 + cls), H, W).astype("float32")
+        img = np.array([[64, 64]], "int32")
+        sig = lambda v: 1.0 / (1.0 + np.exp(-v))
+        xr = x.reshape(N, an, 5 + cls, H, W)
+        boxes = np.zeros((N, H * W * an, 4), "float32")
+        scores = np.zeros((N, H * W * an, cls), "float32")
+        for n in range(N):
+            ih, iw = img[n]
+            i = 0
+            for h in range(H):
+                for w in range(W):
+                    for a in range(an):
+                        cx = (sig(xr[n, a, 0, h, w]) + w) / W
+                        cy = (sig(xr[n, a, 1, h, w]) + h) / H
+                        bw = np.exp(xr[n, a, 2, h, w]) * anchors[2 * a] / (down * W)
+                        bh = np.exp(xr[n, a, 3, h, w]) * anchors[2 * a + 1] / (down * H)
+                        conf = sig(xr[n, a, 4, h, w])
+                        p = sig(xr[n, a, 5:, h, w]) * conf
+                        if conf < 0.5:
+                            p[:] = 0.0
+                        x1 = np.clip((cx - bw / 2) * iw, 0, iw - 1)
+                        y1 = np.clip((cy - bh / 2) * ih, 0, ih - 1)
+                        x2 = np.clip((cx + bw / 2) * iw, 0, iw - 1)
+                        y2 = np.clip((cy + bh / 2) * ih, 0, ih - 1)
+                        boxes[n, i] = [x1, y1, x2, y2]
+                        scores[n, i] = p
+                        i += 1
+        self.inputs = {"X": x, "ImgSize": img}
+        self.attrs = {
+            "anchors": anchors, "class_num": cls, "conf_thresh": 0.5,
+            "downsample_ratio": down,
+        }
+        self.outputs = {"Boxes": boxes, "Scores": scores}
+
+    def test(self):
+        self.setup()
+        self.check_output(atol=1e-5, rtol=1e-4)
+
+
+class TestRoiAlign(OpTest):
+    op_type = "roi_align"
+
+    def setup(self):
+        rng = np.random.RandomState(1)
+        x = rng.randn(2, 3, 8, 8).astype("float32")
+        rois = np.array([[0, 0, 7, 7], [2, 2, 6, 6], [1, 1, 5, 5]], "float32")
+        rois_num = np.array([2, 1], "int32")
+        ph = pw = 2
+        n = 2
+        out = np.zeros((3, 3, ph, pw), "float32")
+        bidx = [0, 0, 1]
+        for r in range(3):
+            x1, y1, x2, y2 = rois[r]
+            rh = max(y2 - y1, 1.0)
+            rw = max(x2 - x1, 1.0)
+            bh, bw = rh / ph, rw / pw
+            img = x[bidx[r]]
+            for c in range(3):
+                for py in range(ph):
+                    for px in range(pw):
+                        acc = 0.0
+                        for iy in range(n):
+                            for ix in range(n):
+                                y = min(max(y1 + (py + (iy + 0.5) / n) * bh, 0), 7.0)
+                                xx = min(max(x1 + (px + (ix + 0.5) / n) * bw, 0), 7.0)
+                                y0, x0 = int(np.floor(y)), int(np.floor(xx))
+                                y1_, x1_ = min(y0 + 1, 7), min(x0 + 1, 7)
+                                ly, lx = y - y0, xx - x0
+                                acc += (
+                                    img[c, y0, x0] * (1 - ly) * (1 - lx)
+                                    + img[c, y0, x1_] * (1 - ly) * lx
+                                    + img[c, y1_, x0] * ly * (1 - lx)
+                                    + img[c, y1_, x1_] * ly * lx
+                                )
+                        out[r, c, py, px] = acc / (n * n)
+        self.inputs = {"X": x, "ROIs": rois, "RoisNum": rois_num}
+        self.attrs = {"pooled_height": ph, "pooled_width": pw,
+                      "spatial_scale": 1.0, "sampling_ratio": n}
+        self.outputs = {"Out": out}
+
+    def test(self):
+        self.setup()
+        self.check_output(atol=1e-4, rtol=1e-4)
+        self.check_grad(["X"], "Out", max_relative_error=3e-2)
+
+
+class TestSigmoidFocalLoss(OpTest):
+    op_type = "sigmoid_focal_loss"
+
+    def setup(self):
+        rng = np.random.RandomState(2)
+        N, C = 6, 4
+        x = rng.randn(N, C).astype("float32")
+        label = np.array([[1], [0], [2], [4], [0], [3]], "int32")
+        fg = np.array([4], "int32")
+        gamma, alpha = 2.0, 0.25
+        p = 1.0 / (1.0 + np.exp(-x))
+        t = (label == np.arange(1, C + 1)[None, :]).astype("float32")
+        ce = -(t * np.log(p) + (1 - t) * np.log(1 - p))
+        w = t * alpha * (1 - p) ** gamma + (1 - t) * (1 - alpha) * p ** gamma
+        self.inputs = {"X": x, "Label": label, "FgNum": fg}
+        self.attrs = {"gamma": gamma, "alpha": alpha}
+        self.outputs = {"Out": w * ce / 4.0}
+
+    def test(self):
+        self.setup()
+        self.check_output(atol=1e-5, rtol=1e-4)
+        self.check_grad(["X"], "Out", max_relative_error=1e-2)
+
+
+class TestBipartiteMatch(OpTest):
+    op_type = "bipartite_match"
+
+    def setup(self):
+        dist = np.array(
+            [[0.1, 0.9, 0.3], [0.8, 0.2, 0.4]], "float32"
+        )  # rows=2 priors, cols=3 gt
+        # greedy: global max 0.9 -> (r0, c1); next 0.8 -> (r1, c0); c2 unmatched
+        self.inputs = {"DistMat": dist}
+        self.outputs = {
+            "ColToRowMatchIndices": np.array([1, 0, -1], "int32"),
+            "ColToRowMatchDist": np.array([0.8, 0.9, 0.0], "float32"),
+        }
+
+    def test(self):
+        self.setup()
+        self.check_output()
+
+
+class TestBipartiteMatchPerPrediction(OpTest):
+    op_type = "bipartite_match"
+
+    def setup(self):
+        dist = np.array([[0.1, 0.9, 0.6], [0.8, 0.2, 0.4]], "float32")
+        self.inputs = {"DistMat": dist}
+        self.attrs = {"match_type": "per_prediction", "dist_threshold": 0.5}
+        # bipartite: c1->r0 (0.9), c0->r1 (0.8); c2 best row r0 with 0.6 >= 0.5
+        self.outputs = {
+            "ColToRowMatchIndices": np.array([1, 0, 0], "int32"),
+            "ColToRowMatchDist": np.array([0.8, 0.9, 0.6], "float32"),
+        }
+
+    def test(self):
+        self.setup()
+        self.check_output()
+
+
+class TestTargetAssign(OpTest):
+    op_type = "target_assign"
+
+    def setup(self):
+        x = np.arange(12, dtype="float32").reshape(1, 3, 4)  # [B, M, K]
+        mi = np.array([[1, -1, 0, 2]], "int32")  # [B, P]
+        expect = np.stack([x[0, 1], np.zeros(4, "float32"), x[0, 0], x[0, 2]])[None]
+        w = np.array([[1.0, 0.0, 1.0, 1.0]], "float32")[..., None]
+        self.inputs = {"X": x, "MatchIndices": mi}
+        self.attrs = {"mismatch_value": 0}
+        self.outputs = {"Out": expect, "OutWeight": w}
+
+    def test(self):
+        self.setup()
+        self.check_output()
+
+
+class TestMineHardExamples(OpTest):
+    op_type = "mine_hard_examples"
+
+    def setup(self):
+        loss = np.array([[0.1, 0.9, 0.5, 0.3, 0.7]], "float32")
+        mi = np.array([[0, -1, -1, -1, -1]], "int32")  # 1 positive
+        # neg_pos_ratio=2 -> 2 negatives, hardest first: idx1 (0.9), idx4 (0.7)
+        self.inputs = {"ClsLoss": loss, "MatchIndices": mi, "MatchDist": loss}
+        self.attrs = {"neg_pos_ratio": 2.0}
+        self.outputs = {
+            "NegIndices": np.array([[0, 1, 0, 0, 1]], "int32"),
+            "UpdatedMatchIndices": mi,
+        }
+
+    def test(self):
+        self.setup()
+        self.check_output()
+
+
+class TestPolygonBoxTransform(OpTest):
+    op_type = "polygon_box_transform"
+
+    def setup(self):
+        rng = np.random.RandomState(5)
+        x = rng.randn(1, 4, 2, 3).astype("float32")
+        gx = np.arange(3, dtype="float32")[None, None, None, :]
+        gy = np.arange(2, dtype="float32")[None, None, :, None]
+        expect = np.where(
+            (np.arange(4) % 2 == 0)[None, :, None, None],
+            4 * gx - x, 4 * gy - x,
+        ).astype("float32")
+        self.inputs = {"Input": x}
+        self.outputs = {"Output": expect}
+
+    def test(self):
+        self.setup()
+        self.check_output()
+
+
+class TestBoxDecoderAndAssign(OpTest):
+    op_type = "box_decoder_and_assign"
+
+    def setup(self):
+        prior = np.array([[0, 0, 9, 9], [10, 10, 19, 19]], "float32")
+        pv = np.array([0.1, 0.1, 0.2, 0.2], "float32")
+        deltas = np.zeros((2, 2 * 4), "float32")
+        deltas[0, 4:] = [0.5, 0.5, 0.1, 0.1]
+        scores = np.array([[0.2, 0.8], [0.9, 0.1]], "float32")
+        R, C = 2, 2
+        dec = np.zeros((R, C, 4), "float32")
+        for r in range(R):
+            pw = prior[r, 2] - prior[r, 0] + 1
+            ph = prior[r, 3] - prior[r, 1] + 1
+            pcx = prior[r, 0] + pw * 0.5
+            pcy = prior[r, 1] + ph * 0.5
+            d = deltas[r].reshape(C, 4)
+            for c in range(C):
+                ocx = pv[0] * d[c, 0] * pw + pcx
+                ocy = pv[1] * d[c, 1] * ph + pcy
+                ow = np.exp(pv[2] * d[c, 2]) * pw
+                oh = np.exp(pv[3] * d[c, 3]) * ph
+                dec[r, c] = [ocx - ow / 2, ocy - oh / 2, ocx + ow / 2 - 1, ocy + oh / 2 - 1]
+        assign = np.stack([dec[0, 1], dec[1, 0]])
+        self.inputs = {"PriorBox": prior, "PriorBoxVar": pv,
+                       "TargetBox": deltas, "BoxScore": scores}
+        self.outputs = {"DecodeBox": dec.reshape(R, C * 4), "OutputAssignBox": assign}
+
+    def test(self):
+        self.setup()
+        self.check_output(atol=1e-5, rtol=1e-4)
+
+
+class TestAnchorGenerator(OpTest):
+    op_type = "anchor_generator"
+
+    def setup(self):
+        feat = np.zeros((1, 8, 2, 2), "float32")
+        sizes, ratios, stride = [32.0], [1.0], [16.0, 16.0]
+        # reference formula: base anchor at each cell center
+        area = stride[0] * stride[1]
+        bw = round(np.sqrt(area / ratios[0]))
+        bh = round(bw * ratios[0])
+        sw = sizes[0] / stride[0]
+        sh = sizes[0] / stride[1]
+        wh = 0.5 * (sw * bw - 1)
+        hh = 0.5 * (sh * bh - 1)
+        anchors = np.zeros((2, 2, 1, 4), "float32")
+        for i in range(2):
+            for j in range(2):
+                cx = (j + 0.5) * stride[0]
+                cy = (i + 0.5) * stride[1]
+                anchors[i, j, 0] = [cx - wh, cy - hh, cx + wh, cy + hh]
+        var = np.tile(np.array([0.1, 0.1, 0.2, 0.2], "float32"), (2, 2, 1, 1))
+        self.inputs = {"Input": feat}
+        self.attrs = {"anchor_sizes": sizes, "aspect_ratios": ratios,
+                      "stride": stride}
+        self.outputs = {"Anchors": anchors, "Variances": var}
+
+    def test(self):
+        self.setup()
+        self.check_output(atol=1e-4, rtol=1e-4)
+
+
+class TestRoiPoolShapes(OpTest):
+    """roi_pool's sample-grid max is a documented XLA redesign of the
+    reference's dynamic bins — test the invariants (shape, max <= true
+    max, contains the per-bin dominant value for aligned rois)."""
+
+    op_type = "roi_pool"
+
+    def test(self):
+        rng = np.random.RandomState(7)
+        x = rng.randn(1, 2, 8, 8).astype("float32")
+        rois = np.array([[0, 0, 7, 7]], "float32")
+        self.inputs = {"X": x, "ROIs": rois}
+        self.attrs = {"pooled_height": 2, "pooled_width": 2, "spatial_scale": 1.0}
+        # placeholders so _build creates the out vars; values asserted below
+        self.outputs = {"Out": np.zeros((1, 2, 2, 2), "float32"),
+                        "Argmax": np.zeros((1, 2, 2, 2), "int32")}
+        main, startup, feed, out_vars = self._build()
+        import paddle_tpu as fluid
+
+        exe = fluid.Executor(fluid.CPUPlace())
+        (out,) = exe.run(main, feed=feed, fetch_list=[out_vars["Out"][0]])
+        assert out.shape == (1, 2, 2, 2)
+        for c in range(2):
+            for py in range(2):
+                for px in range(2):
+                    patch = x[0, c, py * 4:(py + 1) * 4, px * 4:(px + 1) * 4]
+                    assert out[0, c, py, px] <= patch.max() + 1e-5
+                    assert out[0, c, py, px] >= np.median(patch) - 1e-5

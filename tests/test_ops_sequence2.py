@@ -1,0 +1,154 @@
+"""OpTests for the round-2 sequence ops (reference
+operators/sequence_ops/: conv, enumerate, erase, expand_as, scatter,
+slice, topk_avg_pooling) in the dense pad+mask representation."""
+
+import numpy as np
+
+from op_test import OpTest
+
+
+class TestSequenceConv(OpTest):
+    op_type = "sequence_conv"
+
+    def setup(self):
+        rng = np.random.RandomState(0)
+        B, T, D, F, clen, cstart = 2, 5, 3, 4, 3, -1
+        x = rng.randn(B, T, D).astype("float32")
+        w = rng.randn(clen * D, F).astype("float32")
+        ln = np.array([5, 3], "int32")
+        xm = x * (np.arange(T)[None, :, None] < ln[:, None, None])
+        ctx = np.zeros((B, T, clen * D), "float32")
+        for j in range(clen):
+            off = cstart + j
+            for t in range(T):
+                src = t + off
+                if 0 <= src < T:
+                    ctx[:, t, j * D:(j + 1) * D] = xm[:, src]
+        self.inputs = {"X": x, "Filter": w, "Length": ln}
+        self.attrs = {"contextLength": clen, "contextStart": cstart}
+        self.outputs = {"Out": ctx @ w}
+
+    def test(self):
+        self.setup()
+        self.check_output()
+        self.check_grad(["X", "Filter"], "Out", max_relative_error=3e-2)
+
+
+class TestSequenceEnumerate(OpTest):
+    op_type = "sequence_enumerate"
+
+    def setup(self):
+        x = np.array([[1, 2, 3, 4, 0], [5, 6, 0, 0, 0]], "int32")
+        ln = np.array([4, 2], "int32")
+        expect = np.zeros((2, 5, 2), "int32")
+        for b in range(2):
+            for t in range(5):
+                for w in range(2):
+                    src = t + w
+                    expect[b, t, w] = x[b, src] if src < ln[b] else 0
+        self.inputs = {"X": x, "Length": ln}
+        self.attrs = {"win_size": 2, "pad_value": 0}
+        self.outputs = {"Out": expect}
+
+    def test(self):
+        self.setup()
+        self.check_output()
+
+
+class TestSequenceErase(OpTest):
+    op_type = "sequence_erase"
+
+    def setup(self):
+        x = np.array([[2, 1, 5, 3, 5], [1, 2, 0, 0, 0]], "int32")
+        ln = np.array([5, 2], "int32")
+        self.inputs = {"X": x, "Length": ln}
+        self.attrs = {"tokens": [2, 5]}
+        self.outputs = {
+            "Out": np.array([[1, 3, 0, 0, 0], [1, 0, 0, 0, 0]], "int32"),
+            "OutLength": np.array([2, 1], "int32"),
+        }
+
+    def test(self):
+        self.setup()
+        self.check_output()
+
+
+class TestSequenceExpandAs(OpTest):
+    op_type = "sequence_expand_as"
+
+    def setup(self):
+        rng = np.random.RandomState(1)
+        x = rng.randn(2, 1, 3).astype("float32")
+        y = rng.randn(2, 4, 3).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": np.tile(x, (1, 4, 1))}
+
+    def test(self):
+        self.setup()
+        self.check_output()
+
+
+class TestSequenceScatter(OpTest):
+    op_type = "sequence_scatter"
+
+    def setup(self):
+        x = np.ones((2, 6), "float32")
+        ids = np.array([[0, 1, 2, 0], [2, 3, 4, 5]], "int32")
+        upd = np.array([[0.3, 0.3, 0.4, 9.9], [0.4, 0.0, 0.2, 0.3]], "float32")
+        ln = np.array([3, 4], "int32")  # last update of row 0 is padding
+        expect = x.copy()
+        for b in range(2):
+            for t in range(ln[b]):
+                expect[b, ids[b, t]] += upd[b, t]
+        self.inputs = {"X": x, "Ids": ids, "Updates": upd, "Length": ln}
+        self.outputs = {"Out": expect}
+
+    def test(self):
+        self.setup()
+        self.check_output()
+        self.check_grad(["X", "Updates"], "Out", max_relative_error=1e-2)
+
+
+class TestSequenceSlice(OpTest):
+    op_type = "sequence_slice"
+
+    def setup(self):
+        rng = np.random.RandomState(3)
+        x = rng.randn(2, 6, 2).astype("float32")
+        off = np.array([[1], [2]], "int32")
+        ln = np.array([[3], [2]], "int32")
+        expect = np.zeros_like(x)
+        for b in range(2):
+            for t in range(int(ln[b, 0])):
+                expect[b, t] = x[b, t + int(off[b, 0])]
+        self.inputs = {"X": x, "Offset": off, "Length": ln}
+        self.outputs = {"Out": expect, "OutLength": ln.reshape(-1)}
+
+    def test(self):
+        self.setup()
+        self.check_output()
+
+
+class TestSequenceTopkAvgPooling(OpTest):
+    op_type = "sequence_topk_avg_pooling"
+
+    def setup(self):
+        rng = np.random.RandomState(4)
+        B, C, T = 2, 3, 6
+        x = rng.randn(B, C, T).astype("float32")
+        ln = np.array([6, 4], "int32")
+        topks = [1, 3]
+        expect = np.zeros((B, C, len(topks)), "float32")
+        for b in range(B):
+            for c in range(C):
+                valid = np.sort(x[b, c, : ln[b]])[::-1]
+                for i, k in enumerate(topks):
+                    ke = min(k, ln[b])
+                    expect[b, c, i] = valid[:ke].mean()
+        self.inputs = {"X": x, "Length": ln}
+        self.attrs = {"topks": topks}
+        self.outputs = {"Out": expect.reshape(B, C * len(topks))}
+
+    def test(self):
+        self.setup()
+        self.check_output(atol=1e-5, rtol=1e-4)
