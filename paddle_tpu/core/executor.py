@@ -453,6 +453,12 @@ class Executor:
         sig = []
         for name in sorted(feed):
             v = feed[name]
+            if isinstance(v, jax.Array):
+                # DataLoader prefetch already device_put the batch —
+                # a numpy round-trip here would undo the async H2D
+                vals[name] = v
+                sig.append((name, tuple(v.shape), str(v.dtype)))
+                continue
             arr = np.asarray(v)
             # honor declared var dtype (and keep everything x64-free)
             if block.has_var(name):
@@ -482,12 +488,17 @@ class Executor:
                 return block.var(name).persistable
             return False
 
-        def visit_block(blk: Block):
+        def visit_block(blk: Block, local_names=frozenset()):
+            # local_names: vars created IN a nested block (recurrent
+            # step inputs / pre-memories) — bound by the structured
+            # op's lowering, never scope state
             for op in blk.ops:
                 if op.type in ("feed", "fetch"):
                     continue
                 for names in op.inputs.values():
                     for n in names:
+                        if n in local_names:
+                            continue
                         if n not in produced and n not in seen_state:
                             # must come from scope
                             seen_state.add(n)
@@ -500,7 +511,7 @@ class Executor:
                             written.append(n)
                 for v in op.attrs.values():
                     if isinstance(v, Block):
-                        visit_block(v)
+                        visit_block(v, local_names | set(v.vars))
 
         visit_block(block)
         return state_needed, written

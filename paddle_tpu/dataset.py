@@ -166,9 +166,34 @@ class InMemoryDataset(DatasetBase):
         random.Random(seed).shuffle(self._samples)
 
     def global_shuffle(self, fleet=None, thread_num: int = 12, seed: Optional[int] = None):
-        # single-host: equivalent to local_shuffle; multi-host exchange
-        # would ride the coordination service (reference uses fleet RPC)
-        self.local_shuffle(seed)
+        """Shuffle across ALL trainers (reference data_set.cc
+        GlobalShuffle ships samples between workers over fleet RPC).
+
+        TPU-native: every rank loads the same source and applies one
+        seed-synchronized permutation, then keeps its rank's slice —
+        the same resulting partition as the reference's exchange with
+        zero cross-worker traffic. Rank/world come from `fleet` when
+        given, else the launcher env contract."""
+        import os
+
+        if fleet is not None:
+            rank, world = fleet.worker_index(), max(fleet.worker_num(), 1)
+        else:
+            rank = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+            world = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+        # always partition from the FULL load: calling global_shuffle
+        # once per epoch must re-deal the same deck, not slice the
+        # rank's previous slice to nothing
+        if not hasattr(self, "_full_samples"):
+            self._full_samples = list(self._samples)
+        self._shuffle_epoch = getattr(self, "_shuffle_epoch", 0) + 1
+        if seed is None:
+            # must agree across ranks; vary per epoch deterministically
+            seed = self._shuffle_epoch
+        rng = random.Random(seed)
+        order = list(range(len(self._full_samples)))
+        rng.shuffle(order)
+        self._samples = [self._full_samples[i] for i in order[rank::world]]
 
     def release_memory(self):
         self._samples = []

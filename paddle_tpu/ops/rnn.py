@@ -156,3 +156,71 @@ def _gru_unit(ctx, op, ins):
     h = (1 - z) * hp + z * c
     gate = jnp.concatenate([rz, c], axis=-1)
     return {"Gate": [gate], "ResetHiddenPrev": [rhp], "Hidden": [h]}
+
+
+@register_op(
+    "recurrent",
+    inputs=("StepInputs", "InitMemories", "Parameters", "SeqLengths"),
+    outputs=("StepOutputs", "FinalMemories"),
+    no_grad=("SeqLengths",),
+)
+def _recurrent(ctx, op, ins):
+    """User-authored recurrent block as one lax.scan.
+
+    Reference: operators/recurrent_op.cc (RecurrentOp runs the step
+    sub-block T times over sliced inputs with linked memories; its grad
+    op replays in reverse). TPU-native: the step block lowers INSIDE a
+    scan body, so the whole unrolled loop is one fused XLA while; the
+    backward comes from the registry's auto-vjp through the scan — no
+    hand-written recurrent_grad.
+
+    StaticRNN uses time_major=True ([T, B, ...] inputs, no lengths);
+    DynamicRNN uses time_major=False ([B, T, ...]) with SeqLengths:
+    finished rows freeze their memories and emit zeros (the dense
+    replacement for LoD shrinking).
+    """
+    from ..core.executor import _lower_block
+
+    sub = op.attrs["sub_block"]
+    step_in_names = list(op.attrs.get("step_input_names", []))
+    pre_names = list(op.attrs.get("pre_memory_names", []))
+    mem_names = list(op.attrs.get("memory_names", []))
+    out_names = list(op.attrs.get("step_output_names", []))
+    param_names = list(op.attrs.get("parameter_names", []))
+    time_major = bool(op.attrs.get("time_major", True))
+
+    xs = list(ins.get("StepInputs", []))
+    init = list(ins.get("InitMemories", []))
+    params = dict(zip(param_names, ins.get("Parameters", [])))
+    lengths = ins.get("SeqLengths", [None])
+    lengths = lengths[0] if lengths else None
+
+    if not time_major:  # [B, T, ...] -> scan over axis 0 = time
+        xs = [jnp.moveaxis(x, 1, 0) for x in xs]
+
+    T = xs[0].shape[0] if xs else int(op.attrs["max_steps"])
+
+    def step(carry, scan_in):
+        t, xt = scan_in
+        env = dict(params)
+        env.update(zip(pre_names, carry))
+        env.update(zip(step_in_names, xt))
+        _lower_block(sub, env, ctx)
+        new_mems = [env[n] for n in mem_names]
+        outs = [env[n] for n in out_names]
+        if lengths is not None:
+            active = t < lengths  # [B]
+            def mask_to(new, old):
+                a = active.reshape((-1,) + (1,) * (new.ndim - 1))
+                return jnp.where(a, new, old)
+            new_mems = [mask_to(n, o) for n, o in zip(new_mems, carry)]
+            outs = [mask_to(o, jnp.zeros_like(o)) for o in outs]
+        return tuple(new_mems), tuple(outs)
+
+    carry, ys = jax.lax.scan(
+        step, tuple(init), (jnp.arange(T), tuple(xs))
+    )
+    ys = list(ys)
+    if not time_major:
+        ys = [jnp.moveaxis(y, 0, 1) for y in ys]
+    return {"StepOutputs": ys, "FinalMemories": list(carry)}

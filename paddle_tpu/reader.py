@@ -1,15 +1,18 @@
-"""DataLoader: host-side batching + device prefetch.
+"""DataLoader: host-side batching + async device prefetch.
 
 Reference: python/paddle/fluid/reader.py (PyReader/DataLoader over
 C++ blocking queues, operators/reader/buffered_reader.cc async GPU
-prefetch). TPU-native: a background thread pipelines host batches ahead
-of the step via jax.device_put — the same double-buffering effect the
-reference gets from BufferedReader, without custom C++ queues (XLA's
-dispatch queue overlaps H2D with compute).
+prefetch). TPU-native: a background thread batches AND jax.device_put's
+ahead of the step — the H2D transfer of batch N+1 overlaps the compute
+of batch N (the exact job of the reference's BufferedReader double
+buffer), without custom C++ queues. Rank sharding replaces the
+reference's DistributedBatchSampler: each trainer takes every
+num_trainers-th sample.
 """
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
 from typing import Callable, Iterable, List, Optional
@@ -31,7 +34,8 @@ class DataLoader:
 
 
 class GeneratorLoader:
-    def __init__(self, feed_list, capacity=64, use_double_buffer=True, iterable=True):
+    def __init__(self, feed_list, capacity=64, use_double_buffer=True, iterable=True,
+                 trainer_id=None, num_trainers=None):
         self.feed_list = feed_list or []
         self.capacity = capacity
         self.use_double_buffer = use_double_buffer
@@ -39,17 +43,50 @@ class GeneratorLoader:
         self._gen: Optional[Callable] = None
         self._places = None
         self._batch_reader = None
+        # rank sharding (reference DistributedBatchSampler): defaults
+        # from the launcher's env contract
+        self.trainer_id = (
+            int(os.environ.get("PADDLE_TRAINER_ID", 0))
+            if trainer_id is None else int(trainer_id)
+        )
+        self.num_trainers = (
+            int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+            if num_trainers is None else int(num_trainers)
+        )
 
     # reference API: set_sample_generator / set_sample_list_generator /
     # set_batch_generator
     def set_sample_generator(self, reader, batch_size, drop_last=True, places=None):
         def batcher():
             buf = []
-            for sample in reader():
-                buf.append(sample if isinstance(sample, (list, tuple)) else (sample,))
+            mine = 0
+            total = 0
+            head = []  # wrap-around pool for rank equalization
+            for i, sample in enumerate(reader()):
+                total = i + 1
+                s = sample if isinstance(sample, (list, tuple)) else (sample,)
+                if len(head) < max(self.num_trainers, 1):
+                    head.append(s)
+                if self.num_trainers > 1 and i % self.num_trainers != self.trainer_id:
+                    continue
+                mine += 1
+                buf.append(s)
                 if len(buf) == batch_size:
                     yield buf
                     buf = []
+            if self.num_trainers > 1:
+                # every rank must emit the SAME number of samples or a
+                # collective trainer deadlocks waiting for the others
+                # (reference DistributedBatchSampler pads by wrapping)
+                target = -(-total // self.num_trainers)
+                k = 0
+                while mine < target and head:
+                    buf.append(head[k % len(head)])
+                    k += 1
+                    mine += 1
+                    if len(buf) == batch_size:
+                        yield buf
+                        buf = []
             if buf and not drop_last:
                 yield buf
 
@@ -82,19 +119,46 @@ class GeneratorLoader:
         self._places = places
         return self
 
+    def _to_device(self, batch):
+        """Start the H2D transfer now, on the loader thread — the
+        consumer's step then finds the batch already on (or moving to)
+        the device (reference buffered_reader.cc's cuda-stream copy)."""
+        import jax
+
+        dev = None
+        if self._places:
+            did = getattr(self._places[0], "device_id", None)
+            if did is not None and did < len(jax.local_devices()):
+                dev = jax.local_devices()[did]
+        out = {}
+        for k, v in batch.items():
+            arr = np.asarray(v)
+            if arr.dtype == np.float64:
+                arr = arr.astype(np.float32)
+            elif arr.dtype == np.int64:
+                arr = arr.astype(np.int32)
+            out[k] = jax.device_put(arr, dev)
+        return out
+
     def __iter__(self):
         if self._batch_reader is None:
             raise RuntimeError("no generator set; call set_*_generator first")
         if not self.use_double_buffer:
             yield from self._batch_reader()
             return
-        q: "queue.Queue" = queue.Queue(maxsize=max(self.capacity, 2))
+        # depth-2 DEVICE buffer (true double buffering): the queue pins
+        # device memory per entry, so `capacity` host batches would
+        # hold capacity x batch_bytes of HBM for no extra overlap
+        q: "queue.Queue" = queue.Queue(maxsize=2)
         stop = object()
+        err: List[BaseException] = []
 
         def worker():
             try:
                 for b in self._batch_reader():
-                    q.put(b)
+                    q.put(self._to_device(b))
+            except BaseException as e:  # surfaced to the consumer
+                err.append(e)
             finally:
                 q.put(stop)
 
@@ -103,6 +167,8 @@ class GeneratorLoader:
         while True:
             b = q.get()
             if b is stop:
+                if err:
+                    raise err[0]
                 break
             yield b
 

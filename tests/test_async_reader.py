@@ -1,0 +1,156 @@
+"""Async input pipeline (reference operators/reader/buffered_reader.cc
++ DistributedBatchSampler + data_set.cc GlobalShuffle): prefetch
+overlap, device placement, rank sharding, global shuffle partition."""
+
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.reader import DataLoader
+
+
+def _slow_reader(n=6, delay=0.05):
+    def gen():
+        for i in range(n):
+            time.sleep(delay)
+            yield {"x": np.full((2, 3), i, "float32")}
+
+    return gen
+
+
+def test_double_buffer_overlaps_producer_and_consumer():
+    """With prefetch, total time ~ max(produce, consume) per step, not
+    the sum: 6 steps of 50ms produce + 50ms consume must finish well
+    under the 600ms serial time."""
+    n, delay = 6, 0.05
+    loader = DataLoader.from_generator(capacity=4, use_double_buffer=True)
+    loader.set_batch_generator(_slow_reader(n, delay))
+    t0 = time.perf_counter()
+    seen = []
+    for batch in loader:
+        time.sleep(delay)  # consumer work
+        seen.append(float(np.asarray(batch["x"])[0, 0]))
+    elapsed = time.perf_counter() - t0
+    assert seen == list(range(n))
+    serial = 2 * n * delay
+    assert elapsed < serial * 0.8, (elapsed, serial)
+
+
+def test_prefetch_yields_device_arrays_and_executor_accepts_them():
+    import jax
+
+    loader = DataLoader.from_generator(capacity=2, use_double_buffer=True)
+    loader.set_batch_generator(_slow_reader(2, 0.0))
+    batches = list(loader)
+    assert all(isinstance(b["x"], jax.Array) for b in batches)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data("x", [3])
+        out = fluid.layers.scale(x, scale=2.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    (r,) = exe.run(main, feed=batches[1], fetch_list=[out])
+    np.testing.assert_allclose(r, np.full((2, 3), 2.0), rtol=1e-6)
+
+
+def test_worker_exception_propagates():
+    def bad():
+        yield {"x": np.zeros((1,), "float32")}
+        raise RuntimeError("reader exploded")
+
+    loader = DataLoader.from_generator(capacity=2, use_double_buffer=True)
+    loader.set_batch_generator(bad)
+    with pytest.raises(RuntimeError, match="reader exploded"):
+        list(loader)
+
+
+def test_rank_sharding_splits_samples(monkeypatch):
+    def samples():
+        for i in range(8):
+            yield (np.array([i], "float32"),)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data("x", [1])
+    got = {}
+    for rank in (0, 1):
+        loader = fluid.reader.GeneratorLoader(
+            [x], use_double_buffer=False, trainer_id=rank, num_trainers=2)
+        loader.set_sample_generator(samples, batch_size=2)
+        got[rank] = [
+            list(np.asarray(b["x"]).reshape(-1)) for b in loader
+        ]
+    assert got[0] == [[0.0, 2.0], [4.0, 6.0]]
+    assert got[1] == [[1.0, 3.0], [5.0, 7.0]]
+
+
+def test_global_shuffle_partitions_across_ranks(tmp_path, monkeypatch):
+    from paddle_tpu.dataset import InMemoryDataset
+
+    f = tmp_path / "data.txt"
+    # MultiSlot text format: per slot "<count> <values...>"
+    f.write_text("".join(f"1 {i} 1 {i % 3}\n" for i in range(10)))
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        a = fluid.layers.data("a", [1], dtype="float32")
+        b = fluid.layers.data("b", [1], dtype="float32")
+
+    def load(rank, world):
+        monkeypatch.setenv("PADDLE_TRAINER_ID", str(rank))
+        monkeypatch.setenv("PADDLE_TRAINERS_NUM", str(world))
+        ds = InMemoryDataset()
+        ds.set_batch_size(2)
+        ds.set_use_var([a, b])
+        ds.set_filelist([str(f)])
+        ds.load_into_memory()
+        ds.global_shuffle(seed=5)
+        return {int(s[0][0]) for s in ds._samples}
+
+    part0 = load(0, 2)
+    part1 = load(1, 2)
+    assert part0 | part1 == set(range(10))
+    assert part0 & part1 == set()
+    assert len(part0) == len(part1) == 5
+
+
+def test_rank_sharding_equalizes_batch_counts():
+    """7 samples / 2 trainers: rank 1 must wrap-pad so both ranks emit
+    the same number of batches (collective training would deadlock
+    otherwise)."""
+    def samples():
+        for i in range(7):
+            yield (np.array([i], "float32"),)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data("x", [1])
+    counts = {}
+    for rank in (0, 1):
+        loader = fluid.reader.GeneratorLoader(
+            [x], use_double_buffer=False, trainer_id=rank, num_trainers=2)
+        loader.set_sample_generator(samples, batch_size=2)
+        counts[rank] = len(list(loader))
+    assert counts[0] == counts[1] == 2, counts
+
+
+def test_global_shuffle_is_stable_across_epochs(tmp_path, monkeypatch):
+    from paddle_tpu.dataset import InMemoryDataset
+
+    f = tmp_path / "data.txt"
+    f.write_text("".join(f"1 {i}\n" for i in range(10)))
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        a = fluid.layers.data("a2", [1], dtype="float32")
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "2")
+    ds = InMemoryDataset()
+    ds.set_batch_size(2)
+    ds.set_use_var([a])
+    ds.set_filelist([str(f)])
+    ds.load_into_memory()
+    for _ in range(3):  # one call per epoch must NOT shrink the data
+        ds.global_shuffle()
+        assert len(ds._samples) == 5
