@@ -124,9 +124,12 @@ def _lower_block(
     ops=None,
 ):
     """Interpret ops of a block symbolically, updating env in place."""
+    from .registry import _EXERCISED
+
     for op in (block.ops if ops is None else ops):
         if op.type in ("feed", "fetch"):
             continue
+        _EXERCISED.add(op.type)
         lower_control = _CONTROL_FLOW.get(op.type)
         if lower_control is not None:
             lower_control(block, op, env, ctx)

@@ -223,3 +223,13 @@ def _make_auto_grad(fwd: OpDef) -> OpDef:
         + tuple(s + "@GRAD" for s in fwd.output_slots),
         output_slots=tuple(s + "@GRAD" for s in fwd.input_slots),
     )
+
+
+# every op type the executor has actually lowered in this process —
+# the mechanical backing for the "no lowering ships unexercised" test
+# sweep (tests/test_op_sweep.py; reference op_test.py discipline)
+_EXERCISED: set = set()
+
+
+def exercised_ops():
+    return sorted(_EXERCISED)

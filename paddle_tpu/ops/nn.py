@@ -71,11 +71,22 @@ def _conv2d_transpose(ctx, op, ins):
     paddings = _pair(op.attrs.get("paddings", [0, 0]))
     dilations = _pair(op.attrs.get("dilations", [1, 1]))
     groups = int(op.attrs.get("groups", 1))
-    # reference filter layout for transpose conv: [in_c, out_c/g, kh, kw]
+    if groups != 1:
+        raise NotImplementedError(
+            "conv2d_transpose with groups != 1 is not lowered yet — "
+            "running ungrouped would silently produce out_c/groups "
+            "channels with full connectivity"
+        )
+    # reference filter layout for transpose conv: [in_c, out_c/g, kh, kw].
+    # With transpose_kernel=True jax wants the FORWARD conv's kernel,
+    # whose OIHW is exactly [in_c(=O_fwd... the conv being transposed
+    # maps out_c->in_c), out_c, kh, kw] — i.e. w unswapped (caught by
+    # the op sweep: swapping made lhs/rhs channel counts disagree for
+    # any in_c != out_c).
     pad = [(paddings[0], paddings[0]), (paddings[1], paddings[1])]
     out = jax.lax.conv_transpose(
         x,
-        jnp.swapaxes(w, 0, 1) if groups == 1 else w,
+        w,
         strides=strides,
         padding=pad,
         rhs_dilation=dilations,

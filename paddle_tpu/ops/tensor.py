@@ -377,14 +377,31 @@ def _where(ctx, op, ins):
 
 @register_op("range", inputs=("Start", "End", "Step"), outputs=("Out",), stop_gradient=True)
 def _range(ctx, op, ins):
-    s = ins["Start"][0].reshape(())
-    e = ins["End"][0].reshape(())
-    st = ins["Step"][0].reshape(())
-    # XLA needs static sizes: range ops must have constant inputs; the
-    # executor constant-folds fill_constant feeds. Use numpy values.
-    s, e, st = float(s), float(e), float(st)
+    # the output LENGTH depends on (end-start)/step, so all three must
+    # be static (attrs, or concrete inputs — layers.range constant-
+    # folds python scalars)
+    def bound(attr_key, slot):
+        if attr_key in op.attrs:
+            return float(op.attrs[attr_key])
+        try:
+            return float(ins[slot][0].reshape(()))
+        except Exception as exc:
+            raise ValueError(
+                "range bounds must be static under jit (the output shape "
+                "depends on them) — pass python scalars to layers.range or "
+                "set start/end/step attrs"
+            ) from exc
+
+    s = bound("start", "Start")
+    e = bound("end", "End")
+    st = bound("step", "Step")
+    dtype = (
+        ins["Start"][0].dtype
+        if ins.get("Start")
+        else convert_dtype(op.attrs.get("dtype", "float32"))
+    )
     n = max(int(np.ceil((e - s) / st)), 0)
-    return {"Out": [s + st * jnp.arange(n, dtype=ins["Start"][0].dtype)]}
+    return {"Out": [s + st * jnp.arange(n, dtype=dtype)]}
 
 
 @register_op("increment", inputs=("X",), outputs=("Out",))
@@ -461,7 +478,17 @@ def _diag(ctx, op, ins):
 
 @register_op("linspace", inputs=("Start", "Stop", "Num"), outputs=("Out",), stop_gradient=True)
 def _linspace(ctx, op, ins):
-    s = float(ins["Start"][0].reshape(()))
-    e = float(ins["Stop"][0].reshape(()))
-    n = int(ins["Num"][0].reshape(()))
-    return {"Out": [jnp.linspace(s, e, n, dtype=ins["Start"][0].dtype)]}
+    # Num fixes the output SHAPE, so it must be static (attr, or a
+    # concrete input — layers.linspace constant-folds); start/stop may
+    # stay traced
+    try:
+        n = int(op.attrs["num"]) if "num" in op.attrs else int(
+            ins["Num"][0].reshape(()))
+    except Exception as e:
+        raise ValueError(
+            "linspace Num must be static under jit — pass a python scalar "
+            "to layers.linspace or set the 'num' attr"
+        ) from e
+    s = ins["Start"][0].reshape(())
+    e_ = ins["Stop"][0].reshape(())
+    return {"Out": [jnp.linspace(s, e_, n, dtype=ins["Start"][0].dtype)]}
