@@ -362,6 +362,9 @@ class Executor:
         self.place = place or TPUPlace()
         self._cache: Dict[Tuple, _CompiledBlock] = {}
         self._run_counter = 0
+        # hogwild path: concurrent steps over a shared scope must not
+        # alias-donate the same param buffers
+        self.disable_donation = False
 
     # -- public API -----------------------------------------------------------
     def run(
@@ -404,6 +407,7 @@ class Executor:
             scope.uid,
             mesh is not None,
             flag("check_nan_inf"),
+            self.disable_donation,
         )
         compiled = self._cache.get(key) if use_program_cache else None
         if compiled is None:
@@ -566,6 +570,8 @@ class Executor:
             for i, n in enumerate(state_names)
             if n in set(written_names)
         )
+        if self.disable_donation:
+            donate = ()
         jit_kwargs: Dict[str, Any] = {"donate_argnums": donate}
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
@@ -670,7 +676,8 @@ class Executor:
         from ..dataset_runner import run_from_dataset
 
         return run_from_dataset(
-            self, program, dataset, scope, fetch_list, fetch_info, print_period, train=True
+            self, program, dataset, scope, fetch_list, fetch_info,
+            print_period, train=True, thread=thread,
         )
 
     def infer_from_dataset(self, program=None, dataset=None, scope=None, **kw):

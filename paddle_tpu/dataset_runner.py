@@ -1,15 +1,20 @@
 """Dataset-path training driver (reference Executor.train_from_dataset
--> MultiTrainer/HogwildWorker, framework/multi_trainer.cc:157).
+-> MultiTrainer/HogwildWorker, framework/multi_trainer.cc:157,
+framework/hogwild_worker.cc).
 
-The reference runs per-thread hogwild workers over DataFeed channels
-with no Python in the loop. The TPU equivalent keeps the data pipeline
-multi-threaded on host (dataset.py readers) but funnels batches through
-the single compiled train step — device parallelism comes from the
-mesh, not host threads.
+thread <= 1: batches funnel through the single compiled step — device
+parallelism comes from the mesh, not host threads. thread > 1: real
+HogwildWorker semantics — N host threads pull batches from one channel
+and run the SAME compiled step against the SHARED scope without
+synchronization (lock-free updates; last writer wins per step, exactly
+the reference's trade). Buffer donation is disabled on this path: two
+in-flight steps would otherwise alias-donate the same param buffers.
 """
 
 from __future__ import annotations
 
+import queue
+import threading
 from typing import Optional
 
 
@@ -22,11 +27,17 @@ def run_from_dataset(
     fetch_info=None,
     print_period=100,
     train=True,
+    thread=0,
 ):
     if dataset is None:
         raise ValueError("dataset is required")
     fetch_list = fetch_list or []
     fetch_info = fetch_info or [v.name if hasattr(v, "name") else str(v) for v in fetch_list]
+    if thread and thread > 1:
+        return _run_hogwild(
+            executor, program, dataset, scope, fetch_list, fetch_info,
+            print_period, int(thread),
+        )
     step = 0
     results = None
     for batch in dataset._iter_batches():
@@ -43,3 +54,75 @@ def run_from_dataset(
             print(f"[dataset] step {step}: {msgs}")
         step += 1
     return results
+
+
+def _run_hogwild(executor, program, dataset, scope, fetch_list, fetch_info,
+                 print_period, n_threads):
+    from .core.executor import Executor
+
+    # dedicated executor with donation off (shared params, concurrent
+    # steps); program cache still shared per-thread via its own cache
+    exe = Executor(executor.place)
+    exe.disable_donation = True
+
+    channel: "queue.Queue" = queue.Queue(maxsize=2 * n_threads)
+    stop = object()
+    errors = []
+    last = [None]
+    counter = [0]
+    lock = threading.Lock()
+
+    def worker(tid):
+        try:
+            while True:
+                b = channel.get()
+                if b is stop:
+                    return
+                r = exe.run(program=program, feed=b, fetch_list=fetch_list,
+                            scope=scope)
+                with lock:
+                    counter[0] += 1
+                    last[0] = r
+                    step = counter[0]
+                if fetch_list and step % print_period == 0:
+                    msgs = ", ".join(
+                        f"{n}={float(v.reshape(-1)[0]):.6f}"
+                        for n, v in zip(fetch_info, r)
+                    )
+                    print(f"[dataset hogwild t{tid}] step {step}: {msgs}")
+        except BaseException as e:
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=worker, args=(i,), daemon=True)
+        for i in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    try:
+        for batch in dataset._iter_batches():
+            # timed put + liveness check: if every worker died on an
+            # error the bounded queue would otherwise block us forever
+            while True:
+                if errors or not any(t.is_alive() for t in threads):
+                    break
+                try:
+                    channel.put(batch, timeout=1.0)
+                    break
+                except queue.Full:
+                    continue
+            if errors or not any(t.is_alive() for t in threads):
+                break
+    finally:
+        # always deliver sentinels, even when the dataset iterator
+        # raises — otherwise workers block on channel.get forever
+        for _ in threads:
+            try:
+                channel.put(stop, timeout=5.0)
+            except queue.Full:
+                break
+        for t in threads:
+            t.join(timeout=60.0)
+    if errors:
+        raise errors[0]
+    return last[0]

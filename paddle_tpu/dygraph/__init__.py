@@ -12,7 +12,10 @@ gradient accumulation for multi-consumer vars. Layers are shared with
 the declarative mode at the op level, so numerics match by
 construction. @to_static / TracedLayer capture a Program from eager
 code via the same op records (reference dygraph_to_static AST pass is
-unnecessary: the tape IS the program).
+unnecessary: the tape IS the program). For data-dependent python control flow the
+trace cannot capture, @declarative (dygraph_to_static.py) rewrites the
+function's AST so if/while become lax.cond/lax.while_loop — the
+reference dygraph_to_static pass, retargeted at XLA control flow.
 """
 
 from .base import (
@@ -30,4 +33,5 @@ from .nn import Linear, Conv2D, Pool2D, BatchNorm, Embedding, LayerNorm, Dropout
 from .parallel import DataParallel, prepare_context, ParallelEnv
 from .checkpoint import save_dygraph, load_dygraph
 from .jit import TracedLayer, to_static
+from .dygraph_to_static import declarative, convert_to_static
 from .container import Sequential, LayerList, ParameterList

@@ -1,0 +1,449 @@
+"""dygraph_to_static: AST transform of python control flow.
+
+Reference: python/paddle/fluid/dygraph/dygraph_to_static/
+(ast_transformer.py rewrites a dygraph function's source — IfElse/loop
+transformers — so data-dependent python `if`/`while` over Variables
+become cond/while ops in a Program; cache_program.py caches the
+converted function).
+
+TPU-native redesign: the same source-to-source rewrite, but the
+converted control flow targets lax.cond / lax.while_loop directly, so
+the converted function is fully jax.jit-able (python `if tracer:` would
+throw a TracerBoolConversionError). Dispatch is at runtime: with
+concrete (eager) values the original python branch executes, so one
+converted function serves both dygraph eagerness and the compiled
+static path — the dual-mode contract of the reference's
+@declarative."""
+
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+# -- runtime helpers (the `_jst` namespace the rewritten code calls) --------
+
+
+def _unwrap(v):
+    from .base import VarBase
+
+    return v.value if isinstance(v, VarBase) else v
+
+
+def _is_traced(v) -> bool:
+    return isinstance(_unwrap(v), jax.core.Tracer)
+
+
+def _to_pred(v):
+    return jnp.reshape(jnp.asarray(_unwrap(v)), ()).astype(bool)
+
+
+class _Undef:
+    """Placeholder for names not yet bound before a converted block."""
+
+    def __repr__(self):
+        return "<to_static undefined>"
+
+
+UNDEF = _Undef()
+
+
+def grab(lcls, names):
+    return tuple(lcls.get(n, UNDEF) for n in names)
+
+
+def _wrap_like(new_vals, templates):
+    from .base import VarBase
+
+    out = []
+    for nv, t in zip(new_vals, templates):
+        if isinstance(t, VarBase):
+            out.append(VarBase(nv, stop_gradient=True))
+        else:
+            out.append(nv)
+    return tuple(out)
+
+
+def _check_no_undef(vals):
+    if any(isinstance(v, _Undef) for v in vals):
+        raise NotImplementedError(
+            "to_static: a variable assigned in only one branch of a "
+            "traced if/else must be defined before it"
+        )
+
+
+def convert_ifelse(pred, true_fn, false_fn, init):
+    """Branch fns take the tuple of assigned names' CURRENT values (a
+    branch that reads a name it also assigns would otherwise hit
+    UnboundLocalError — python makes assigned names function-local) and
+    return the updated tuple."""
+    from .base import VarBase
+
+    if not _is_traced(pred):
+        p = _unwrap(pred)
+        p = bool(np.asarray(p).reshape(())) if hasattr(p, "reshape") or hasattr(
+            p, "__array__") else bool(p)
+        return true_fn(init) if p else false_fn(init)
+    if any(isinstance(v, VarBase) for v in init):
+        # VarBase-under-trace: evaluate both branches, select (the
+        # rewrap bookkeeping through a lazy cond is not worth it for
+        # the eager-API-under-jit corner)
+        template = true_fn(init)
+        f_template = false_fn(init)
+        _check_no_undef(template + f_template)
+        t_vals = tuple(_unwrap(v) for v in template)
+        f_vals = tuple(_unwrap(v) for v in f_template)
+        out = jax.lax.cond(_to_pred(pred), lambda: t_vals, lambda: f_vals)
+        return _wrap_like(out, template)
+    # pure-array path: a REAL lazy cond — XLA executes only the taken
+    # branch, so `if use_aux: big_network(x)` costs nothing when False
+    defined_idx = [i for i, v in enumerate(init) if not isinstance(v, _Undef)]
+    raw = tuple(init[i] for i in defined_idx)
+
+    def run(branch_fn, c):
+        full = list(init)
+        for j, i in enumerate(defined_idx):
+            full[i] = c[j]
+        res = branch_fn(tuple(full))
+        _check_no_undef(res)
+        return tuple(res)
+
+    return jax.lax.cond(
+        _to_pred(pred),
+        lambda c: run(true_fn, c),
+        lambda c: run(false_fn, c),
+        raw,
+    )
+
+
+def convert_while(cond_fn, body_fn, init):
+    """cond_fn(carry_tuple) -> scalar; body_fn(carry_tuple) -> carry
+    tuple. Dispatches on whether the condition of the INITIAL carry is
+    traced."""
+    first = cond_fn(init)
+    if not _is_traced(first) and not any(_is_traced(v) for v in init):
+        carry = init
+        while bool(np.asarray(_unwrap(cond_fn(carry))).reshape(())):
+            carry = body_fn(carry)
+        return carry
+    if any(isinstance(v, _Undef) for v in init):
+        raise NotImplementedError(
+            "to_static: every variable a traced while assigns must be "
+            "defined before the loop (it is part of the loop carry)"
+        )
+    template = init
+    raw = tuple(_unwrap(v) for v in init)
+
+    def cond(c):
+        return _to_pred(cond_fn(_wrap_like(c, template)))
+
+    def body(c):
+        return tuple(_unwrap(v) for v in body_fn(_wrap_like(c, template)))
+
+    out = jax.lax.while_loop(cond, body, raw)
+    return _wrap_like(out, template)
+
+
+def convert_logical_and(a, b_fn):
+    if _is_traced(a):
+        return jnp.logical_and(_to_pred(a), _to_pred(b_fn()))
+    return bool(np.asarray(_unwrap(a)).reshape(())) and b_fn()
+
+
+def convert_logical_or(a, b_fn):
+    if _is_traced(a):
+        return jnp.logical_or(_to_pred(a), _to_pred(b_fn()))
+    return bool(np.asarray(_unwrap(a)).reshape(())) or b_fn()
+
+
+def convert_logical_not(a):
+    if _is_traced(a):
+        return jnp.logical_not(_to_pred(a))
+    return not bool(np.asarray(_unwrap(a)).reshape(()))
+
+
+import numpy as np  # noqa: E402  (used by the helpers above)
+
+
+# -- the AST transformer -----------------------------------------------------
+
+
+def _assigned_names(stmts) -> list:
+    names = []
+
+    class V(ast.NodeVisitor):
+        def visit_Assign(self, node):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    if t.id not in names:
+                        names.append(t.id)
+                elif isinstance(t, (ast.Tuple, ast.List)):
+                    for e in t.elts:
+                        if isinstance(e, ast.Name) and e.id not in names:
+                            names.append(e.id)
+            self.generic_visit(node)
+
+        def visit_AugAssign(self, node):
+            if isinstance(node.target, ast.Name) and node.target.id not in names:
+                names.append(node.target.id)
+            self.generic_visit(node)
+
+        def visit_FunctionDef(self, node):
+            pass  # nested defs keep their own scope
+
+    for s in stmts:
+        V().visit(s)
+    return names
+
+
+def _contains_return(stmts) -> bool:
+    class V(ast.NodeVisitor):
+        found = False
+
+        def visit_Return(self, node):
+            V.found = True
+
+        def visit_FunctionDef(self, node):
+            pass
+
+    for s in stmts:
+        V().visit(s)
+    return V.found
+
+
+def _name_tuple(names, ctx):
+    return ast.Tuple(elts=[ast.Name(id=n, ctx=ctx()) for n in names], ctx=ctx())
+
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    """Rewrites If/While whose condition may be traced. Mirrors the
+    reference's IfElseTransformer/LoopTransformer at the scope the
+    framework supports (no return/break/continue inside converted
+    blocks — same early-scope limits the reference documents)."""
+
+    def __init__(self):
+        self._count = 0
+
+    def _uid(self):
+        self._count += 1
+        return self._count
+
+    def visit_If(self, node):
+        self.generic_visit(node)
+        if _contains_return(node.body) or _contains_return(node.orelse):
+            raise NotImplementedError(
+                "to_static: `return` inside a converted if/else is not "
+                "supported — assign to a variable and return after"
+            )
+        names = sorted(
+            set(_assigned_names(node.body)) | set(_assigned_names(node.orelse))
+        )
+        if not names:
+            return node  # pure-side-effect if over concrete values only
+        k = self._uid()
+        carry = f"_jst_ifc_{k}"
+        tname, fname = f"_jst_true_{k}", f"_jst_false_{k}"
+        unpack = ast.Assign(
+            targets=[_name_tuple(names, ast.Store)],
+            value=ast.Name(id=carry, ctx=ast.Load()),
+        )
+        ret = ast.Return(value=_name_tuple(names, ast.Load))
+        import copy
+
+        tfn = ast.FunctionDef(
+            name=tname, args=_one_arg(carry),
+            body=[unpack] + node.body + [ret], decorator_list=[],
+        )
+        ffn = ast.FunctionDef(
+            name=fname, args=_one_arg(carry),
+            body=[copy.deepcopy(unpack)] + list(node.orelse)
+            + [copy.deepcopy(ret)],
+            decorator_list=[],
+        )
+        call = ast.Assign(
+            targets=[_name_tuple(names, ast.Store)],
+            value=ast.Call(
+                func=_jst_attr("convert_ifelse"),
+                args=[_transform_test(node.test),
+                      ast.Name(id=tname, ctx=ast.Load()),
+                      ast.Name(id=fname, ctx=ast.Load()),
+                      _grab_expr(names)],
+                keywords=[],
+            ),
+        )
+        return [tfn, ffn, call]
+
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if node.orelse:
+            raise NotImplementedError("to_static: while/else is not supported")
+        if _contains_return(node.body):
+            raise NotImplementedError(
+                "to_static: `return` inside a converted while is not supported"
+            )
+        names = _assigned_names(node.body)
+        if not names:
+            raise NotImplementedError(
+                "to_static: converted while must assign at least one variable"
+            )
+        k = self._uid()
+        carry = f"_jst_carry_{k}"
+        cname, bname = f"_jst_cond_{k}", f"_jst_body_{k}"
+        unpack = ast.Assign(
+            targets=[_name_tuple(names, ast.Store)],
+            value=ast.Name(id=carry, ctx=ast.Load()),
+        )
+        import copy
+
+        cfn = ast.FunctionDef(
+            name=cname, args=_one_arg(carry),
+            body=[unpack, ast.Return(value=_transform_test(node.test))],
+            decorator_list=[],
+        )
+        bfn = ast.FunctionDef(
+            name=bname, args=_one_arg(carry),
+            body=[copy.deepcopy(unpack)] + node.body + [
+                ast.Return(value=_name_tuple(names, ast.Load))],
+            decorator_list=[],
+        )
+        call = ast.Assign(
+            targets=[_name_tuple(names, ast.Store)],
+            value=ast.Call(
+                func=_jst_attr("convert_while"),
+                args=[ast.Name(id=cname, ctx=ast.Load()),
+                      ast.Name(id=bname, ctx=ast.Load()),
+                      _grab_expr(names)],
+                keywords=[],
+            ),
+        )
+        return [cfn, bfn, call]
+
+    # NOTE: and/or/not are rewritten ONLY inside if/while TESTS
+    # (_transform_test below). A value-position boolop like
+    # `cfg = opts or {}` keeps python's value-returning semantics.
+
+
+class _TestExprTransformer(ast.NodeTransformer):
+    """Rewrites and/or/not within a condition expression, preserving
+    short-circuit for concrete values via lambdas."""
+
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        fn = ("convert_logical_and" if isinstance(node.op, ast.And)
+              else "convert_logical_or")
+        out = node.values[0]
+        for v in node.values[1:]:
+            out = ast.Call(
+                func=_jst_attr(fn),
+                args=[out, ast.Lambda(args=_empty_args(), body=v)],
+                keywords=[],
+            )
+        return out
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return ast.Call(func=_jst_attr("convert_logical_not"),
+                            args=[node.operand], keywords=[])
+        return node
+
+    def visit_Lambda(self, node):
+        return node  # don't descend into nested value expressions
+
+
+def _transform_test(test):
+    return ast.fix_missing_locations(_TestExprTransformer().visit(test))
+
+
+def _empty_args():
+    return ast.arguments(posonlyargs=[], args=[], vararg=None, kwonlyargs=[],
+                         kw_defaults=[], kwarg=None, defaults=[])
+
+
+def _one_arg(name):
+    return ast.arguments(posonlyargs=[], args=[ast.arg(arg=name)], vararg=None,
+                         kwonlyargs=[], kw_defaults=[], kwarg=None, defaults=[])
+
+
+def _jst_attr(name):
+    return ast.Attribute(value=ast.Name(id="_jst", ctx=ast.Load()),
+                         attr=name, ctx=ast.Load())
+
+
+def _grab_expr(names):
+    """`_jst.grab(locals(), [names])` — tolerates names not yet bound
+    (assigned for the first time inside the converted block)."""
+    return ast.Call(
+        func=_jst_attr("grab"),
+        args=[
+            ast.Call(func=ast.Name(id="locals", ctx=ast.Load()),
+                     args=[], keywords=[]),
+            ast.List(elts=[ast.Constant(value=n) for n in names],
+                     ctx=ast.Load()),
+        ],
+        keywords=[],
+    )
+
+
+_CACHE = {}
+
+
+def convert_to_static(fn):
+    """Source-to-source conversion (reference cache_program.py caches
+    by function; same here)."""
+    if fn in _CACHE:
+        return _CACHE[fn]
+    src = textwrap.dedent(inspect.getsource(fn))
+    tree = ast.parse(src)
+    fdef = tree.body[0]
+    fdef.decorator_list = []  # drop @declarative/@to_static
+    new_tree = _ControlFlowTransformer().visit(tree)
+    ast.fix_missing_locations(new_tree)
+    import sys
+
+    # exec into the LIVE module globals (not a copy) so forward
+    # references and monkeypatched globals keep working; only _jst is
+    # injected (collision-checked)
+    ns = fn.__globals__
+    me = sys.modules[__name__]
+    if "_jst" in ns and ns["_jst"] is not me:
+        raise RuntimeError(
+            "to_static: the module already binds the name '_jst'"
+        )
+    ns["_jst"] = me
+    converted_name = fdef.name
+    fdef.name = f"_jst_converted_{fn.__name__}"
+    ast.fix_missing_locations(new_tree)
+    code = compile(new_tree, filename=f"<to_static:{fn.__name__}>", mode="exec")
+    exec(code, ns)
+    converted = ns.pop(fdef.name)
+    converted.__name__ = converted_name
+    if fn.__closure__:
+        raise NotImplementedError(
+            "to_static: closures over local variables are not supported — "
+            "pass them as arguments"
+        )
+    _CACHE[fn] = converted
+    return converted
+
+
+def declarative(fn=None):
+    """@declarative — the reference dygraph_to_static entry point."""
+    def deco(f):
+        converted = convert_to_static(f)
+
+        @functools.wraps(f)
+        def wrapper(*args, **kwargs):
+            return converted(*args, **kwargs)
+
+        wrapper._converted = converted
+        wrapper._original = f
+        return wrapper
+
+    return deco(fn) if fn is not None else deco

@@ -332,18 +332,71 @@ class LarsMomentumOptimizer(Optimizer):
 
 
 class DGCMomentumOptimizer(MomentumOptimizer):
-    """Reference optimizer.py:1042 — momentum + deep gradient
-    compression (top-k sparsified allreduce). On TPU dense psum over ICI
-    is bandwidth-rich enough that sparsification rarely wins; we keep
-    the API and momentum-correction semantics but run dense gradients
-    (rampup knobs accepted and recorded)."""
+    """Reference optimizer.py:1042 — momentum with deep gradient
+    compression (operators/dgc_op.cc): each param keeps U (momentum-
+    corrected accumulator) and V (local residual); every step the
+    top-s% of |V| ships as the gradient, the rest stays local. The
+    momentum lives INSIDE the dgc op (paper's momentum correction), so
+    the parameter update itself is plain sgd on the sparsified grad.
+    Before rampup_begin_step gradients pass through dense."""
 
     def __init__(self, learning_rate, momentum, rampup_begin_step=0,
                  rampup_step=1, sparsity=(0.999,), use_nesterov=False, **kw):
         super().__init__(learning_rate, momentum, use_nesterov, **kw)
         self._rampup_begin_step = rampup_begin_step
         self._rampup_step = rampup_step
-        self._sparsity = sparsity
+        self._sparsity = list(sparsity)
+        self._dgc_step_var = None
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("dgc_u", p)
+            self._add_accumulator("dgc_v", p)
+        if self._dgc_step_var is None:
+            from .layers.tensor import create_global_var
+
+            self._dgc_step_var = create_global_var(
+                [1], 0.0, "float32", persistable=True,
+                name=unique_name.generate("dgc_step"),
+            )
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        u = self._get_accumulator("dgc_u", p)
+        v = self._get_accumulator("dgc_v", p)
+        enc = block.create_var(
+            name=unique_name.generate(f"{p.name}.dgc_enc"),
+            shape=p.shape, dtype=p.dtype, stop_gradient=True,
+        )
+        block.append_op(
+            type="dgc",
+            inputs={"U": [u], "V": [v], "Grad": [g],
+                    "CurrentStep": [self._dgc_step_var]},
+            outputs={"UOut": [u], "VOut": [v], "EncodeGrad": [enc]},
+            attrs={
+                "m": float(self._momentum),
+                "use_nesterov": self._use_nesterov,
+                "rampup_begin_step": float(self._rampup_begin_step),
+                "rampup_step": float(self._rampup_step),
+                "sparsity": self._sparsity,
+                "op_role": OpRole.Optimize,
+            },
+        )
+        return block.append_op(
+            type="sgd",
+            inputs={"Param": [p], "Grad": [enc],
+                    "LearningRate": [self._create_param_lr(p)]},
+            outputs={"ParamOut": [p]},
+            attrs={"op_role": OpRole.Optimize},
+        )
+
+    def _finish_update(self, block, params_grads):
+        block.append_op(
+            type="increment",
+            inputs={"X": [self._dgc_step_var]},
+            outputs={"Out": [self._dgc_step_var]},
+            attrs={"step": 1.0, "op_role": OpRole.Optimize},
+        )
 
 
 class AdagradOptimizer(Optimizer):

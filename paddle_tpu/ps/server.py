@@ -57,6 +57,11 @@ class _ShardState:
             v = self.state.setdefault("v", np.zeros_like(self.value))
             v[:] = mu * v + grad
             self.value -= lr * v
+        elif kind == "adagrad":
+            eps = self.spec.get("epsilon", 1e-6)
+            acc = self.state.setdefault("acc", np.zeros_like(self.value))
+            acc += grad * grad
+            self.value -= lr * grad / (np.sqrt(acc) + eps)
         else:
             raise NotImplementedError(f"pserver optimizer {kind!r}")
 

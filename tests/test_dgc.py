@@ -1,0 +1,97 @@
+"""Deep gradient compression (reference operators/dgc_op.cc +
+DGCMomentumOptimizer, optimizer.py:1042): momentum correction, residual
+accumulation, top-s% sparsification with rampup."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from op_test import OpTest
+
+
+class TestDGCOpSemantics(OpTest):
+    op_type = "dgc"
+
+    def setup(self):
+        rng = np.random.RandomState(0)
+        u = rng.randn(4, 8).astype("float32") * 0.1
+        v = rng.randn(4, 8).astype("float32") * 0.1
+        g = rng.randn(4, 8).astype("float32")
+        step = np.array([10.0], "float32")  # past rampup
+        m, s = 0.9, 0.75
+        u_new = m * u + g
+        v_new = v + u_new
+        thresh = np.quantile(np.abs(v_new).reshape(-1), s)
+        mask = np.abs(v_new) >= thresh
+        self.inputs = {"U": u, "V": v, "Grad": g, "CurrentStep": step}
+        self.attrs = {"m": m, "sparsity": [s], "rampup_begin_step": 0.0,
+                      "rampup_step": 1.0}
+        self.outputs = {
+            "UOut": np.where(mask, 0.0, u_new).astype("float32"),
+            "VOut": np.where(mask, 0.0, v_new).astype("float32"),
+            "EncodeGrad": np.where(mask, v_new, 0.0).astype("float32"),
+        }
+
+    def test(self):
+        self.setup()
+        self.check_output(atol=1e-5, rtol=1e-4)
+
+
+def test_dgc_dense_before_rampup():
+    t = TestDGCOpSemantics()
+    rng = np.random.RandomState(1)
+    u = np.zeros((3, 3), "float32")
+    v = np.zeros((3, 3), "float32")
+    g = rng.randn(3, 3).astype("float32")
+    t.inputs = {"U": u, "V": v, "Grad": g,
+                "CurrentStep": np.array([2.0], "float32")}
+    t.attrs = {"m": 0.9, "sparsity": [0.9], "rampup_begin_step": 5.0,
+               "rampup_step": 4.0}
+    # step < rampup_begin: dense MOMENTUM — u keeps accumulating
+    # (u0=0 so u_new = g), value shipped is the corrected grad, no
+    # residual
+    t.outputs = {
+        "UOut": g,  # 0.9 * 0 + g
+        "VOut": np.zeros((3, 3), "float32"),
+        "EncodeGrad": g,
+    }
+    t.check_output(atol=1e-5, rtol=1e-4)
+
+
+def test_dgc_momentum_training_sparsifies_and_converges():
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 3
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data("x", [16])
+        y = fluid.layers.data("y", [1])
+        pred = fluid.layers.fc(x, 1, bias_attr=False)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        opt = fluid.optimizer.DGCMomentumOptimizer(
+            0.05, momentum=0.9, rampup_begin_step=3, rampup_step=1,
+            sparsity=[0.75],
+        )
+        opt.minimize(loss)
+        # fetch the encoded grad to measure realized sparsity
+        enc_name = next(
+            n for n in main.global_block().vars if ".dgc_enc" in n
+        )
+
+    rng = np.random.RandomState(4)
+    W = rng.randn(16, 1).astype("float32")
+    scope = fluid.Scope()
+    losses, spars = [], []
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        for step in range(40):
+            xb = rng.randn(32, 16).astype("float32")
+            l, e = exe.run(
+                main, feed={"x": xb, "y": xb @ W},
+                fetch_list=[loss, enc_name],
+            )
+            losses.append(float(l))
+            spars.append(float(np.mean(np.asarray(e) == 0.0)))
+    # dense pre-rampup, ~75% zeros after
+    assert spars[0] < 0.1, spars[:5]
+    assert np.mean(spars[10:]) > 0.6, np.mean(spars[10:])
+    # still converges (the whole point of momentum correction)
+    assert losses[-1] < losses[0] * 0.2, (losses[0], losses[-1])

@@ -1,0 +1,143 @@
+"""Downpour table configs (reference pslib node.py/optimizer_factory.py
++ fleet_wrapper.h) and Hogwild multi-thread trainer
+(framework/hogwild_worker.cc)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.ps.downpour import DownpourSGD
+from paddle_tpu.transpiler import DistributeTranspiler, DistributeTranspilerConfig
+from paddle_tpu.ps.transpile import launch_pservers, PSTrainer
+
+_PORT = [6470]
+
+
+def _ports(n):
+    base = _PORT[0]
+    _PORT[0] += n
+    return [f"127.0.0.1:{p}" for p in range(base, base + n)]
+
+
+def _sparse_model(seed=5):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = seed
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        ids = fluid.layers.data("ids", [4], dtype="int64")
+        y = fluid.layers.data("y", [1])
+        emb = fluid.layers.embedding(ids, size=[32, 8], is_sparse=True)
+        pooled = fluid.layers.reduce_mean(emb, dim=1)
+        pred = fluid.layers.fc(pooled, 1, bias_attr=False)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    return main, startup, loss
+
+
+def test_downpour_sgd_builds_tables():
+    main, startup, loss = _sparse_model()
+    with fluid.program_guard(main, startup):
+        opt = DownpourSGD(learning_rate=0.05, sparse_accessor="sparse_adagrad")
+        opt.minimize(loss)
+    tables = main._downpour_tables
+    sparse = [t for t in tables.values() if t.type == "sparse"]
+    dense = [t for t in tables.values() if t.type == "dense"]
+    assert len(sparse) == 1 and sparse[0].fea_dim == 8
+    assert sparse[0].accessor == "sparse_adagrad"
+    assert len(dense) == 1 and len(dense[0].param_names) == 1  # the fc weight
+    assert sparse[0].param_names[0].startswith("embedding")
+
+
+def test_downpour_ps_training_uses_table_accessor():
+    """End to end over the socket PS: the sparse table's server-side
+    rule must be the accessor (adagrad state appears on the server),
+    and the model must still train."""
+    main, startup, loss = _sparse_model()
+    with fluid.program_guard(main, startup):
+        opt = DownpourSGD(learning_rate=0.1, sparse_accessor="sparse_adagrad")
+        opt.minimize(loss)
+    eps = _ports(1)
+    cfg = DistributeTranspilerConfig()
+    cfg.mode = "pserver"
+    t = DistributeTranspiler(cfg)
+    t.transpile(0, program=main, pservers=",".join(eps), trainers=1,
+                sync_mode=True, startup_program=startup)
+    art = opt.apply_to_artifacts(t._ps_artifacts)
+    emb_param = next(iter(
+        tc for tc in opt.server.tables.values() if tc.type == "sparse"
+    )).param_names[0]
+    assert art.optimizer_specs[emb_param]["type"] == "adagrad"
+
+    rng = np.random.RandomState(2)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        servers = launch_pservers(art, scope)
+        trainer = PSTrainer(art, exe, scope)
+        losses = []
+        for _ in range(15):
+            ids = rng.randint(0, 32, (16, 4)).astype("int64")
+            yv = (ids.sum(1, keepdims=True) / 64.0).astype("float32")
+            (l,) = trainer.run_step({"ids": ids, "y": yv}, [loss])
+            losses.append(float(l))
+        # server-side adagrad state materialized for the sparse shard
+        adagrad_shards = [
+            s for srv in servers for name, s in srv._shards.items()
+            if emb_param in name and "acc" in s.state
+        ]
+        trainer.client.shutdown_servers()
+    assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+    if adagrad_shards is not None:
+        assert adagrad_shards, "sparse table never used its adagrad accessor"
+
+
+def test_hogwild_multithread_training():
+    """thread=4 HogwildWorker path: all batches consumed across
+    threads, shared params still converge on a linear task."""
+    from paddle_tpu.dataset import InMemoryDataset
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 7
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data("x", [8])
+        y = fluid.layers.data("y", [1])
+        pred = fluid.layers.fc(x, 1, bias_attr=False)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(0.05).minimize(loss)
+
+    rng = np.random.RandomState(3)
+    W = rng.randn(8, 1).astype("float32")
+
+    import tempfile, os
+
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "data.txt")
+        with open(path, "w") as f:
+            for _ in range(400):
+                xv = rng.randn(8)
+                yv = float(xv @ W[:, 0])
+                f.write("8 " + " ".join(f"{v:.6f}" for v in xv)
+                        + f" 1 {yv:.6f}\n")
+        ds = InMemoryDataset()
+        ds.set_batch_size(16)
+        ds.set_use_var([x, y])
+        ds.set_filelist([path])
+        ds.load_into_memory()
+
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            first = exe.run(main, feed={
+                "x": np.asarray([s[0] for s in ds._samples[:16]], "float32"),
+                "y": np.asarray([s[1] for s in ds._samples[:16]], "float32"),
+            }, fetch_list=[loss])
+            for _ in range(15):  # epochs; ~25 hogwild steps each
+                exe.train_from_dataset(
+                    program=main, dataset=ds, scope=scope, thread=4,
+                    fetch_list=[loss], print_period=1000,
+                )
+            w_learned = scope.get_numpy(
+                next(n for n in scope.local_var_names() if ".w_0" in n)
+            )
+    # hogwild-converged weights approach the generating W
+    assert np.abs(w_learned - W).max() < 0.2, np.abs(w_learned - W).max()

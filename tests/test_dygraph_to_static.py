@@ -1,0 +1,122 @@
+"""dygraph_to_static AST transform (reference
+dygraph/dygraph_to_static/ast_transformer.py): python if/while over
+traced values become lax.cond/lax.while_loop, so the converted function
+jits — while staying eager-correct on concrete values."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as fluid
+from paddle_tpu.dygraph import declarative
+
+
+@declarative
+def _branchy(x):
+    if jnp.sum(x) > 0:
+        y = x * 2.0
+        z = y + 1.0
+    else:
+        y = -x
+        z = y - 1.0
+    return z
+
+
+def test_if_conversion_eager_and_jit():
+    pos = jnp.asarray(np.ones((2, 2), "float32"))
+    neg = -pos
+    # eager (concrete) path: python if
+    np.testing.assert_allclose(_branchy(pos), np.full((2, 2), 3.0))
+    np.testing.assert_allclose(_branchy(neg), np.full((2, 2), 0.0))
+    # jit path: same function compiles, both predicates work
+    jf = jax.jit(_branchy)
+    np.testing.assert_allclose(jf(pos), np.full((2, 2), 3.0))
+    np.testing.assert_allclose(jf(neg), np.full((2, 2), 0.0))
+
+
+@declarative
+def _loopy(s, cap):
+    n = jnp.zeros((), "int32")
+    while jnp.sum(s) < cap:
+        s = s * 2.0
+        n = n + 1
+    return s, n
+
+
+def test_while_conversion_eager_and_jit():
+    s0 = jnp.asarray(np.ones(4, "float32"))  # sum 4
+    s, n = _loopy(s0, 100.0)
+    assert float(jnp.sum(s)) == 128.0 and int(n) == 5
+    js, jn = jax.jit(_loopy, static_argnums=())(s0, jnp.float32(100.0))
+    assert float(jnp.sum(js)) == 128.0 and int(jn) == 5
+
+
+@declarative
+def _boolops(x, lo, hi):
+    if (jnp.sum(x) > lo) and (jnp.sum(x) < hi):
+        r = x + 1.0
+    else:
+        r = x - 1.0
+    return r
+
+
+def test_boolop_conversion():
+    x = jnp.asarray(np.ones(3, "float32"))  # sum 3
+    np.testing.assert_allclose(_boolops(x, 0.0, 10.0), np.full(3, 2.0))
+    np.testing.assert_allclose(_boolops(x, 5.0, 10.0), np.zeros(3))
+    jf = jax.jit(_boolops)
+    np.testing.assert_allclose(jf(x, 0.0, 10.0), np.full(3, 2.0))
+    np.testing.assert_allclose(jf(x, 5.0, 10.0), np.zeros(3))
+
+
+def test_varbase_dygraph_control_flow():
+    """The converted function also runs over dygraph VarBase values —
+    eager branch on concrete data, compiled control flow under trace."""
+    from paddle_tpu.dygraph import VarBase, guard
+
+    @declarative
+    def f(v):
+        if jnp.sum(v.value if hasattr(v, "value") else v) > 0:
+            out = v * 2.0
+        else:
+            out = v * -1.0
+        return out
+
+    with guard():
+        v = VarBase(np.ones(3, "float32"))
+        r = f(v)
+        np.testing.assert_allclose(np.asarray(r.value), np.full(3, 2.0))
+        v2 = VarBase(-np.ones(3, "float32"))
+        r2 = f(v2)
+        np.testing.assert_allclose(np.asarray(r2.value), np.ones(3))
+
+
+def test_nested_if_in_while():
+    @declarative
+    def f(x):
+        total = jnp.zeros((), "float32")
+        i = jnp.zeros((), "int32")
+        while i < 4:
+            if x > 0:
+                total = total + x
+            else:
+                total = total - x
+            i = i + 1
+        return total
+
+    assert float(f(jnp.float32(2.0))) == 8.0
+    assert float(f(jnp.float32(-3.0))) == 12.0
+    jf = jax.jit(f)
+    assert float(jf(jnp.float32(2.0))) == 8.0
+    assert float(jf(jnp.float32(-3.0))) == 12.0
+
+
+def test_return_inside_if_rejected():
+    with pytest.raises(NotImplementedError, match="return"):
+        @declarative
+        def bad(x):
+            if jnp.sum(x) > 0:
+                return x
+            return -x

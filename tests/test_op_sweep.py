@@ -293,6 +293,7 @@ COVERED_ELSEWHERE = {
     'adadelta', 'adagrad', 'adamax', 'adamw', 'decayed_adagrad', 'dpsgd',
     'ftrl', 'lamb', 'lars_momentum', 'rmsprop',
     'merge_selected_rows', 'get_tensor_from_selected_rows',
+    'dgc',  # tests/test_dgc.py
 }
 
 
