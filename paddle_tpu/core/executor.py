@@ -399,12 +399,16 @@ class Executor:
         feed_vals, feed_sig = self._prepare_feed(block, feed)
         from ..flags import flag
 
+        # NOTE: no scope identity in the key — state analysis depends
+        # only on the program, and jax.jit already retraces when a
+        # different scope supplies different shapes/dtypes. Keying on
+        # scope.uid forced a recompile per Scope, which made the
+        # predictor's clone-per-thread pattern recompile per clone.
         key = (
             program.uid,
             program.version,
             feed_sig,
             tuple(fetch_names),
-            scope.uid,
             mesh is not None,
             flag("check_nan_inf"),
             self.disable_donation,

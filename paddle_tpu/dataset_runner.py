@@ -61,9 +61,13 @@ def _run_hogwild(executor, program, dataset, scope, fetch_list, fetch_info,
     from .core.executor import Executor
 
     # dedicated executor with donation off (shared params, concurrent
-    # steps); program cache still shared per-thread via its own cache
-    exe = Executor(executor.place)
-    exe.disable_donation = True
+    # steps) — cached on the caller so repeated epochs reuse compiled
+    # steps instead of recompiling per call
+    exe = getattr(executor, "_hogwild_exe", None)
+    if exe is None:
+        exe = Executor(executor.place)
+        exe.disable_donation = True
+        executor._hogwild_exe = exe
 
     channel: "queue.Queue" = queue.Queue(maxsize=2 * n_threads)
     stop = object()
@@ -114,15 +118,23 @@ def _run_hogwild(executor, program, dataset, scope, fetch_list, fetch_info,
             if errors or not any(t.is_alive() for t in threads):
                 break
     finally:
-        # always deliver sentinels, even when the dataset iterator
-        # raises — otherwise workers block on channel.get forever
+        # always deliver ALL sentinels, even when the dataset iterator
+        # raises — a worker left without one blocks on channel.get
+        # forever and keeps mutating the shared scope. If the queue is
+        # full (workers wedged in a long first-step compile), make room
+        # by dropping queued batches.
         for _ in threads:
-            try:
-                channel.put(stop, timeout=5.0)
-            except queue.Full:
-                break
+            while True:
+                try:
+                    channel.put(stop, timeout=1.0)
+                    break
+                except queue.Full:
+                    try:
+                        channel.get_nowait()
+                    except queue.Empty:
+                        pass
         for t in threads:
-            t.join(timeout=60.0)
+            t.join(timeout=120.0)
     if errors:
         raise errors[0]
     return last[0]

@@ -89,7 +89,11 @@ def convert_ifelse(pred, true_fn, false_fn, init):
         p = _unwrap(pred)
         p = bool(np.asarray(p).reshape(())) if hasattr(p, "reshape") or hasattr(
             p, "__array__") else bool(p)
-        return true_fn(init) if p else false_fn(init)
+        res = true_fn(init) if p else false_fn(init)
+        # a name assigned only in the untaken branch must not leak the
+        # (truthy) UNDEF sentinel into user code
+        _check_no_undef(res)
+        return res
     if any(isinstance(v, VarBase) for v in init):
         # VarBase-under-trace: evaluate both branches, select (the
         # rewrap bookkeeping through a lazy cond is not worth it for

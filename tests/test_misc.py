@@ -176,3 +176,40 @@ def test_metrics_accuracy_and_auc():
     labels = np.array([1, 0, 1, 0])
     auc.update(preds, labels)
     assert auc.eval() == pytest.approx(1.0)
+
+
+def test_local_fs_operations(tmp_path):
+    """LocalFS (reference framework/io/fs.cc localfs_*)."""
+    from paddle_tpu.fs import LocalFS, FSFileExistsError
+
+    fs = LocalFS()
+    d = str(tmp_path / "a" / "b")
+    fs.mkdirs(d)
+    assert fs.is_dir(d) and fs.is_exist(d) and not fs.is_file(d)
+    f = str(tmp_path / "a" / "x.txt")
+    fs.touch(f)
+    assert fs.is_file(f)
+    dirs, files = fs.ls_dir(str(tmp_path / "a"))
+    assert dirs == ["b"] and files == ["x.txt"]
+    fs.rename(f, str(tmp_path / "a" / "y.txt"))
+    assert not fs.is_exist(f) and fs.is_file(str(tmp_path / "a" / "y.txt"))
+    import pytest as _pytest
+
+    fs.touch(str(tmp_path / "a" / "z.txt"))
+    with _pytest.raises(FSFileExistsError):
+        fs.mv(str(tmp_path / "a" / "y.txt"), str(tmp_path / "a" / "z.txt"))
+    fs.delete(str(tmp_path / "a"))
+    assert not fs.is_exist(str(tmp_path / "a"))
+
+
+def test_hdfs_client_without_hadoop(tmp_path):
+    from paddle_tpu.fs import HDFSClient, ExecuteError
+    import pytest as _pytest
+
+    cli = HDFSClient(hadoop_home=str(tmp_path))  # no hadoop binary here
+    with _pytest.raises(ExecuteError, match="hadoop binary not found"):
+        cli.is_exist("/foo")
+    # command construction (what the subprocess would run)
+    assert cli._cmd("-ls", "/x")[-2:] == ["-ls", "/x"]
+    # 7 files over 3 trainers -> blocks [3, 2, 2]; trainer 1 gets d, e
+    assert HDFSClient.split_files(list("abcdefg"), 1, 3) == ["d", "e"]
