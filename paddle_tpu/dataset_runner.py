@@ -120,19 +120,20 @@ def _run_hogwild(executor, program, dataset, scope, fetch_list, fetch_info,
     finally:
         # always deliver ALL sentinels, even when the dataset iterator
         # raises — a worker left without one blocks on channel.get
-        # forever and keeps mutating the shared scope. If the queue is
-        # full (workers wedged in a long first-step compile), make room
-        # by dropping queued batches.
+        # forever and keeps mutating the shared scope. Queued REAL
+        # batches are only dropped on the error path (workers dead or
+        # wedged); on a normal epoch end we wait for them to drain.
         for _ in threads:
             while True:
                 try:
                     channel.put(stop, timeout=1.0)
                     break
                 except queue.Full:
-                    try:
-                        channel.get_nowait()
-                    except queue.Empty:
-                        pass
+                    if errors or not any(t.is_alive() for t in threads):
+                        try:
+                            channel.get_nowait()  # make room: abandon run
+                        except queue.Empty:
+                            pass
         for t in threads:
             t.join(timeout=120.0)
     if errors:

@@ -45,10 +45,21 @@ def _to_pred(v):
 
 
 class _Undef:
-    """Placeholder for names not yet bound before a converted block."""
+    """Placeholder for names not yet bound before a converted block.
+    Use-site traps make it behave like an unbound name: mere presence
+    in a carry is fine (an if-without-else that assigns a new name is
+    legal python when the branch is untaken), USING it raises."""
 
     def __repr__(self):
         return "<to_static undefined>"
+
+    def _raise(self, *a, **k):
+        raise NameError(
+            "to_static: variable was only assigned in an untaken branch"
+        )
+
+    __bool__ = __call__ = __getattr__ = __add__ = __radd__ = _raise
+    __sub__ = __mul__ = __truediv__ = __iter__ = __array__ = _raise
 
 
 UNDEF = _Undef()
@@ -89,11 +100,9 @@ def convert_ifelse(pred, true_fn, false_fn, init):
         p = _unwrap(pred)
         p = bool(np.asarray(p).reshape(())) if hasattr(p, "reshape") or hasattr(
             p, "__array__") else bool(p)
-        res = true_fn(init) if p else false_fn(init)
-        # a name assigned only in the untaken branch must not leak the
-        # (truthy) UNDEF sentinel into user code
-        _check_no_undef(res)
-        return res
+        # an untaken branch may leave a fresh name as UNDEF — legal
+        # until used (the sentinel's use-site traps raise then)
+        return true_fn(init) if p else false_fn(init)
     if any(isinstance(v, VarBase) for v in init):
         # VarBase-under-trace: evaluate both branches, select (the
         # rewrap bookkeeping through a lazy cond is not worth it for

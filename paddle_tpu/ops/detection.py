@@ -613,3 +613,378 @@ def _polygon_box_transform(ctx, op, ins):
     gy = jnp.broadcast_to(jnp.arange(H, dtype=x.dtype)[None, None, :, None], x.shape)
     is_x = (jnp.arange(C) % 2 == 0)[None, :, None, None]
     return {"Output": [jnp.where(is_x, 4 * gx - x, 4 * gy - x)]}
+
+
+# -- round-3: proposal pipeline + YOLO training ----------------------------
+
+
+@register_op("generate_proposals", inputs=("Scores", "BboxDeltas", "ImInfo", "Anchors", "Variances"), outputs=("RpnRois", "RpnRoiProbs", "RpnRoisNum"), stop_gradient=True)
+def _generate_proposals(ctx, op, ins):
+    """Reference detection/generate_proposals_op.cc: decode anchor
+    deltas, clip, drop tiny boxes, pre-NMS top-k, NMS, post-NMS top-k.
+    Dense outputs [N, post_nms_topN, 4] + per-image counts."""
+    scores = ins["Scores"][0]        # [N, A, H, W]
+    deltas = ins["BboxDeltas"][0]    # [N, 4A, H, W]
+    im_info = ins["ImInfo"][0]       # [N, 3]
+    anchors = ins["Anchors"][0].reshape(-1, 4)    # [H*W*A, 4]
+    var = ins["Variances"][0].reshape(-1, 4) if ins.get("Variances") else jnp.ones_like(anchors)
+    pre_n = int(op.attrs.get("pre_nms_topN", 6000))
+    post_n = int(op.attrs.get("post_nms_topN", 1000))
+    thresh = float(op.attrs.get("nms_thresh", 0.7))
+    min_size = float(op.attrs.get("min_size", 0.1))
+    N, A, H, W = scores.shape
+    M = A * H * W
+    pre_n = min(pre_n, M)
+    post_n = min(post_n, pre_n)
+    sc = scores.transpose(0, 2, 3, 1).reshape(N, M)
+    dl = deltas.reshape(N, A, 4, H, W).transpose(0, 3, 4, 1, 2).reshape(N, M, 4)
+
+    aw = anchors[:, 2] - anchors[:, 0] + 1.0
+    ah = anchors[:, 3] - anchors[:, 1] + 1.0
+    acx = anchors[:, 0] + aw * 0.5
+    acy = anchors[:, 1] + ah * 0.5
+
+    def per_image(s, d, info):
+        cx = var[:, 0] * d[:, 0] * aw + acx
+        cy = var[:, 1] * d[:, 1] * ah + acy
+        w = jnp.exp(jnp.minimum(var[:, 2] * d[:, 2], 10.0)) * aw
+        h = jnp.exp(jnp.minimum(var[:, 3] * d[:, 3], 10.0)) * ah
+        x1 = jnp.clip(cx - w * 0.5, 0, info[1] - 1)
+        y1 = jnp.clip(cy - h * 0.5, 0, info[0] - 1)
+        x2 = jnp.clip(cx + w * 0.5, 0, info[1] - 1)
+        y2 = jnp.clip(cy + h * 0.5, 0, info[0] - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], axis=1)
+        keep = ((x2 - x1 + 1) >= min_size * info[2]) & \
+               ((y2 - y1 + 1) >= min_size * info[2])
+        s = jnp.where(keep, s, -jnp.inf)
+        top_s, top_i = jax.lax.top_k(s, pre_n)
+        top_b = boxes[top_i]
+        picked = _greedy_nms(top_b, top_s, thresh, -jnp.inf, post_n,
+                             normalized=False)
+        ps = jnp.where(picked & jnp.isfinite(top_s), top_s, -jnp.inf)
+        fs, fi = jax.lax.top_k(ps, post_n)
+        valid = jnp.isfinite(fs)
+        rois = top_b[fi] * valid[:, None]
+        return rois, jnp.where(valid, fs, 0.0), jnp.sum(valid).astype(jnp.int32)
+
+    rois, probs, num = jax.vmap(per_image)(sc, dl, im_info)
+    return {"RpnRois": [rois], "RpnRoiProbs": [probs[..., None]],
+            "RpnRoisNum": [num]}
+
+
+@register_op("distribute_fpn_proposals", inputs=("FpnRois", "RoisNum"), outputs=("MultiFpnRois", "RestoreIndex", "MultiLevelRoIsNum"), stop_gradient=True)
+def _distribute_fpn_proposals(ctx, op, ins):
+    """Reference detection/distribute_fpn_proposals_op.cc: route each
+    roi to its FPN level by scale. Dense form: each level output keeps
+    the full [R, 4] buffer with that level's rois compacted to the
+    front (counts say how many are real)."""
+    rois = ins["FpnRois"][0]  # [R, 4]
+    min_lv = int(op.attrs["min_level"])
+    max_lv = int(op.attrs["max_level"])
+    refer_lv = int(op.attrs["refer_level"])
+    refer_sc = float(op.attrs["refer_scale"])
+    R = rois.shape[0]
+    w = jnp.maximum(rois[:, 2] - rois[:, 0] + 1.0, 1.0)
+    h = jnp.maximum(rois[:, 3] - rois[:, 1] + 1.0, 1.0)
+    scale = jnp.sqrt(w * h)
+    lv = jnp.floor(refer_lv + jnp.log2(scale / refer_sc + 1e-8))
+    lv = jnp.clip(lv, min_lv, max_lv).astype(jnp.int32)
+    outs, nums = [], []
+    for L in range(min_lv, max_lv + 1):
+        mask = lv == L
+        order = jnp.argsort(jnp.where(mask, 0, 1) * (R + 1) + jnp.arange(R))
+        packed = rois[order] * mask[order][:, None]
+        outs.append(packed)
+        nums.append(jnp.sum(mask).astype(jnp.int32))
+    # RestoreIndex maps original roi i -> its row in
+    # concat(MultiFpnRois) with this PADDED layout: level slot * R +
+    # rank within level (counting lower levels only compactly would
+    # point into padding)
+    level_idx = lv - min_lv
+    # rank within level: count of earlier rois with the same level
+    same = (lv[:, None] == lv[None, :]) & (jnp.arange(R)[None, :] < jnp.arange(R)[:, None])
+    rank = jnp.sum(same, axis=1)
+    restore = (level_idx * R + rank).astype(jnp.int32)
+    return {"MultiFpnRois": outs, "RestoreIndex": [restore[:, None]],
+            "MultiLevelRoIsNum": [jnp.stack(nums)]}
+
+
+@register_op("collect_fpn_proposals", inputs=("MultiLevelRois", "MultiLevelScores", "MultiLevelRoIsNum"), outputs=("FpnRois", "RoisNum"), stop_gradient=True)
+def _collect_fpn_proposals(ctx, op, ins):
+    """Reference detection/collect_fpn_proposals_op.cc: merge all
+    levels, keep the post_nms_topN highest-scoring. MultiLevelRoIsNum
+    masks the dense per-level padding so fake rois never win top-k."""
+    rois = jnp.concatenate(ins["MultiLevelRois"], axis=0)
+    scores = jnp.concatenate(
+        [s.reshape(-1) for s in ins["MultiLevelScores"]], axis=0
+    )
+    if ins.get("MultiLevelRoIsNum"):
+        nums = ins["MultiLevelRoIsNum"][0].reshape(-1)
+        masks = []
+        for i, lvl in enumerate(ins["MultiLevelRois"]):
+            masks.append(jnp.arange(lvl.shape[0]) < nums[i])
+        valid = jnp.concatenate(masks)
+        scores = jnp.where(valid, scores, -jnp.inf)
+    post = min(int(op.attrs.get("post_nms_topN", rois.shape[0])), rois.shape[0])
+    top_s, top_i = jax.lax.top_k(scores, post)
+    keep = jnp.isfinite(top_s)
+    return {"FpnRois": [rois[top_i] * keep[:, None]],
+            "RoisNum": [jnp.sum(keep).astype(jnp.int32).reshape(1)]}
+
+
+@register_op("rpn_target_assign", inputs=("Anchor", "GtBoxes", "IsCrowd", "ImInfo"), outputs=("LocationIndex", "ScoreIndex", "TargetBBox", "TargetLabel", "BBoxInsideWeight"), stop_gradient=True)
+def _rpn_target_assign(ctx, op, ins):
+    """Reference detection/rpn_target_assign_op.cc. Deterministic dense
+    redesign: fg = anchors with IoU >= pos_thresh (plus each gt's best
+    anchor), bg = IoU < neg_thresh; the reference's random subsampling
+    becomes top-by-IoU subsampling (fixed sizes for XLA)."""
+    anchors = ins["Anchor"][0].reshape(-1, 4)
+    gt = ins["GtBoxes"][0].reshape(-1, 4)
+    batch = int(op.attrs.get("rpn_batch_size_per_im", 256))
+    fg_frac = float(op.attrs.get("rpn_fg_fraction", 0.5))
+    pos_t = float(op.attrs.get("rpn_positive_overlap", 0.7))
+    neg_t = float(op.attrs.get("rpn_negative_overlap", 0.3))
+    A = anchors.shape[0]
+    n_fg = max(int(batch * fg_frac), 1)
+    n_bg = batch - n_fg
+    # zero-padded gt rows (dense batching) and crowd boxes must not
+    # participate in assignment (reference excludes IsCrowd gts)
+    gt_area = (gt[:, 2] - gt[:, 0]) * (gt[:, 3] - gt[:, 1])
+    valid_gt = gt_area > 0
+    if ins.get("IsCrowd"):
+        valid_gt = valid_gt & (ins["IsCrowd"][0].reshape(-1) == 0)
+    iou = _pairwise_iou(anchors, gt, normalized=False)  # [A, G]
+    iou = jnp.where(valid_gt[None, :], iou, 0.0)
+    best_gt = jnp.argmax(iou, axis=1)
+    best_iou = jnp.max(iou, axis=1)
+    # each VALID gt's best anchor is always fg (reference rule)
+    gt_best_anchor = jnp.argmax(iou, axis=0)  # [G]
+    forced = jnp.zeros((A,), bool).at[gt_best_anchor].max(valid_gt)
+    is_fg = (best_iou >= pos_t) | forced
+    is_bg = (best_iou < neg_t) & ~is_fg
+    fg_rank = jnp.where(is_fg, best_iou, -jnp.inf)
+    fg_score, fg_idx = jax.lax.top_k(fg_rank, min(n_fg, A))
+    fg_valid = jnp.isfinite(fg_score)
+    bg_rank = jnp.where(is_bg, -best_iou, -jnp.inf)  # easiest negatives first
+    bg_score, bg_idx = jax.lax.top_k(bg_rank, min(n_bg, A))
+    bg_valid = jnp.isfinite(bg_score)
+    loc_idx = jnp.where(fg_valid, fg_idx, 0).astype(jnp.int32)
+    score_idx = jnp.concatenate([loc_idx, jnp.where(bg_valid, bg_idx, 0).astype(jnp.int32)])
+    labels = jnp.concatenate([
+        fg_valid.astype(jnp.int32), jnp.zeros_like(bg_valid, jnp.int32)
+    ])
+    # bbox regression targets for the fg anchors (encode vs matched gt)
+    a = anchors[loc_idx]
+    g = gt[best_gt[loc_idx]]
+    aw = a[:, 2] - a[:, 0] + 1.0
+    ah = a[:, 3] - a[:, 1] + 1.0
+    gw = g[:, 2] - g[:, 0] + 1.0
+    gh = g[:, 3] - g[:, 1] + 1.0
+    tx = ((g[:, 0] + gw / 2) - (a[:, 0] + aw / 2)) / aw
+    ty = ((g[:, 1] + gh / 2) - (a[:, 1] + ah / 2)) / ah
+    tw = jnp.log(gw / aw)
+    th = jnp.log(gh / ah)
+    tgt = jnp.stack([tx, ty, tw, th], axis=1) * fg_valid[:, None]
+    return {
+        "LocationIndex": [loc_idx],
+        "ScoreIndex": [score_idx],
+        "TargetBBox": [tgt],
+        "TargetLabel": [labels[:, None]],
+        "BBoxInsideWeight": [fg_valid[:, None].astype(jnp.float32)
+                             * jnp.ones((1, 4), jnp.float32)],
+    }
+
+
+@register_op("retinanet_detection_output", inputs=("BBoxes", "Scores", "Anchors", "ImInfo"), outputs=("Out", "NmsRoisNum"), stop_gradient=True)
+def _retinanet_detection_output(ctx, op, ins):
+    """Reference detection/retinanet_detection_output_op.cc: decode
+    per-level predictions against anchors, then class-wise NMS. Dense
+    form concatenates all levels before one NMS pass."""
+    deltas = jnp.concatenate([b.reshape(b.shape[0], -1, 4) for b in ins["BBoxes"]], axis=1)
+    scores = jnp.concatenate([s.reshape(s.shape[0], -1, s.shape[-1]) for s in ins["Scores"]], axis=1)
+    anchors = jnp.concatenate([a.reshape(-1, 4) for a in ins["Anchors"]], axis=0)
+    im_info = ins["ImInfo"][0]
+    s_thresh = float(op.attrs.get("score_threshold", 0.05))
+    n_thresh = float(op.attrs.get("nms_threshold", 0.3))
+    keep_k = int(op.attrs.get("keep_top_k", 100))
+    nms_k = int(op.attrs.get("nms_top_k", 1000))
+    N, M, C = scores.shape
+    aw = anchors[:, 2] - anchors[:, 0] + 1.0
+    ah = anchors[:, 3] - anchors[:, 1] + 1.0
+
+    def per_image(d, s, info):
+        cx = d[:, 0] * aw + anchors[:, 0] + aw * 0.5
+        cy = d[:, 1] * ah + anchors[:, 1] + ah * 0.5
+        w = jnp.exp(jnp.minimum(d[:, 2], 10.0)) * aw
+        h = jnp.exp(jnp.minimum(d[:, 3], 10.0)) * ah
+        x1 = jnp.clip(cx - w / 2, 0, info[1] - 1)
+        y1 = jnp.clip(cy - h / 2, 0, info[0] - 1)
+        x2 = jnp.clip(cx + w / 2, 0, info[1] - 1)
+        y2 = jnp.clip(cy + h / 2, 0, info[0] - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], 1)
+
+        def per_class(cls_scores):
+            return _greedy_nms(boxes, cls_scores, n_thresh, s_thresh,
+                               min(nms_k, M), normalized=False)
+
+        picked = jax.vmap(per_class)(s.T)  # [C, M]
+        flat_valid = picked.reshape(-1)
+        flat_scores = jnp.where(flat_valid, s.T.reshape(-1), -jnp.inf)
+        K = min(keep_k, M * C)
+        order = jnp.argsort(-flat_scores)[:K]
+        lbl = (order // M).astype(jnp.float32)
+        sc = s.T.reshape(-1)[order]
+        bsel = boxes[order % M]
+        valid = flat_valid[order]
+        row = jnp.concatenate(
+            [jnp.where(valid, lbl, -1.0)[:, None], (sc * valid)[:, None],
+             bsel * valid[:, None]], axis=1)
+        return row, jnp.sum(valid).astype(jnp.int32)
+
+    out, num = jax.vmap(per_image)(deltas, scores, im_info)
+    return {"Out": [out], "NmsRoisNum": [num]}
+
+
+@register_op("locality_aware_nms", inputs=("BBoxes", "Scores"), outputs=("Out",), stop_gradient=True)
+def _locality_aware_nms(ctx, op, ins):
+    """Reference detection/locality_aware_nms_op.cc (EAST text): merge
+    overlapping boxes by score-weighted averaging, then standard NMS."""
+    boxes = ins["BBoxes"][0]
+    scores = ins["Scores"][0]
+    if boxes.ndim == 3:
+        boxes, scores = boxes[0], scores[0]
+    if scores.ndim == 2:
+        scores = scores[0] if scores.shape[0] == 1 else scores.max(0)
+    n_thresh = float(op.attrs.get("nms_threshold", 0.3))
+    s_thresh = float(op.attrs.get("score_threshold", 0.0))
+    keep_k = int(op.attrs.get("keep_top_k", boxes.shape[0]))
+    M = boxes.shape[0]
+    iou = _pairwise_iou(boxes, boxes)
+    # locality merge: each box becomes the score-weighted mean of its
+    # high-overlap neighbours; its score the sum (reference weighted_merge)
+    wgt = jnp.where(iou > n_thresh, scores[None, :], 0.0)
+    merged = (wgt @ boxes) / jnp.maximum(jnp.sum(wgt, 1, keepdims=True), 1e-8)
+    mscores = jnp.sum(wgt, axis=1)
+    picked = _greedy_nms(merged, mscores, n_thresh, s_thresh,
+                         min(keep_k, M), normalized=False)
+    valid = picked
+    order = jnp.argsort(-jnp.where(valid, mscores, -jnp.inf))[:keep_k]
+    v = valid[order]
+    row = jnp.concatenate(
+        [jnp.where(v, 0.0, -1.0)[:, None],
+         (mscores[order] * v)[:, None], merged[order] * v[:, None]], axis=1)
+    return {"Out": [row]}
+
+
+@register_op("yolov3_loss", inputs=("X", "GTBox", "GTLabel", "GTScore"), outputs=("Loss", "ObjectnessMask", "GTMatchMask"), no_grad=("GTBox", "GTLabel", "GTScore"))
+def _yolov3_loss(ctx, op, ins):
+    """Reference detection/yolov3_loss_op.cc: per-gt best-anchor
+    assignment, xy/wh regression + objectness + class BCE; anchors with
+    IoU > ignore_thresh against any gt are excluded from the no-object
+    loss."""
+    x = ins["X"][0]                 # [N, mask*(5+C), H, W]
+    gtbox = ins["GTBox"][0]         # [N, B, 4] (cx, cy, w, h; normalized)
+    gtlabel = ins["GTLabel"][0]     # [N, B]
+    anchors = [int(a) for a in op.attrs["anchors"]]
+    amask = [int(a) for a in op.attrs.get("anchor_mask", list(range(len(anchors) // 2)))]
+    C = int(op.attrs["class_num"])
+    ignore = float(op.attrs.get("ignore_thresh", 0.7))
+    down = int(op.attrs.get("downsample_ratio", 32))
+    use_label_smooth = bool(op.attrs.get("use_label_smooth", False))
+    an_num = len(amask)
+    N, _, H, W = x.shape
+    B = gtbox.shape[1]
+    x = x.reshape(N, an_num, 5 + C, H, W)
+    input_size = down * H
+    all_w = jnp.asarray(anchors[0::2], jnp.float32)
+    all_h = jnp.asarray(anchors[1::2], jnp.float32)
+    mask_w = all_w[jnp.asarray(amask)]
+    mask_h = all_h[jnp.asarray(amask)]
+    sig = jax.nn.sigmoid
+    softplus = lambda v: jnp.log1p(jnp.exp(-jnp.abs(v))) + jnp.maximum(v, 0.0)
+    bce = lambda logit, t: softplus(logit) - t * logit
+
+    def per_image(xi, gb, gl, gs):
+        # gt -> best anchor over ALL anchors by wh IoU
+        gw = gb[:, 2] * input_size
+        gh = gb[:, 3] * input_size
+        inter = jnp.minimum(gw[:, None], all_w[None, :]) * \
+            jnp.minimum(gh[:, None], all_h[None, :])
+        wh_iou = inter / (gw[:, None] * gh[:, None]
+                          + all_w[None, :] * all_h[None, :] - inter + 1e-9)
+        best = jnp.argmax(wh_iou, axis=1)  # [B] global anchor idx
+        valid_gt = (gb[:, 2] > 0) & (gb[:, 3] > 0)
+        # local anchor slot (or -1 if best anchor not in this head's mask)
+        local = jnp.full((B,), -1, jnp.int32)
+        for li, a in enumerate(amask):
+            local = jnp.where(best == a, li, local)
+        gi = jnp.clip((gb[:, 0] * W).astype(jnp.int32), 0, W - 1)
+        gj = jnp.clip((gb[:, 1] * H).astype(jnp.int32), 0, H - 1)
+        responsible = valid_gt & (local >= 0)
+
+        # objectness target + match bookkeeping
+        obj_t = jnp.zeros((an_num, H, W))
+        cls_t = jnp.zeros((an_num, H, W, C))
+        tx = gb[:, 0] * W - gi
+        ty = gb[:, 1] * H - gj
+        tw = jnp.log(jnp.maximum(gw / jnp.maximum(all_w[best], 1e-9), 1e-9))
+        th = jnp.log(jnp.maximum(gh / jnp.maximum(all_h[best], 1e-9), 1e-9))
+        scale = 2.0 - gb[:, 2] * gb[:, 3]  # small boxes weigh more
+
+        li = jnp.where(responsible, local, 0)
+        obj_t = obj_t.at[li, gj, gi].max(
+            jnp.where(responsible, gs, 0.0))
+        onehot = jax.nn.one_hot(gl.astype(jnp.int32), C)
+        if use_label_smooth:
+            onehot = onehot * (1 - 1.0 / C) + 1.0 / C * 0.5
+        cls_t = cls_t.at[li, gj, gi].add(onehot * responsible[:, None])
+
+        # per-gt coordinate losses gathered at the responsible cell
+        px = xi[li, 0, gj, gi]
+        py = xi[li, 1, gj, gi]
+        pw = xi[li, 2, gj, gi]
+        ph = xi[li, 3, gj, gi]
+        # GTScore weights each gt's losses (mixup training, reference
+        # yolov3_loss_op.cc uses it on coord/obj/class terms)
+        coord = (bce(px, tx) + bce(py, ty)
+                 + 0.5 * ((pw - tw) ** 2 + (ph - th) ** 2)) * scale * gs
+        coord_loss = jnp.sum(jnp.where(responsible, coord, 0.0))
+
+        # ignore mask: predicted boxes with IoU > thresh vs any gt
+        gxs = jnp.arange(W, dtype=jnp.float32)[None, None, :]
+        gys = jnp.arange(H, dtype=jnp.float32)[None, :, None]
+        pcx = (sig(xi[:, 0]) + gxs) / W
+        pcy = (sig(xi[:, 1]) + gys) / H
+        pww = jnp.exp(jnp.minimum(xi[:, 2], 10.0)) * mask_w[:, None, None] / input_size
+        phh = jnp.exp(jnp.minimum(xi[:, 3], 10.0)) * mask_h[:, None, None] / input_size
+        px1, px2 = pcx - pww / 2, pcx + pww / 2
+        py1, py2 = pcy - phh / 2, pcy + phh / 2
+        gx1 = gb[:, 0] - gb[:, 2] / 2
+        gx2 = gb[:, 0] + gb[:, 2] / 2
+        gy1 = gb[:, 1] - gb[:, 3] / 2
+        gy2 = gb[:, 1] + gb[:, 3] / 2
+
+        def iou_with_gt(k):
+            ix = jnp.clip(jnp.minimum(px2, gx2[k]) - jnp.maximum(px1, gx1[k]), 0)
+            iy = jnp.clip(jnp.minimum(py2, gy2[k]) - jnp.maximum(py1, gy1[k]), 0)
+            inter = ix * iy
+            u = pww * phh + gb[k, 2] * gb[k, 3] - inter
+            return jnp.where(valid_gt[k], inter / jnp.maximum(u, 1e-9), 0.0)
+
+        best_pred_iou = jnp.max(jax.vmap(iou_with_gt)(jnp.arange(B)), axis=0)
+        noobj_ok = (best_pred_iou <= ignore) & (obj_t == 0)
+
+        pobj = xi[:, 4]
+        obj_loss = jnp.sum(jnp.where(obj_t > 0, obj_t * bce(pobj, 1.0), 0.0)) + \
+            jnp.sum(jnp.where(noobj_ok, bce(pobj, 0.0), 0.0))
+        pcls = xi[:, 5:].transpose(0, 2, 3, 1)  # [an, H, W, C]
+        cls_loss = jnp.sum(
+            jnp.where((obj_t > 0)[..., None], bce(pcls, jnp.clip(cls_t, 0, 1)), 0.0)
+        )
+        return coord_loss + obj_loss + cls_loss, obj_t, responsible
+
+    gtscore = (ins["GTScore"][0] if ins.get("GTScore")
+               else jnp.ones(gtlabel.shape, jnp.float32))
+    loss, objm, match = jax.vmap(per_image)(x, gtbox, gtlabel, gtscore)
+    return {"Loss": [loss], "ObjectnessMask": [objm],
+            "GTMatchMask": [match.astype(jnp.int32)]}

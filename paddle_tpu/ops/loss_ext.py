@@ -203,3 +203,220 @@ def _edit_distance(ctx, op, ins):
         "Out": [out.reshape(B, 1)],
         "SequenceNum": [jnp.asarray(B, jnp.int64)],
     }
+
+
+# -- round-3 losses / metrics (reference operators/*.cc, same-named) -------
+
+
+@register_op("hinge_loss", inputs=("Logits", "Labels"), outputs=("Loss",), no_grad=("Labels",))
+def _hinge_loss(ctx, op, ins):
+    x, y = ins["Logits"][0], ins["Labels"][0]
+    return {"Loss": [jnp.maximum(1.0 - (2.0 * y - 1.0) * x, 0.0)]}
+
+
+@register_op("rank_loss", inputs=("Label", "Left", "Right"), outputs=("Out",), no_grad=("Label",))
+def _rank_loss(ctx, op, ins):
+    # reference rank_loss_op.cc: sigmoid cross entropy on o_left-o_right
+    lbl, l, r = ins["Label"][0], ins["Left"][0], ins["Right"][0]
+    d = l - r
+    return {"Out": [jnp.log1p(jnp.exp(-jnp.abs(d))) + jnp.maximum(d, 0.0) - lbl * d]}
+
+
+@register_op("margin_rank_loss", inputs=("Label", "X1", "X2"), outputs=("Out", "Activated"), no_grad=("Label",))
+def _margin_rank_loss(ctx, op, ins):
+    lbl, x1, x2 = ins["Label"][0], ins["X1"][0], ins["X2"][0]
+    m = float(op.attrs.get("margin", 0.0))
+    out = jnp.maximum(-lbl * (x1 - x2) + m, 0.0)
+    return {"Out": [out], "Activated": [(out > 0).astype(x1.dtype)]}
+
+
+@register_op("bpr_loss", inputs=("X", "Label"), outputs=("Y",), no_grad=("Label",))
+def _bpr_loss(ctx, op, ins):
+    # Bayesian personalized ranking (reference bpr_loss_op.cc): for the
+    # positive class p, loss = -mean_j log(sigmoid(x_p - x_j)), j != p
+    x = ins["X"][0]  # [N, C] scores
+    lbl = ins["Label"][0].reshape(-1).astype(jnp.int32)
+    N, C = x.shape
+    pos = jnp.take_along_axis(x, lbl[:, None], axis=1)  # [N,1]
+    diff = pos - x
+    logsig = -jnp.log1p(jnp.exp(-diff))
+    notp = jnp.arange(C)[None, :] != lbl[:, None]
+    return {"Y": [(-jnp.sum(jnp.where(notp, logsig, 0.0), axis=1,
+                            keepdims=True) / jnp.maximum(C - 1, 1))]}
+
+
+@register_op("modified_huber_loss", inputs=("X", "Y"), outputs=("Out", "IntermediateVal"), no_grad=("Y",))
+def _modified_huber_loss(ctx, op, ins):
+    x, y = ins["X"][0], ins["Y"][0]
+    z = (2.0 * y - 1.0) * x
+    out = jnp.where(z < -1.0, -4.0 * z,
+                    jnp.where(z < 1.0, (1.0 - z) ** 2, 0.0))
+    return {"Out": [out], "IntermediateVal": [z]}
+
+
+@register_op("teacher_student_sigmoid_loss", inputs=("X", "Label"), outputs=("Y",), no_grad=("Label",))
+def _teacher_student_sigmoid_loss(ctx, op, ins):
+    """Reference teacher_student_sigmoid_loss_op.cc: label in {-1..2}
+    mixes a hard click signal with a soft teacher score."""
+    x = ins["X"][0].reshape(-1)
+    lbl = ins["Label"][0].reshape(-1)
+    softplus = lambda v: jnp.log1p(jnp.exp(-jnp.abs(v))) + jnp.maximum(v, 0.0)
+    # teacher part: label<-1 -> 0; -1<=label<0 -> (1+label) weighting;
+    # simple faithful form: hard = sigmoid ce with (label>0); soft =
+    # sigmoid ce with fractional part where 0<label<1
+    hard = softplus(x) - jnp.where(lbl > 0.0, x, 0.0)
+    frac = jnp.clip(lbl, 0.0, 1.0)
+    soft = softplus(x) - frac * x
+    out = jnp.where((lbl > 0.0) & (lbl < 1.0), soft, hard)
+    return {"Y": [out.reshape(-1, 1)]}
+
+
+@register_op("cos_sim", inputs=("X", "Y"), outputs=("Out", "XNorm", "YNorm"))
+def _cos_sim(ctx, op, ins):
+    x, y = ins["X"][0], ins["Y"][0]
+    xn = jnp.sqrt(jnp.sum(x * x, axis=-1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(y * y, axis=-1, keepdims=True))
+    out = jnp.sum(x * y, axis=-1, keepdims=True) / jnp.maximum(xn * yn, 1e-12)
+    return {"Out": [out], "XNorm": [xn], "YNorm": [yn]}
+
+
+@register_op("center_loss", inputs=("X", "Label", "Centers", "CenterUpdateRate"), outputs=("Loss", "SampleCenterDiff", "CentersOut"), no_grad=("Label", "Centers", "CenterUpdateRate"))
+def _center_loss(ctx, op, ins):
+    """Reference center_loss_op.cc: L2 distance to the class center;
+    centers drift toward their members when update_center."""
+    x = ins["X"][0]
+    lbl = ins["Label"][0].reshape(-1).astype(jnp.int32)
+    centers = ins["Centers"][0]
+    alpha = (ins["CenterUpdateRate"][0].reshape(())
+             if ins.get("CenterUpdateRate") else jnp.float32(0.1))
+    c = centers[lbl]  # [N, D]
+    diff = x - c
+    loss = 0.5 * jnp.sum(diff * diff, axis=-1, keepdims=True)
+    if bool(op.attrs.get("need_update", True)):
+        cnt = jnp.zeros((centers.shape[0],), x.dtype).at[lbl].add(1.0)
+        upd = jnp.zeros_like(centers).at[lbl].add(diff)
+        centers = centers + alpha * upd / (cnt[:, None] + 1.0)
+    return {"Loss": [loss], "SampleCenterDiff": [diff], "CentersOut": [centers]}
+
+
+@register_op("mean_iou", inputs=("Predictions", "Labels", "InWrongs", "InCorrects", "InMeanIou"), outputs=("OutMeanIou", "OutWrong", "OutCorrect"), stop_gradient=True)
+def _mean_iou(ctx, op, ins):
+    pred = ins["Predictions"][0].reshape(-1).astype(jnp.int32)
+    lbl = ins["Labels"][0].reshape(-1).astype(jnp.int32)
+    C = int(op.attrs["num_classes"])
+    correct = jnp.zeros((C,), jnp.float32).at[lbl].add(
+        (pred == lbl).astype(jnp.float32))
+    wrong_pred = jnp.zeros((C,), jnp.float32).at[pred].add(
+        (pred != lbl).astype(jnp.float32))
+    wrong_lbl = jnp.zeros((C,), jnp.float32).at[lbl].add(
+        (pred != lbl).astype(jnp.float32))
+    if ins.get("InCorrects"):
+        correct = correct + ins["InCorrects"][0]
+    wrong = wrong_pred + wrong_lbl
+    if ins.get("InWrongs"):
+        wrong = wrong + ins["InWrongs"][0]
+    denom = correct + wrong
+    iou = jnp.where(denom > 0, correct / jnp.maximum(denom, 1.0), 0.0)
+    valid = (denom > 0).astype(jnp.float32)
+    miou = jnp.sum(iou) / jnp.maximum(jnp.sum(valid), 1.0)
+    return {"OutMeanIou": [miou], "OutWrong": [wrong], "OutCorrect": [correct]}
+
+
+@register_op("chunk_eval", inputs=("Inference", "Label", "SeqLength"), outputs=("Precision", "Recall", "F1-Score", "NumInferChunks", "NumLabelChunks", "NumCorrectChunks"), stop_gradient=True)
+def _chunk_eval(ctx, op, ins):
+    """Chunk-level P/R/F1 (reference chunk_eval_op.cc). Dense form with
+    plain (IOB-free) chunk semantics: a chunk is a maximal run of one
+    non-background tag; a predicted chunk is correct iff it matches a
+    label chunk exactly (same span, same tag)."""
+    inf = ins["Inference"][0]
+    lbl = ins["Label"][0]
+    if inf.ndim > 2:
+        inf = inf.reshape(inf.shape[0], -1)
+        lbl = lbl.reshape(lbl.shape[0], -1)
+    B, T = inf.shape
+    bg = int(op.attrs.get("excluded_chunk_types_bg", op.attrs.get("num_chunk_types", 0)))
+    ln = (ins["SeqLength"][0].reshape(-1) if ins.get("SeqLength")
+          else jnp.full((B,), T, jnp.int32))
+    valid = jnp.arange(T)[None, :] < ln[:, None]
+
+    def starts(t):
+        prev = jnp.concatenate([jnp.full((B, 1), -1, t.dtype), t[:, :-1]], 1)
+        return valid & (t != bg) & (t != prev)
+
+    inf_start = starts(inf)
+    lbl_start = starts(lbl)
+    n_inf = jnp.sum(inf_start)
+    n_lbl = jnp.sum(lbl_start)
+    # correct chunk: starts aligned, same tag, and runs identical until
+    # both end: positionwise "both equal along whole chunk" via suffix
+    # scan — approximate with: start positions equal AND tags equal AND
+    # next-start/end positions equal
+    nxt_inf = jnp.concatenate([inf[:, 1:], jnp.full((B, 1), -1, inf.dtype)], 1)
+    nxt_lbl = jnp.concatenate([lbl[:, 1:], jnp.full((B, 1), -1, lbl.dtype)], 1)
+    end_inf = valid & (inf != bg) & (inf != nxt_inf)
+    end_lbl = valid & (lbl != bg) & (lbl != nxt_lbl)
+    # chunk correct iff aligned start, aligned end, agree everywhere
+    # between — tracked by the scan below
+    agree = inf == lbl
+
+    def body(carry, t):
+        open_ok, n_corr = carry
+        s_here = lbl_start[:, t]
+        e_here = end_lbl[:, t]
+        open_ok = jnp.where(s_here, inf_start[:, t] & agree[:, t],
+                            open_ok & agree[:, t])
+        match_end = e_here & open_ok & end_inf[:, t]
+        n_corr = n_corr + jnp.sum(match_end)
+        open_ok = jnp.where(e_here, False, open_ok)
+        return (open_ok, n_corr), None
+
+    (_, n_corr), _ = jax.lax.scan(
+        body, (jnp.zeros((B,), bool), jnp.zeros((), jnp.int32)), jnp.arange(T)
+    )
+    p = n_corr / jnp.maximum(n_inf, 1)
+    r = n_corr / jnp.maximum(n_lbl, 1)
+    f1 = 2 * p * r / jnp.maximum(p + r, 1e-6)
+    i32 = lambda v: v.astype(jnp.int64)
+    return {
+        "Precision": [p.astype(jnp.float32)],
+        "Recall": [r.astype(jnp.float32)],
+        "F1-Score": [f1.astype(jnp.float32)],
+        "NumInferChunks": [i32(n_inf)],
+        "NumLabelChunks": [i32(n_lbl)],
+        "NumCorrectChunks": [i32(n_corr)],
+    }
+
+
+@register_op("positive_negative_pair", inputs=("Score", "Label", "QueryID"), outputs=("PositivePair", "NegativePair", "NeutralPair"), stop_gradient=True)
+def _positive_negative_pair(ctx, op, ins):
+    """Ranking pair counts within each query (reference
+    positive_negative_pair_op.cc)."""
+    s = ins["Score"][0].reshape(-1)
+    l = ins["Label"][0].reshape(-1)
+    q = ins["QueryID"][0].reshape(-1)
+    same_q = q[:, None] == q[None, :]
+    upper = jnp.triu(jnp.ones((s.shape[0],) * 2, bool), k=1)
+    m = same_q & upper & (l[:, None] != l[None, :])
+    hi_lbl = l[:, None] > l[None, :]
+    hi_scr = s[:, None] > s[None, :]
+    eq_scr = s[:, None] == s[None, :]
+    pos = jnp.sum(m & (hi_lbl == hi_scr) & ~eq_scr)
+    neu = jnp.sum(m & eq_scr)
+    neg = jnp.sum(m) - pos - neu
+    f = lambda v: v.astype(jnp.float32).reshape(1)
+    return {"PositivePair": [f(pos)], "NegativePair": [f(neg)],
+            "NeutralPair": [f(neu)]}
+
+
+@register_op("cvm", inputs=("X", "CVM"), outputs=("Y",), no_grad=("CVM",))
+def _cvm(ctx, op, ins):
+    """Continuous-value model feature op (reference cvm_op.cc): the
+    first two columns are show/click; use_cvm keeps them log-adjusted,
+    otherwise they are dropped."""
+    x = ins["X"][0]
+    use_cvm = bool(op.attrs.get("use_cvm", True))
+    if not use_cvm:
+        return {"Y": [x[:, 2:]]}
+    show = jnp.log(x[:, :1] + 1.0)
+    ctr = jnp.log(x[:, 1:2] + 1.0) - jnp.log(x[:, :1] + 1.0)
+    return {"Y": [jnp.concatenate([show, ctr, x[:, 2:]], axis=1)]}

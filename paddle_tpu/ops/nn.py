@@ -505,3 +505,404 @@ def _bilinear_interp(ctx, op, ins):
 from ..core import registry as _registry
 
 _OPDEF_BATCH_NORM = _registry._OP_REGISTRY["batch_norm"]
+
+
+# -- round-3 nn ops (reference operators/*.cc, same-named) -----------------
+
+
+@register_op("add_position_encoding", inputs=("X",), outputs=("Out",))
+def _add_position_encoding(ctx, op, ins):
+    # reference add_position_encoding_op.cc: sinusoidal PE scaled into x
+    x = ins["X"][0]  # [B, T, D]
+    alpha = float(op.attrs.get("alpha", 1.0))
+    beta = float(op.attrs.get("beta", 1.0))
+    B, T, D = x.shape
+    pos = jnp.arange(T, dtype=jnp.float32)[:, None]
+    i = jnp.arange(D // 2, dtype=jnp.float32)[None, :]
+    angle = pos / jnp.power(10000.0, 2.0 * i / D)
+    pe = jnp.concatenate([jnp.sin(angle), jnp.cos(angle)], axis=1)
+    return {"Out": [alpha * x + beta * pe[None, :, :D].astype(x.dtype)]}
+
+
+@register_op("affine_channel", inputs=("X", "Scale", "Bias"), outputs=("Out",))
+def _affine_channel(ctx, op, ins):
+    x = ins["X"][0]
+    s = ins["Scale"][0].reshape(1, -1, 1, 1)
+    b = ins["Bias"][0].reshape(1, -1, 1, 1)
+    return {"Out": [x * s + b]}
+
+
+@register_op("affine_grid", inputs=("Theta", "OutputShape"), outputs=("Output",), no_grad=("OutputShape",))
+def _affine_grid(ctx, op, ins):
+    """Reference affine_grid_op.cc: sampling grid from 2x3 affine
+    matrices, normalized coords in [-1, 1]."""
+    theta = ins["Theta"][0]  # [N, 2, 3]
+    if ins.get("OutputShape"):
+        oshape = [int(v) for v in np.asarray(ins["OutputShape"][0]).reshape(-1)]
+    else:
+        oshape = [int(v) for v in op.attrs["output_shape"]]
+    N, _, H, W = oshape
+    ys = jnp.linspace(-1.0, 1.0, H)
+    xs = jnp.linspace(-1.0, 1.0, W)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # [H, W, 3]
+    out = jnp.einsum("hwk,nck->nhwc", base, theta)  # [N, H, W, 2]
+    return {"Output": [out]}
+
+
+@register_op("grid_sampler", inputs=("X", "Grid"), outputs=("Output",), no_grad=("Grid",))
+def _grid_sampler(ctx, op, ins):
+    """Reference grid_sampler_op.cc: bilinear sample X at normalized
+    grid coords."""
+    x, grid = ins["X"][0], ins["Grid"][0]  # [N,C,H,W], [N,Ho,Wo,2]
+    N, C, H, W = x.shape
+    gx = (grid[..., 0] + 1.0) * (W - 1) / 2.0
+    gy = (grid[..., 1] + 1.0) * (H - 1) / 2.0
+    x0 = jnp.floor(gx).astype(jnp.int32)
+    y0 = jnp.floor(gy).astype(jnp.int32)
+    x1 = x0 + 1
+    y1 = y0 + 1
+    lx, ly = gx - x0, gy - y0
+
+    def pick(img, yy, xx):
+        inb = (yy >= 0) & (yy < H) & (xx >= 0) & (xx < W)
+        v = img[:, jnp.clip(yy, 0, H - 1), jnp.clip(xx, 0, W - 1)]
+        return jnp.where(inb[None], v, 0.0)
+
+    def one(img, yy0, yy1, xx0, xx1, llx, lly):
+        v00 = pick(img, yy0, xx0)
+        v01 = pick(img, yy0, xx1)
+        v10 = pick(img, yy1, xx0)
+        v11 = pick(img, yy1, xx1)
+        return (v00 * (1 - lly) * (1 - llx) + v01 * (1 - lly) * llx
+                + v10 * lly * (1 - llx) + v11 * lly * llx)
+
+    out = jax.vmap(one)(x, y0, y1, x0, x1, lx, ly)
+    return {"Output": [out]}
+
+
+@register_op("pixel_shuffle", inputs=("X",), outputs=("Out",))
+def _pixel_shuffle(ctx, op, ins):
+    x = ins["X"][0]  # [N, C*r^2, H, W]
+    r = int(op.attrs.get("upscale_factor", 1))
+    N, C, H, W = x.shape
+    c = C // (r * r)
+    out = x.reshape(N, c, r, r, H, W).transpose(0, 1, 4, 2, 5, 3)
+    return {"Out": [out.reshape(N, c, H * r, W * r)]}
+
+
+@register_op("space_to_depth", inputs=("X",), outputs=("Out",))
+def _space_to_depth(ctx, op, ins):
+    x = ins["X"][0]
+    bs = int(op.attrs.get("blocksize", 1))
+    N, C, H, W = x.shape
+    out = x.reshape(N, C, H // bs, bs, W // bs, bs).transpose(0, 3, 5, 1, 2, 4)
+    return {"Out": [out.reshape(N, C * bs * bs, H // bs, W // bs)]}
+
+
+@register_op("temporal_shift", inputs=("X",), outputs=("Out",))
+def _temporal_shift(ctx, op, ins):
+    """Reference temporal_shift_op.cc (TSM): shift 1/4 channels +1
+    frame, 1/4 -1 frame within each segment."""
+    x = ins["X"][0]  # [N*T, C, H, W]
+    T = int(op.attrs["seg_num"])
+    ratio = float(op.attrs.get("shift_ratio", 0.25))
+    NT, C, H, W = x.shape
+    N = NT // T
+    c1 = int(C * ratio)
+    c2 = int(C * 2 * ratio)
+    v = x.reshape(N, T, C, H, W)
+    fwd = jnp.concatenate([v[:, 1:, :c1], jnp.zeros_like(v[:, :1, :c1])], 1)
+    bwd = jnp.concatenate([jnp.zeros_like(v[:, :1, c1:c2]), v[:, :-1, c1:c2]], 1)
+    out = jnp.concatenate([fwd, bwd, v[:, :, c2:]], axis=2)
+    return {"Out": [out.reshape(NT, C, H, W)]}
+
+
+@register_op("unfold", inputs=("X",), outputs=("Y",))
+def _unfold(ctx, op, ins):
+    """im2col (reference unfold_op.cc): [N,C,H,W] ->
+    [N, C*kh*kw, L]."""
+    x = ins["X"][0]
+    kh, kw = [int(v) for v in op.attrs["kernel_sizes"]]
+    sh, sw = [int(v) for v in op.attrs.get("strides", [1, 1])]
+    pads = [int(v) for v in op.attrs.get("paddings", [0, 0, 0, 0])]
+    dh, dw = [int(v) for v in op.attrs.get("dilations", [1, 1])]
+    N, C, H, W = x.shape
+    xp = jnp.pad(x, ((0, 0), (0, 0), (pads[0], pads[2]), (pads[1], pads[3])))
+    Hp, Wp = xp.shape[2], xp.shape[3]
+    oh = (Hp - (dh * (kh - 1) + 1)) // sh + 1
+    ow = (Wp - (dw * (kw - 1) + 1)) // sw + 1
+    cols = []
+    for i in range(kh):
+        for j in range(kw):
+            patch = xp[:, :, i * dh:i * dh + sh * oh:sh, j * dw:j * dw + sw * ow:sw]
+            cols.append(patch)
+    out = jnp.stack(cols, axis=2)  # [N, C, kh*kw, oh, ow]
+    return {"Y": [out.reshape(N, C * kh * kw, oh * ow)]}
+
+
+@register_op("im2sequence", inputs=("X",), outputs=("Out",))
+def _im2sequence(ctx, op, ins):
+    # reference im2sequence_op.cc: sliding blocks as a sequence
+    x = ins["X"][0]
+    kh, kw = [int(v) for v in op.attrs["kernels"]]
+    sh, sw = [int(v) for v in op.attrs.get("strides", [1, 1])]
+    N, C, H, W = x.shape
+    oh = (H - kh) // sh + 1
+    ow = (W - kw) // sw + 1
+    cols = []
+    for i in range(kh):
+        for j in range(kw):
+            cols.append(x[:, :, i:i + sh * oh:sh, j:j + sw * ow:sw])
+    out = jnp.stack(cols, axis=-1)  # [N, C, oh, ow, kh*kw]
+    out = out.transpose(0, 2, 3, 1, 4).reshape(N, oh * ow, C * kh * kw)
+    return {"Out": [out]}
+
+
+@register_op("lrn", inputs=("X",), outputs=("Out", "MidOut"))
+def _lrn(ctx, op, ins):
+    x = ins["X"][0]
+    n = int(op.attrs.get("n", 5))
+    k = float(op.attrs.get("k", 2.0))
+    alpha = float(op.attrs.get("alpha", 1e-4))
+    beta = float(op.attrs.get("beta", 0.75))
+    sq = x * x
+    half = n // 2
+    pad = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+    acc = sum(pad[:, i:i + x.shape[1]] for i in range(n))
+    mid = k + alpha * acc
+    return {"Out": [x / jnp.power(mid, beta)], "MidOut": [mid]}
+
+
+@register_op("data_norm", inputs=("X", "BatchSize", "BatchSum", "BatchSquareSum"), outputs=("Y", "Means", "Scales"), no_grad=("BatchSize", "BatchSum", "BatchSquareSum"))
+def _data_norm(ctx, op, ins):
+    """Reference data_norm_op.cc: normalize by accumulated batch
+    statistics (CTR models)."""
+    x = ins["X"][0]
+    n = ins["BatchSize"][0]
+    s = ins["BatchSum"][0]
+    ssq = ins["BatchSquareSum"][0]
+    mean = s / jnp.maximum(n, 1e-4)
+    scale = jnp.sqrt(jnp.maximum(n, 1e-4) / jnp.maximum(ssq - s * mean, 1e-4))
+    return {"Y": [(x - mean) * scale], "Means": [mean], "Scales": [scale]}
+
+
+@register_op("spectral_norm", inputs=("Weight", "U", "V"), outputs=("Out",), no_grad=("U", "V"))
+def _spectral_norm(ctx, op, ins):
+    """Reference spectral_norm_op.cc: W / sigma via power iteration."""
+    w = ins["Weight"][0]
+    dim = int(op.attrs.get("dim", 0))
+    iters = int(op.attrs.get("power_iters", 1))
+    eps = float(op.attrs.get("eps", 1e-12))
+    wm = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+    u = ins["U"][0].reshape(-1)
+    v = ins["V"][0].reshape(-1)
+    for _ in range(max(iters, 1)):
+        v = wm.T @ u
+        v = v / (jnp.linalg.norm(v) + eps)
+        u = wm @ v
+        u = u / (jnp.linalg.norm(u) + eps)
+    sigma = u @ wm @ v
+    return {"Out": [w / sigma]}
+
+
+@register_op("bilinear_tensor_product", inputs=("X", "Y", "Weight", "Bias"), outputs=("Out",))
+def _bilinear_tensor_product(ctx, op, ins):
+    x, y, w = ins["X"][0], ins["Y"][0], ins["Weight"][0]  # [B,M],[B,N],[K,M,N]
+    out = jnp.einsum("bm,kmn,bn->bk", x, w, y)
+    if ins.get("Bias"):
+        out = out + ins["Bias"][0].reshape(1, -1)
+    return {"Out": [out]}
+
+
+@register_op("conv_shift", inputs=("X", "Y"), outputs=("Out",))
+def _conv_shift(ctx, op, ins):
+    """Circular correlation (reference conv_shift_op.cc):
+    out[i,j] = sum_k x[i, (j+k-w/2) mod n] * y[i,k]."""
+    x, y = ins["X"][0], ins["Y"][0]  # [B, N], [B, W]
+    B, N = x.shape
+    Wd = y.shape[1]
+    half = Wd // 2
+    idx = (jnp.arange(N)[:, None] + jnp.arange(Wd)[None, :] - half) % N
+    gath = x[:, idx]  # [B, N, W]
+    return {"Out": [jnp.einsum("bnw,bw->bn", gath, y)]}
+
+
+@register_op("row_conv", inputs=("X", "Filter"), outputs=("Out",))
+def _row_conv(ctx, op, ins):
+    """Lookahead row convolution (reference row_conv_op.cc):
+    out[t] = sum_j W[j] * x[t+j]."""
+    x, w = ins["X"][0], ins["Filter"][0]  # [B, T, D], [K, D]
+    K = w.shape[0]
+    B, T, D = x.shape
+    xp = jnp.pad(x, ((0, 0), (0, K - 1), (0, 0)))
+    out = sum(xp[:, j:j + T] * w[j][None, None, :] for j in range(K))
+    return {"Out": [out]}
+
+
+@register_op("pool_with_index", inputs=("X",), outputs=("Out", "Mask"))
+def _pool_with_index(ctx, op, ins):
+    """max_pool2d_with_index (reference pool_with_index_op.cc): max
+    pool + flat argmax indices."""
+    x = ins["X"][0]
+    ks = [int(v) for v in op.attrs.get("ksize", [2, 2])]
+    st = [int(v) for v in op.attrs.get("strides", ks)]
+    N, C, H, W = x.shape
+    oh = (H - ks[0]) // st[0] + 1
+    ow = (W - ks[1]) // st[1] + 1
+    patches = []
+    flat_idx = []
+    for i in range(ks[0]):
+        for j in range(ks[1]):
+            patches.append(x[:, :, i:i + st[0] * oh:st[0], j:j + st[1] * ow:st[1]])
+            rows = (jnp.arange(oh) * st[0] + i)[:, None]
+            cols = (jnp.arange(ow) * st[1] + j)[None, :]
+            flat_idx.append(jnp.broadcast_to(rows * W + cols, (oh, ow)))
+    stacked = jnp.stack(patches, axis=-1)  # [N,C,oh,ow,k]
+    which = jnp.argmax(stacked, axis=-1)
+    out = jnp.max(stacked, axis=-1)
+    idxs = jnp.stack(flat_idx, axis=-1)  # [oh, ow, k]
+    mask = jnp.take_along_axis(
+        jnp.broadcast_to(idxs[None, None], (N, C, oh, ow, len(patches))),
+        which[..., None], axis=-1,
+    )[..., 0]
+    return {"Out": [out], "Mask": [mask.astype(jnp.int32)]}
+
+
+@register_op("spp", inputs=("X",), outputs=("Out",))
+def _spp(ctx, op, ins):
+    """Spatial pyramid pooling (reference spp_op.cc)."""
+    x = ins["X"][0]
+    levels = int(op.attrs.get("pyramid_height", 2))
+    ptype = op.attrs.get("pooling_type", "max")
+    N, C, H, W = x.shape
+    outs = []
+    for lv in range(levels):
+        bins = 2 ** lv
+        hs = [H * i // bins for i in range(bins + 1)]
+        ws = [W * i // bins for i in range(bins + 1)]
+        for bi in range(bins):
+            for bj in range(bins):
+                patch = x[:, :, hs[bi]:hs[bi + 1], ws[bj]:ws[bj + 1]]
+                red = (jnp.max(patch, axis=(2, 3)) if ptype == "max"
+                       else jnp.mean(patch, axis=(2, 3)))
+                outs.append(red)
+    return {"Out": [jnp.concatenate(outs, axis=1)]}
+
+
+@register_op("fsp", inputs=("X", "Y"), outputs=("Out",))
+def _fsp(ctx, op, ins):
+    """FSP matrix for distillation (reference fsp_op.cc):
+    out = X · Y^T over spatial dims / (H*W)."""
+    x, y = ins["X"][0], ins["Y"][0]  # [N,C1,H,W], [N,C2,H,W]
+    N, C1, H, W = x.shape
+    return {"Out": [jnp.einsum("nchw,ndhw->ncd", x, y) / (H * W)]}
+
+
+@register_op("minus", inputs=("X", "Y"), outputs=("Out",))
+def _minus(ctx, op, ins):
+    return {"Out": [ins["X"][0] - ins["Y"][0]]}
+
+
+@register_op("selu", inputs=("X",), outputs=("Out",))
+def _selu(ctx, op, ins):
+    scale = float(op.attrs.get("scale", 1.0507009873554805))
+    alpha = float(op.attrs.get("alpha", 1.6732632423543772))
+    x = ins["X"][0]
+    return {"Out": [scale * jnp.where(x > 0, x, alpha * (jnp.exp(x) - 1.0))]}
+
+
+@register_op("l1_norm", inputs=("X",), outputs=("Out",))
+def _l1_norm(ctx, op, ins):
+    return {"Out": [jnp.sum(jnp.abs(ins["X"][0]))]}
+
+
+@register_op("clip_by_norm", inputs=("X",), outputs=("Out",))
+def _clip_by_norm(ctx, op, ins):
+    x = ins["X"][0]
+    mn = float(op.attrs.get("max_norm", 1.0))
+    norm = jnp.sqrt(jnp.sum(x * x))
+    return {"Out": [jnp.where(norm > mn, x * (mn / norm), x)]}
+
+
+@register_op("label_smooth", inputs=("X", "PriorDist"), outputs=("Out",), no_grad=("PriorDist",))
+def _label_smooth(ctx, op, ins):
+    x = ins["X"][0]
+    eps = float(op.attrs.get("epsilon", 0.1))
+    if ins.get("PriorDist"):
+        prior = ins["PriorDist"][0].reshape(1, -1)
+    else:
+        prior = 1.0 / x.shape[-1]
+    return {"Out": [(1.0 - eps) * x + eps * prior]}
+
+
+@register_op("nce", inputs=("Input", "Label", "Weight", "Bias", "SampleWeight"), outputs=("Cost", "SampleLogits", "SampleLabels"), no_grad=("Label", "SampleWeight"))
+def _nce(ctx, op, ins):
+    """Noise-contrastive estimation (reference nce_op.cc): one positive
+    + num_neg uniform noise classes per sample, binary logistic loss."""
+    x = ins["Input"][0]  # [B, D]
+    lbl = ins["Label"][0].reshape(-1).astype(jnp.int32)  # [B]
+    w = ins["Weight"][0]  # [C, D]
+    num_total = w.shape[0]
+    num_neg = int(op.attrs.get("num_neg_samples", 10))
+    B = x.shape[0]
+    neg = jax.random.randint(ctx.op_key(op), (B, num_neg), 0, num_total)
+    cls = jnp.concatenate([lbl[:, None], neg], axis=1)  # [B, 1+neg]
+    wsel = w[cls]  # [B, 1+neg, D]
+    logits = jnp.einsum("bd,bkd->bk", x, wsel)
+    if ins.get("Bias"):
+        logits = logits + ins["Bias"][0].reshape(-1)[cls]
+    labels = jnp.concatenate(
+        [jnp.ones((B, 1)), jnp.zeros((B, num_neg))], axis=1
+    ).astype(x.dtype)
+    softplus = lambda v: jnp.log1p(jnp.exp(-jnp.abs(v))) + jnp.maximum(v, 0.0)
+    ce = softplus(logits) - labels * logits
+    return {
+        "Cost": [jnp.sum(ce, axis=1, keepdims=True)],
+        "SampleLogits": [logits],
+        "SampleLabels": [cls.astype(jnp.int64)],
+    }
+
+
+@register_op("hierarchical_sigmoid", inputs=("X", "W", "Label", "PathTable", "PathCode", "Bias"), outputs=("Out", "PreOut", "W_Out"), no_grad=("Label", "PathTable", "PathCode"))
+def _hierarchical_sigmoid(ctx, op, ins):
+    """Reference hierarchical_sigmoid_op.cc: binary-tree softmax. The
+    default complete-binary-tree coding is used when no custom
+    PathTable is given: label l maps to node path of ceil(log2 C)
+    bits."""
+    x = ins["X"][0]  # [B, D]
+    w = ins["W"][0]  # [C-1 (or nodes), D]
+    lbl = ins["Label"][0].reshape(-1).astype(jnp.int32)
+    B = x.shape[0]
+    C = int(op.attrs.get("num_classes", w.shape[0] + 1))
+    depth = max(int(np.ceil(np.log2(max(C, 2)))), 1)
+    if ins.get("PathTable"):
+        table = ins["PathTable"][0].astype(jnp.int32)  # [B, depth]
+        code = ins["PathCode"][0].astype(jnp.float32)
+        depth = table.shape[1]
+        node_ids = table
+        bits = code
+        valid = table >= 0
+        node_ids = jnp.maximum(node_ids, 0)
+    else:
+        # complete tree: internal node ids 0..C-2; leaf l's path from
+        # root follows the binary digits of l+C (MSB after the top)
+        key = lbl + C
+        shifts = jnp.arange(depth - 1, -1, -1)
+        path = key[:, None] >> (shifts[None, :] + 1)  # ancestor keys
+        bits = ((key[:, None] >> shifts[None, :]) & 1).astype(jnp.float32)
+        node_ids = path - 1  # internal node index
+        valid = (node_ids >= 0) & (node_ids < w.shape[0])
+        node_ids = jnp.clip(node_ids, 0, w.shape[0] - 1)
+    wsel = w[node_ids]  # [B, depth, D]
+    pre = jnp.einsum("bd,bkd->bk", x, wsel)
+    if ins.get("Bias"):
+        pre = pre + ins["Bias"][0].reshape(-1)[node_ids]
+    softplus = lambda v: jnp.log1p(jnp.exp(-jnp.abs(v))) + jnp.maximum(v, 0.0)
+    ce = softplus(pre) - bits * pre
+    ce = jnp.where(valid, ce, 0.0)
+    return {
+        "Out": [jnp.sum(ce, axis=1, keepdims=True)],
+        "PreOut": [pre],
+        "W_Out": [w],
+    }

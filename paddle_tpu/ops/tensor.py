@@ -492,3 +492,247 @@ def _linspace(ctx, op, ins):
     s = ins["Start"][0].reshape(())
     e_ = ins["Stop"][0].reshape(())
     return {"Out": [jnp.linspace(s, e_, n, dtype=ins["Start"][0].dtype)]}
+
+
+# -- round-3 tensor ops (reference operators/*.cc, same-named) -------------
+
+
+@register_op("sign", inputs=("X",), outputs=("Out",))
+def _sign(ctx, op, ins):
+    return {"Out": [jnp.sign(ins["X"][0])]}
+
+
+@register_op("eye", inputs=(), outputs=("Out",), stop_gradient=True)
+def _eye(ctx, op, ins):
+    n = int(op.attrs["num_rows"])
+    m = int(op.attrs.get("num_columns", -1))
+    dt = convert_dtype(op.attrs.get("dtype", "float32"))
+    return {"Out": [jnp.eye(n, m if m > 0 else n, dtype=dt)]}
+
+
+@register_op("fill", inputs=(), outputs=("Out",), stop_gradient=True)
+def _fill(ctx, op, ins):
+    # reference fill_op.cc: explicit value list + shape
+    shape = tuple(int(s) for s in op.attrs["shape"])
+    dt = convert_dtype(op.attrs.get("dtype", "float32"))
+    vals = jnp.asarray(list(op.attrs["value"]), dt)
+    return {"Out": [vals.reshape(shape)]}
+
+
+@register_op("fill_any_like", inputs=("X",), outputs=("Out",), stop_gradient=True)
+def _fill_any_like(ctx, op, ins):
+    x = ins["X"][0]
+    v = op.attrs.get("value", 0.0)
+    return {"Out": [jnp.full_like(x, v)]}
+
+
+@register_op("reverse", inputs=("X",), outputs=("Out",))
+def _reverse(ctx, op, ins):
+    axes = [int(a) for a in op.attrs.get("axis", [0])]
+    out = ins["X"][0]
+    for a in axes:
+        out = jnp.flip(out, axis=a)
+    return {"Out": [out]}
+
+
+@register_op("crop", inputs=("X", "Y", "Offsets"), outputs=("Out",), no_grad=("Y", "Offsets"))
+def _crop(ctx, op, ins):
+    x = ins["X"][0]
+    shape = (
+        tuple(ins["Y"][0].shape) if ins.get("Y")
+        else tuple(int(s) for s in op.attrs["shape"])
+    )
+    if ins.get("Offsets"):
+        off = [int(v) for v in np.asarray(ins["Offsets"][0]).reshape(-1)]
+    else:
+        off = [int(v) for v in op.attrs.get("offsets", [0] * x.ndim)]
+    idx = tuple(slice(o, o + s) for o, s in zip(off, shape))
+    return {"Out": [x[idx]]}
+
+
+@register_op("crop_tensor", inputs=("X", "Shape", "Offsets"), outputs=("Out",), no_grad=("Shape", "Offsets"))
+def _crop_tensor(ctx, op, ins):
+    x = ins["X"][0]
+    shape = (
+        [int(v) for v in np.asarray(ins["Shape"][0]).reshape(-1)]
+        if ins.get("Shape") else [int(s) for s in op.attrs["shape"]]
+    )
+    if ins.get("Offsets"):
+        off = [int(v) for v in np.asarray(ins["Offsets"][0]).reshape(-1)]
+    else:
+        off = [int(v) for v in op.attrs.get("offsets", [0] * x.ndim)]
+    shape = [x.shape[i] - off[i] if s == -1 else s for i, s in enumerate(shape)]
+    idx = tuple(slice(o, o + s) for o, s in zip(off, shape))
+    return {"Out": [x[idx]]}
+
+
+@register_op("pad_constant_like", inputs=("X", "Y"), outputs=("Out",), no_grad=("X",))
+def _pad_constant_like(ctx, op, ins):
+    # pad Y up to X's shape with pad_value (reference pad_constant_like_op.cc)
+    x, y = ins["X"][0], ins["Y"][0]
+    v = float(op.attrs.get("pad_value", 0.0))
+    pads = [(0, xs - ys) for xs, ys in zip(x.shape, y.shape)]
+    return {"Out": [jnp.pad(y, pads, constant_values=v)]}
+
+
+@register_op("multiplex", inputs=("Ids", "X"), outputs=("Out",), no_grad=("Ids",))
+def _multiplex(ctx, op, ins):
+    # out[i] = X[ids[i]][i] (reference multiplex_op.cc row gather)
+    ids = ins["Ids"][0].reshape(-1).astype(jnp.int32)
+    stacked = jnp.stack(ins["X"], axis=0)  # [K, N, ...]
+    rows = jnp.arange(stacked.shape[1])
+    return {"Out": [stacked[ids, rows]]}
+
+
+@register_op("partial_concat", inputs=("X",), outputs=("Out",))
+def _partial_concat(ctx, op, ins):
+    # concat column slices [start, start+length) of each input
+    start = int(op.attrs.get("start_index", 0))
+    length = int(op.attrs.get("length", -1))
+    parts = []
+    for x in ins["X"]:
+        end = x.shape[1] if length < 0 else start + length
+        parts.append(x[:, start:end])
+    return {"Out": [jnp.concatenate(parts, axis=1)]}
+
+
+@register_op("partial_sum", inputs=("X",), outputs=("Out",))
+def _partial_sum(ctx, op, ins):
+    start = int(op.attrs.get("start_index", 0))
+    length = int(op.attrs.get("length", -1))
+    tot = None
+    for x in ins["X"]:
+        end = x.shape[1] if length < 0 else start + length
+        s = x[:, start:end]
+        tot = s if tot is None else tot + s
+    return {"Out": [tot]}
+
+
+@register_op("is_empty", inputs=("X",), outputs=("Out",), stop_gradient=True)
+def _is_empty(ctx, op, ins):
+    return {"Out": [jnp.asarray(ins["X"][0].size == 0)]}
+
+
+@register_op("unique", inputs=("X",), outputs=("Out", "Index"), stop_gradient=True)
+def _unique(ctx, op, ins):
+    """XLA needs static shapes: Out is padded to |X| (reference returns
+    the shrunk array; consumers here use Index, which is exact)."""
+    x = ins["X"][0].reshape(-1)
+    uniq, inv = jnp.unique(x, return_inverse=True, size=x.shape[0], fill_value=0)
+    return {"Out": [uniq], "Index": [inv.astype(jnp.int32)]}
+
+
+@register_op("unique_with_counts", inputs=("X",), outputs=("Out", "Index", "Count"), stop_gradient=True)
+def _unique_with_counts(ctx, op, ins):
+    x = ins["X"][0].reshape(-1)
+    uniq, inv, cnt = jnp.unique(
+        x, return_inverse=True, return_counts=True, size=x.shape[0], fill_value=0
+    )
+    return {"Out": [uniq], "Index": [inv.astype(jnp.int32)],
+            "Count": [cnt.astype(jnp.int32)]}
+
+
+@register_op("scatter_nd_add", inputs=("X", "Index", "Updates"), outputs=("Out",), no_grad=("Index",))
+def _scatter_nd_add(ctx, op, ins):
+    x, idx, upd = ins["X"][0], ins["Index"][0], ins["Updates"][0]
+    return {"Out": [x.at[tuple(jnp.moveaxis(idx, -1, 0))].add(upd)]}
+
+
+@register_op("gather_tree", inputs=("Ids", "Parents"), outputs=("Out",), stop_gradient=True)
+def _gather_tree(ctx, op, ins):
+    """Backtrack beam parents (reference gather_tree_op.cc; same job as
+    beam_search_decode but keeping the [T, B, beam] layout)."""
+    ids, parents = ins["Ids"][0], ins["Parents"][0]
+    T, B, beam = ids.shape
+
+    def back(cur, step):
+        sid, spar = step
+        tok = jnp.take_along_axis(sid, cur, axis=1)
+        prev = jnp.take_along_axis(spar, cur, axis=1).astype(jnp.int32)
+        return prev, tok
+
+    init = jnp.broadcast_to(jnp.arange(beam, dtype=jnp.int32)[None], (B, beam))
+    _, toks = jax.lax.scan(back, init, (ids, parents), reverse=True)
+    return {"Out": [toks]}
+
+
+@register_op("max_sequence_len", inputs=("RankTable",), outputs=("Out",), stop_gradient=True)
+def _max_sequence_len(ctx, op, ins):
+    # dense representation: the padded time axis IS the max length
+    x = ins["RankTable"][0]
+    return {"Out": [jnp.asarray(x.shape[1] if x.ndim > 1 else x.shape[0], jnp.int32)]}
+
+
+@register_op("lod_reset", inputs=("X", "Y"), outputs=("Out",), no_grad=("Y",))
+def _lod_reset(ctx, op, ins):
+    # LoD is pad+mask here; resetting LoD is identity on the dense data
+    return {"Out": [ins["X"][0]]}
+
+
+@register_op("shuffle_batch", inputs=("X", "Seed"), outputs=("Out", "ShuffleIdx", "SeedOut"), stop_gradient=True)
+def _shuffle_batch(ctx, op, ins):
+    x = ins["X"][0]
+    perm = jax.random.permutation(ctx.op_key(op), x.shape[0])
+    seed = ins["Seed"][0] if ins.get("Seed") else jnp.zeros((1,), jnp.int32)
+    return {"Out": [x[perm]], "ShuffleIdx": [perm.astype(jnp.int32)],
+            "SeedOut": [seed]}
+
+
+@register_op("random_crop", inputs=("X", "Seed"), outputs=("Out", "SeedOut"), stop_gradient=True)
+def _random_crop(ctx, op, ins):
+    x = ins["X"][0]
+    shape = [int(s) for s in op.attrs["shape"]]  # crop of trailing dims
+    key = ctx.op_key(op)
+    starts = []
+    for i, s in enumerate(shape):
+        dim = x.ndim - len(shape) + i
+        key, sub = jax.random.split(key)
+        starts.append(jax.random.randint(sub, (), 0, x.shape[dim] - s + 1))
+    out = jax.lax.dynamic_slice(
+        x,
+        [0] * (x.ndim - len(shape)) + [st for st in starts],
+        list(x.shape[: x.ndim - len(shape)]) + shape,
+    )
+    seed = ins["Seed"][0] if ins.get("Seed") else jnp.zeros((1,), jnp.int32)
+    return {"Out": [out], "SeedOut": [seed]}
+
+
+@register_op("seed", inputs=(), outputs=("Out",), stop_gradient=True)
+def _seed(ctx, op, ins):
+    return {"Out": [jnp.asarray([int(op.attrs.get("seed", 0))], jnp.int32)]}
+
+
+@register_op("hash", inputs=("X",), outputs=("Out",), stop_gradient=True)
+def _hash(ctx, op, ins):
+    """Integer feature hashing (reference hash_op.cc uses xxhash; this
+    is a splitmix-style mix — same capability, different constants)."""
+    x = ins["X"][0].astype(jnp.uint32)
+    num_hash = int(op.attrs.get("num_hash", 1))
+    mod_by = int(op.attrs.get("mod_by", 1))
+    outs = []
+    for i in range(num_hash):
+        h = x * jnp.uint32(0x9E3779B1) + jnp.uint32(i * 0x85EBCA6B)
+        h = h ^ (h >> 16)
+        h = h * jnp.uint32(0xC2B2AE35)
+        h = h ^ (h >> 13)
+        outs.append((h % jnp.uint32(mod_by)).astype(jnp.int64))
+    return {"Out": [jnp.stack(outs, axis=-2) if num_hash > 1 else outs[0]]}
+
+
+@register_op("ctc_align", inputs=("Input", "InputLength"), outputs=("Output", "OutputLength"), stop_gradient=True)
+def _ctc_align(ctx, op, ins):
+    """CTC decode alignment: merge repeats then drop blanks (reference
+    ctc_align_op.cc); dense [B, T] with compaction + new lengths."""
+    x = ins["Input"][0]
+    blank = int(op.attrs.get("blank", 0))
+    B, T = x.shape
+    ln = (ins["InputLength"][0].reshape(-1) if ins.get("InputLength")
+          else jnp.full((B,), T, jnp.int32))
+    in_seq = jnp.arange(T)[None, :] < ln[:, None]
+    prev = jnp.concatenate([jnp.full((B, 1), -1, x.dtype), x[:, :-1]], axis=1)
+    keep = in_seq & (x != blank) & (x != prev)
+    order = jnp.argsort(jnp.where(keep, 0, 1) * (T + 1) + jnp.arange(T)[None, :], axis=1)
+    compacted = jnp.take_along_axis(x, order, axis=1)
+    new_len = jnp.sum(keep, axis=1).astype(jnp.int32)
+    out = jnp.where(jnp.arange(T)[None, :] < new_len[:, None], compacted, 0)
+    return {"Output": [out], "OutputLength": [new_len]}

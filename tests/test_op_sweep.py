@@ -258,6 +258,187 @@ SPECS = {
         {"Input": F(2, 12), "HiddenPrev": F(2, 4), "Weight": F(4, 12),
          "Bias": np.zeros(12, "float32")}, grads=["Input", "HiddenPrev"],
     ),
+    # -- round-3 tensor ops --
+    "sign": spec({"X": F(2, 3)}, grads=["X"]),
+    "eye": spec({}, {"num_rows": 3}),
+    "fill": spec({}, {"shape": [2, 2], "value": [1.0, 2.0, 3.0, 4.0]}),
+    "fill_any_like": spec({"X": F(2, 3)}, {"value": 7.0}),
+    "reverse": spec({"X": F(2, 3)}, {"axis": [1]}, grads=["X"]),
+    "crop": spec({"X": F(4, 5)}, {"shape": [2, 3], "offsets": [1, 1]}, grads=["X"]),
+    "crop_tensor": spec({"X": F(4, 5)}, {"shape": [2, 3], "offsets": [1, 1]}),
+    "pad_constant_like": spec({"X": F(4, 5), "Y": F(2, 3)}, {"pad_value": 0.0}),
+    "multiplex": spec({"Ids": I32(3, 1, hi=2),
+                       "X": [F(3, 4), F(3, 4)]}),
+    "partial_concat": spec({"X": [F(2, 4), F(2, 4)]},
+                           {"start_index": 1, "length": 2}),
+    "partial_sum": spec({"X": [F(2, 4), F(2, 4)]},
+                        {"start_index": 0, "length": 3}),
+    "is_empty": spec({"X": F(2, 2)}),
+    "unique": spec({"X": I32(6, hi=3)}),
+    "unique_with_counts": spec({"X": I32(6, hi=3)}),
+    "scatter_nd_add": spec(
+        {"X": F(4, 3), "Index": I32(2, 1, hi=4), "Updates": F(2, 3)},
+        grads=["X", "Updates"],
+    ),
+    "gather_tree": spec({"Ids": I32(3, 1, 2, hi=9),
+                         "Parents": I32(3, 1, 2, hi=2)}),
+    "max_sequence_len": spec({"RankTable": F(2, 5)}),
+    "lod_reset": spec({"X": F(2, 3)}),
+    "shuffle_batch": spec({"X": F(4, 3)}),
+    "random_crop": spec({"X": F(2, 3, 8, 8)}, {"shape": [4, 4]}),
+    "seed": spec({}, {"seed": 3}),
+    "hash": spec({"X": I32(4, 1, hi=100)}, {"num_hash": 2, "mod_by": 1000}),
+    "ctc_align": spec(
+        {"Input": np.array([[1, 1, 0, 2, 2], [3, 0, 3, 0, 0]], "int32"),
+         "InputLength": np.array([5, 3], "int32")}, {"blank": 0},
+    ),
+    # -- round-3 losses / metrics --
+    "hinge_loss": spec({"Logits": F(4, 1),
+                        "Labels": B8(4, 1).astype("float32")}, grads=["Logits"]),
+    "rank_loss": spec({"Label": B8(4, 1).astype("float32"),
+                       "Left": F(4, 1), "Right": F(4, 1)}, grads=["Left"]),
+    "margin_rank_loss": spec(
+        {"Label": (B8(4, 1).astype("float32") * 2 - 1), "X1": F(4, 1),
+         "X2": F(4, 1)}, {"margin": 0.1}, grads=["X1"],
+    ),
+    "bpr_loss": spec({"X": F(4, 5), "Label": I32(4, 1, hi=5)}, grads=["X"]),
+    "modified_huber_loss": spec(
+        {"X": F(4, 1), "Y": B8(4, 1).astype("float32")}, grads=["X"],
+    ),
+    "teacher_student_sigmoid_loss": spec(
+        {"X": F(4, 1), "Label": rng.rand(4, 1).astype("float32")},
+        grads=["X"],
+    ),
+    "cos_sim": spec({"X": F(4, 8), "Y": F(4, 8)}, grads=["X", "Y"]),
+    "center_loss": spec(
+        {"X": F(4, 8), "Label": I32(4, 1, hi=3), "Centers": F(3, 8),
+         "CenterUpdateRate": np.full(1, 0.1, "float32")},
+        {"need_update": True},
+    ),
+    "mean_iou": spec(
+        {"Predictions": I32(8, hi=3), "Labels": I32(8, hi=3)},
+        {"num_classes": 3},
+    ),
+    "chunk_eval": spec(
+        {"Inference": np.array([[1, 1, 0, 2, 2]], "int32"),
+         "Label": np.array([[1, 1, 0, 2, 0]], "int32"),
+         "SeqLength": np.array([5], "int32")},
+        {"num_chunk_types": 3, "excluded_chunk_types_bg": 0},
+    ),
+    "positive_negative_pair": spec(
+        {"Score": rng.rand(6, 1).astype("float32"),
+         "Label": I32(6, 1, hi=2), "QueryID": I32(6, 1, hi=2)},
+    ),
+    "cvm": spec({"X": POS(4, 6), "CVM": POS(4, 2)}, {"use_cvm": True}),
+    # -- round-3 nn ops --
+    "add_position_encoding": spec({"X": F(2, 5, 8)},
+                                  {"alpha": 1.0, "beta": 1.0}, grads=["X"]),
+    "affine_channel": spec(
+        {"X": F(2, 3, 4, 4), "Scale": POS(3), "Bias": F(3)}, grads=["X"],
+    ),
+    "affine_grid": spec({"Theta": F(2, 2, 3)},
+                        {"output_shape": [2, 1, 4, 4]}, grads=["Theta"]),
+    "grid_sampler": spec(
+        {"X": F(2, 3, 5, 5),
+         "Grid": (rng.rand(2, 4, 4, 2) * 2 - 1).astype("float32")},
+        grads=["X"],
+    ),
+    "pixel_shuffle": spec({"X": F(1, 8, 3, 3)}, {"upscale_factor": 2}),
+    "space_to_depth": spec({"X": F(1, 2, 4, 4)}, {"blocksize": 2}),
+    "temporal_shift": spec({"X": F(8, 8, 3, 3)},
+                           {"seg_num": 4, "shift_ratio": 0.25}),
+    "unfold": spec({"X": F(1, 2, 5, 5)},
+                   {"kernel_sizes": [3, 3], "strides": [1, 1],
+                    "paddings": [1, 1, 1, 1], "dilations": [1, 1]}),
+    "im2sequence": spec({"X": F(1, 2, 6, 6)},
+                        {"kernels": [3, 3], "strides": [1, 1]}),
+    "lrn": spec({"X": F(1, 6, 4, 4)}, {"n": 5}),
+    "data_norm": spec(
+        {"X": F(4, 3), "BatchSize": np.full(3, 10.0, "float32"),
+         "BatchSum": F(3), "BatchSquareSum": POS(3) * 20},
+    ),
+    "spectral_norm": spec(
+        {"Weight": F(4, 6), "U": F(4), "V": F(6)},
+        {"dim": 0, "power_iters": 2},
+    ),
+    "bilinear_tensor_product": spec(
+        {"X": F(3, 4), "Y": F(3, 5), "Weight": F(2, 4, 5), "Bias": F(2)},
+        grads=["X", "Y", "Weight"],
+    ),
+    "conv_shift": spec({"X": F(2, 8), "Y": F(2, 3)}, grads=["X", "Y"]),
+    "row_conv": spec({"X": F(2, 6, 4), "Filter": F(3, 4)},
+                     grads=["X", "Filter"]),
+    "pool_with_index": spec({"X": F(1, 2, 4, 4)},
+                            {"ksize": [2, 2], "strides": [2, 2]}),
+    "spp": spec({"X": F(1, 2, 4, 4)}, {"pyramid_height": 2}),
+    "fsp": spec({"X": F(2, 3, 4, 4), "Y": F(2, 5, 4, 4)}, grads=["X", "Y"]),
+    "minus": spec({"X": F(2, 3), "Y": F(2, 3)}, grads=["X"]),
+    "selu": spec({"X": F(2, 3)}, grads=["X"]),
+    "l1_norm": spec({"X": F(2, 3)}, grads=["X"]),
+    "clip_by_norm": spec({"X": F(2, 3)}, {"max_norm": 1.0}, grads=["X"]),
+    "label_smooth": spec({"X": np.eye(3, dtype="float32")},
+                         {"epsilon": 0.1}),
+    "nce": spec(
+        {"Input": F(4, 8), "Label": I32(4, 1, hi=10), "Weight": F(10, 8),
+         "Bias": F(10)}, {"num_neg_samples": 3}, grads=["Input", "Weight"],
+    ),
+    "hierarchical_sigmoid": spec(
+        {"X": F(4, 8), "W": F(7, 8), "Label": I32(4, 1, hi=8),
+         "Bias": F(7)}, {"num_classes": 8}, grads=["X", "W"],
+    ),
+    # -- round-3 detection: proposal pipeline + yolo loss --
+    "generate_proposals": spec(
+        {"Scores": rng.rand(1, 3, 4, 4).astype("float32"),
+         "BboxDeltas": (rng.randn(1, 12, 4, 4) * 0.1).astype("float32"),
+         "ImInfo": np.array([[64, 64, 1.0]], "float32"),
+         "Anchors": (rng.rand(4, 4, 3, 4) * 32 + np.array([0, 0, 16, 16])).astype("float32"),
+         "Variances": np.ones((4, 4, 3, 4), "float32")},
+        {"pre_nms_topN": 20, "post_nms_topN": 5, "nms_thresh": 0.7,
+         "min_size": 1.0},
+    ),
+    "distribute_fpn_proposals": spec(
+        {"FpnRois": (rng.rand(8, 4) * np.array([10, 10, 200, 200])).astype("float32")},
+        {"min_level": 2, "max_level": 5, "refer_level": 4,
+         "refer_scale": 224},
+        n_out={"MultiFpnRois": 4},
+    ),
+    "collect_fpn_proposals": spec(
+        {"MultiLevelRois": [F(4, 4), F(4, 4)],
+         "MultiLevelScores": [rng.rand(4, 1).astype("float32"),
+                              rng.rand(4, 1).astype("float32")]},
+        {"post_nms_topN": 5},
+    ),
+    "rpn_target_assign": spec(
+        {"Anchor": (rng.rand(20, 2) * 30).astype("float32").repeat(2, 1)
+         + np.array([0, 0, 16, 16], "float32"),
+         "GtBoxes": np.array([[5, 5, 25, 25], [30, 30, 44, 44]], "float32"),
+         "IsCrowd": np.zeros((2, 1), "int32"),
+         "ImInfo": np.array([[64, 64, 1.0]], "float32")},
+        {"rpn_batch_size_per_im": 8, "rpn_fg_fraction": 0.5,
+         "rpn_positive_overlap": 0.5, "rpn_negative_overlap": 0.3},
+    ),
+    "retinanet_detection_output": spec(
+        {"BBoxes": [(rng.randn(1, 6, 4) * 0.1).astype("float32")],
+         "Scores": [rng.rand(1, 6, 3).astype("float32")],
+         "Anchors": [(rng.rand(6, 2) * 20).astype("float32").repeat(2, 1)
+                     + np.array([0, 0, 16, 16], "float32")],
+         "ImInfo": np.array([[64, 64, 1.0]], "float32")},
+        {"score_threshold": 0.05, "nms_threshold": 0.3, "keep_top_k": 5,
+         "nms_top_k": 6},
+    ),
+    "locality_aware_nms": spec(
+        {"BBoxes": _boxes, "Scores": rng.rand(3).astype("float32")},
+        {"nms_threshold": 0.3, "keep_top_k": 3},
+    ),
+    "yolov3_loss": spec(
+        {"X": (rng.randn(1, 2 * 8, 4, 4) * 0.1).astype("float32"),
+         "GTBox": np.array([[[0.5, 0.5, 0.3, 0.4], [0.2, 0.2, 0.1, 0.1]]],
+                           "float32"),
+         "GTLabel": np.array([[1, 2]], "int32"),
+         "GTScore": np.ones((1, 2), "float32")},
+        {"anchors": [10, 13, 16, 30], "anchor_mask": [0, 1], "class_num": 3,
+         "ignore_thresh": 0.7, "downsample_ratio": 32}, grads=["X"],
+    ),
 }
 
 # no-input no-output comm-setup ops: just lower them inside a program
