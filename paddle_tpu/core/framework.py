@@ -400,6 +400,17 @@ class Block:
         return var
 
     def create_parameter(self, name, shape, dtype="float32", **kwargs) -> Parameter:
+        existing = self.program.global_block().vars.get(name)
+        if isinstance(existing, Parameter):
+            if tuple(existing.shape or ()) != tuple(shape or ()):
+                raise ValueError(
+                    f"parameter {name!r} already exists with shape "
+                    f"{existing.shape}, requested {tuple(shape)} — explicit "
+                    "param names shared across layers must agree on shape "
+                    "(an fc over a LIST of inputs needs per-input names or "
+                    "a pre-concat)"
+                )
+            return existing  # weight sharing
         param = Parameter(self, name, shape, dtype, **kwargs)
         self.vars[name] = param
         # Parameters are global: also visible from block 0.

@@ -124,12 +124,17 @@ def _run_hogwild(executor, program, dataset, scope, fetch_list, fetch_info,
         # batches are only dropped on the error path (workers dead or
         # wedged); on a normal epoch end we wait for them to drain.
         for _ in threads:
+            attempts = 0
             while True:
                 try:
                     channel.put(stop, timeout=1.0)
                     break
                 except queue.Full:
-                    if errors or not any(t.is_alive() for t in threads):
+                    attempts += 1
+                    # drop queued batches when workers are dead, erroring,
+                    # or wedged past a deadline — never hang forever
+                    if (errors or attempts > 120
+                            or not any(t.is_alive() for t in threads)):
                         try:
                             channel.get_nowait()  # make room: abandon run
                         except queue.Empty:

@@ -60,6 +60,8 @@ class _Undef:
 
     __bool__ = __call__ = __getattr__ = __add__ = __radd__ = _raise
     __sub__ = __mul__ = __truediv__ = __iter__ = __array__ = _raise
+    __eq__ = __ne__ = __lt__ = __le__ = __gt__ = __ge__ = _raise
+    __getitem__ = __len__ = __str__ = __format__ = __hash__ = _raise
 
 
 UNDEF = _Undef()

@@ -68,6 +68,13 @@ class LayerHelper:
             init = ConstantInitializer(0.0) if is_bias else XavierInitializer()
 
         main_gb = self.main_program.global_block()
+        from .core.framework import Parameter as _Param
+
+        if isinstance(main_gb.vars.get(attr.name), _Param):
+            # weight sharing: return the existing param WITHOUT another
+            # startup init op (a second layer's initializer would
+            # silently overwrite the first's at startup)
+            return main_gb.create_parameter(attr.name, shape, dtype)
         param = main_gb.create_parameter(
             attr.name,
             shape,

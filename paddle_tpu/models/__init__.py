@@ -9,3 +9,10 @@ by bench.py and __graft_entry__.py.
 from .bert import BertConfig, build_bert_pretrain, apply_megatron_sharding
 from .resnet import build_resnet50
 from .mnist import build_lenet
+from .gpt import (
+    GPTConfig,
+    build_gpt_lm,
+    apply_gpt_megatron_sharding,
+    synthetic_lm_batch,
+)
+from .seq2seq import build_seq2seq, beam_search_infer

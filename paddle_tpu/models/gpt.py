@@ -1,0 +1,176 @@
+"""Decoder-only causal transformer LM (GPT family).
+
+Reference analogue: tests/unittests/dist_transformer.py +
+book/test_machine_translation.py scale models — the canonical
+"transformer trained via the Program API" exercise. TPU-first choices:
+fused QKV (one MXU matmul), causal flash attention (Pallas,
+kernels/flash_attention.py) on TPU, megatron column/row sharding
+annotations on the same `mp` axis convention as models/bert.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+from .. import layers, nets
+from ..core.framework import Program, default_main_program, program_guard
+from ..param_attr import ParamAttr
+from ..initializer import NormalInitializer
+
+
+@dataclasses.dataclass
+class GPTConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    ffn_size: int = 3072
+    max_position: int = 1024
+    hidden_dropout: float = 0.1
+    attention_dropout: float = 0.1
+    initializer_range: float = 0.02
+    use_flash_attention: bool = False
+
+    @staticmethod
+    def small():
+        return GPTConfig()
+
+    @staticmethod
+    def tiny():
+        return GPTConfig(vocab_size=1000, hidden_size=64, num_layers=2,
+                         num_heads=4, ffn_size=256, max_position=128,
+                         hidden_dropout=0.0, attention_dropout=0.0)
+
+
+def _attr(name, std):
+    return ParamAttr(name=name, initializer=NormalInitializer(0.0, std))
+
+
+def _decoder_layer(x, cfg: GPTConfig, idx: int, is_test=False):
+    h = cfg.hidden_size
+    std = cfg.initializer_range
+    pre = f"dec{idx}"
+    ln1 = layers.layer_norm(
+        x, begin_norm_axis=2,
+        param_attr=ParamAttr(name=f"{pre}_ln1.scale"),
+        bias_attr=ParamAttr(name=f"{pre}_ln1.bias"),
+    )
+    qkv = layers.fc(
+        ln1, 3 * h, num_flatten_dims=2,
+        param_attr=_attr(f"{pre}_qkv.w", std),
+        bias_attr=ParamAttr(name=f"{pre}_qkv.b"),
+    )
+    q, k, v = layers.split(qkv, 3, dim=2)
+    if cfg.use_flash_attention:
+        from ..kernels import flash_attention_layer
+
+        ctx = flash_attention_layer(q, k, v, cfg.num_heads, causal=True)
+    else:
+        ctx = nets.scaled_dot_product_attention(
+            q, k, v, num_heads=cfg.num_heads, causal=True,
+            dropout_rate=0.0 if is_test else cfg.attention_dropout,
+        )
+    proj = layers.fc(
+        ctx, h, num_flatten_dims=2,
+        param_attr=_attr(f"{pre}_proj.w", std),
+        bias_attr=ParamAttr(name=f"{pre}_proj.b"),
+    )
+    if not is_test and cfg.hidden_dropout:
+        proj = layers.dropout(proj, cfg.hidden_dropout,
+                              dropout_implementation="upscale_in_train")
+    x = layers.elementwise_add(x, proj)
+    ln2 = layers.layer_norm(
+        x, begin_norm_axis=2,
+        param_attr=ParamAttr(name=f"{pre}_ln2.scale"),
+        bias_attr=ParamAttr(name=f"{pre}_ln2.bias"),
+    )
+    ffn1 = layers.fc(
+        ln2, cfg.ffn_size, num_flatten_dims=2, act="gelu",
+        param_attr=_attr(f"{pre}_ffn1.w", std),
+        bias_attr=ParamAttr(name=f"{pre}_ffn1.b"),
+    )
+    ffn2 = layers.fc(
+        ffn1, h, num_flatten_dims=2,
+        param_attr=_attr(f"{pre}_ffn2.w", std),
+        bias_attr=ParamAttr(name=f"{pre}_ffn2.b"),
+    )
+    if not is_test and cfg.hidden_dropout:
+        ffn2 = layers.dropout(ffn2, cfg.hidden_dropout,
+                              dropout_implementation="upscale_in_train")
+    return layers.elementwise_add(x, ffn2)
+
+
+def build_gpt_lm(cfg: GPTConfig, seq_len: int, optimizer=None, is_test=False):
+    """Next-token LM: returns (main, startup, feeds, fetches).
+    tokens [B, S] int64 -> loss (shifted CE) + logits."""
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        tokens = layers.data("tokens", [seq_len], dtype="int64")
+        labels = layers.data("labels", [seq_len], dtype="int64")
+        emb = layers.embedding(
+            tokens, size=[cfg.vocab_size, cfg.hidden_size],
+            param_attr=_attr("gpt_tok_emb", cfg.initializer_range),
+        )
+        pos = layers.embedding(
+            layers.assign(np.arange(seq_len, dtype="int64")[None, :]),
+            size=[cfg.max_position, cfg.hidden_size],
+            param_attr=_attr("gpt_pos_emb", cfg.initializer_range),
+        )
+        x = layers.elementwise_add(emb, pos)
+        for i in range(cfg.num_layers):
+            x = _decoder_layer(x, cfg, i, is_test=is_test)
+        x = layers.layer_norm(
+            x, begin_norm_axis=2,
+            param_attr=ParamAttr(name="gpt_lnf.scale"),
+            bias_attr=ParamAttr(name="gpt_lnf.bias"),
+        )
+        logits = layers.fc(
+            x, cfg.vocab_size, num_flatten_dims=2,
+            param_attr=_attr("gpt_head.w", cfg.initializer_range),
+            bias_attr=ParamAttr(name="gpt_head.b"),
+        )
+        loss = layers.mean(
+            layers.softmax_with_cross_entropy(
+                logits, layers.unsqueeze(labels, [2])
+            )
+        )
+        if optimizer is not None:
+            optimizer.minimize(loss)
+    return main, startup, {"tokens": tokens, "labels": labels}, {
+        "loss": loss, "logits": logits,
+    }
+
+
+def apply_gpt_megatron_sharding(program: Program, mp_axis: str = "mp"):
+    """Column-parallel qkv/ffn1, row-parallel proj/ffn2, vocab-parallel
+    embeddings — same annotation scheme as models/bert.py
+    apply_megatron_sharding."""
+    block = program.global_block()
+    for name, v in block.vars.items():
+        if v.sharding is not None or not getattr(v, "persistable", False):
+            continue
+        if "_qkv.w" in name or "_ffn1.w" in name:
+            v.sharding = (None, mp_axis)
+        elif "_qkv.b" in name or "_ffn1.b" in name:
+            v.sharding = (mp_axis,)
+        elif "_proj.w" in name or "_ffn2.w" in name:
+            v.sharding = (mp_axis, None)
+        elif name in ("gpt_tok_emb", "gpt_head.w"):
+            v.sharding = (None, mp_axis) if name == "gpt_head.w" else (mp_axis, None)
+    program._bump()
+
+
+def synthetic_lm_batch(rng: np.random.RandomState, batch: int, seq_len: int,
+                       vocab: int):
+    """Learnable synthetic corpus: next token = (3*cur + 7) % vocab with
+    occasional noise."""
+    toks = rng.randint(0, vocab, (batch, seq_len)).astype("int64")
+    for t in range(1, seq_len):
+        toks[:, t] = (3 * toks[:, t - 1] + 7) % vocab
+    labels = np.concatenate(
+        [toks[:, 1:], ((3 * toks[:, -1:] + 7) % vocab)], axis=1
+    ).astype("int64")
+    return {"tokens": toks, "labels": labels}

@@ -100,7 +100,7 @@ def glu(input, dim=-1):
 
 
 def scaled_dot_product_attention(
-    queries, keys, values, num_heads=1, dropout_rate=0.0
+    queries, keys, values, num_heads=1, dropout_rate=0.0, causal=False
 ):
     """Multi-head attention from program-level ops (reference nets.py).
     The fused Pallas path is paddle_tpu.kernels.flash_attention, used by
@@ -122,6 +122,14 @@ def scaled_dot_product_attention(
     v = _split_heads(values)
     scaled = layers.scale(q, scale=d_key**-0.5)
     logits = layers.matmul(scaled, k, transpose_y=True)
+    if causal:
+        import numpy as _np
+
+        T = int(logits.shape[-1])
+        mask = layers.assign(
+            _np.triu(_np.full((T, T), -1e9, "float32"), k=1)[None, None]
+        )
+        logits = layers.elementwise_add(logits, mask)
     weights = layers.softmax(logits)
     if dropout_rate:
         weights = layers.dropout(

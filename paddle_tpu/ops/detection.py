@@ -684,11 +684,18 @@ def _distribute_fpn_proposals(ctx, op, ins):
     refer_lv = int(op.attrs["refer_level"])
     refer_sc = float(op.attrs["refer_scale"])
     R = rois.shape[0]
+    # dense padding rows (beyond RoisNum) must not be routed anywhere
+    if ins.get("RoisNum"):
+        n_valid = ins["RoisNum"][0].reshape(-1)[0]
+        valid = jnp.arange(R) < n_valid
+    else:
+        valid = jnp.ones((R,), bool)
     w = jnp.maximum(rois[:, 2] - rois[:, 0] + 1.0, 1.0)
     h = jnp.maximum(rois[:, 3] - rois[:, 1] + 1.0, 1.0)
     scale = jnp.sqrt(w * h)
     lv = jnp.floor(refer_lv + jnp.log2(scale / refer_sc + 1e-8))
     lv = jnp.clip(lv, min_lv, max_lv).astype(jnp.int32)
+    lv = jnp.where(valid, lv, -1)  # padding routed to no level
     outs, nums = [], []
     for L in range(min_lv, max_lv + 1):
         mask = lv == L
@@ -704,7 +711,7 @@ def _distribute_fpn_proposals(ctx, op, ins):
     # rank within level: count of earlier rois with the same level
     same = (lv[:, None] == lv[None, :]) & (jnp.arange(R)[None, :] < jnp.arange(R)[:, None])
     rank = jnp.sum(same, axis=1)
-    restore = (level_idx * R + rank).astype(jnp.int32)
+    restore = jnp.where(valid, level_idx * R + rank, 0).astype(jnp.int32)
     return {"MultiFpnRois": outs, "RestoreIndex": [restore[:, None]],
             "MultiLevelRoIsNum": [jnp.stack(nums)]}
 
@@ -770,8 +777,11 @@ def _rpn_target_assign(ctx, op, ins):
     bg_valid = jnp.isfinite(bg_score)
     loc_idx = jnp.where(fg_valid, fg_idx, 0).astype(jnp.int32)
     score_idx = jnp.concatenate([loc_idx, jnp.where(bg_valid, bg_idx, 0).astype(jnp.int32)])
+    # unfilled slots get label -1 (ignore, the reference convention) so
+    # anchor 0 never receives contradictory supervision from padding
     labels = jnp.concatenate([
-        fg_valid.astype(jnp.int32), jnp.zeros_like(bg_valid, jnp.int32)
+        jnp.where(fg_valid, 1, -1).astype(jnp.int32),
+        jnp.where(bg_valid, 0, -1).astype(jnp.int32),
     ])
     # bbox regression targets for the fg anchors (encode vs matched gt)
     a = anchors[loc_idx]
@@ -901,7 +911,7 @@ def _yolov3_loss(ctx, op, ins):
     mask_w = all_w[jnp.asarray(amask)]
     mask_h = all_h[jnp.asarray(amask)]
     sig = jax.nn.sigmoid
-    softplus = lambda v: jnp.log1p(jnp.exp(-jnp.abs(v))) + jnp.maximum(v, 0.0)
+    softplus = jax.nn.softplus
     bce = lambda logit, t: softplus(logit) - t * logit
 
     def per_image(xi, gb, gl, gs):

@@ -219,7 +219,7 @@ def _rank_loss(ctx, op, ins):
     # reference rank_loss_op.cc: sigmoid cross entropy on o_left-o_right
     lbl, l, r = ins["Label"][0], ins["Left"][0], ins["Right"][0]
     d = l - r
-    return {"Out": [jnp.log1p(jnp.exp(-jnp.abs(d))) + jnp.maximum(d, 0.0) - lbl * d]}
+    return {"Out": [jax.nn.softplus(d) - lbl * d]}
 
 
 @register_op("margin_rank_loss", inputs=("Label", "X1", "X2"), outputs=("Out", "Activated"), no_grad=("Label",))
@@ -239,7 +239,7 @@ def _bpr_loss(ctx, op, ins):
     N, C = x.shape
     pos = jnp.take_along_axis(x, lbl[:, None], axis=1)  # [N,1]
     diff = pos - x
-    logsig = -jnp.log1p(jnp.exp(-diff))
+    logsig = -jax.nn.softplus(-diff)
     notp = jnp.arange(C)[None, :] != lbl[:, None]
     return {"Y": [(-jnp.sum(jnp.where(notp, logsig, 0.0), axis=1,
                             keepdims=True) / jnp.maximum(C - 1, 1))]}
@@ -260,7 +260,7 @@ def _teacher_student_sigmoid_loss(ctx, op, ins):
     mixes a hard click signal with a soft teacher score."""
     x = ins["X"][0].reshape(-1)
     lbl = ins["Label"][0].reshape(-1)
-    softplus = lambda v: jnp.log1p(jnp.exp(-jnp.abs(v))) + jnp.maximum(v, 0.0)
+    softplus = jax.nn.softplus
     # teacher part: label<-1 -> 0; -1<=label<0 -> (1+label) weighting;
     # simple faithful form: hard = sigmoid ce with (label>0); soft =
     # sigmoid ce with fractional part where 0<label<1

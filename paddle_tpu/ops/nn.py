@@ -855,7 +855,7 @@ def _nce(ctx, op, ins):
     labels = jnp.concatenate(
         [jnp.ones((B, 1)), jnp.zeros((B, num_neg))], axis=1
     ).astype(x.dtype)
-    softplus = lambda v: jnp.log1p(jnp.exp(-jnp.abs(v))) + jnp.maximum(v, 0.0)
+    softplus = jax.nn.softplus
     ce = softplus(logits) - labels * logits
     return {
         "Cost": [jnp.sum(ce, axis=1, keepdims=True)],
@@ -898,7 +898,7 @@ def _hierarchical_sigmoid(ctx, op, ins):
     pre = jnp.einsum("bd,bkd->bk", x, wsel)
     if ins.get("Bias"):
         pre = pre + ins["Bias"][0].reshape(-1)[node_ids]
-    softplus = lambda v: jnp.log1p(jnp.exp(-jnp.abs(v))) + jnp.maximum(v, 0.0)
+    softplus = jax.nn.softplus
     ce = softplus(pre) - bits * pre
     ce = jnp.where(valid, ce, 0.0)
     return {
