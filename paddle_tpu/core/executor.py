@@ -600,6 +600,14 @@ class Executor:
             for n in state_names:
                 shardings.append(_state_sharding(n))
             jit_kwargs["in_shardings"] = tuple(shardings)
+            # pin outputs too: without this GSPMD may hand back written
+            # state (e.g. params updated from ZeRO-sharded moments)
+            # dp-sharded, and the NEXT call's in_shardings reject the
+            # committed arrays
+            jit_kwargs["out_shardings"] = tuple(
+                [NamedSharding(mesh, P())] * len(fetch_names)
+                + [_state_sharding(n) for n in written_names]
+            )
         jitted = jax.jit(fn, **jit_kwargs)
         return _CompiledBlock(
             jitted, list(feed_names), state_names, fetch_names, written_names, donate

@@ -104,3 +104,14 @@ _register_noop("c_sync_calc_stream")
 _register_noop("c_sync_comm_stream")
 _register_noop("c_wait_comm", ())
 _register_noop("c_wait_compute", ())
+
+
+@register_op("local_sgd_select", inputs=("Step", "Avg", "Param"), outputs=("Out",), stop_gradient=True)
+def _local_sgd_select(ctx, op, ins):
+    """Gate for LocalSGD (transpiler/collective.py): take the
+    cross-replica average only every `every` steps, else keep the local
+    param (reference LocalSGD's conditional communication)."""
+    step = ins["Step"][0].reshape(())
+    every = float(op.attrs.get("every", 1.0))
+    sync = jnp.mod(step, every) < 0.5
+    return {"Out": [jnp.where(sync, ins["Avg"][0], ins["Param"][0])]}

@@ -130,19 +130,32 @@ class LocalSGD(Collective):
             step = create_global_var([1], 0, "float32", persistable=True,
                                      name=unique_name.generate("local_sgd_step"))
         block.append_op(type="increment", inputs={"X": [step.name]},
-                        outputs={"Out": [step.name]}, attrs={"step": 1.0})
-        # every local_steps: param = pmean(param). Emitted unconditionally
-        # with a where-select on the counter so the graph stays static.
+                        outputs={"Out": [step.name]},
+                        attrs={"step": 1.0, "op_role": OpRole.Optimize})
+        # every local_steps: param = pmean(param). The averaging is
+        # emitted unconditionally (static graph) and SELECTED by a
+        # where on (step mod local_steps == 0) — real gating, not just
+        # a recorded attr.
+        k = float(max(self.local_steps, 1))
         for p in self.main_program.all_parameters():
-            block.append_op(
-                type="c_allreduce_sum", inputs={"X": [p.name]},
-                outputs={"Out": [p.name]},
-                attrs={"ring_id": 0, "op_role": OpRole.Optimize,
-                       "local_sgd_every": self.local_steps},
+            avg = block.create_var(
+                name=unique_name.generate(f"{p.name}.lsgd_avg"),
+                shape=p.shape, dtype=p.dtype, stop_gradient=True,
             )
             block.append_op(
-                type="scale", inputs={"X": [p.name]}, outputs={"Out": [p.name]},
+                type="c_allreduce_sum", inputs={"X": [p.name]},
+                outputs={"Out": [avg.name]},
+                attrs={"ring_id": 0, "op_role": OpRole.Optimize},
+            )
+            block.append_op(
+                type="scale", inputs={"X": [avg.name]}, outputs={"Out": [avg.name]},
                 attrs={"scale": 1.0 / self.nranks, "op_role": OpRole.Optimize},
+            )
+            block.append_op(
+                type="local_sgd_select",
+                inputs={"Step": [step.name], "Avg": [avg.name], "Param": [p.name]},
+                outputs={"Out": [p.name]},
+                attrs={"every": k, "op_role": OpRole.Optimize},
             )
         self.main_program._bump()
 

@@ -475,6 +475,7 @@ COVERED_ELSEWHERE = {
     'ftrl', 'lamb', 'lars_momentum', 'rmsprop',
     'merge_selected_rows', 'get_tensor_from_selected_rows',
     'dgc',  # tests/test_dgc.py
+    'local_sgd_select',  # tests/test_zero_localsgd.py
 }
 
 

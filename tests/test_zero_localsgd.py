@@ -1,0 +1,158 @@
+"""P3 (ZeRO sharded optimizer state) and P10 (LocalSGD) end-to-end
+training tests — the two parallelism rows round 1 left unproven."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _mlp(width=16):
+    x = fluid.layers.data("x", [width])
+    y = fluid.layers.data("y", [1])
+    h = fluid.layers.fc(x, width, act="relu")
+    pred = fluid.layers.fc(h, 1, bias_attr=False)
+    return fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+
+
+def test_zero_sharded_adam_training_parity():
+    """Adam with ZeRO-1 sharded moments over dp8 must train exactly
+    like single-device Adam (reference P3: reduce-scatter grads,
+    sharded update, all-gather params — GSPMD derives it from the
+    accumulator shardings)."""
+    import jax
+    from paddle_tpu.parallel.sharding import shard_optimizer_states
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    rng0 = np.random.RandomState(3)
+    W = rng0.randn(16, 1).astype("float32")
+
+    def run(sharded):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 7
+        with fluid.program_guard(main, startup), fluid.unique_name.guard():
+            loss = _mlp()
+            fluid.optimizer.Adam(5e-3).minimize(loss)
+        target = main
+        if sharded:
+            n = shard_optimizer_states(main, 8)
+            assert n >= 2, n  # both fc weights' moments sharded
+            target = fluid.CompiledProgram(main).with_data_parallel(
+                loss_name=loss.name)
+        rng = np.random.RandomState(11)
+        scope = fluid.Scope()
+        losses = []
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.TPUPlace())
+            exe.run(startup)
+            for _ in range(8):
+                xb = rng.randn(16, 16).astype("float32")
+                (l,) = exe.run(target, feed={"x": xb, "y": xb @ W},
+                               fetch_list=[loss])
+                losses.append(float(l))
+            params = {
+                n2: scope.get_numpy(n2) for n2 in scope.local_var_names()
+                if ".w_0" in n2 and "@" not in n2 and "moment" not in n2
+            }
+        return losses, params
+
+    base_l, base_p = run(False)
+    z_l, z_p = run(True)
+    np.testing.assert_allclose(z_l, base_l, rtol=1e-4, atol=1e-5)
+    for n in base_p:
+        np.testing.assert_allclose(np.asarray(z_p[n]), base_p[n],
+                                   rtol=1e-4, atol=1e-5, err_msg=n)
+
+
+_LSGD_WORKER = textwrap.dedent(
+    """
+    import os, sys, json
+    sys.path.insert(0, {repo!r})
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["JAX_PLATFORM_NAME"] = "cpu"
+    import numpy as np
+    from paddle_tpu.parallel.env import init_parallel_env
+
+    env = init_parallel_env()
+    import paddle_tpu as fluid
+    from paddle_tpu.transpiler.collective import LocalSGD
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 5
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data("x", [8])
+        y = fluid.layers.data("y", [1])
+        pred = fluid.layers.fc(x, 1, bias_attr=False)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(0.05).minimize(loss)
+    t = LocalSGD(local_steps={local_steps!r})
+    t.transpile(startup, main, rank=env.rank,
+                endpoints=list(env.trainer_endpoints),
+                current_endpoint=env.current_endpoint)
+    rng = np.random.RandomState(100 + env.rank)  # DIFFERENT data per rank
+    W = np.random.RandomState(9).randn(8, 1).astype("float32")
+    scope = fluid.Scope()
+    losses = []
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        for _ in range({steps!r}):
+            xb = rng.randn(16, 8).astype("float32")
+            (l,) = exe.run(main, feed={{"x": xb, "y": xb @ W}}, fetch_list=[loss])
+            losses.append(float(l))
+        wname = next(n for n in scope.local_var_names() if ".w_0" in n and "@" not in n)
+        w = scope.get_numpy(wname)
+    with open({outdir!r} + f"/lsgd_rank{{env.rank}}.json", "w") as f:
+        json.dump({{"losses": losses, "w": np.asarray(w).tolist()}}, f)
+    """
+)
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.parametrize("local_steps,steps,expect_equal", [
+    (2, 6, True),   # last step is a sync step -> params identical
+    (4, 6, False),  # last sync at step 4; steps 5-6 local -> diverged
+])
+def test_localsgd_multiprocess(tmp_path, local_steps, steps, expect_equal):
+    """2 subprocess trainers on DIFFERENT data with periodic param
+    averaging: params agree exactly after a sync step and diverge
+    between syncs — proving the averaging is real AND gated."""
+    worker = tmp_path / "lsgd_worker.py"
+    worker.write_text(_LSGD_WORKER.format(
+        repo=REPO, outdir=str(tmp_path), local_steps=local_steps, steps=steps))
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["JAX_PLATFORM_NAME"] = "cpu"
+    env["XLA_FLAGS"] = ""
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node=2", f"--started_port={_free_port()}", str(worker)],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=240,
+    )
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out[-3000:]
+    d0 = json.loads((tmp_path / "lsgd_rank0.json").read_text())
+    d1 = json.loads((tmp_path / "lsgd_rank1.json").read_text())
+    assert d0["losses"][-1] < d0["losses"][0], d0["losses"]
+    w0, w1 = np.asarray(d0["w"]), np.asarray(d1["w"])
+    if expect_equal:
+        np.testing.assert_allclose(w0, w1, rtol=1e-6, atol=1e-7)
+    else:
+        assert np.abs(w0 - w1).max() > 1e-6
