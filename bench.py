@@ -161,6 +161,38 @@ def main():
     )
 
 
+def _probe_relay(pypath, axon_ips):
+    """Quick child that just enumerates devices: a wedged relay makes
+    `jax.devices()` hang forever (observed multi-hour outages after a
+    dropped session), and each TPU ladder stage would burn its full
+    timeout. 240s probe budget instead."""
+    import subprocess
+
+    env = {**os.environ, "PYTHONPATH": pypath,
+           "PALLAS_AXON_POOL_IPS": axon_ips}
+    env.pop("PT_BENCH_AXON_IPS", None)
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print('BACKEND', jax.default_backend())"],
+            env=env, capture_output=True, text=True, timeout=240,
+        )
+        # a soft plugin failure falls back to the CPU backend with
+        # rc=0 — that must NOT count as a live relay
+        ok = (proc.returncode == 0 and "BACKEND" in proc.stdout
+              and "BACKEND cpu" not in proc.stdout)
+    except subprocess.TimeoutExpired:
+        ok = False
+    if not ok:
+        sys.stderr.write("[bench] TPU relay probe FAILED — skipping TPU "
+                         "stages (relay wedged or unreachable)\n")
+    else:
+        # the probe child held the single-claim relay; give it time to
+        # release before the first measured stage connects
+        time.sleep(COOLDOWN_S)
+    return ok
+
+
 def _orchestrate():
     """Role 2: no jax anywhere in this process. Walk the stage ladder."""
     import subprocess
@@ -172,7 +204,12 @@ def _orchestrate():
                      if os.environ.get("PYTHONPATH") else "")
     axon_ips = os.environ.get("PT_BENCH_AXON_IPS", "")
 
+    relay_ok = bool(axon_ips) and _probe_relay(pypath, axon_ips)
+
     for i, stage in enumerate(STAGES):
+        if stage["backend"] == "tpu" and not relay_ok:
+            sys.stderr.write(f"[bench] stage {i + 1}: skipped (relay down)\n")
+            continue
         env = {**os.environ,
                "PT_BENCH_CHILD": "1",
                "PYTHONPATH": pypath,

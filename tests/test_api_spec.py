@@ -1,0 +1,24 @@
+"""Public-API spec ratchet (reference tools/print_signatures.py +
+API.spec CI check): a signature change must come with a spec update."""
+
+import os
+import subprocess
+import sys
+
+
+def test_api_surface_matches_spec():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(repo, "tools"))
+    import print_signatures
+
+    current = sorted(set(print_signatures.iter_api()))
+    with open(os.path.join(repo, "paddle_tpu.api.spec")) as f:
+        recorded = [l.rstrip("\n") for l in f if l.strip()]
+    cur_set, rec_set = set(current), set(recorded)
+    added = sorted(cur_set - rec_set)
+    removed = sorted(rec_set - cur_set)
+    assert not added and not removed, (
+        f"public API changed: +{len(added)} -{len(removed)}.\n"
+        f"added: {added[:10]}\nremoved: {removed[:10]}\n"
+        "regenerate with: python tools/print_signatures.py paddle_tpu.api.spec"
+    )

@@ -213,3 +213,44 @@ def test_hdfs_client_without_hadoop(tmp_path):
     assert cli._cmd("-ls", "/x")[-2:] == ["-ls", "/x"]
     # 7 files over 3 trainers -> blocks [3, 2, 2]; trainer 1 gets d, e
     assert HDFSClient.split_files(list("abcdefg"), 1, 3) == ["d", "e"]
+
+
+def test_orbax_sharded_checkpoint_roundtrip(tmp_path):
+    """save_checkpoint/load_checkpoint (orbax): exact persistable
+    round trip + step dirs + resume helper + async save."""
+    import numpy as np
+    import paddle_tpu as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 3
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data("x", [4])
+        y = fluid.layers.data("y", [1])
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(
+            fluid.layers.fc(x, 1), y))
+        fluid.optimizer.Adam(1e-2).minimize(loss)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        for _ in range(3):
+            exe.run(main, feed={"x": np.ones((4, 4), "float32"),
+                                "y": np.zeros((4, 1), "float32")},
+                    fetch_list=[loss])
+        saved = {n: np.asarray(scope.find_var(n))
+                 for n in scope.local_var_names()}
+        ck = fluid.io.save_checkpoint(str(tmp_path / "ck"), main, scope, step=3)
+        assert ck is None
+        h = fluid.io.save_checkpoint(str(tmp_path / "ck"), main, scope,
+                                     step=7, async_save=True)
+        h.wait_until_finished()
+    assert fluid.io.latest_checkpoint(str(tmp_path / "ck")) == 7
+
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        names = fluid.io.load_checkpoint(str(tmp_path / "ck"), main, scope2,
+                                         step=3)
+        assert len(names) == len(saved)
+        for n in names:
+            np.testing.assert_array_equal(
+                np.asarray(scope2.find_var(n)), saved[n], err_msg=n)

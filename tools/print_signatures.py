@@ -1,0 +1,82 @@
+"""Dump the public API surface as a stable spec.
+
+Reference: tools/print_signatures.py + API.spec — the reference's CI
+fails when a PR changes a public signature without updating the spec;
+same ratchet here (tests/test_api_spec.py)."""
+
+from __future__ import annotations
+
+import inspect
+import sys
+
+
+MODULES = [
+    "paddle_tpu",
+    "paddle_tpu.layers",
+    "paddle_tpu.optimizer",
+    "paddle_tpu.nets",
+    "paddle_tpu.io",
+    "paddle_tpu.fs",
+    "paddle_tpu.clip",
+    "paddle_tpu.regularizer",
+    "paddle_tpu.initializer",
+    "paddle_tpu.metrics",
+    "paddle_tpu.dygraph",
+    "paddle_tpu.reader",
+    "paddle_tpu.dataset",
+    "paddle_tpu.models",
+    "paddle_tpu.parallel.fleet",
+    "paddle_tpu.transpiler",
+    "paddle_tpu.contrib.mixed_precision",
+    "paddle_tpu.layers.distributions",
+]
+
+
+def _sig(obj):
+    try:
+        return str(inspect.signature(obj))
+    except (ValueError, TypeError):
+        return "(...)"
+
+
+def iter_api():
+    import importlib
+
+    for modname in MODULES:
+        mod = importlib.import_module(modname)
+        for name in sorted(dir(mod)):
+            if name.startswith("_"):
+                continue
+            obj = getattr(mod, name)
+            if inspect.ismodule(obj):
+                continue
+            owner = getattr(obj, "__module__", "") or ""
+            if not owner.startswith("paddle_tpu"):
+                continue
+            if inspect.isclass(obj):
+                yield f"{modname}.{name} class{_sig(obj.__init__)}"
+                for mname in sorted(dir(obj)):
+                    if mname.startswith("_"):
+                        continue
+                    m = getattr(obj, mname)
+                    if callable(m):
+                        yield f"{modname}.{name}.{mname} {_sig(m)}"
+            elif callable(obj):
+                yield f"{modname}.{name} {_sig(obj)}"
+
+
+def main(out=None):
+    lines = sorted(set(iter_api()))
+    text = "\n".join(lines) + "\n"
+    if out:
+        with open(out, "w") as f:
+            f.write(text)
+    else:
+        sys.stdout.write(text)
+
+
+if __name__ == "__main__":
+    import os
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    main(sys.argv[1] if len(sys.argv) > 1 else None)
