@@ -153,8 +153,16 @@ def append_backward(
                 aligned.append(zname)
             g_inputs[slot + "@GRAD"] = aligned
 
+        # in-place pattern (write_to_array & co): an op whose output name
+        # is also one of its input names. The incoming grad (for the
+        # post-write value) is consumed as this grad op's out-grad; the
+        # produced in-grad REPLACES the map entry for earlier producers
+        # — summing would double-count (SSA values share one name).
+        op_out_names = {n for ns in op.outputs.values() for n in ns}
+
         g_outputs: Dict[str, List[str]] = {}
         pending_sums: List[Tuple[str, str, str]] = []  # (final, old, new)
+        pending_replace: List[Tuple[str, str]] = []    # (name, new grad var)
         for slot, names in op.inputs.items():
             if slot not in want_slots:
                 continue
@@ -168,8 +176,6 @@ def append_backward(
                     continue
                 gname = _grad_name(n)
                 if n in grad_map:
-                    # second producer: rename + sum (reference
-                    # _addup_repetitive_outputs)
                     renamed = gname + f"@RENAME@{len(block.ops)}"
                     block.create_var(
                         name=renamed,
@@ -177,7 +183,12 @@ def append_backward(
                         dtype=(_var_or_none(block, n) or loss).dtype,
                         stop_gradient=True,
                     )
-                    pending_sums.append((gname, grad_map[n], renamed))
+                    if n in op_out_names:
+                        pending_replace.append((n, renamed))
+                    else:
+                        # second producer: rename + sum (reference
+                        # _addup_repetitive_outputs)
+                        pending_sums.append((gname, grad_map[n], renamed))
                     onames.append(renamed)
                 else:
                     _create_grad_var(block, n)
@@ -203,6 +214,8 @@ def append_backward(
             )
             grad_map_key = final[: -len("@GRAD")]
             grad_map[grad_map_key] = final
+        for n, new in pending_replace:
+            grad_map[n] = new
 
     program._bump()
 

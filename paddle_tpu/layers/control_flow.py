@@ -17,7 +17,8 @@ from ..core.framework import Variable, default_main_program, unique_name
 from ..layer_helper import LayerHelper
 
 __all__ = [
-    "increment", "array_write", "array_read", "less_than", "less_equal",
+    "increment", "create_array", "array_write", "array_read", "array_length",
+    "less_than", "less_equal",
     "greater_than", "greater_equal", "equal", "not_equal", "While",
     "Switch", "cond", "StaticRNN", "DynamicRNN",
 ]
@@ -83,18 +84,73 @@ def equal(x, y, cond=None):
     return cond
 
 
-def array_write(x, i, array=None):
-    raise NotImplementedError(
-        "LoDTensorArray is inherently dynamic; on TPU use lax.scan-style "
-        "rnn() (layers.rnn) or static python lists of Variables"
+def create_array(dtype, capacity, elem_shape):
+    """Allocate a dense tensor array [capacity, *elem_shape].
+
+    Reference create_array makes an empty LoDTensorArray that grows on
+    write; XLA needs the capacity up front (= the loop trip count in
+    every reference usage pattern)."""
+    helper = LayerHelper("create_array")
+    # differentiable carrier: grads must flow through array writes back
+    # to what was written (fill_constant outputs default stop_gradient)
+    out = helper.create_variable_for_type_inference(
+        dtype=dtype, shape=(capacity,) + tuple(elem_shape), stop_gradient=False
     )
+    from .tensor import fill_constant
+
+    return fill_constant([capacity] + list(elem_shape), dtype, 0.0, out=out)
+
+
+def array_write(x, i, array=None, capacity=None):
+    """A[i] = x. Reference: tensor_array_read_write_op.cc (write_to_array).
+
+    With array=None a fresh dense array is allocated and ``capacity``
+    is REQUIRED (the reference grows the array dynamically; XLA shapes
+    are static, so the bound must be declared — usually the loop trip
+    count). Prefer ``create_array`` + in-place writes."""
+    helper = LayerHelper("array_write")
+    inputs = {"X": [x], "I": [i]}
+    if array is not None:
+        inputs["Array"] = [array]
+        out = array  # in-place semantics: read-then-write -> loop carry
+    else:
+        if capacity is None:
+            raise ValueError(
+                "array_write(array=None) needs an explicit capacity: dense "
+                "tensor arrays are fixed-size on TPU (use create_array)"
+            )
+        out = helper.create_variable_for_type_inference(
+            dtype=x.dtype, shape=(capacity,) + tuple(x.shape or ())
+        )
+    helper.append_op(
+        type="write_to_array", inputs=inputs, outputs={"Out": [out]},
+        attrs={"capacity": int(capacity or 0)},
+    )
+    return out
+
+
+def array_length(array):
+    helper = LayerHelper("array_length")
+    out = helper.create_variable_for_type_inference(
+        dtype="int64", shape=(1,), stop_gradient=True
+    )
+    helper.append_op(
+        type="lod_array_length", inputs={"X": [array]}, outputs={"Out": [out]}
+    )
+    return out
 
 
 def array_read(array, i):
-    raise NotImplementedError(
-        "LoDTensorArray is inherently dynamic; on TPU use lax.scan-style "
-        "rnn() (layers.rnn) or static python lists of Variables"
+    """out = A[i]. Reference: tensor_array_read_write_op.cc."""
+    helper = LayerHelper("array_read")
+    out = helper.create_variable_for_type_inference(
+        dtype=array.dtype, shape=tuple((array.shape or (1,))[1:])
     )
+    helper.append_op(
+        type="read_from_array", inputs={"X": [array], "I": [i]},
+        outputs={"Out": [out]},
+    )
+    return out
 
 
 class While:

@@ -19,3 +19,4 @@ from . import sequence  # noqa: F401
 from . import detection  # noqa: F401
 from . import metrics  # noqa: F401
 from . import beam  # noqa: F401
+from . import lod  # noqa: F401

@@ -1,0 +1,208 @@
+"""LoDTensorArray + rank-table ops over dense stacked buffers.
+
+Reference: operators/controlflow/tensor_array_read_write_op.cc,
+lod_rank_table_op.cc, lod_array_length_op.cc, shrink_rnn_memory_op.cc,
+split_lod_tensor_op.cc, merge_lod_tensor_op.cc,
+tensor_array_to_tensor_op.cc, array_to_lod_tensor_op.cc,
+lod_tensor_to_array_op.cc, select_input_op.cc, select_output_op.cc,
+rnn_memory_helper_op.cc.
+
+TPU-native representation (XLA needs static shapes):
+
+* a LoDTensorArray is a dense stacked buffer ``[capacity, *elem]`` —
+  writes are ``lax.dynamic_update_slice`` (so the index may be a traced
+  loop counter inside a lowered ``while`` block), reads are
+  ``lax.dynamic_index_in_dim``.  Capacity is fixed at allocation
+  (layers.create_array / first write), matching the scan-style loops
+  these ops appear in, where the trip count bounds the array length.
+* a LoDRankTable is a dense ``[batch, 2]`` int64 tensor of
+  (row_index, length) sorted by descending length — the same
+  information the reference stores as a C++ struct
+  (lod_rank_table.h), kept on device so downstream gathers compile.
+* split/merge by mask keep static shapes: rows are masked, not
+  compacted (the reference compacts; dense padding is the TPU idiom,
+  same stance as ops/sequence.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op
+
+
+def _scalar_i(ins, slot="I"):
+    i = ins[slot][0]
+    return jnp.reshape(i, ()).astype(jnp.int32)
+
+
+@register_op("write_to_array", inputs=("X", "I", "Array"), outputs=("Out",),
+             no_grad=("I",))
+def _write_to_array(ctx, op, ins):
+    x = ins["X"][0]
+    i = _scalar_i(ins)
+    if ins.get("Array"):
+        arr = ins["Array"][0]
+    else:
+        cap = int(op.attrs.get("capacity", 0)) or 1
+        arr = jnp.zeros((cap,) + tuple(x.shape), x.dtype)
+    x = x.astype(arr.dtype)
+    out = lax.dynamic_update_slice(
+        arr, x[None], (i,) + (jnp.int32(0),) * x.ndim
+    )
+    return {"Out": [out]}
+
+
+@register_op("read_from_array", inputs=("X", "I"), outputs=("Out",),
+             no_grad=("I",))
+def _read_from_array(ctx, op, ins):
+    arr = ins["X"][0]
+    i = _scalar_i(ins)
+    return {"Out": [lax.dynamic_index_in_dim(arr, i, axis=0, keepdims=False)]}
+
+
+@register_op("lod_array_length", inputs=("X",), outputs=("Out",),
+             stop_gradient=True)
+def _lod_array_length(ctx, op, ins):
+    # dense arrays have fixed capacity; the reference returns the grown
+    # length — loops here are bounded by capacity, so they coincide for
+    # fully-written arrays.
+    return {"Out": [jnp.asarray([ins["X"][0].shape[0]], jnp.int64)]}
+
+
+@register_op("lod_rank_table", inputs=("X", "Length"), outputs=("Out",),
+             stop_gradient=True)
+def _lod_rank_table(ctx, op, ins):
+    x = ins["X"][0]
+    b = x.shape[0]
+    if ins.get("Length"):
+        lengths = ins["Length"][0].astype(jnp.int64).reshape(b)
+    else:
+        t = x.shape[1] if x.ndim > 1 else 1
+        lengths = jnp.full((b,), t, jnp.int64)
+    # stable sort by descending length: reference sorts (idx, len) pairs
+    order = jnp.argsort(-lengths, stable=True)
+    return {"Out": [jnp.stack([order.astype(jnp.int64), lengths[order]], 1)]}
+
+
+@register_op("reorder_lod_tensor_by_rank", inputs=("X", "RankTable"),
+             outputs=("Out",), no_grad=("RankTable",))
+def _reorder_by_rank(ctx, op, ins):
+    x = ins["X"][0]
+    order = ins["RankTable"][0][:, 0].astype(jnp.int32)
+    return {"Out": [jnp.take(x, order, axis=0)]}
+
+
+@register_op("shrink_rnn_memory", inputs=("X", "RankTable", "I"),
+             outputs=("Out",), no_grad=("RankTable", "I"))
+def _shrink_rnn_memory(ctx, op, ins):
+    # reference slices the first k rows still active at step I (rows are
+    # rank-sorted by length); dense form freezes finished rows to zero
+    # so shapes stay static.
+    x = ins["X"][0]
+    i = _scalar_i(ins)
+    lengths = ins["RankTable"][0][:, 1]
+    active = (lengths > i.astype(lengths.dtype)).astype(x.dtype)
+    return {"Out": [x * active.reshape((-1,) + (1,) * (x.ndim - 1))]}
+
+
+@register_op("rnn_memory_helper", inputs=("X",), outputs=("Out",))
+def _rnn_memory_helper(ctx, op, ins):
+    return {"Out": [ins["X"][0]]}
+
+
+def _col_mask(mask, x):
+    m = jnp.reshape(mask, (-1,)).astype(bool)
+    return m.reshape((-1,) + (1,) * (x.ndim - 1))
+
+
+@register_op("split_lod_tensor", inputs=("X", "Mask"),
+             outputs=("OutTrue", "OutFalse"), no_grad=("Mask",))
+def _split_lod_tensor(ctx, op, ins):
+    x = ins["X"][0]
+    m = _col_mask(ins["Mask"][0], x)
+    z = jnp.zeros_like(x)
+    return {"OutTrue": [jnp.where(m, x, z)], "OutFalse": [jnp.where(m, z, x)]}
+
+
+def _merge_lod(ctx, op, ins):
+    t, f = ins["InTrue"][0], ins["InFalse"][0]
+    m = _col_mask(ins["Mask"][0], t)
+    return {"Out": [jnp.where(m, t, f.astype(t.dtype))]}
+
+
+register_op("merge_lod_tensor", inputs=("X", "Mask", "InTrue", "InFalse"),
+            outputs=("Out",), no_grad=("X", "Mask"))(_merge_lod)
+register_op("merge_lod_tensor_infer",
+            inputs=("X", "Mask", "InTrue", "InFalse"), outputs=("Out",),
+            no_grad=("X", "Mask"))(_merge_lod)
+
+
+@register_op("array_to_lod_tensor", inputs=("X", "RankTable"),
+             outputs=("Out",), no_grad=("RankTable",))
+def _array_to_lod_tensor(ctx, op, ins):
+    # stacked array is time-major [T, B, ...]; the dense LoDTensor form
+    # is batch-major padded [B, T, ...] with rank-table order undone.
+    arr = ins["X"][0]
+    out = jnp.swapaxes(arr, 0, 1)
+    if ins.get("RankTable"):
+        order = ins["RankTable"][0][:, 0].astype(jnp.int32)
+        inv = jnp.argsort(order)
+        out = jnp.take(out, inv, axis=0)
+    return {"Out": [out]}
+
+
+@register_op("lod_tensor_to_array", inputs=("X", "RankTable"),
+             outputs=("Out",), no_grad=("RankTable",))
+def _lod_tensor_to_array(ctx, op, ins):
+    x = ins["X"][0]
+    if ins.get("RankTable"):
+        order = ins["RankTable"][0][:, 0].astype(jnp.int32)
+        x = jnp.take(x, order, axis=0)
+    return {"Out": [jnp.swapaxes(x, 0, 1)]}
+
+
+@register_op("tensor_array_to_tensor", inputs=("X",),
+             outputs=("Out", "OutIndex"))
+def _tensor_array_to_tensor(ctx, op, ins):
+    arr = ins["X"][0]
+    axis = int(op.attrs.get("axis", 0))
+    if axis < 0:
+        axis += arr.ndim - 1  # normalize against the ELEMENT rank
+    if bool(op.attrs.get("use_stack", False)):
+        out = jnp.moveaxis(arr, 0, axis) if axis else arr
+        sizes = jnp.ones((arr.shape[0],), jnp.int32)
+    else:
+        out = jnp.concatenate(list(arr), axis=axis)
+        sizes = jnp.full((arr.shape[0],), arr.shape[1 + axis], jnp.int32)
+    return {"Out": [out], "OutIndex": [sizes]}
+
+
+@register_op("select_input", inputs=("X", "Mask"), outputs=("Out",),
+             no_grad=("Mask",))
+def _select_input(ctx, op, ins):
+    branches = jnp.stack(ins["X"], 0)
+    i = _scalar_i(ins, "Mask")
+    return {"Out": [lax.dynamic_index_in_dim(branches, i, 0, keepdims=False)]}
+
+
+@register_op("select_output", inputs=("X", "Mask"), outputs=("Out",),
+             no_grad=("Mask",))
+def _select_output(ctx, op, ins):
+    # route X to output[mask]; unselected branches get zeros (static
+    # shapes — the reference leaves them uninitialized)
+    x = ins["X"][0]
+    i = _scalar_i(ins, "Mask")
+    n = len(op.outputs.get("Out", [])) or 1
+    outs = [
+        jnp.where(jnp.equal(i, k), x, jnp.zeros_like(x)) for k in range(n)
+    ]
+    return {"Out": outs}
+
+
+@register_op("get_places", inputs=(), outputs=("Out",), stop_gradient=True)
+def _get_places(ctx, op, ins):
+    n = int(op.attrs.get("device_count", 0)) or len(jax.devices())
+    return {"Out": [jnp.arange(n, dtype=jnp.int32)]}

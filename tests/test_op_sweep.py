@@ -63,6 +63,8 @@ SPECS = {
     "l2_normalize": spec({"X": F(2, 3)}, {"axis": 1}, grads=["X"]),
     "norm": spec({"X": F(2, 3)}, {"axis": 1}),
     "diag": spec({"Diagonal": F(4)}),
+    "rnn_memory_helper": spec({"X": F(2, 3)}, grads=["X"]),
+    "get_places": spec({}, {"device_count": 2}),
     # binary / comparison / logical
     "elementwise_floordiv": spec({"X": I32(2, 3, hi=9) + 1, "Y": I32(2, 3, hi=3) + 1}),
     "elementwise_min": spec({"X": F(2, 3), "Y": F(2, 3)}, grads=["X"]),
@@ -476,6 +478,12 @@ COVERED_ELSEWHERE = {
     'merge_selected_rows', 'get_tensor_from_selected_rows',
     'dgc',  # tests/test_dgc.py
     'local_sgd_select',  # tests/test_zero_localsgd.py
+    # tensor-array / rank-table family: tests/test_ops_lod.py
+    'write_to_array', 'read_from_array', 'lod_array_length',
+    'lod_rank_table', 'reorder_lod_tensor_by_rank', 'shrink_rnn_memory',
+    'split_lod_tensor', 'merge_lod_tensor', 'merge_lod_tensor_infer',
+    'array_to_lod_tensor', 'lod_tensor_to_array', 'tensor_array_to_tensor',
+    'select_input', 'select_output',
 }
 
 

@@ -1,0 +1,228 @@
+"""Tensor-array / rank-table op tests (ops/lod.py).
+
+Reference tests: tests/unittests/test_lod_array_length_op.py,
+test_lod_rank_table.py, test_shrink_rnn_memory.py,
+test_split_and_merge_lod_tensor_op.py, test_tensor_array_to_tensor.py,
+test_reorder_lod_tensor.py.
+"""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from op_test import OpTest
+
+
+def _run(main, startup, feed, fetch):
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        return exe.run(main, feed=feed, fetch_list=fetch)
+
+
+class TestWriteReadArray(OpTest):
+    op_type = "write_to_array"
+    x = np.random.randn(2, 3).astype("float32")
+    arr = np.zeros((4, 2, 3), "float32")
+    expect = arr.copy()
+    expect[1] = x
+    inputs = {"X": x, "I": np.array([1], "int64"), "Array": arr}
+    outputs = {"Out": expect}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestReadArray(OpTest):
+    op_type = "read_from_array"
+    arr = np.random.randn(4, 2, 3).astype("float32")
+    inputs = {"X": arr, "I": np.array([2], "int64")}
+    outputs = {"Out": arr[2]}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestLodRankTable(OpTest):
+    op_type = "lod_rank_table"
+    x = np.random.randn(4, 5).astype("float32")
+    lengths = np.array([2, 5, 3, 5], "int64")
+    # stable descending sort: rows 1,3 (len 5), 2 (len 3), 0 (len 2)
+    expect = np.array([[1, 5], [3, 5], [2, 3], [0, 2]], "int64")
+    inputs = {"X": x, "Length": lengths}
+    outputs = {"Out": expect}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestReorderByRank(OpTest):
+    op_type = "reorder_lod_tensor_by_rank"
+    x = np.random.randn(4, 5).astype("float32")
+    table = np.array([[1, 5], [3, 5], [2, 3], [0, 2]], "int64")
+    inputs = {"X": x, "RankTable": table}
+    outputs = {"Out": x[[1, 3, 2, 0]]}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestShrinkRnnMemory(OpTest):
+    op_type = "shrink_rnn_memory"
+    x = np.random.randn(4, 3).astype("float32")
+    table = np.array([[1, 5], [3, 5], [2, 3], [0, 2]], "int64")
+    i = np.array([2], "int64")
+    expect = x * (table[:, 1] > 2).astype("float32")[:, None]
+    inputs = {"X": x, "RankTable": table, "I": i}
+    outputs = {"Out": expect}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestSplitMergeLodTensor(OpTest):
+    op_type = "split_lod_tensor"
+    x = np.random.randn(4, 3).astype("float32")
+    mask = np.array([[1], [0], [1], [0]], "bool")
+    inputs = {"X": x, "Mask": mask}
+    outputs = {
+        "OutTrue": x * mask.astype("float32"),
+        "OutFalse": x * (~mask).astype("float32"),
+    }
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestMergeLodTensor(OpTest):
+    op_type = "merge_lod_tensor"
+    t = np.random.randn(4, 3).astype("float32")
+    f = np.random.randn(4, 3).astype("float32")
+    mask = np.array([[1], [0], [1], [0]], "bool")
+    inputs = {"X": t, "Mask": mask, "InTrue": t, "InFalse": f}
+    outputs = {"Out": np.where(mask, t, f)}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestArrayToLodTensor(OpTest):
+    op_type = "array_to_lod_tensor"
+    arr = np.random.randn(5, 4, 3).astype("float32")  # [T, B, d]
+    table = np.array([[1, 5], [3, 5], [2, 3], [0, 2]], "int64")
+    perm = np.argsort([1, 3, 2, 0])
+    inputs = {"X": arr, "RankTable": table}
+    outputs = {"Out": arr.transpose(1, 0, 2)[perm]}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestLodTensorToArray(OpTest):
+    op_type = "lod_tensor_to_array"
+    x = np.random.randn(4, 5, 3).astype("float32")  # [B, T, d]
+    table = np.array([[1, 5], [3, 5], [2, 3], [0, 2]], "int64")
+    inputs = {"X": x, "RankTable": table}
+    outputs = {"Out": x[[1, 3, 2, 0]].transpose(1, 0, 2)}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestTensorArrayToTensorStack(OpTest):
+    op_type = "tensor_array_to_tensor"
+    arr = np.random.randn(3, 2, 4).astype("float32")
+    inputs = {"X": arr}
+    attrs = {"axis": 0, "use_stack": True}
+    outputs = {"Out": arr, "OutIndex": np.ones(3, "int32")}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestTensorArrayToTensorConcat(OpTest):
+    op_type = "tensor_array_to_tensor"
+    arr = np.random.randn(3, 2, 4).astype("float32")
+    inputs = {"X": arr}
+    attrs = {"axis": 1, "use_stack": False}
+    outputs = {
+        "Out": np.concatenate(list(arr), axis=1),
+        "OutIndex": np.full(3, 4, "int32"),
+    }
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestSelectInput(OpTest):
+    op_type = "select_input"
+    a = np.random.randn(2, 3).astype("float32")
+    b = np.random.randn(2, 3).astype("float32")
+    inputs = {"X": [a, b], "Mask": np.array([1], "int32")}
+    outputs = {"Out": b}
+
+    def test_output(self):
+        self.check_output()
+
+
+def test_array_write_read_loop():
+    """layers-level API: write T slices into an array inside a While
+    loop, read them back (the reference DynamicRNN decode pattern)."""
+    main, startup = fluid.Program(), fluid.Program()
+    T = 4
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[T, 3], append_batch_size=False)
+        arr = layers.create_array("float32", T, [3])
+        i = layers.fill_constant([1], "int64", 0)
+        n = layers.fill_constant([1], "int64", T)
+        cond = layers.less_than(i, n)
+        loop = layers.While(cond)
+        with loop.block():
+            xi = layers.array_read(x, i)  # x as dense array [T, 3]
+            arr = layers.array_write(xi, i, array=arr)
+            layers.increment(i, 1.0)
+            layers.less_than(i, n, cond=cond)
+    xv = np.random.randn(T, 3).astype("float32")
+    (out,) = _run(main, startup, {"x": xv}, [arr])
+    np.testing.assert_allclose(out, xv, rtol=1e-6)
+
+
+def test_array_write_grad_exact():
+    """In-place array writes must REPLACE the grad-map entry, not sum
+    with it (double-count regression): d mean(arr)/dh == 1/numel."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        h = layers.data(name="h", shape=[2, 3], append_batch_size=False)
+        arr = layers.create_array("float32", 2, [3])
+        for t in range(2):
+            it = layers.fill_constant([1], "int64", t)
+            arr = layers.array_write(layers.array_read(h, it), it, array=arr)
+        loss = layers.mean(arr)
+        (g,) = fluid.gradients(loss, [h])
+    hv = np.random.randn(2, 3).astype("float32")
+    (gv,) = _run(main, startup, {"h": hv}, [g])
+    np.testing.assert_allclose(
+        np.asarray(gv), np.full((2, 3), 1 / 6, "float32"), rtol=1e-5
+    )
+
+
+def test_select_output_routes():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        block = main.global_block()
+        x = layers.data(name="x", shape=[2, 3], append_batch_size=False)
+        m = layers.fill_constant([1], "int32", 1.0)
+        o0 = block.create_var(name="o0")
+        o1 = block.create_var(name="o1")
+        block.append_op(
+            type="select_output", inputs={"X": [x], "Mask": [m]},
+            outputs={"Out": [o0, o1]},
+        )
+    xv = np.random.randn(2, 3).astype("float32")
+    r0, r1 = _run(main, startup, {"x": xv}, [o0, o1])
+    np.testing.assert_allclose(r1, xv, rtol=1e-6)
+    np.testing.assert_allclose(r0, np.zeros_like(xv))
