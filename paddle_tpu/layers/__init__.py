@@ -20,6 +20,7 @@ from .collective import (
 )
 from .detection import iou_similarity, box_coder, prior_box
 from .sequence import *  # noqa: F401,F403
+from .py_func_registry import py_func
 from .rnn import (
     dynamic_lstm,
     dynamic_gru,

@@ -1,0 +1,46 @@
+"""Callable registry for the py_func op (reference py_func_op.cc keeps
+a global vector of py::objects indexed by callable id; pybind looks
+them up at kernel time). Here the executor's lowering resolves ids via
+this module, and jax.pure_callback hosts the call."""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List
+
+_CALLABLES: List[Callable] = []
+
+
+def register_callable(fn: Callable) -> int:
+    _CALLABLES.append(fn)
+    return len(_CALLABLES) - 1
+
+
+def get_callable(fid: int) -> Callable:
+    return _CALLABLES[fid]
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Layer API (reference fluid.layers.py_func): run `func` on the
+    host over x, producing `out` (Variables with declared shape/dtype
+    — pure_callback needs static result shapes)."""
+    from ..layer_helper import LayerHelper
+
+    helper = LayerHelper("py_func")
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    fid = register_callable(func)
+    attrs = {
+        "forward_callable_id": fid,
+        "out_shapes": [list(o.shape or ()) for o in outs],
+        "out_dtypes": [str(o.dtype) for o in outs],
+    }
+    if backward_func is not None:
+        # backward_func(*x_values, *out_grad_values) -> grads per x
+        attrs["backward_callable_id"] = register_callable(backward_func)
+    helper.append_op(
+        type="py_func",
+        inputs={"X": list(xs)},
+        outputs={"Out": list(outs)},
+        attrs=attrs,
+    )
+    return out

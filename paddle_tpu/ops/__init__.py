@@ -20,3 +20,7 @@ from . import detection  # noqa: F401
 from . import metrics  # noqa: F401
 from . import beam  # noqa: F401
 from . import lod  # noqa: F401
+from . import fused  # noqa: F401
+from . import vision3d  # noqa: F401
+from . import dist_compute  # noqa: F401
+from . import misc  # noqa: F401

@@ -1,0 +1,182 @@
+"""Pure-compute distributed helper ops + var-lifecycle ops.
+
+Reference: operators/distributed_ops/split_ids_op.cc, merge_ids_op.cc,
+split_byref_op.cc, ref_by_trainer_id_op.cc, split_selected_rows_op.cc,
+distributed_ops/distributed_lookup_table_op.cc,
+lookup_sparse_table_op.cc, distributed_ops/fake_init_op.cc,
+delete_var_op.cc, coalesce_tensor_op.cc.
+
+The RPC legs of the reference PS path (send/recv/listen_and_serv) live
+OUTSIDE the compiled program in this framework (ps/ runtime + the
+transpiler orchestrate them host-side — SURVEY §2f P5); these ops are
+the parts that are genuinely tensor compute, lowered with static
+shapes: shard routing keeps full-length outputs with zero/sentinel
+padding instead of compaction (XLA static-shape idiom; sums restore
+exact merge semantics).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from ..core.selected_rows import SelectedRows
+
+
+@register_op("split_ids", inputs=("Ids",), outputs=("Out",),
+             stop_gradient=True)
+def _split_ids(ctx, op, ins):
+    """Route ids to N shards by id % N. Static-shape form: every shard
+    output keeps the input length; slots not owned by the shard hold
+    sentinel -1 (scatter/gather consumers drop out-of-range rows)."""
+    ids = ins["Ids"][0].reshape(-1)
+    n = len(op.outputs.get("Out", [])) or 1
+    outs = []
+    for k in range(n):
+        mine = (ids % n) == k
+        outs.append(jnp.where(mine, ids, -1))
+    return {"Out": outs}
+
+
+@register_op("merge_ids", inputs=("Ids", "Rows", "X"), outputs=("Out",),
+             no_grad=("Ids", "Rows"))
+def _merge_ids(ctx, op, ins):
+    """Inverse of split_ids + per-shard lookup: each X[k] holds rows for
+    the ids split_ids routed to shard k (padded convention: full length,
+    zero rows for not-owned). The merge is a sum — exact because every
+    position is owned by exactly one shard."""
+    xs = ins["X"]
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return {"Out": [out]}
+
+
+@register_op("split_byref", inputs=("X",), outputs=("Out",))
+def _split_byref(ctx, op, ins):
+    # contiguous row sections (reference split_byref_op.cc; the PS param
+    # splitter). section_rows attr or equal split over N outputs.
+    x = ins["X"][0]
+    n = len(op.outputs.get("Out", [])) or 1
+    sections = list(op.attrs.get("sections", []))
+    if not sections:
+        # equal split, remainder to the last section (reference
+        # splitter semantics — no rows may be dropped)
+        base = x.shape[0] // n
+        sections = [base] * (n - 1) + [x.shape[0] - base * (n - 1)]
+    outs = []
+    start = 0
+    for k in range(n):
+        rows = int(sections[k])
+        outs.append(x[start: start + rows])
+        start += rows
+    return {"Out": outs}
+
+
+@register_op("ref_by_trainer_id", inputs=("X", "TrainerId"),
+             outputs=("Out",), no_grad=("TrainerId",))
+def _ref_by_trainer_id(ctx, op, ins):
+    # pick X[trainer_id] (reference ref_by_trainer_id_op.cc — per-
+    # trainer learning-rate blocks on the pserver)
+    tid = ins["TrainerId"][0].reshape(()).astype(jnp.int32)
+    stacked = jnp.stack(ins["X"], 0)
+    return {"Out": [jax.lax.dynamic_index_in_dim(stacked, tid, 0,
+                                                 keepdims=False)]}
+
+
+@register_op("split_selected_rows", inputs=("X",), outputs=("Out",),
+             stop_gradient=True)
+def _split_selected_rows(ctx, op, ins):
+    """Split a SelectedRows by height sections (reference
+    split_selected_rows_op.cc). Static form: each shard keeps all N
+    slots; rows outside its section become out-of-range sentinels that
+    XLA scatter drops on apply."""
+    x = ins["X"][0]
+    assert isinstance(x, SelectedRows), "split_selected_rows needs SelectedRows"
+    n = len(op.outputs.get("Out", [])) or 1
+    sections = list(op.attrs.get("height_sections", []))
+    if not sections:
+        sections = [x.height // n] * n
+    outs = []
+    start = 0
+    for k in range(n):
+        h = int(sections[k])
+        owned = (x.rows >= start) & (x.rows < start + h)
+        # rebase rows into the shard's local index space; disowned -> -1
+        local = jnp.where(owned, x.rows - start, -1)
+        vals = jnp.where(owned.reshape((-1,) + (1,) * (x.values.ndim - 1)),
+                         x.values, 0)
+        outs.append(SelectedRows(local, vals, h))
+        start += h
+    return {"Out": outs}
+
+
+@register_op("distributed_lookup_table", inputs=("W", "Ids"),
+             outputs=("Outputs",), no_grad=("Ids",))
+def _distributed_lookup_table(ctx, op, ins):
+    """Multi-input embedding lookup (reference
+    distributed_lookup_table_op.cc). The RPC prefetch leg is handled by
+    the PS communicator host-side; inside the program the lookup is a
+    local gather on the (prefetched or fully-sharded) table."""
+    w = ins["W"][0]
+    outs = []
+    for ids in ins["Ids"]:
+        shape = ids.shape
+        flat = jnp.take(w, ids.reshape(-1), axis=0)
+        outs.append(flat.reshape(tuple(shape[:-1]) + (w.shape[-1],))
+                    if shape and shape[-1] == 1
+                    else flat.reshape(tuple(shape) + (w.shape[-1],)))
+    return {"Outputs": outs}
+
+
+@register_op("lookup_sparse_table", inputs=("W", "Ids"), outputs=("Out",),
+             no_grad=("Ids",))
+def _lookup_sparse_table(ctx, op, ins):
+    # auto-grown sparse table lookup (reference lookup_sparse_table_op):
+    # unseen ids read as init value; dense table form reads zeros-init
+    # rows, so a plain gather is exact.
+    w, ids = ins["W"][0], ins["Ids"][0]
+    flat = ids.reshape(-1)
+    return {"Out": [jnp.take(w, flat, axis=0)]}
+
+
+@register_op("fake_init", inputs=(), outputs=("Out",), stop_gradient=True)
+def _fake_init(ctx, op, ins):
+    # reference fake_init_op.cc: declare a var without materializing it
+    # (trainer-side placeholder for pserver-owned params); dense form
+    # must produce a value — zeros of the declared shape.
+    shape = [int(s) for s in op.attrs.get("shape", [1])]
+    return {"Out": [jnp.zeros(shape, jnp.float32)]}
+
+
+@register_op("delete_var", inputs=("X",), outputs=(), stop_gradient=True)
+def _delete_var(ctx, op, ins):
+    # explicit free (reference delete_var_op.cc). Lifetimes inside a
+    # compiled block are XLA's problem; scope-level deletion happens in
+    # Scope.erase — nothing to lower.
+    return {}
+
+
+@register_op("coalesce_tensor", inputs=("Input",),
+             outputs=("Output", "FusedOutput"))
+def _coalesce_tensor(ctx, op, ins):
+    """Pack N tensors into one contiguous fused buffer + return aligned
+    views (reference coalesce_tensor_op.cc, the fuse_all_reduce
+    building block). XLA owns layout, so the fused buffer is a concat
+    of flattened inputs and the views are exact reshapes of its
+    slices."""
+    xs = ins["Input"]
+    flat = [x.reshape(-1) for x in xs]
+    fused = jnp.concatenate(flat) if len(flat) > 1 else flat[0]
+    if bool(op.attrs.get("set_constant", False)):
+        # views alias the constant-filled fused space (reference makes
+        # Outputs sub-tensors of the fused buffer)
+        fused = jnp.full_like(fused, float(op.attrs.get("constant", 0.0)))
+    outs = []
+    off = 0
+    for x in xs:
+        n = x.size
+        outs.append(jax.lax.dynamic_slice(fused, (off,), (n,)).reshape(x.shape))
+        off += n
+    return {"Output": outs, "FusedOutput": [fused]}

@@ -1,0 +1,397 @@
+"""Fused ops (reference operators/fused/ — hand-written CUDA/MKL fusion
+kernels: conv_fusion_op.cu, fused_fc_elementwise_layernorm_op.cu,
+multihead_matmul_op.cu, fusion_lstm_op.cc, fusion_gru_op.cc,
+fused_embedding_seq_pool_op.cc, fused_elemwise_activation_op.cc,
+fusion_seq*_op.cc, fusion_repeated_fc_relu_op.cc,
+fusion_squared_mat_sub_op.cc, fusion_transpose_flatten_concat_op.cc,
+fc_op.cc).
+
+TPU-native stance: XLA fuses elementwise chains into matmul/conv
+epilogues automatically, so these lowerings express the SAME fused
+capability as plain compositions — the op types exist for program
+parity (inference graphs from the reference's fuse passes name them),
+while the fusion itself is the compiler's job. The compositions keep
+the matmuls large and batched (one projection matmul per op, MXU
+shaped), which is the part that actually matters on TPU.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op, get_op_def
+
+
+_UNARY = {
+    "relu": jax.nn.relu,
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "gelu": jax.nn.gelu,
+    "identity": lambda x: x,
+    "": lambda x: x,
+}
+_BINARY = {
+    "elementwise_add": jnp.add,
+    "elementwise_sub": jnp.subtract,
+    "elementwise_mul": jnp.multiply,
+}
+
+
+def _act(name):
+    return _UNARY[str(name or "identity").lower()]
+
+
+def _fc_compute(x, w, bias, in_num_col_dims=1, act=None):
+    import math
+
+    lead = x.shape[:in_num_col_dims]
+    x2 = x.reshape((math.prod(lead) if lead else 1, -1))
+    out = x2 @ w
+    if bias is not None:
+        out = out + bias.reshape((1, -1))
+    out = _act(act)(out)
+    return out.reshape(tuple(lead) + (w.shape[1],))
+
+
+@register_op("fc", inputs=("Input", "W", "Bias"), outputs=("Out",))
+def _fc(ctx, op, ins):
+    bias = ins["Bias"][0] if ins.get("Bias") else None
+    return {"Out": [_fc_compute(
+        ins["Input"][0], ins["W"][0], bias,
+        int(op.attrs.get("in_num_col_dims", 1)),
+        op.attrs.get("activation_type", ""),
+    )]}
+
+
+@register_op("fused_elemwise_activation", inputs=("X", "Y"),
+             outputs=("Out", "IntermediateOut"))
+def _fused_elemwise_activation(ctx, op, ins):
+    # functor_list = [outer, inner]; forms: binary(X, unary(Y)) or
+    # unary(binary(X, Y)) — reference fused_elemwise_activation_op.h
+    x, y = ins["X"][0], ins["Y"][0]
+    outer, inner = list(op.attrs.get("functor_list", ["elementwise_add", ""]))
+    has_scale = "scale" in op.attrs
+    scale = float(op.attrs.get("scale", 1.0))
+
+    def apply_unary(name, v):
+        if name.startswith("scale"):
+            # explicit scale attr wins even at 0.0 (falsy)
+            return v * (scale if has_scale else 1.0)
+        return _act(name)(v)
+
+    if outer in _BINARY:
+        mid = apply_unary(inner, y)
+        out = _BINARY[outer](x, mid)
+    else:
+        mid = _BINARY[inner](x, y)
+        out = apply_unary(outer, mid)
+    return {"Out": [out], "IntermediateOut": [mid]}
+
+
+@register_op("fused_embedding_seq_pool", inputs=("W", "Ids"),
+             outputs=("Out",), no_grad=("Ids",))
+def _fused_embedding_seq_pool(ctx, op, ins):
+    w, ids = ins["W"][0], ins["Ids"][0]
+    ids = ids.reshape(ids.shape[0], -1)  # [B, T]
+    emb = jnp.take(w, ids, axis=0)  # [B, T, H]
+    pad = op.attrs.get("padding_idx", None)
+    if pad is not None and int(pad) >= 0:
+        keep = (ids != int(pad))[..., None].astype(emb.dtype)
+        emb = emb * keep
+    combiner = str(op.attrs.get("combiner", "sum")).lower()
+    out = jnp.mean(emb, 1) if combiner == "mean" else jnp.sum(emb, 1)
+    return {"Out": [out]}
+
+
+@register_op("fused_fc_elementwise_layernorm",
+             inputs=("X", "W", "Bias0", "Y", "Scale", "Bias1"),
+             outputs=("Out", "Mean", "Variance"))
+def _fused_fc_elementwise_layernorm(ctx, op, ins):
+    bias0 = ins["Bias0"][0] if ins.get("Bias0") else None
+    h = _fc_compute(ins["X"][0], ins["W"][0], bias0,
+                    int(op.attrs.get("x_num_col_dims", 1)))
+    h = h + ins["Y"][0]
+    axis = int(op.attrs.get("begin_norm_axis", 1))
+    eps = float(op.attrs.get("epsilon", 1e-5))
+    red = tuple(range(axis, h.ndim))
+    mean = jnp.mean(h, axis=red, keepdims=True)
+    var = jnp.var(h, axis=red, keepdims=True)
+    norm = (h - mean) * jax.lax.rsqrt(var + eps)
+    if ins.get("Scale"):
+        norm = norm * ins["Scale"][0]
+    if ins.get("Bias1"):
+        norm = norm + ins["Bias1"][0]
+    norm = _act(op.attrs.get("activation_type", ""))(norm)
+    return {"Out": [norm], "Mean": [mean.reshape(-1)],
+            "Variance": [var.reshape(-1)]}
+
+
+@register_op("fused_batch_norm_act",
+             inputs=("X", "Scale", "Bias", "Mean", "Variance"),
+             outputs=("Y", "MeanOut", "VarianceOut", "SavedMean",
+                      "SavedVariance", "ReserveSpace"),
+             no_grad=("Mean", "Variance"))
+def _fused_batch_norm_act(ctx, op, ins):
+    bn = get_op_def("batch_norm").lower(ctx, op, ins)
+    act = _act(op.attrs.get("act_type", "relu"))
+    bn["Y"] = [act(bn["Y"][0])]
+    bn["ReserveSpace"] = [jnp.zeros((0,), jnp.float32)]
+    return bn
+
+
+def _delegate(op, attrs=None):
+    class _P:
+        __slots__ = ("type", "attrs", "inputs", "outputs")
+    p = _P()
+    p.type = op.type
+    p.attrs = dict(op.attrs) if attrs is None else attrs
+    p.inputs, p.outputs = op.inputs, op.outputs
+    return p
+
+
+@register_op("fusion_lstm",
+             inputs=("X", "WeightX", "WeightH", "Bias", "H0", "C0", "Length"),
+             outputs=("Hidden", "Cell", "XX", "BatchedInput", "BatchedHidden",
+                      "BatchedCell", "ReorderedH0", "ReorderedC0",
+                      "CheckedCell"),
+             no_grad=("Length",))
+def _fusion_lstm(ctx, op, ins):
+    r = get_op_def("fused_lstm").lower(ctx, _delegate(op), ins)
+    x, wx = ins["X"][0], ins["WeightX"][0]
+    xx = jnp.einsum("btd,dk->btk", x, wx)
+    if ins.get("Bias"):
+        xx = xx + ins["Bias"][0]
+    H = ins["WeightH"][0].shape[0]
+    B = x.shape[0]
+    z = lambda v: v if v is not None else jnp.zeros((B, H), x.dtype)
+    return {
+        "Hidden": r["Hidden"], "Cell": r["Cell"], "XX": [xx],
+        "BatchedInput": [xx], "BatchedHidden": r["Hidden"],
+        "BatchedCell": r["Cell"],
+        "ReorderedH0": [z(ins["H0"][0] if ins.get("H0") else None)],
+        "ReorderedC0": [z(ins["C0"][0] if ins.get("C0") else None)],
+        "CheckedCell": [jnp.zeros((2, H), x.dtype)],
+    }
+
+
+@register_op("fusion_gru",
+             inputs=("X", "H0", "WeightX", "WeightH", "Bias", "Length"),
+             outputs=("ReorderedH0", "XX", "BatchedInput", "BatchedOut",
+                      "Hidden"),
+             no_grad=("Length",))
+def _fusion_gru(ctx, op, ins):
+    r = get_op_def("fused_gru").lower(ctx, _delegate(op), ins)
+    x, wx = ins["X"][0], ins["WeightX"][0]
+    xx = jnp.einsum("btd,dk->btk", x, wx)
+    if ins.get("Bias"):
+        xx = xx + ins["Bias"][0]
+    H = ins["WeightH"][0].shape[0]
+    B = x.shape[0]
+    h0 = ins["H0"][0] if ins.get("H0") else jnp.zeros((B, H), x.dtype)
+    return {"ReorderedH0": [h0], "XX": [xx], "BatchedInput": [xx],
+            "BatchedOut": r["Hidden"], "Hidden": r["Hidden"]}
+
+
+@register_op("fused_embedding_fc_lstm",
+             inputs=("Ids", "Embeddings", "WeightH", "Bias", "H0", "C0"),
+             outputs=("Hidden", "Cell", "XX", "BatchedInput", "BatchedHidden",
+                      "BatchedCell", "ReorderedH0", "ReorderedC0"),
+             no_grad=("Ids",))
+def _fused_embedding_fc_lstm(ctx, op, ins):
+    # Embeddings [vocab, 4H] ARE the pre-projected x@Wx (+bias folded by
+    # the reference's fuse pass) — lookup replaces the input matmul.
+    ids = ins["Ids"][0].reshape(ins["Ids"][0].shape[0], -1)  # [B, T]
+    emb = jnp.take(ins["Embeddings"][0], ids, axis=0)  # [B, T, 4H]
+    if ins.get("Bias"):
+        emb = emb + ins["Bias"][0]
+    wh = ins["WeightH"][0]
+    B, T, H4 = emb.shape
+    H = wh.shape[0]
+    h0 = ins["H0"][0] if ins.get("H0") else jnp.zeros((B, H), emb.dtype)
+    c0 = ins["C0"][0] if ins.get("C0") else jnp.zeros((B, H), emb.dtype)
+
+    def cell(carry, xp):
+        h, c = carry
+        gates = xp + h @ wh
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        c_new = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+        h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+        return (h_new, c_new), (h_new, c_new)
+
+    (_, _), (hs, cs) = jax.lax.scan(cell, (h0, c0), jnp.swapaxes(emb, 0, 1))
+    hid = jnp.swapaxes(hs, 0, 1)
+    cell_seq = jnp.swapaxes(cs, 0, 1)
+    return {"Hidden": [hid], "Cell": [cell_seq], "XX": [emb],
+            "BatchedInput": [emb], "BatchedHidden": [hid],
+            "BatchedCell": [cell_seq], "ReorderedH0": [h0],
+            "ReorderedC0": [c0]}
+
+
+@register_op("fusion_repeated_fc_relu", inputs=("X", "W", "Bias"),
+             outputs=("ReluOut", "Out"))
+def _fusion_repeated_fc_relu(ctx, op, ins):
+    x = ins["X"][0]
+    ws, bs = ins["W"], ins["Bias"]
+    relu_outs = []
+    for i, (w, b) in enumerate(zip(ws, bs)):
+        last = i == len(ws) - 1
+        x = _fc_compute(x, w, b, 1, None if last else "relu")
+        if not last:
+            relu_outs.append(x)
+    return {"ReluOut": relu_outs, "Out": [x]}
+
+
+@register_op("fusion_seqconv_eltadd_relu", inputs=("X", "Filter", "Bias"),
+             outputs=("Out", "ColMat"))
+def _fusion_seqconv_eltadd_relu(ctx, op, ins):
+    r = get_op_def("sequence_conv").lower(ctx, _delegate(op), ins)
+    out = jax.nn.relu(r["Out"][0] + ins["Bias"][0])
+    return {"Out": [out], "ColMat": [jnp.zeros((0,), out.dtype)]}
+
+
+@register_op("fusion_seqexpand_concat_fc", inputs=("X", "FCWeight", "FCBias"),
+             outputs=("Out", "FCOut"))
+def _fusion_seqexpand_concat_fc(ctx, op, ins):
+    # X[0]: [B, T, D0] sequence; X[1:]: [B, Di] per-sequence vectors
+    # broadcast along T (reference seq_expand), concat, one fused fc.
+    seq = ins["X"][0]
+    B, T = seq.shape[0], seq.shape[1]
+    parts = [seq]
+    for v in ins["X"][1:]:
+        parts.append(jnp.broadcast_to(v[:, None, :], (B, T, v.shape[-1])))
+    cat = jnp.concatenate(parts, axis=-1)
+    bias = ins["FCBias"][0] if ins.get("FCBias") else None
+    out = _fc_compute(cat, ins["FCWeight"][0], bias, 2,
+                      op.attrs.get("fc_activation", ""))
+    return {"Out": [out], "FCOut": [out]}
+
+
+def _seq_pool(x, pooltype):
+    pt = str(pooltype).upper()
+    if pt == "SUM":
+        return jnp.sum(x, 1)
+    if pt == "AVERAGE":
+        return jnp.mean(x, 1)
+    if pt == "SQRT":
+        return jnp.sum(x, 1) / jnp.sqrt(float(x.shape[1]))
+    if pt == "MAX":
+        return jnp.max(x, 1)
+    if pt == "LAST":
+        return x[:, -1]
+    if pt == "FIRST":
+        return x[:, 0]
+    raise NotImplementedError(pt)
+
+
+@register_op("fusion_seqpool_concat", inputs=("X",), outputs=("Out",))
+def _fusion_seqpool_concat(ctx, op, ins):
+    pt = op.attrs.get("pooltype", "SUM")
+    return {"Out": [jnp.concatenate(
+        [_seq_pool(x, pt) for x in ins["X"]], axis=-1)]}
+
+
+@register_op("fusion_seqpool_cvm_concat", inputs=("X", "CVM"),
+             outputs=("Out",), no_grad=("CVM",))
+def _fusion_seqpool_cvm_concat(ctx, op, ins):
+    pt = op.attrs.get("pooltype", "SUM")
+    use_cvm = bool(op.attrs.get("use_cvm", True))
+    pooled = []
+    for x in ins["X"]:
+        p = _seq_pool(x, pt)
+        if not use_cvm:
+            p = p[:, 2:]
+        pooled.append(p)
+    return {"Out": [jnp.concatenate(pooled, axis=-1)]}
+
+
+@register_op("fusion_squared_mat_sub", inputs=("X", "Y"),
+             outputs=("SquaredX", "SquaredY", "SquaredXY", "Out"))
+def _fusion_squared_mat_sub(ctx, op, ins):
+    # Out = scalar * ((X@Y)^2 - (X^2)@(Y^2)) — word2vec-style pairwise
+    # feature (reference fusion_squared_mat_sub_op.cc)
+    x, y = ins["X"][0], ins["Y"][0]
+    scalar = float(op.attrs.get("scalar", 1.0))
+    sx, sy = x * x, y * y
+    sxy = (x @ y) ** 2
+    return {"SquaredX": [sx], "SquaredY": [sy], "SquaredXY": [sxy],
+            "Out": [scalar * (sxy - sx @ sy)]}
+
+
+@register_op("fusion_transpose_flatten_concat", inputs=("X",),
+             outputs=("Out",))
+def _fusion_transpose_flatten_concat(ctx, op, ins):
+    trans = list(op.attrs.get("trans_axis", []))
+    flat = int(op.attrs.get("flatten_axis", 1))
+    cat = int(op.attrs.get("concat_axis", 1))
+    outs = []
+    for x in ins["X"]:
+        if trans:
+            x = jnp.transpose(x, trans)
+        lead = 1
+        for s in x.shape[:flat]:
+            lead *= s
+        outs.append(x.reshape(lead, -1))
+    return {"Out": [jnp.concatenate(outs, axis=cat % 2)]}
+
+
+@register_op("multihead_matmul", inputs=("Input", "W", "Bias", "BiasQK"),
+             outputs=("Out",), no_grad=("BiasQK",))
+def _multihead_matmul(ctx, op, ins):
+    """Fused QKV attention (reference fused/multihead_matmul_op.cu — the
+    inference transformer fusion produced by
+    ir/multihead_matmul_fuse_pass.cc). Input [B, S, D], W [D, 3, N, H]
+    combined QKV projection, Bias [3, N, H], BiasQK broadcastable to
+    [B, N, S, S]. One einsum per projection keeps the MXU busy; XLA
+    fuses softmax into the chain."""
+    x = ins["Input"][0]
+    w = ins["W"][0]
+    bias = ins["Bias"][0]
+    B, S, D = x.shape
+    _, three, N, H = w.shape
+    alpha = float(op.attrs.get("alpha", 1.0))
+    qkv = jnp.einsum("bsd,dcnh->cbnsh", x, w) + bias.reshape(3, 1, N, 1, H)
+    q, k, v = qkv[0], qkv[1], qkv[2]  # [B, N, S, H]
+    scores = jnp.einsum("bnsh,bnth->bnst", q, k) * alpha
+    if ins.get("BiasQK"):
+        scores = scores + ins["BiasQK"][0]
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bnst,bnth->bnsh", probs, v)
+    return {"Out": [out.transpose(0, 2, 1, 3).reshape(B, S, N * H)]}
+
+
+@register_op("conv2d_fusion",
+             inputs=("Input", "Filter", "Bias", "ResidualData"),
+             outputs=("Output",))
+def _conv2d_fusion(ctx, op, ins):
+    # conv + bias + residual-add + activation (reference
+    # fused/conv_fusion_op.cu, cudnnConvolutionBiasActivationForward)
+    conv_ins = {"Input": ins["Input"], "Filter": ins["Filter"]}
+    r = get_op_def("conv2d").lower(ctx, _delegate(op), conv_ins)
+    out = r["Output"][0]
+    if ins.get("Bias"):
+        out = out + ins["Bias"][0].reshape((1, -1, 1, 1))
+    if ins.get("ResidualData"):
+        out = out + ins["ResidualData"][0]
+    return {"Output": [_act(op.attrs.get("activation", "relu"))(out)]}
+
+
+@register_op("conv2d_inception_fusion",
+             inputs=("Input", "Filter", "Bias"),
+             outputs=("Output", "TempOutput"))
+def _conv2d_inception_fusion(ctx, op, ins):
+    # 4 aggregated 1x1/3x3 branch convs + relu, channel-concat
+    # (reference fused/fusion_conv_inception_op.cu)
+    x = ins["Input"][0]
+    outs = []
+    for w, b in zip(ins["Filter"], ins["Bias"]):
+        kh, kw = w.shape[2], w.shape[3]
+        pad = [(kh // 2, kh // 2), (kw // 2, kw // 2)]
+        o = jax.lax.conv_general_dilated(
+            x, w, window_strides=(1, 1), padding=pad,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        )
+        o = jax.nn.relu(o + b.reshape((1, -1, 1, 1)))
+        outs.append(o)
+    return {"Output": [jnp.concatenate(outs, axis=1)],
+            "TempOutput": [jnp.zeros((0,), x.dtype)]}

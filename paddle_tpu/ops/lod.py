@@ -169,12 +169,14 @@ def _lod_tensor_to_array(ctx, op, ins):
 def _tensor_array_to_tensor(ctx, op, ins):
     arr = ins["X"][0]
     axis = int(op.attrs.get("axis", 0))
-    if axis < 0:
-        axis += arr.ndim - 1  # normalize against the ELEMENT rank
     if bool(op.attrs.get("use_stack", False)):
+        if axis < 0:
+            axis += arr.ndim  # stack output rank == element rank + 1
         out = jnp.moveaxis(arr, 0, axis) if axis else arr
         sizes = jnp.ones((arr.shape[0],), jnp.int32)
     else:
+        if axis < 0:
+            axis += arr.ndim - 1  # normalize against the ELEMENT rank
         out = jnp.concatenate(list(arr), axis=axis)
         sizes = jnp.full((arr.shape[0],), arr.shape[1 + axis], jnp.int32)
     return {"Out": [out], "OutIndex": [sizes]}

@@ -1,0 +1,330 @@
+"""Misc op-gap closers: shape aliases, sampling, matching/text ops,
+py_func host callback.
+
+Reference: operators/flatten_op.cc (flatten), squeeze_op.cc,
+unsqueeze_op.cc, fill_zeros_like_op.cc (fill_zeros_like2),
+cross_entropy_op.cc (cross_entropy2), gaussian_random_batch_size_like
+(gaussian_random_op.cc), sample_logits_op.cc, similarity_focus_op.cc,
+filter_by_instag_op.cc, pyramid_hash_op.cc, match_matrix_tensor_op.cc,
+tree_conv_op.cc, var_conv_2d_op.cc, py_func_op.cc.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op, get_op_def
+
+
+@register_op("flatten", inputs=("X",), outputs=("Out",))
+def _flatten(ctx, op, ins):
+    x = ins["X"][0]
+    axis = int(op.attrs.get("axis", 1))
+    lead = math.prod(x.shape[:axis]) if axis else 1
+    return {"Out": [x.reshape(lead, -1)]}
+
+
+@register_op("squeeze", inputs=("X",), outputs=("Out",))
+def _squeeze(ctx, op, ins):
+    x = ins["X"][0]
+    axes = [int(a) for a in op.attrs.get("axes", [])]
+    if not axes:
+        shape = [s for s in x.shape if s != 1]
+    else:
+        axes = [a % x.ndim for a in axes]
+        shape = [s for i, s in enumerate(x.shape)
+                 if not (i in axes and s == 1)]
+    return {"Out": [x.reshape(shape or (1,))]}
+
+
+@register_op("unsqueeze", inputs=("X",), outputs=("Out",))
+def _unsqueeze(ctx, op, ins):
+    x = ins["X"][0]
+    out = x
+    for a in sorted(int(a) for a in op.attrs.get("axes", [0])):
+        out = jnp.expand_dims(out, a)
+    return {"Out": [out]}
+
+
+@register_op("fill_zeros_like2", inputs=("X",), outputs=("Out",),
+             stop_gradient=True)
+def _fill_zeros_like2(ctx, op, ins):
+    return {"Out": [jnp.zeros_like(ins["X"][0])]}
+
+
+@register_op("cross_entropy2", inputs=("X", "Label"),
+             outputs=("Y", "MatchX", "XShape"), no_grad=("Label",))
+def _cross_entropy2(ctx, op, ins):
+    # hard-label-only CE that also outputs the matched probability
+    # (reference cross_entropy_op.cc CrossEntropyOp2)
+    x, label = ins["X"][0], ins["Label"][0]
+    idx = label.reshape(label.shape[0], -1)[:, 0].astype(jnp.int32)
+    probs = jnp.take_along_axis(
+        x.reshape(x.shape[0], -1), idx[:, None], axis=1)
+    ce = -jnp.log(jnp.maximum(probs, 1e-20))
+    return {"Y": [ce], "MatchX": [probs],
+            "XShape": [jnp.asarray(x.shape, jnp.int32)]}
+
+
+@register_op("gaussian_random_batch_size_like", inputs=("Input",),
+             outputs=("Out",), stop_gradient=True)
+def _gaussian_random_batch_size_like(ctx, op, ins):
+    ref = ins["Input"][0]
+    shape = [int(s) for s in op.attrs.get("shape", [1])]
+    in_idx = int(op.attrs.get("input_dim_idx", 0))
+    out_idx = int(op.attrs.get("output_dim_idx", 0))
+    shape[out_idx] = ref.shape[in_idx]
+    mean = float(op.attrs.get("mean", 0.0))
+    std = float(op.attrs.get("std", 1.0))
+    return {"Out": [mean + std * jax.random.normal(
+        ctx.op_key(op), tuple(shape), jnp.float32)]}
+
+
+@register_op("sample_logits",
+             inputs=("Logits", "Labels", "CustomizedSamples",
+                     "CustomizedProbabilities"),
+             outputs=("Samples", "Probabilities", "LogitsDim", "LabelsDim",
+                      "SampledLogits", "SampledLabels"),
+             no_grad=("Labels", "CustomizedSamples",
+                      "CustomizedProbabilities"))
+def _sample_logits(ctx, op, ins):
+    """Sampled-softmax support (reference sample_logits_op.cc): gather
+    the true-label logits plus num_samples uniformly sampled negative
+    classes; remapped labels index into the sampled set."""
+    logits, labels = ins["Logits"][0], ins["Labels"][0]
+    B, C = logits.shape
+    labels = labels.reshape(B, -1)
+    nt = labels.shape[1]
+    ns = int(op.attrs.get("num_samples", 5))
+    if ins.get("CustomizedSamples"):
+        neg = ins["CustomizedSamples"][0].reshape(B, -1)[:, nt:]
+        probs_neg = ins["CustomizedProbabilities"][0].reshape(B, -1)[:, nt:]
+    else:
+        neg = jax.random.randint(ctx.op_key(op), (B, ns), 0, C)
+        probs_neg = jnp.full((B, ns), 1.0 / C, logits.dtype)
+    samples = jnp.concatenate([labels.astype(jnp.int64),
+                               neg.astype(jnp.int64)], 1)
+    probs = jnp.concatenate(
+        [jnp.full((B, nt), 1.0 / C, logits.dtype), probs_neg], 1)
+    sampled = jnp.take_along_axis(logits, samples.astype(jnp.int32), axis=1)
+    if bool(op.attrs.get("remove_accidental_hits", True)):
+        # negatives equal to a true label get -inf'd out
+        hit = (samples[:, None, nt:] == labels[:, :, None]).any(1)
+        mask = jnp.concatenate(
+            [jnp.zeros((B, nt), bool), hit], 1)
+        sampled = jnp.where(mask, jnp.asarray(-1e20, sampled.dtype), sampled)
+    return {
+        "Samples": [samples],
+        "Probabilities": [probs],
+        "LogitsDim": [jnp.asarray(logits.shape, jnp.int64)],
+        "LabelsDim": [jnp.asarray(labels.shape, jnp.int64)],
+        "SampledLogits": [sampled],
+        "SampledLabels": [jnp.broadcast_to(jnp.arange(nt, dtype=jnp.int64),
+                                           (B, nt))],
+    }
+
+
+@register_op("similarity_focus", inputs=("X",), outputs=("Out",),
+             stop_gradient=True)
+def _similarity_focus(ctx, op, ins):
+    """Similarity-focus mask (reference similarity_focus_op.cc): for
+    each selected channel of [B, C, A, B'] pick per-row and per-column
+    argmax cells; output is an indicator over the full X shape."""
+    x = ins["X"][0]
+    axis = int(op.attrs.get("axis", 1))
+    idxs = [int(i) for i in op.attrs.get("indexes", [0])]
+    assert axis == 1, "similarity_focus lowered for channel axis=1"
+    B, C, H, W = x.shape
+    mask = jnp.zeros_like(x)
+    for ci in idxs:
+        ch = x[:, ci]  # [B, H, W]
+        rmax = (ch == ch.max(axis=2, keepdims=True))
+        cmax = (ch == ch.max(axis=1, keepdims=True))
+        sel = (rmax | cmax).astype(x.dtype)  # [B, H, W]
+        mask = mask + sel[:, None, :, :]
+    return {"Out": [jnp.minimum(mask, 1.0)]}
+
+
+@register_op("filter_by_instag", inputs=("Ins", "Ins_tag", "Filter_tag"),
+             outputs=("Out", "LossWeight", "IndexMap"),
+             no_grad=("Ins_tag", "Filter_tag"))
+def _filter_by_instag(ctx, op, ins):
+    """Tag-based instance filter (reference filter_by_instag_op.cc).
+    Dense static-shape form: rows whose tag misses the filter are
+    zeroed and get LossWeight 0 (the reference compacts; masking keeps
+    shapes static and is loss-equivalent when the consumer weights by
+    LossWeight)."""
+    x = ins["Ins"][0]
+    tags = ins["Ins_tag"][0].reshape(x.shape[0], -1)
+    filt = ins["Filter_tag"][0].reshape(-1)
+    keep = (tags[:, :, None] == filt[None, None, :]).any((1, 2))
+    w = keep.astype(x.dtype)
+    out = x * w.reshape((-1,) + (1,) * (x.ndim - 1))
+    idx = jnp.arange(x.shape[0], dtype=jnp.int64)
+    return {"Out": [out], "LossWeight": [w.reshape(-1, 1)],
+            "IndexMap": [jnp.stack([idx, idx], 1)]}
+
+
+@register_op("pyramid_hash", inputs=("X", "W", "WhiteList", "BlackList"),
+             outputs=("Out", "DropPos", "X_Temp_Out"),
+             no_grad=("X", "WhiteList", "BlackList"))
+def _pyramid_hash(ctx, op, ins):
+    """Pyramid hashing embedding (reference pyramid_hash_op.cc): for
+    every n-gram (n = 2..pyramid_layer) of the int token sequence,
+    hash into [space_len] buckets and sum the looked-up rand_len-wide
+    embedding slices. Multiplicative hashing replaces the reference's
+    xxhash (in-framework consistency is what matters)."""
+    x = ins["X"][0].reshape(ins["X"][0].shape[0], -1)  # [B, T] int
+    w = ins["W"][0]  # [space_len + rand_len - 1? dense: space_len, rand]
+    layers = int(op.attrs.get("pyramid_layer", 2))
+    space = int(op.attrs.get("space_len", w.shape[0]))
+    B, T = x.shape
+    emb_dim = w.shape[1]
+    out = jnp.zeros((B, emb_dim), w.dtype)
+    xi = x.astype(jnp.uint32)
+    for n in range(2, max(layers + 1, 3)):
+        if n > T:
+            break
+        h = jnp.zeros((B, T - n + 1), jnp.uint32)
+        for j in range(n):
+            h = h * jnp.uint32(2654435761) + xi[:, j: T - n + 1 + j]
+        bucket = (h % jnp.uint32(space)).astype(jnp.int32)
+        out = out + jnp.take(w, bucket, axis=0).sum(1)
+    return {"Out": [out], "DropPos": [jnp.zeros((B, 1), jnp.int32)],
+            "X_Temp_Out": [x]}
+
+
+@register_op("match_matrix_tensor", inputs=("X", "Y", "W"),
+             outputs=("Out", "Tmp"))
+def _match_matrix_tensor(ctx, op, ins):
+    # bilinear match grid (reference match_matrix_tensor_op.cc):
+    # out[b,t,i,j] = x[b,i] . W[:,t,:] . y[b,j]
+    x, y, w = ins["X"][0], ins["Y"][0], ins["W"][0]
+    tmp = jnp.einsum("bid,dtk->btik", x, w)
+    out = jnp.einsum("btik,bjk->btij", tmp, y)
+    return {"Out": [out], "Tmp": [tmp]}
+
+
+@register_op("tree_conv", inputs=("NodesVector", "EdgeSet", "Filter"),
+             outputs=("Out",), no_grad=("EdgeSet",))
+def _tree_conv(ctx, op, ins):
+    """Tree-based convolution (TBCNN, reference tree_conv_op.cc).
+    NodesVector [B, N, D]; EdgeSet [B, E, 2] (parent, child) int pairs;
+    Filter [D, F, 3] — three mixing matrices (top/left/right) blended
+    per child by its normalized sibling position. Dense message
+    passing: one scatter-add per batch via vmap."""
+    nodes = ins["NodesVector"][0]
+    edges = ins["EdgeSet"][0].astype(jnp.int32)
+    filt = ins["Filter"][0]  # [D, F, 3]
+    B, N, D = nodes.shape
+    E = edges.shape[1]
+    wt, wl, wr = filt[..., 0], filt[..., 1], filt[..., 2]  # [D, F]
+
+    # per-edge position blend: child k of m siblings gets
+    # eta_l = (m-k)/(m-1), eta_r = (k-1)/(m-1) (single child: 0.5/0.5)
+    def one(bnodes, bedges):
+        parents, children = bedges[:, 0], bedges[:, 1]
+        # sibling index = rank of this edge among edges sharing a parent
+        same = parents[:, None] == parents[None, :]
+        earlier = same & (jnp.arange(E)[None, :] < jnp.arange(E)[:, None])
+        k = earlier.sum(1).astype(jnp.float32)          # 0-based sibling idx
+        m = same.sum(1).astype(jnp.float32)             # sibling count
+        denom = jnp.maximum(m - 1.0, 1.0)
+        eta_r = jnp.where(m > 1, k / denom, 0.5)
+        eta_l = 1.0 - eta_r
+        cvec = jnp.take(bnodes, children, axis=0)       # [E, D]
+        msg = (cvec @ wl) * eta_l[:, None] + (cvec @ wr) * eta_r[:, None]
+        agg = jnp.zeros((N, wl.shape[1]), nodes.dtype).at[parents].add(msg)
+        return jnp.tanh(bnodes @ wt + agg)
+
+    return {"Out": [jax.vmap(one)(nodes, edges)]}
+
+
+@register_op("var_conv_2d", inputs=("X", "ROW", "COLUMN", "W"),
+             outputs=("Out", "Col"), no_grad=("ROW", "COLUMN"))
+def _var_conv_2d(ctx, op, ins):
+    """Variable-size 2D conv (reference var_conv_2d_op.cc — the
+    match-pyramid conv over per-pair grids). Dense form: X is the
+    padded grid batch [B, C_in, H, W]; ROW/COLUMN carry per-sample
+    valid extents and mask the output."""
+    x = ins["X"][0]
+    w = ins["W"][0]  # [C_out, C_in * KH * KW]
+    cin = int(op.attrs.get("InputChannel", x.shape[1]))
+    cout = int(op.attrs.get("OutputChannel", w.shape[0]))
+    kh = int(op.attrs.get("KernelH", 3))
+    kw = int(op.attrs.get("KernelW", 3))
+    sh = int(op.attrs.get("StrideH", 1))
+    sw = int(op.attrs.get("StrideW", 1))
+    kern = w.reshape(cout, cin, kh, kw)
+    out = jax.lax.conv_general_dilated(
+        x, kern, window_strides=(sh, sw),
+        padding=[(kh // 2, kh // 2), (kw // 2, kw // 2)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+    )
+    if ins.get("ROW") and ins.get("COLUMN"):
+        rows = ins["ROW"][0].reshape(-1)
+        cols = ins["COLUMN"][0].reshape(-1)
+        hmask = jnp.arange(out.shape[2])[None, :] < rows[:, None]
+        wmask = jnp.arange(out.shape[3])[None, :] < cols[:, None]
+        out = out * hmask[:, None, :, None] * wmask[:, None, None, :]
+    return {"Out": [out], "Col": [jnp.zeros((0,), x.dtype)]}
+
+
+def _callback_results(shapes, dtypes):
+    return [
+        jax.ShapeDtypeStruct(tuple(int(d) for d in s), jnp.dtype(dt))
+        for s, dt in zip(shapes, dtypes)
+    ]
+
+
+@register_op("py_func", inputs=("X",), outputs=("Out",))
+def _py_func(ctx, op, ins):
+    """User python callback inside the program (reference py_func_op.cc
+    keeps a registry of callables; the op calls back into python).
+    TPU-native: jax.pure_callback — the host function runs outside the
+    compiled program with results fed back in, shapes declared by the
+    output vars' metadata via out_shapes/out_dtypes attrs.
+
+    Gradients come from the EXPLICIT py_func_grad lowering below (the
+    registry prefers a registered <type>_grad over auto-vjp, which
+    would fail: pure_callback is not reverse-differentiable); it calls
+    the layer's backward_func and raises if none was registered."""
+    from ..layers.py_func_registry import get_callable
+
+    fid = int(op.attrs.get("forward_callable_id", 0))
+    fn = get_callable(fid)
+    outs = jax.pure_callback(
+        lambda *a: fn(*a),
+        _callback_results(op.attrs.get("out_shapes", []),
+                          op.attrs.get("out_dtypes", ["float32"])),
+        *ins["X"],
+    )
+    return {"Out": list(outs)}
+
+
+@register_op("py_func_grad", inputs=("X", "Out@GRAD"),
+             outputs=("X@GRAD",))
+def _py_func_grad(ctx, op, ins):
+    """Host backward callback: backward_func(*x, *out_grads) returns
+    grads for each X (numpy arrays, same shapes/dtypes as X)."""
+    from ..layers.py_func_registry import get_callable
+
+    bid = op.attrs.get("backward_callable_id", None)
+    xs = ins.get("X", [])
+    if bid is None:
+        raise NotImplementedError(
+            "differentiating through py_func requires backward_func= "
+            "(host callbacks have no automatic vjp)"
+        )
+    fn = get_callable(int(bid))
+    shapes = [tuple(x.shape) for x in xs]
+    dtypes = [str(x.dtype) for x in xs]
+    grads = jax.pure_callback(
+        lambda *a: fn(*a),
+        _callback_results(shapes, dtypes),
+        *xs, *ins.get("Out@GRAD", []),
+    )
+    return {"X@GRAD": list(grads)}

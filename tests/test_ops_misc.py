@@ -1,0 +1,376 @@
+"""Misc/dist-compute/optimizer-extra op tests (ops/misc.py,
+ops/dist_compute.py, ops/optim.py additions).
+
+Reference tests: tests/unittests/test_sample_logits.py,
+test_match_matrix_tensor_op.py, test_tree_conv_op.py,
+test_split_ids_op.py, test_merge_ids_op.py, test_proximal_*_op.py,
+test_average_accumulates_op.py, test_py_func_op.py.
+"""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from op_test import OpTest
+
+rng = np.random.RandomState(5)
+
+
+class TestFlatten(OpTest):
+    op_type = "flatten"
+    x = rng.randn(2, 3, 4).astype("float32")
+    inputs = {"X": x}
+    attrs = {"axis": 2}
+    outputs = {"Out": x.reshape(6, 4)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestSqueeze(OpTest):
+    op_type = "squeeze"
+    x = rng.randn(2, 1, 3, 1).astype("float32")
+    inputs = {"X": x}
+    attrs = {"axes": [1]}
+    outputs = {"Out": x.reshape(2, 3, 1)}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestUnsqueeze(OpTest):
+    op_type = "unsqueeze"
+    x = rng.randn(2, 3).astype("float32")
+    inputs = {"X": x}
+    attrs = {"axes": [0, 2]}
+    outputs = {"Out": x.reshape(1, 2, 1, 3)}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestCrossEntropy2(OpTest):
+    op_type = "cross_entropy2"
+    p = np.array([[0.2, 0.5, 0.3], [0.7, 0.1, 0.2]], "float32")
+    lbl = np.array([[1], [0]], "int64")
+    inputs = {"X": p, "Label": lbl}
+    outputs = {
+        "Y": -np.log(np.array([[0.5], [0.7]], "float32")),
+        "MatchX": np.array([[0.5], [0.7]], "float32"),
+        "XShape": np.array([2, 3], "int32"),
+    }
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+
+class TestMatchMatrixTensor(OpTest):
+    op_type = "match_matrix_tensor"
+    x = rng.randn(2, 3, 4).astype("float32")
+    y = rng.randn(2, 5, 4).astype("float32")
+    w = rng.randn(4, 2, 4).astype("float32")
+    tmp = np.einsum("bid,dtk->btik", x, w)
+    inputs = {"X": x, "Y": y, "W": w}
+    attrs = {"dim_t": 2}
+    outputs = {"Out": np.einsum("btik,bjk->btij", tmp, y), "Tmp": tmp}
+
+    def test_output(self):
+        self.check_output(atol=1e-4, rtol=1e-4)
+
+    def test_grad(self):
+        self.check_grad(["X", "Y", "W"], "Out", max_relative_error=0.02)
+
+
+class TestTreeConvSingleChild(OpTest):
+    op_type = "tree_conv"
+    # node 1 has one child (node 2): eta_l = eta_r = 0.5
+    nodes = rng.randn(1, 3, 4).astype("float32")
+    edges = np.array([[[1, 2]]], "int32")
+    filt = rng.randn(4, 5, 3).astype("float32")
+
+    def test_output(self):
+        wt, wl, wr = self.filt[..., 0], self.filt[..., 1], self.filt[..., 2]
+        base = self.nodes[0] @ wt  # [3, 5]
+        child = self.nodes[0, 2]
+        base[1] += 0.5 * (child @ wl) + 0.5 * (child @ wr)
+        self.inputs = {"NodesVector": self.nodes, "EdgeSet": self.edges,
+                       "Filter": self.filt}
+        self.outputs = {"Out": np.tanh(base)[None]}
+        self.check_output(atol=1e-4, rtol=1e-4)
+
+
+class TestSplitMergeIds(OpTest):
+    op_type = "split_ids"
+    ids = np.array([3, 4, 7, 10], "int64")
+
+    def test_roundtrip(self):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            block = main.global_block()
+            iv = block.create_var(name="ids", shape=(4,), dtype="int64",
+                                  is_data=True)
+            o0 = block.create_var(name="o0")
+            o1 = block.create_var(name="o1")
+            block.append_op(type="split_ids", inputs={"Ids": [iv]},
+                            outputs={"Out": [o0, o1]})
+        exe = fluid.Executor(fluid.CPUPlace())
+        r0, r1 = exe.run(main, feed={"ids": self.ids}, fetch_list=[o0, o1])
+        # shard 0 owns even ids, shard 1 odd; others sentinel -1
+        np.testing.assert_array_equal(np.asarray(r0), [-1, 4, -1, 10])
+        np.testing.assert_array_equal(np.asarray(r1), [3, -1, 7, -1])
+
+
+class TestMergeIds(OpTest):
+    op_type = "merge_ids"
+    ids = np.array([[3], [4]], "int64")
+    x0 = np.array([[0, 0], [4.0, 4.5]], "float32")  # shard 0 rows
+    x1 = np.array([[3.0, 3.5], [0, 0]], "float32")  # shard 1 rows
+    inputs = {"Ids": ids, "Rows": ids, "X": [x0, x1]}
+    outputs = {"Out": x0 + x1}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestRefByTrainerId(OpTest):
+    op_type = "ref_by_trainer_id"
+    a = rng.randn(2, 3).astype("float32")
+    b = rng.randn(2, 3).astype("float32")
+    inputs = {"X": [a, b], "TrainerId": np.array([1], "int64")}
+    outputs = {"Out": b}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestCoalesceTensor(OpTest):
+    op_type = "coalesce_tensor"
+    a = rng.randn(2, 3).astype("float32")
+    b = rng.randn(4).astype("float32")
+    inputs = {"Input": [a, b]}
+    outputs = {
+        "Output": [a, b],
+        "FusedOutput": np.concatenate([a.ravel(), b.ravel()]),
+    }
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestProximalGD(OpTest):
+    op_type = "proximal_gd"
+    p = rng.randn(3, 4).astype("float32")
+    g = rng.randn(3, 4).astype("float32")
+    lr = np.array([0.1], "float32")
+    l1, l2 = 0.05, 0.05
+    prox = p - 0.1 * g
+    expect = np.sign(prox) * np.maximum(np.abs(prox) - 0.1 * l1, 0) / (1 + 0.1 * l2)
+    inputs = {"Param": p, "Grad": g, "LearningRate": lr}
+    attrs = {"l1": l1, "l2": l2}
+    outputs = {"ParamOut": expect}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+
+class TestProximalAdagrad(OpTest):
+    op_type = "proximal_adagrad"
+    p = rng.randn(3, 4).astype("float32")
+    m = np.abs(rng.randn(3, 4)).astype("float32") + 0.1
+    g = rng.randn(3, 4).astype("float32")
+    lr = np.array([0.1], "float32")
+    l1, l2 = 0.05, 0.05
+    m2 = m + g * g
+    elr = 0.1 / np.sqrt(m2)
+    prox = p - elr * g
+    expect = np.sign(prox) * np.maximum(np.abs(prox) - elr * l1, 0) / (1 + elr * l2)
+    inputs = {"Param": p, "Moment": m, "Grad": g, "LearningRate": lr}
+    attrs = {"l1": l1, "l2": l2}
+    outputs = {"ParamOut": expect, "MomentOut": m2}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+
+class TestDgcMomentum(OpTest):
+    op_type = "dgc_momentum"
+    p = rng.randn(3).astype("float32")
+    g = rng.randn(3).astype("float32")
+    v = rng.randn(3).astype("float32")
+    lr = np.array([0.1], "float32")
+
+    def test_pre_rampup_sgd(self):
+        self.inputs = {"Param": self.p, "Grad": self.g, "Velocity": self.v,
+                       "LearningRate": self.lr,
+                       "current_step": np.array([1.0], "float32"),
+                       "nranks": np.array([2.0], "float32")}
+        self.attrs = {"mu": 0.9, "rampup_begin_step": 10.0}
+        self.outputs = {"ParamOut": self.p - 0.1 * self.g / 2,
+                        "VelocityOut": self.v,
+                        "Grad_out": self.g / 2}
+        self.check_output(atol=1e-6)
+
+    def test_post_rampup_momentum(self):
+        v2 = 0.9 * self.v + self.g
+        self.inputs = {"Param": self.p, "Grad": self.g, "Velocity": self.v,
+                       "LearningRate": self.lr,
+                       "current_step": np.array([20.0], "float32"),
+                       "nranks": np.array([2.0], "float32")}
+        self.attrs = {"mu": 0.9, "rampup_begin_step": 10.0}
+        self.outputs = {"ParamOut": self.p - 0.1 * v2,
+                        "VelocityOut": v2, "Grad_out": self.g}
+        self.check_output(atol=1e-6)
+
+
+class TestAverageAccumulates(OpTest):
+    op_type = "average_accumulates"
+    p = rng.randn(4).astype("float32")
+    s1 = rng.randn(4).astype("float32")
+    s2 = rng.randn(4).astype("float32")
+    s3 = np.zeros(4, "float32")
+
+    def test_accumulate(self):
+        self.inputs = {
+            "param": self.p, "in_sum_1": self.s1, "in_sum_2": self.s2,
+            "in_sum_3": self.s3,
+            "in_num_accumulates": np.array([5], "int64"),
+            "in_old_num_accumulates": np.array([0], "int64"),
+            "in_num_updates": np.array([5], "int64"),
+        }
+        self.attrs = {"average_window": 0.5, "max_average_window": 100,
+                      "min_average_window": 100}
+        self.outputs = {
+            "out_sum_1": self.s1 + self.p, "out_sum_2": self.s2,
+            "out_sum_3": self.s3,
+            "out_num_accumulates": np.array([6], "int64"),
+            "out_old_num_accumulates": np.array([0], "int64"),
+            "out_num_updates": np.array([6], "int64"),
+        }
+        self.check_output(atol=1e-5)
+
+    def test_window_rollover(self):
+        self.inputs = {
+            "param": self.p, "in_sum_1": self.s1, "in_sum_2": self.s2,
+            "in_sum_3": self.s3,
+            "in_num_accumulates": np.array([9], "int64"),
+            "in_old_num_accumulates": np.array([0], "int64"),
+            "in_num_updates": np.array([9], "int64"),
+        }
+        self.attrs = {"average_window": 1.0, "max_average_window": 10,
+                      "min_average_window": 1}
+        z = np.zeros(4, "float32")
+        self.outputs = {
+            "out_sum_1": z, "out_sum_2": z,
+            "out_sum_3": self.s1 + self.p + self.s2,
+            "out_num_accumulates": np.array([0], "int64"),
+            "out_old_num_accumulates": np.array([10], "int64"),
+            "out_num_updates": np.array([10], "int64"),
+        }
+        self.check_output(atol=1e-5)
+
+
+def test_py_func_layer():
+    """py_func: host callback through jax.pure_callback."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[2, 3], append_batch_size=False)
+        out = main.global_block().create_var(
+            name="pf_out", shape=(2, 3), dtype="float32")
+        layers.py_func(lambda a: np.asarray(a) * 2 + 1, x, out)
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = rng.randn(2, 3).astype("float32")
+    (r,) = exe.run(main, feed={"x": xv}, fetch_list=[out])
+    np.testing.assert_allclose(np.asarray(r), xv * 2 + 1, rtol=1e-6)
+
+
+def test_sample_logits_shapes():
+    from paddle_tpu.core.registry import get_op_def
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        block = main.global_block()
+        lg = block.create_var(name="lg", shape=(4, 10), dtype="float32",
+                              is_data=True)
+        lb = block.create_var(name="lb", shape=(4, 1), dtype="int64",
+                              is_data=True)
+        outs = {n: [block.create_var(name=f"sl_{n}")] for n in
+                ("Samples", "Probabilities", "LogitsDim", "LabelsDim",
+                 "SampledLogits", "SampledLabels")}
+        block.append_op(
+            type="sample_logits", inputs={"Logits": [lg], "Labels": [lb]},
+            outputs=outs, attrs={"num_samples": 3})
+    exe = fluid.Executor(fluid.CPUPlace())
+    logits = rng.randn(4, 10).astype("float32")
+    labels = rng.randint(0, 10, (4, 1)).astype("int64")
+    samples, sampled = exe.run(
+        main, feed={"lg": logits, "lb": labels},
+        fetch_list=[outs["Samples"][0], outs["SampledLogits"][0]])
+    samples = np.asarray(samples)
+    sampled = np.asarray(sampled)
+    assert samples.shape == (4, 4)  # 1 true + 3 sampled
+    assert sampled.shape == (4, 4)
+    # true-label logits occupy column 0
+    np.testing.assert_allclose(
+        sampled[:, 0], logits[np.arange(4), labels[:, 0]], rtol=1e-6)
+
+
+def test_split_selected_rows():
+    """Shard a sparse embedding grad by height sections; rebased local
+    rows + zeroed disowned slices, summed reconstruction is exact."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        ids = layers.data("ids", [3], dtype="int64")
+        emb = layers.embedding(ids, size=[8, 4], is_sparse=True)
+        loss = layers.reduce_sum(emb)
+        fluid.optimizer.SGD(0.0).minimize(loss)
+        block = main.global_block()
+        gname = [v for v in block.vars if v.endswith(".w_0@GRAD")][0]
+        s0 = block.create_var(name="shard0", stop_gradient=True)
+        s1 = block.create_var(name="shard1", stop_gradient=True)
+        block.append_op(
+            type="split_selected_rows", inputs={"X": [gname]},
+            outputs={"Out": [s0, s1]}, attrs={"height_sections": [4, 4]})
+        d0 = block.create_var(name="dense0", stop_gradient=True)
+        d1 = block.create_var(name="dense1", stop_gradient=True)
+        for s, d in ((s0, d0), (s1, d1)):
+            m = block.create_var(name=s.name + "_m", stop_gradient=True)
+            block.append_op(type="merge_selected_rows", inputs={"X": [s]},
+                            outputs={"Out": [m]})
+            block.append_op(type="get_tensor_from_selected_rows",
+                            inputs={"X": [m]}, outputs={"Out": [d]})
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        r0, r1 = exe.run(
+            main, feed={"ids": np.array([[1, 5, 5]], "int64")},
+            fetch_list=[d0, d1])
+    r0, r1 = np.asarray(r0), np.asarray(r1)
+    assert r0.shape == (4, 4) and r1.shape == (4, 4)
+    np.testing.assert_allclose(r0[1], np.ones(4), rtol=1e-6)  # id 1 -> shard0 row1
+    np.testing.assert_allclose(r1[1], 2 * np.ones(4), rtol=1e-6)  # id 5 twice -> shard1 row1
+    assert np.abs(r0).sum() == 4 and np.abs(r1).sum() == 8
+
+
+def test_py_func_backward():
+    """py_func with backward_func: custom host gradient flows."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[2, 3], append_batch_size=False)
+        out = main.global_block().create_var(
+            name="pfb_out", shape=(2, 3), dtype="float32",
+            stop_gradient=False)
+        layers.py_func(
+            lambda a: np.asarray(a) ** 2,
+            x, out,
+            backward_func=lambda a, g: 2.0 * np.asarray(a) * np.asarray(g),
+        )
+        loss = layers.mean(out)
+        (gx,) = fluid.gradients(loss, [x])
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = rng.randn(2, 3).astype("float32")
+    (gv,) = exe.run(main, feed={"x": xv}, fetch_list=[gx])
+    np.testing.assert_allclose(np.asarray(gv), 2 * xv / 6, rtol=1e-5)

@@ -1,0 +1,234 @@
+"""Non-fused RNN op tests: lstm/gru/lstmp/cudnn_lstm/attention_lstm
+(ops/rnn.py additions) vs numpy step oracles.
+
+Reference tests: tests/unittests/test_lstm_op.py, test_gru_op.py,
+test_lstmp_op.py, test_lstm_cudnn_op.py.
+"""
+
+import numpy as np
+
+from op_test import OpTest
+
+rng = np.random.RandomState(11)
+sig = lambda v: 1 / (1 + np.exp(-v))
+
+
+def lstm_ref(xp, wh, h0, c0):
+    """xp [B,T,4H] pre-projected; i,f,g,o gate order."""
+    B, T, H4 = xp.shape
+    H = H4 // 4
+    h, c = h0.copy(), c0.copy()
+    hs, cs = [], []
+    for t in range(T):
+        g = xp[:, t] + h @ wh
+        i, f, gg, o = np.split(g, 4, axis=-1)
+        c = sig(f) * c + sig(i) * np.tanh(gg)
+        h = sig(o) * np.tanh(c)
+        hs.append(h.copy())
+        cs.append(c.copy())
+    return np.stack(hs, 1), np.stack(cs, 1)
+
+
+class TestLstm(OpTest):
+    op_type = "lstm"
+    B, T, H = 2, 4, 3
+    xp = rng.randn(B, T, 4 * H).astype("float32")
+    wh = rng.randn(H, 4 * H).astype("float32")
+    h0 = rng.randn(B, H).astype("float32")
+    c0 = rng.randn(B, H).astype("float32")
+    hid, cell = lstm_ref(xp, wh, h0, c0)
+    inputs = {"Input": xp, "H0": h0, "C0": c0, "Weight": wh}
+    outputs = {"Hidden": hid, "Cell": cell, "BatchGate": xp,
+               "BatchCellPreAct": cell}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+    def test_grad(self):
+        self.check_grad(["Input", "Weight"], "Hidden",
+                        max_relative_error=0.02)
+
+
+class TestGru(OpTest):
+    op_type = "gru"
+    B, T, H = 2, 4, 3
+    xp = rng.randn(B, T, 3 * H).astype("float32")
+    wh = rng.randn(H, 3 * H).astype("float32")
+    h0 = rng.randn(B, H).astype("float32")
+
+    def _ref(self, origin):
+        h = self.h0.copy()
+        H = self.H
+        hs = []
+        for t in range(self.T):
+            xp = self.xp[:, t]
+            rz = sig(xp[:, : 2 * H] + h @ self.wh[:, : 2 * H])
+            r, z = rz[:, :H], rz[:, H:]
+            c = np.tanh(xp[:, 2 * H:] + (r * h) @ self.wh[:, 2 * H:])
+            h = z * h + (1 - z) * c if origin else (1 - z) * h + z * c
+            hs.append(h.copy())
+        return np.stack(hs, 1)
+
+    def test_output(self):
+        hid = self._ref(False)
+        self.inputs = {"Input": self.xp, "H0": self.h0, "Weight": self.wh}
+        self.outputs = {"Hidden": hid}
+        self.check_output(atol=1e-5, no_check_set=(
+            "BatchGate", "BatchResetHiddenPrev", "BatchHidden"))
+
+    def test_output_origin_mode(self):
+        hid = self._ref(True)
+        self.inputs = {"Input": self.xp, "H0": self.h0, "Weight": self.wh}
+        self.attrs = {"origin_mode": True}
+        self.outputs = {"Hidden": hid}
+        self.check_output(atol=1e-5, no_check_set=(
+            "BatchGate", "BatchResetHiddenPrev", "BatchHidden"))
+
+
+class TestLstmp(OpTest):
+    op_type = "lstmp"
+    B, T, H, P = 2, 3, 4, 2
+    xp = rng.randn(B, T, 4 * H).astype("float32")
+    wh = rng.randn(P, 4 * H).astype("float32")
+    wp = rng.randn(H, P).astype("float32")
+
+    def test_output(self):
+        h = np.zeros((self.B, self.P), "float32")
+        c = np.zeros((self.B, self.H), "float32")
+        ps, cs = [], []
+        for t in range(self.T):
+            g = self.xp[:, t] + h @ self.wh
+            i, f, gg, o = np.split(g, 4, axis=-1)
+            c = sig(f) * c + sig(i) * np.tanh(gg)
+            hh = sig(o) * np.tanh(c)
+            h = hh @ self.wp
+            ps.append(h.copy())
+            cs.append(c.copy())
+        self.inputs = {"Input": self.xp, "Weight": self.wh,
+                       "ProjWeight": self.wp}
+        self.outputs = {"Projection": np.stack(ps, 1), "Cell": np.stack(cs, 1)}
+        self.check_output(atol=1e-5, no_check_set=(
+            "BatchGate", "BatchCellPreAct", "BatchHidden"))
+
+
+class TestCudnnLstm(OpTest):
+    op_type = "cudnn_lstm"
+    T, B, D, H = 4, 2, 3, 5
+    x = rng.randn(T, B, D).astype("float32")
+    wx = rng.randn(D, 4 * H).astype("float32")
+    wh = rng.randn(H, 4 * H).astype("float32")
+    b1 = rng.randn(4 * H).astype("float32")
+    b2 = rng.randn(4 * H).astype("float32")
+    w = np.concatenate([wx.ravel(), wh.ravel(), b1, b2])
+
+    def test_output(self):
+        xp = np.einsum("tbd,dk->tbk", self.x, self.wx) + self.b1 + self.b2
+        hid, cell = lstm_ref(
+            xp.transpose(1, 0, 2), self.wh,
+            np.zeros((self.B, self.H), "float32"),
+            np.zeros((self.B, self.H), "float32"),
+        )
+        self.inputs = {"Input": self.x, "W": self.w}
+        self.attrs = {"hidden_size": self.H}
+        self.outputs = {
+            "Out": hid.transpose(1, 0, 2),
+            "last_h": hid[:, -1][None],
+            "last_c": cell[:, -1][None],
+        }
+        self.check_output(atol=1e-4, rtol=1e-4)
+
+    def test_bidirectional_shapes(self):
+        import paddle_tpu as fluid
+
+        w2 = np.concatenate([self.w, self.w])
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            block = main.global_block()
+            xv = block.create_var(name="x", shape=self.x.shape,
+                                  dtype="float32", is_data=True)
+            wv = block.create_var(name="w", shape=w2.shape, dtype="float32",
+                                  is_data=True)
+            out = block.create_var(name="out")
+            lh = block.create_var(name="lh")
+            lc = block.create_var(name="lc")
+            block.append_op(
+                type="cudnn_lstm", inputs={"Input": [xv], "W": [wv]},
+                outputs={"Out": [out], "last_h": [lh], "last_c": [lc]},
+                attrs={"hidden_size": self.H, "is_bidirec": True},
+            )
+        exe = fluid.Executor(fluid.CPUPlace())
+        o, h, c = exe.run(main, feed={"x": self.x, "w": w2},
+                          fetch_list=[out, lh, lc])
+        assert np.asarray(o).shape == (self.T, self.B, 2 * self.H)
+        assert np.asarray(h).shape == (2, self.B, self.H)
+        assert np.asarray(c).shape == (2, self.B, self.H)
+
+
+class TestAttentionLstm(OpTest):
+    op_type = "attention_lstm"
+    B, T, M, D = 2, 3, 4, 5
+
+    def test_output(self):
+        # reference semantics: attention keyed on prev CELL with relu
+        # scoring + scalar stage; lstm weight [D+M, 4D] hidden-rows-
+        # first with gate order {forget, input, output, candidate}
+        x = rng.randn(self.B, self.T, self.M).astype("float32")
+        aw = rng.randn(self.M + self.D, 1).astype("float32")
+        scal = np.array([[1.3]], "float32")
+        scal_b = np.array([[0.2]], "float32")
+        lw = rng.randn(self.D + self.M, 4 * self.D).astype("float32")
+        wh, wx = lw[: self.D], lw[self.D:]
+        h = np.zeros((self.B, self.D), "float32")
+        c = np.zeros((self.B, self.D), "float32")
+        hs, cs = [], []
+        for _ in range(self.T):
+            scores = x @ aw[: self.M, 0] + (c @ aw[self.M:, 0])[:, None]
+            scores = np.maximum(scores, 0)
+            scores = np.maximum(scores * scal[0, 0] + scal_b[0, 0], 0)
+            e = np.exp(scores - scores.max(-1, keepdims=True))
+            probs = e / e.sum(-1, keepdims=True)
+            att = np.einsum("bt,btm->bm", probs, x)
+            g = att @ wx + h @ wh
+            f, i, o, gg = np.split(g, 4, axis=-1)
+            c = sig(f) * c + sig(i) * np.tanh(gg)
+            h = sig(o) * np.tanh(c)
+            hs.append(h.copy())
+            cs.append(c.copy())
+        self.inputs = {"X": x, "AttentionWeight": aw,
+                       "AttentionScalar": scal,
+                       "AttentionScalarBias": scal_b, "LSTMWeight": lw}
+        self.outputs = {"Hidden": np.stack(hs, 1), "Cell": np.stack(cs, 1)}
+        self.check_output(atol=1e-4, rtol=1e-4, no_check_set=(
+            "AttentionedX", "AttentionFCOut", "LSTMX", "LSTMOUT"))
+
+
+class TestLstmPeephole(OpTest):
+    op_type = "lstm"
+    B, T, H = 2, 3, 4
+
+    def test_output(self):
+        # 7H bias: 4H gate bias ++ W_ic, W_fc, W_oc diagonals
+        xp = rng.randn(self.B, self.T, 4 * self.H).astype("float32")
+        wh = rng.randn(self.H, 4 * self.H).astype("float32")
+        bias = rng.randn(7 * self.H).astype("float32")
+        gb, w_ic, w_fc, w_oc = np.split(bias, [4 * self.H, 5 * self.H,
+                                               6 * self.H])
+        h = np.zeros((self.B, self.H), "float32")
+        c = np.zeros((self.B, self.H), "float32")
+        hs, cs = [], []
+        for t in range(self.T):
+            g = xp[:, t] + gb + h @ wh
+            i, f, gg, o = np.split(g, 4, axis=-1)
+            i = i + w_ic * c
+            f = f + w_fc * c
+            c = sig(f) * c + sig(i) * np.tanh(gg)
+            o = o + w_oc * c
+            h = sig(o) * np.tanh(c)
+            hs.append(h.copy())
+            cs.append(c.copy())
+        self.inputs = {"Input": xp, "Weight": wh,
+                       "Bias": bias.reshape(1, -1)}
+        self.attrs = {"use_peepholes": True}
+        self.outputs = {"Hidden": np.stack(hs, 1), "Cell": np.stack(cs, 1)}
+        self.check_output(atol=1e-5, no_check_set=(
+            "BatchGate", "BatchCellPreAct"))

@@ -1,0 +1,198 @@
+"""3D conv/pool + index-pool + interpolation op tests (ops/vision3d.py).
+
+Reference tests: tests/unittests/test_conv3d_op.py, test_pool3d_op.py,
+test_pool_max_op.py, test_unpool_op.py, test_trilinear_interp_op.py,
+test_conv3d_transpose_op.py.
+"""
+
+import numpy as np
+
+from op_test import OpTest
+
+rng = np.random.RandomState(3)
+
+
+def _conv3d_ref(x, w, stride=1, pad=0):
+    n, cin, D, H, W = x.shape
+    cout, _, kd, kh, kw = w.shape
+    xp = np.pad(x, ((0, 0), (0, 0), (pad, pad), (pad, pad), (pad, pad)))
+    od = (D + 2 * pad - kd) // stride + 1
+    oh = (H + 2 * pad - kh) // stride + 1
+    ow = (W + 2 * pad - kw) // stride + 1
+    out = np.zeros((n, cout, od, oh, ow), "float32")
+    for d in range(od):
+        for i in range(oh):
+            for j in range(ow):
+                patch = xp[:, :, d * stride:d * stride + kd,
+                           i * stride:i * stride + kh,
+                           j * stride:j * stride + kw]
+                out[:, :, d, i, j] = np.einsum("ncdhw,ocdhw->no", patch, w)
+    return out
+
+
+class TestConv3d(OpTest):
+    op_type = "conv3d"
+    x = rng.randn(2, 3, 5, 5, 5).astype("float32")
+    w = rng.randn(4, 3, 3, 3, 3).astype("float32")
+    inputs = {"Input": x, "Filter": w}
+    attrs = {"strides": [1, 1, 1], "paddings": [1, 1, 1]}
+    outputs = {"Output": _conv3d_ref(x, w, 1, 1)}
+
+    def test_output(self):
+        self.check_output(atol=1e-3, rtol=1e-3)
+
+    def test_grad(self):
+        # small shapes: the mean-loss FD signal shrinks as 1/numel and
+        # float32 noise dominates on the full-size case
+        self.inputs = {
+            "Input": rng.randn(1, 2, 3, 3, 3).astype("float32"),
+            "Filter": rng.randn(2, 2, 2, 2, 2).astype("float32"),
+        }
+        self.attrs = {"strides": [1, 1, 1], "paddings": [0, 0, 0]}
+        self.outputs = {"Output": np.zeros((1, 2, 2, 2, 2), "float32")}
+        # 0.04: float32 FD noise (reference whitelists conv tolerances
+        # the same way — op_accuracy_white_list.py)
+        self.check_grad(["Input", "Filter"], "Output",
+                        max_relative_error=0.04)
+
+
+class TestPool3dAvg(OpTest):
+    op_type = "pool3d"
+    x = rng.randn(2, 3, 4, 4, 4).astype("float32")
+    inputs = {"X": x}
+    attrs = {"pooling_type": "avg", "ksize": [2, 2, 2],
+             "strides": [2, 2, 2], "paddings": [0, 0, 0]}
+    outputs = {"Out": x.reshape(2, 3, 2, 2, 2, 2, 2, 2).mean((3, 5, 7))}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestMaxPool2dWithIndex(OpTest):
+    op_type = "max_pool2d_with_index"
+    x = rng.randn(2, 3, 4, 4).astype("float32")
+
+    def test_output(self):
+        x = self.x
+        n, c, h, w = x.shape
+        vals = np.zeros((n, c, 2, 2), "float32")
+        idx = np.zeros((n, c, 2, 2), "int32")
+        for i in range(2):
+            for j in range(2):
+                win = x[:, :, 2 * i:2 * i + 2, 2 * j:2 * j + 2].reshape(n, c, 4)
+                vals[:, :, i, j] = win.max(-1)
+                a = win.argmax(-1)
+                rows, cols = a // 2 + 2 * i, a % 2 + 2 * j
+                idx[:, :, i, j] = rows * w + cols
+        self.inputs = {"X": x}
+        self.attrs = {"ksize": [2, 2], "strides": [2, 2]}
+        self.outputs = {"Out": vals, "Mask": idx}
+        self.check_output()
+
+
+class TestMaxPool3dWithIndex(OpTest):
+    op_type = "max_pool3d_with_index"
+    x = rng.randn(1, 2, 4, 4, 4).astype("float32")
+
+    def test_output(self):
+        x = self.x
+        n, c = 1, 2
+        vals = np.zeros((n, c, 2, 2, 2), "float32")
+        for d in range(2):
+            for i in range(2):
+                for j in range(2):
+                    win = x[:, :, 2 * d:2 * d + 2, 2 * i:2 * i + 2,
+                            2 * j:2 * j + 2].reshape(n, c, 8)
+                    vals[:, :, d, i, j] = win.max(-1)
+        self.inputs = {"X": x}
+        self.attrs = {"ksize": [2, 2, 2], "strides": [2, 2, 2]}
+        self.outputs = {"Out": vals}
+        self.check_output(no_check_set=("Mask",))
+
+
+class TestUnpool(OpTest):
+    op_type = "unpool"
+    # pool 4x4 -> 2x2 with indices, then unpool back to 4x4
+    x = np.array([[[[5.0, 6.0], [7.0, 8.0]]]], "float32")
+    idx = np.array([[[[0, 3], [10, 13]]]], "int32")
+    expect = np.zeros((1, 1, 4, 4), "float32")
+    expect[0, 0, 0, 0] = 5
+    expect[0, 0, 0, 3] = 6
+    expect[0, 0, 2, 2] = 7
+    expect[0, 0, 3, 1] = 8
+    inputs = {"X": x, "Indices": idx}
+    attrs = {"ksize": [2, 2], "strides": [2, 2], "paddings": [0, 0]}
+    outputs = {"Out": expect}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestTrilinearInterp(OpTest):
+    op_type = "trilinear_interp"
+    x = rng.randn(1, 2, 2, 2, 2).astype("float32")
+
+    def test_output(self):
+        # doubling with align_corners=True: corners preserved
+        self.inputs = {"X": self.x}
+        self.attrs = {"out_d": 3, "out_h": 3, "out_w": 3,
+                      "align_corners": True}
+        from itertools import product
+
+        x = self.x
+        out = np.zeros((1, 2, 3, 3, 3), "float32")
+        coords = np.array([0.0, 0.5, 1.0])
+        for d, i, j in product(range(3), range(3), range(3)):
+            fd, fi, fj = coords[d], coords[i], coords[j]
+            ld, li, lj = int(np.floor(fd)), int(np.floor(fi)), int(np.floor(fj))
+            hd, hi, hj = min(ld + 1, 1), min(li + 1, 1), min(lj + 1, 1)
+            td, ti, tj = fd - ld, fi - li, fj - lj
+            acc = 0
+            for (a, wa) in ((ld, 1 - td), (hd, td)):
+                for (b, wb) in ((li, 1 - ti), (hi, ti)):
+                    for (cc, wc) in ((lj, 1 - tj), (hj, tj)):
+                        acc = acc + x[:, :, a, b, cc] * wa * wb * wc
+            out[:, :, d, i, j] = acc
+        self.outputs = {"Out": out}
+        self.check_output(atol=1e-5)
+
+    def test_grad(self):
+        self.inputs = {"X": self.x}
+        self.attrs = {"out_d": 3, "out_h": 3, "out_w": 3,
+                      "align_corners": True}
+        self.outputs = {"Out": np.zeros((1, 2, 3, 3, 3), "float32")}
+        self.check_grad(["X"], "Out")
+
+
+class TestConv3dTranspose(OpTest):
+    op_type = "conv3d_transpose"
+    # stride-1 no-pad 1x1x1 kernel: pure channel mixing, easy oracle
+    x = rng.randn(2, 3, 4, 4, 4).astype("float32")
+    w = rng.randn(3, 5, 1, 1, 1).astype("float32")
+    inputs = {"Input": x, "Filter": w}
+    attrs = {"strides": [1, 1, 1], "paddings": [0, 0, 0]}
+    outputs = {"Output": np.einsum("ncdhw,co->nodhw", x, w[:, :, 0, 0, 0])}
+
+    def test_output(self):
+        self.check_output(atol=1e-4, rtol=1e-4)
+
+
+class TestDepthwiseConv2dTranspose(OpTest):
+    op_type = "depthwise_conv2d_transpose"
+    x = rng.randn(2, 3, 4, 4).astype("float32")
+    w = rng.randn(3, 1, 1, 1).astype("float32")
+    inputs = {"Input": x, "Filter": w}
+    attrs = {"strides": [1, 1], "paddings": [0, 0], "groups": 3}
+    outputs = {"Output": x * w[:, 0, 0, 0].reshape(1, 3, 1, 1)}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+    def test_grad(self):
+        self.check_grad(["Input"], "Output")
