@@ -24,3 +24,4 @@ from . import fused  # noqa: F401
 from . import vision3d  # noqa: F401
 from . import dist_compute  # noqa: F401
 from . import misc  # noqa: F401
+from . import detection2  # noqa: F401

@@ -1,0 +1,543 @@
+"""Detection op family, part 2: deformable sampling, position-sensitive
+ROI pooling, perspective ROIs, mAP metric, target assignment/sampling.
+
+Reference: operators/deformable_conv_op.cc, deformable_conv_v1_op.cc,
+deformable_psroi_pooling_op.cc, psroi_pool_op.cc, prroi_pool_op.cc,
+detection/roi_perspective_transform_op.cc, detection_map_op.cc,
+detection/rpn_target_assign_op.cc (retinanet_target_assign),
+detection/generate_proposal_labels_op.cc.
+
+Dense TPU stance (same as ops/detection.py NMS): anything the reference
+emits with data-dependent row counts keeps FULL static extent here plus
+validity masks/weights — compaction is a host-side concern. Sampling
+grids are vmapped bilinear gathers: one fused program per op, static
+shapes throughout.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+def _bilinear(img, y, x):
+    """img [C, H, W]; y/x scalars (traced); zero outside."""
+    C, H, W = img.shape
+    valid = (y > -1.0) & (y < H) & (x > -1.0) & (x < W)
+    y = jnp.clip(y, 0.0, H - 1.0)
+    x = jnp.clip(x, 0.0, W - 1.0)
+    y0 = jnp.floor(y).astype(jnp.int32)
+    x0 = jnp.floor(x).astype(jnp.int32)
+    y1 = jnp.minimum(y0 + 1, H - 1)
+    x1 = jnp.minimum(x0 + 1, W - 1)
+    ly, lx = y - y0, x - x0
+    v = (img[:, y0, x0] * (1 - ly) * (1 - lx)
+         + img[:, y0, x1] * (1 - ly) * lx
+         + img[:, y1, x0] * ly * (1 - lx)
+         + img[:, y1, x1] * ly * lx)
+    return jnp.where(valid, v, 0.0)
+
+
+def _deformable_conv(ctx, op, ins, with_mask):
+    x = ins["Input"][0]          # [N, C, H, W]
+    offset = ins["Offset"][0]    # [N, 2*dg*kh*kw, Ho, Wo]
+    w = ins["Filter"][0]         # [O, C/g, kh, kw]
+    mask = ins["Mask"][0] if (with_mask and ins.get("Mask")) else None
+    sh, sw = [int(v) for v in op.attrs.get("strides", [1, 1])][:2]
+    ph, pw = [int(v) for v in op.attrs.get("paddings", [0, 0])][:2]
+    dh, dw = [int(v) for v in op.attrs.get("dilations", [1, 1])][:2]
+    groups = int(op.attrs.get("groups", 1))
+    dg = int(op.attrs.get("deformable_groups", 1))
+    N, C, H, W = x.shape
+    O, Cg, kh, kw = w.shape
+    Ho = (H + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    Wo = (W + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+    K = kh * kw
+
+    def sample_image(img, off, msk):
+        # img [C,H,W]; off [2*dg*K, Ho, Wo]; msk [dg*K, Ho, Wo] | None
+        ys0 = (jnp.arange(Ho) * sh - ph)[:, None, None]     # [Ho,1,1]
+        xs0 = (jnp.arange(Wo) * sw - pw)[None, :, None]     # [1,Wo,1]
+        ky = (jnp.arange(kh) * dh)[None, None, :, None]
+        kx = (jnp.arange(kw) * dw)[None, None, None, :]
+        off = off.reshape(dg, K, 2, Ho, Wo)
+        cpg = C // dg  # channels per deformable group
+
+        def per_group(g_idx):
+            oy = off[g_idx, :, 0].transpose(1, 2, 0).reshape(Ho, Wo, kh, kw)
+            ox = off[g_idx, :, 1].transpose(1, 2, 0).reshape(Ho, Wo, kh, kw)
+            yy = ys0[:, :, :, None] + ky + oy          # [Ho, Wo, kh, kw]
+            xx = xs0[:, :, :, None] + kx + ox
+            sub = jax.lax.dynamic_slice_in_dim(img, g_idx * cpg, cpg, 0)
+            flat_y = yy.reshape(-1)
+            flat_x = xx.reshape(-1)
+            vals = jax.vmap(lambda a, b: _bilinear(sub, a, b))(flat_y, flat_x)
+            vals = vals.reshape(Ho, Wo, kh, kw, cpg)
+            if msk is not None:
+                m = msk[g_idx * K:(g_idx + 1) * K].transpose(1, 2, 0)
+                vals = vals * m.reshape(Ho, Wo, kh, kw, 1)
+            return vals  # [Ho, Wo, kh, kw, cpg]
+
+        groups_vals = jnp.stack([per_group(g) for g in range(dg)], 0)
+        # -> [Ho, Wo, kh, kw, C]
+        return jnp.concatenate(list(groups_vals), axis=-1)
+
+    if mask is not None:
+        patches = jax.vmap(sample_image)(x, offset, mask)
+    else:
+        patches = jax.vmap(lambda img, off: sample_image(img, off, None))(
+            x, offset)
+    # patches [N, Ho, Wo, kh, kw, C] x w [O, C/g, kh, kw] (groups over C)
+    cpg2 = C // groups
+    opg = O // groups
+    outs = []
+    for g in range(groups):
+        p = patches[..., g * cpg2:(g + 1) * cpg2]
+        f = w[g * opg:(g + 1) * opg]
+        outs.append(jnp.einsum("nhwklc,ockl->nohw", p, f))
+    return {"Output": [jnp.concatenate(outs, axis=1)]}
+
+
+@register_op("deformable_conv", inputs=("Input", "Offset", "Mask", "Filter"),
+             outputs=("Output",))
+def _deformable_conv_v2(ctx, op, ins):
+    return _deformable_conv(ctx, op, ins, with_mask=True)
+
+
+@register_op("deformable_conv_v1", inputs=("Input", "Offset", "Filter"),
+             outputs=("Output",))
+def _deformable_conv_v1(ctx, op, ins):
+    return _deformable_conv(ctx, op, ins, with_mask=False)
+
+
+def _roi_batch_idx(ins, R):
+    if ins.get("RoisNum"):
+        nums = ins["RoisNum"][0]
+        return jnp.repeat(jnp.arange(nums.shape[0]), nums, total_repeat_length=R)
+    return jnp.zeros((R,), jnp.int32)
+
+
+@register_op("psroi_pool", inputs=("X", "ROIs", "RoisNum"), outputs=("Out",),
+             no_grad=("ROIs", "RoisNum"))
+def _psroi_pool(ctx, op, ins):
+    """Position-sensitive ROI average pooling (reference
+    psroi_pool_op.cc): bin (i,j) pools channel group (i*pw+j)."""
+    x, rois = ins["X"][0], ins["ROIs"][0]
+    scale = float(op.attrs.get("spatial_scale", 1.0))
+    oc = int(op.attrs.get("output_channels", 1))
+    ph = int(op.attrs.get("pooled_height", 1))
+    pw = int(op.attrs.get("pooled_width", 1))
+    N, C, H, W = x.shape
+    R = rois.shape[0]
+    bidx = _roi_batch_idx(ins, R)
+    n = 2  # static samples per bin side
+
+    def one(roi, bi):
+        x1 = jnp.round(roi[0]) * scale
+        y1 = jnp.round(roi[1]) * scale
+        x2 = (jnp.round(roi[2]) + 1.0) * scale
+        y2 = (jnp.round(roi[3]) + 1.0) * scale
+        rh = jnp.maximum(y2 - y1, 0.1)
+        rw = jnp.maximum(x2 - x1, 0.1)
+        img = x[bi].reshape(oc, ph * pw, H, W)
+        iy = jnp.arange(ph)[:, None] + (jnp.arange(n)[None, :] + 0.5) / n
+        ix = jnp.arange(pw)[:, None] + (jnp.arange(n)[None, :] + 0.5) / n
+        ys = (y1 + iy * (rh / ph)).reshape(-1)   # [ph*n]
+        xs = (x1 + ix * (rw / pw)).reshape(-1)   # [pw*n]
+
+        def at(y, xx):
+            return _bilinear(img.reshape(oc * ph * pw, H, W), y, xx)
+
+        vals = jax.vmap(lambda y: jax.vmap(lambda xx: at(y, xx))(xs))(ys)
+        vals = vals.reshape(ph, n, pw, n, oc, ph * pw).mean(axis=(1, 3))
+        # pick the position-sensitive group per bin
+        sel = (jnp.arange(ph)[:, None] * pw + jnp.arange(pw)[None, :])
+        picked = jnp.take_along_axis(
+            vals.transpose(2, 0, 1, 3), sel[None, :, :, None], axis=3)
+        return picked[..., 0]  # [oc, ph, pw]
+
+    return {"Out": [jax.vmap(one)(rois, bidx)]}
+
+
+@register_op("prroi_pool", inputs=("X", "ROIs", "BatchRoINums"),
+             outputs=("Out",), no_grad=("ROIs", "BatchRoINums"))
+def _prroi_pool(ctx, op, ins):
+    """Precise ROI pooling (reference prroi_pool_op.cc): exact integral
+    of the bilinear surface per bin; lowered as dense 4x4 sampling per
+    bin — converges to the integral and keeps shapes static."""
+    x, rois = ins["X"][0], ins["ROIs"][0]
+    scale = float(op.attrs.get("spatial_scale", 1.0))
+    ph = int(op.attrs.get("pooled_height", 1))
+    pw = int(op.attrs.get("pooled_width", 1))
+    N, C, H, W = x.shape
+    R = rois.shape[0]
+    if ins.get("BatchRoINums"):
+        nums = ins["BatchRoINums"][0]
+        bidx = jnp.repeat(jnp.arange(nums.shape[0]), nums,
+                          total_repeat_length=R)
+    else:
+        bidx = jnp.zeros((R,), jnp.int32)
+    n = 4
+
+    def one(roi, bi):
+        x1, y1, x2, y2 = (roi[0] * scale, roi[1] * scale,
+                          roi[2] * scale, roi[3] * scale)
+        rh = jnp.maximum(y2 - y1, 1e-3)
+        rw = jnp.maximum(x2 - x1, 1e-3)
+        iy = jnp.arange(ph)[:, None] + (jnp.arange(n)[None, :] + 0.5) / n
+        ix = jnp.arange(pw)[:, None] + (jnp.arange(n)[None, :] + 0.5) / n
+        ys = (y1 + iy * (rh / ph)).reshape(-1)
+        xs = (x1 + ix * (rw / pw)).reshape(-1)
+        img = x[bi]
+        vals = jax.vmap(
+            lambda y: jax.vmap(lambda xx: _bilinear(img, y, xx))(xs))(ys)
+        return vals.reshape(ph, n, pw, n, C).mean(axis=(1, 3)).transpose(2, 0, 1)
+
+    return {"Out": [jax.vmap(one)(rois, bidx)]}
+
+
+@register_op("deformable_psroi_pooling",
+             inputs=("Input", "ROIs", "Trans", "RoisNum"),
+             outputs=("Output", "TopCount"), no_grad=("ROIs", "RoisNum"))
+def _deformable_psroi_pooling(ctx, op, ins):
+    """PS-ROI pooling with learned per-part offsets (reference
+    deformable_psroi_pooling_op.cc): each bin's sampling window shifts
+    by trans * trans_std * roi_size."""
+    x, rois = ins["Input"][0], ins["ROIs"][0]
+    trans = ins["Trans"][0] if ins.get("Trans") else None
+    scale = float(op.attrs.get("spatial_scale", 1.0))
+    oc = int(op.attrs.get("output_dim", 1))
+    ph = int(op.attrs.get("pooled_height", 1))
+    pw = int(op.attrs.get("pooled_width", 1))
+    trans_std = float(op.attrs.get("trans_std", 0.1))
+    N, C, H, W = x.shape
+    R = rois.shape[0]
+    bidx = _roi_batch_idx(ins, R)  # route each ROI to its source image
+    n = 2
+
+    def one(r, roi, bi):
+        x1, y1, x2, y2 = (roi[0] * scale, roi[1] * scale,
+                          roi[2] * scale, roi[3] * scale)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        rw = jnp.maximum(x2 - x1, 0.1)
+        img = x[bi].reshape(oc * ph * pw, H, W)
+        iy = jnp.arange(ph)[:, None] + (jnp.arange(n)[None, :] + 0.5) / n
+        ix = jnp.arange(pw)[:, None] + (jnp.arange(n)[None, :] + 0.5) / n
+        if trans is not None:
+            dy = trans[r, 0].reshape(-1)[: ph * pw].reshape(ph, pw)
+            dx = trans[r, 1].reshape(-1)[: ph * pw].reshape(ph, pw)
+        else:
+            dy = dx = jnp.zeros((ph, pw))
+        ybins = y1 + iy[:, None, :] * (rh / ph) + (dy * trans_std * rh)[:, :, None]
+        xbins = x1 + ix[None, :, :] * (rw / pw) + (dx * trans_std * rw)[:, :, None]
+        # [ph, pw, n] each -> sample all (bin, sample) pairs
+        def bin_val(i, j):
+            ys = ybins[i, j]
+            xs = xbins[i, j]
+            v = jax.vmap(lambda y: jax.vmap(
+                lambda xx: _bilinear(img, y, xx))(xs))(ys)
+            return v.mean(axis=(0, 1))  # [oc*ph*pw]
+
+        vals = jax.vmap(lambda i: jax.vmap(lambda j: bin_val(i, j))(
+            jnp.arange(pw)))(jnp.arange(ph))  # [ph, pw, oc*ph*pw]
+        sel = (jnp.arange(ph)[:, None] * pw + jnp.arange(pw)[None, :])
+        vals = vals.reshape(ph, pw, oc, ph * pw)
+        picked = jnp.take_along_axis(vals, sel[:, :, None, None], axis=3)
+        return picked[..., 0].transpose(2, 0, 1)  # [oc, ph, pw]
+
+    out = jax.vmap(one)(jnp.arange(R), rois, bidx)
+    return {"Output": [out], "TopCount": [jnp.ones_like(out)]}
+
+
+@register_op("roi_perspective_transform", inputs=("X", "ROIs", "RoisNum"),
+             outputs=("Out", "Mask", "TransformMatrix", "Out2InIdx",
+                      "Out2InWeights"),
+             no_grad=("ROIs", "RoisNum"), stop_gradient=True)
+def _roi_perspective_transform(ctx, op, ins):
+    """Warp quadrilateral ROIs to fixed rectangles (reference
+    detection/roi_perspective_transform_op.cc): per ROI solve the 8-dof
+    homography mapping the output rect onto the quad, then bilinear
+    sample."""
+    x, rois = ins["X"][0], ins["ROIs"][0]  # rois [R, 8] quad corners
+    scale = float(op.attrs.get("spatial_scale", 1.0))
+    th = int(op.attrs.get("transformed_height", 1))
+    tw = int(op.attrs.get("transformed_width", 1))
+    N, C, H, W = x.shape
+    R = rois.shape[0]
+    bidx = _roi_batch_idx(ins, R)  # route each ROI to its source image
+
+    def homography(quad):
+        # map (0,0),(tw-1,0),(tw-1,th-1),(0,th-1) -> quad corners
+        src = jnp.asarray([[0, 0], [tw - 1, 0], [tw - 1, th - 1],
+                           [0, th - 1]], jnp.float32)
+        dst = quad.reshape(4, 2) * scale
+        rows = []
+        rhs = []
+        for k in range(4):
+            sx, sy = src[k, 0], src[k, 1]
+            dx, dy = dst[k, 0], dst[k, 1]
+            rows.append(jnp.stack([sx, sy, 1.0, 0.0, 0.0, 0.0,
+                                   -dx * sx, -dx * sy]))
+            rows.append(jnp.stack([0.0, 0.0, 0.0, sx, sy, 1.0,
+                                   -dy * sx, -dy * sy]))
+            rhs.extend([dx, dy])
+        A = jnp.stack(rows)
+        b = jnp.stack(rhs)
+        h = jnp.linalg.solve(A + 1e-6 * jnp.eye(8), b)
+        return jnp.concatenate([h, jnp.ones(1)]).reshape(3, 3)
+
+    def one(quad, bi):
+        Hm = homography(quad)
+        gy, gx = jnp.meshgrid(jnp.arange(th, dtype=jnp.float32),
+                              jnp.arange(tw, dtype=jnp.float32),
+                              indexing="ij")
+        ones = jnp.ones_like(gx)
+        pts = jnp.stack([gx.reshape(-1), gy.reshape(-1),
+                         ones.reshape(-1)])  # [3, th*tw]
+        mapped = Hm @ pts
+        mx = mapped[0] / jnp.maximum(jnp.abs(mapped[2]), 1e-6) * jnp.sign(
+            mapped[2] + 1e-12)
+        my = mapped[1] / jnp.maximum(jnp.abs(mapped[2]), 1e-6) * jnp.sign(
+            mapped[2] + 1e-12)
+        img = x[bi]
+        vals = jax.vmap(lambda yy, xx: _bilinear(img, yy, xx))(my, mx)
+        valid = ((mx > -1) & (mx < W) & (my > -1) & (my < H))
+        return (vals.T.reshape(C, th, tw),
+                valid.reshape(1, th, tw).astype(jnp.int32), Hm.reshape(9))
+
+    outs, masks, mats = jax.vmap(one)(rois, bidx)
+    zero = jnp.zeros((1,), jnp.int32)
+    return {"Out": [outs], "Mask": [masks], "TransformMatrix": [mats],
+            "Out2InIdx": [zero], "Out2InWeights": [zero.astype(jnp.float32)]}
+
+
+@register_op("detection_map", inputs=("DetectRes", "Label", "HasState",
+                                      "PosCount", "TruePos", "FalsePos"),
+             outputs=("MAP", "AccumPosCount", "AccumTruePos",
+                      "AccumFalsePos"),
+             stop_gradient=True)
+def _detection_map(ctx, op, ins):
+    """Mean average precision (reference detection_map_op.cc), single-
+    batch integral/11-point AP over dense padded detections.
+    DetectRes rows: [label, score, x1, y1, x2, y2] (label < 0 = pad);
+    Label rows: [label, x1, y1, x2, y2] or +difficult. The streaming
+    accumulator state (PosCount/TruePos/FalsePos) passes through dense:
+    this lowering computes the batch MAP and re-emits the inputs."""
+    det = ins["DetectRes"][0]
+    gt = ins["Label"][0]
+    iou_t = float(op.attrs.get("overlap_threshold", 0.5))
+    ap_type = str(op.attrs.get("ap_type", "integral"))
+    class_num = int(op.attrs.get("class_num", 21))
+    M = det.shape[0]
+    G = gt.shape[0]
+    gl = gt[:, 0]
+    gbox = gt[:, -4:]
+    dl = det[:, 0]
+    ds = det[:, 1]
+    dbox = det[:, 2:6]
+    dvalid = dl >= 0
+    gvalid = gl >= 0
+
+    def iou(a, b):
+        ix1 = jnp.maximum(a[0], b[0])
+        iy1 = jnp.maximum(a[1], b[1])
+        ix2 = jnp.minimum(a[2], b[2])
+        iy2 = jnp.minimum(a[3], b[3])
+        iw = jnp.maximum(ix2 - ix1, 0.0)
+        ih = jnp.maximum(iy2 - iy1, 0.0)
+        inter = iw * ih
+        ua = ((a[2] - a[0]) * (a[3] - a[1])
+              + (b[2] - b[0]) * (b[3] - b[1]) - inter)
+        return inter / jnp.maximum(ua, 1e-10)
+
+    ious = jax.vmap(lambda d: jax.vmap(lambda g: iou(d, g))(gbox))(dbox)
+
+    def class_ap(c):
+        npos = jnp.sum(gvalid & (gl == c))
+        dmask = dvalid & (dl == c)
+        order = jnp.argsort(-jnp.where(dmask, ds, -jnp.inf))
+        matched = (ious > iou_t) & (gl[None, :] == c) & gvalid[None, :]
+        best = jnp.argmax(jnp.where(matched, ious, -1.0), axis=1)
+        has = jnp.any(matched, axis=1)
+        sorted_best = best[order]
+        sorted_has = has[order] & dmask[order]
+        first = jnp.zeros((M,), bool)
+        seen = jnp.zeros((G,), bool)
+
+        def scan_fn(seen, i):
+            b = sorted_best[i]
+            tp = sorted_has[i] & ~seen[b]
+            return seen.at[b].set(seen[b] | sorted_has[i]), tp
+
+        seen, tps = jax.lax.scan(scan_fn, seen, jnp.arange(M))
+        fps = dmask[order] & ~tps
+        ctp = jnp.cumsum(tps.astype(jnp.float32))
+        cfp = jnp.cumsum(fps.astype(jnp.float32))
+        recall = ctp / jnp.maximum(npos.astype(jnp.float32), 1.0)
+        precision = ctp / jnp.maximum(ctp + cfp, 1e-10)
+        if ap_type == "11point":
+            pts = jnp.linspace(0, 1, 11)
+            ap = jnp.mean(jax.vmap(
+                lambda r: jnp.max(jnp.where(recall >= r, precision, 0.0))
+            )(pts))
+        else:  # integral
+            drecall = jnp.diff(recall, prepend=0.0)
+            ap = jnp.sum(precision * drecall)
+        return jnp.where(npos > 0, ap, jnp.nan)
+
+    aps = jax.vmap(class_ap)(jnp.arange(1, class_num, dtype=jnp.float32))
+    mAP = jnp.nanmean(aps) * 100.0
+    passthru = lambda s, shape: (ins[s][0] if ins.get(s)
+                                 else jnp.zeros(shape, jnp.float32))
+    return {
+        "MAP": [jnp.where(jnp.isnan(mAP), 0.0, mAP).reshape(1)],
+        "AccumPosCount": [passthru("PosCount", (1, 1))],
+        "AccumTruePos": [passthru("TruePos", (1, 2))],
+        "AccumFalsePos": [passthru("FalsePos", (1, 2))],
+    }
+
+
+@register_op("retinanet_target_assign",
+             inputs=("Anchor", "GtBoxes", "GtLabels", "IsCrowd", "ImInfo"),
+             outputs=("LocationIndex", "ScoreIndex", "TargetLabel",
+                      "TargetBBox", "BBoxInsideWeight", "ForegroundNumber"),
+             stop_gradient=True)
+def _retinanet_target_assign(ctx, op, ins):
+    """Anchor->gt assignment for RetinaNet (reference
+    rpn_target_assign_op.cc RetinanetTargetAssign): IoU >= pos_thresh
+    is positive (label = gt label), IoU < neg_thresh is background
+    (label 0), in-between ignored (-1). Dense outputs keep full anchor
+    extent: index outputs are arange with the mask carried by
+    TargetLabel/BBoxInsideWeight (XLA static shapes; compaction is a
+    host concern)."""
+    anchors = ins["Anchor"][0]       # [A, 4]
+    gtb = ins["GtBoxes"][0]          # [G, 4]
+    gtl = ins["GtLabels"][0].reshape(-1)  # [G]
+    pos_t = float(op.attrs.get("positive_overlap", 0.5))
+    neg_t = float(op.attrs.get("negative_overlap", 0.4))
+    A = anchors.shape[0]
+
+    def iou_one(a, b):
+        ix1 = jnp.maximum(a[0], b[0])
+        iy1 = jnp.maximum(a[1], b[1])
+        ix2 = jnp.minimum(a[2], b[2])
+        iy2 = jnp.minimum(a[3], b[3])
+        inter = jnp.maximum(ix2 - ix1, 0.0) * jnp.maximum(iy2 - iy1, 0.0)
+        ua = ((a[2] - a[0]) * (a[3] - a[1])
+              + (b[2] - b[0]) * (b[3] - b[1]) - inter)
+        return inter / jnp.maximum(ua, 1e-10)
+
+    gvalid = (gtl > 0)
+    ious = jax.vmap(lambda a: jax.vmap(lambda g: iou_one(a, g))(gtb))(anchors)
+    ious = jnp.where(gvalid[None, :], ious, -1.0)
+    best_gt = jnp.argmax(ious, axis=1)
+    best_iou = jnp.max(ious, axis=1)
+    pos = best_iou >= pos_t
+    neg = best_iou < neg_t
+    label = jnp.where(pos, gtl[best_gt], jnp.where(neg, 0, -1))
+
+    # bbox regression targets (standard box encoding vs matched gt)
+    ga = gtb[best_gt]
+    aw = jnp.maximum(anchors[:, 2] - anchors[:, 0], 1e-6)
+    ah = jnp.maximum(anchors[:, 3] - anchors[:, 1], 1e-6)
+    acx = anchors[:, 0] + aw * 0.5
+    acy = anchors[:, 1] + ah * 0.5
+    gw = jnp.maximum(ga[:, 2] - ga[:, 0], 1e-6)
+    gh = jnp.maximum(ga[:, 3] - ga[:, 1], 1e-6)
+    gcx = ga[:, 0] + gw * 0.5
+    gcy = ga[:, 1] + gh * 0.5
+    tgt = jnp.stack([(gcx - acx) / aw, (gcy - acy) / ah,
+                     jnp.log(gw / aw), jnp.log(gh / ah)], 1)
+    w = pos.astype(jnp.float32)[:, None]
+    return {
+        "LocationIndex": [jnp.arange(A, dtype=jnp.int32)],
+        "ScoreIndex": [jnp.arange(A, dtype=jnp.int32)],
+        "TargetLabel": [label.astype(jnp.int32).reshape(A, 1)],
+        "TargetBBox": [tgt * w],
+        "BBoxInsideWeight": [jnp.broadcast_to(w, (A, 4))],
+        "ForegroundNumber": [jnp.sum(pos).astype(jnp.int32).reshape(1, 1)],
+    }
+
+
+@register_op("generate_proposal_labels",
+             inputs=("RpnRois", "GtClasses", "IsCrowd", "GtBoxes", "ImInfo"),
+             outputs=("Rois", "LabelsInt32", "BboxTargets",
+                      "BboxInsideWeights", "BboxOutsideWeights"),
+             stop_gradient=True)
+def _generate_proposal_labels(ctx, op, ins):
+    """Sample training ROIs for the RCNN head (reference
+    detection/generate_proposal_labels_op.cc): label each proposal by
+    best-IoU gt (fg >= fg_thresh, bg in [bg_lo, bg_hi)), keep a fixed
+    batch_size_per_im with ~fg_fraction foreground. Static form: rank
+    by jittered IoU within fg/bg pools (RNG from the op key, matching
+    the reference's shuffle), take top-K of each."""
+    rois = ins["RpnRois"][0]         # [R, 4]
+    gtc = ins["GtClasses"][0].reshape(-1)
+    gtb = ins["GtBoxes"][0]
+    bs = int(op.attrs.get("batch_size_per_im", 256))
+    fg_frac = float(op.attrs.get("fg_fraction", 0.25))
+    fg_t = float(op.attrs.get("fg_thresh", 0.5))
+    bg_hi = float(op.attrs.get("bg_thresh_hi", 0.5))
+    bg_lo = float(op.attrs.get("bg_thresh_lo", 0.0))
+    R = rois.shape[0]
+    bs = min(bs, R)
+    n_fg = max(1, int(bs * fg_frac))
+    n_bg = bs - n_fg
+
+    def iou_one(a, b):
+        ix1 = jnp.maximum(a[0], b[0])
+        iy1 = jnp.maximum(a[1], b[1])
+        ix2 = jnp.minimum(a[2], b[2])
+        iy2 = jnp.minimum(a[3], b[3])
+        inter = jnp.maximum(ix2 - ix1, 0.0) * jnp.maximum(iy2 - iy1, 0.0)
+        ua = ((a[2] - a[0]) * (a[3] - a[1])
+              + (b[2] - b[0]) * (b[3] - b[1]) - inter)
+        return inter / jnp.maximum(ua, 1e-10)
+
+    ious = jax.vmap(lambda r: jax.vmap(lambda g: iou_one(r, g))(gtb))(rois)
+    ious = jnp.where((gtc > 0)[None, :], ious, -1.0)
+    best_gt = jnp.argmax(ious, axis=1)
+    best_iou = jnp.max(ious, axis=1)
+    is_fg = best_iou >= fg_t
+    is_bg = (best_iou < bg_hi) & (best_iou >= bg_lo)
+
+    jitter = jax.random.uniform(ctx.op_key(op), (R,)) * 1e-3
+    fg_rank = jnp.where(is_fg, best_iou + jitter, -jnp.inf)
+    bg_rank = jnp.where(is_bg, jitter, -jnp.inf)
+    fg_idx = jnp.argsort(-fg_rank)[:n_fg]
+    bg_idx = jnp.argsort(-bg_rank)[:n_bg]
+    keep = jnp.concatenate([fg_idx, bg_idx])
+
+    sel_rois = rois[keep]
+    # under-filled pools pull in rows that are neither fg nor bg (and
+    # can duplicate fg rows): a slot is valid only if drawn from its
+    # OWN pool. Invalid slots get label -1 (ignored) and zero weights.
+    slot_is_fg = is_fg[fg_idx]
+    slot_is_bg = is_bg[bg_idx] & ~is_fg[bg_idx]
+    sel_fg = jnp.concatenate([slot_is_fg, jnp.zeros((n_bg,), bool)])
+    valid = jnp.concatenate([slot_is_fg, slot_is_bg])
+    labels = jnp.where(
+        sel_fg, gtc[best_gt[keep]],
+        jnp.where(valid, 0, -1)).astype(jnp.int32)
+
+    ga = gtb[best_gt[keep]]
+    rw = jnp.maximum(sel_rois[:, 2] - sel_rois[:, 0], 1e-6)
+    rh = jnp.maximum(sel_rois[:, 3] - sel_rois[:, 1], 1e-6)
+    rcx = sel_rois[:, 0] + rw * 0.5
+    rcy = sel_rois[:, 1] + rh * 0.5
+    gw = jnp.maximum(ga[:, 2] - ga[:, 0], 1e-6)
+    gh = jnp.maximum(ga[:, 3] - ga[:, 1], 1e-6)
+    gcx = ga[:, 0] + gw * 0.5
+    gcy = ga[:, 1] + gh * 0.5
+    tgt = jnp.stack([(gcx - rcx) / rw, (gcy - rcy) / rh,
+                     jnp.log(gw / rw), jnp.log(gh / rh)], 1)
+    w = sel_fg.astype(jnp.float32)[:, None]
+    return {
+        "Rois": [sel_rois],
+        "LabelsInt32": [labels.reshape(-1, 1)],
+        "BboxTargets": [tgt * w],
+        "BboxInsideWeights": [jnp.broadcast_to(w, (bs, 4))],
+        "BboxOutsideWeights": [jnp.broadcast_to(w, (bs, 4))],
+    }

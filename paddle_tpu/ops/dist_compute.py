@@ -97,7 +97,9 @@ def _split_selected_rows(ctx, op, ins):
     n = len(op.outputs.get("Out", [])) or 1
     sections = list(op.attrs.get("height_sections", []))
     if not sections:
-        sections = [x.height // n] * n
+        # remainder to the last section — no rows may be disowned
+        base = x.height // n
+        sections = [base] * (n - 1) + [x.height - base * (n - 1)]
     outs = []
     start = 0
     for k in range(n):

@@ -151,17 +151,20 @@ def _delegate(op, attrs=None):
 
 
 @register_op("fusion_lstm",
-             inputs=("X", "WeightX", "WeightH", "Bias", "H0", "C0", "Length"),
+             inputs=("X", "WeightX", "WeightH", "Bias", "H0", "C0"),
              outputs=("Hidden", "Cell", "XX", "BatchedInput", "BatchedHidden",
                       "BatchedCell", "ReorderedH0", "ReorderedC0",
-                      "CheckedCell"),
-             no_grad=("Length",))
+                      "CheckedCell"))
 def _fusion_lstm(ctx, op, ins):
-    r = get_op_def("fused_lstm").lower(ctx, _delegate(op), ins)
+    # one projection matmul total: xx feeds BOTH the XX output and the
+    # scan (delegating to fused_lstm would recompute x@wx internally)
     x, wx = ins["X"][0], ins["WeightX"][0]
     xx = jnp.einsum("btd,dk->btk", x, wx)
     if ins.get("Bias"):
         xx = xx + ins["Bias"][0]
+    pre = {"Input": [xx], "Weight": ins["WeightH"],
+           "H0": ins.get("H0", []), "C0": ins.get("C0", [])}
+    r = get_op_def("lstm").lower(ctx, _delegate(op), pre)
     H = ins["WeightH"][0].shape[0]
     B = x.shape[0]
     z = lambda v: v if v is not None else jnp.zeros((B, H), x.dtype)
@@ -176,16 +179,18 @@ def _fusion_lstm(ctx, op, ins):
 
 
 @register_op("fusion_gru",
-             inputs=("X", "H0", "WeightX", "WeightH", "Bias", "Length"),
+             inputs=("X", "H0", "WeightX", "WeightH", "Bias"),
              outputs=("ReorderedH0", "XX", "BatchedInput", "BatchedOut",
-                      "Hidden"),
-             no_grad=("Length",))
+                      "Hidden"))
 def _fusion_gru(ctx, op, ins):
-    r = get_op_def("fused_gru").lower(ctx, _delegate(op), ins)
+    # single projection matmul shared by XX and the scan (see
+    # fusion_lstm note)
     x, wx = ins["X"][0], ins["WeightX"][0]
     xx = jnp.einsum("btd,dk->btk", x, wx)
     if ins.get("Bias"):
         xx = xx + ins["Bias"][0]
+    pre = {"Input": [xx], "Weight": ins["WeightH"], "H0": ins.get("H0", [])}
+    r = get_op_def("gru").lower(ctx, _delegate(op), pre)
     H = ins["WeightH"][0].shape[0]
     B = x.shape[0]
     h0 = ins["H0"][0] if ins.get("H0") else jnp.zeros((B, H), x.dtype)
@@ -231,13 +236,14 @@ def _fused_embedding_fc_lstm(ctx, op, ins):
 @register_op("fusion_repeated_fc_relu", inputs=("X", "W", "Bias"),
              outputs=("ReluOut", "Out"))
 def _fusion_repeated_fc_relu(ctx, op, ins):
+    # every layer is fc+relu, INCLUDING the last (reference
+    # fusion_repeated_fc_relu_op.cc applies fc_relu throughout)
     x = ins["X"][0]
     ws, bs = ins["W"], ins["Bias"]
     relu_outs = []
     for i, (w, b) in enumerate(zip(ws, bs)):
-        last = i == len(ws) - 1
-        x = _fc_compute(x, w, b, 1, None if last else "relu")
-        if not last:
+        x = _fc_compute(x, w, b, 1, "relu")
+        if i < len(ws) - 1:
             relu_outs.append(x)
     return {"ReluOut": relu_outs, "Out": [x]}
 

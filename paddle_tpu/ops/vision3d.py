@@ -126,12 +126,13 @@ def _max_pool_with_index(x, k, s, p, spatial):
     pads = ((0, 0), (0, 0)) + tuple((pi, pi) for pi in p)
     vals = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, window, strd, pads)
 
-    # flat index grid over the spatial dims
+    # flat index grid over the spatial dims: int32 — float32 mantissa
+    # collapses indices past 2^24 (large feature maps / 3D volumes)
     import math
 
     sizes = [x.shape[2 + i] for i in range(nd)]
     flat = jnp.arange(math.prod(sizes)).reshape(sizes)
-    flat = jnp.broadcast_to(flat, x.shape).astype(jnp.float32)
+    flat = jnp.broadcast_to(flat, x.shape).astype(jnp.int32)
 
     # select index where value == window max; tie -> smallest index
     def sel(a, b):
@@ -140,7 +141,8 @@ def _max_pool_with_index(x, k, s, p, spatial):
         pick_a = (av > bv) | ((av == bv) & (ai <= bi))
         return jnp.where(pick_a, av, bv), jnp.where(pick_a, ai, bi)
 
-    init = (jnp.asarray(-jnp.inf, x.dtype), jnp.asarray(jnp.inf, jnp.float32))
+    init = (jnp.asarray(-jnp.inf, x.dtype),
+            jnp.asarray(jnp.iinfo(jnp.int32).max, jnp.int32))
     _, idx = jax.lax.reduce_window(
         (x, flat), init, sel, window, strd, pads)
     return vals, idx.astype(jnp.int32)

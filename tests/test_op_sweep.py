@@ -541,6 +541,10 @@ COVERED_ELSEWHERE = {
     'merge_selected_rows', 'get_tensor_from_selected_rows',
     'dgc',  # tests/test_dgc.py
     'local_sgd_select',  # tests/test_zero_localsgd.py
+    # detection part 2: tests/test_ops_detection2.py
+    'deformable_conv', 'deformable_conv_v1', 'deformable_psroi_pooling',
+    'psroi_pool', 'prroi_pool', 'roi_perspective_transform',
+    'detection_map', 'retinanet_target_assign', 'generate_proposal_labels',
     # misc/dist-compute batch: tests/test_ops_misc.py
     'flatten', 'squeeze', 'unsqueeze', 'cross_entropy2',
     'match_matrix_tensor', 'tree_conv', 'split_ids', 'merge_ids',

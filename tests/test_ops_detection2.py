@@ -1,0 +1,244 @@
+"""Detection part-2 op tests (ops/detection2.py).
+
+Reference tests: tests/unittests/test_deformable_conv_op.py,
+test_psroi_pool_op.py, test_prroi_pool_op.py, test_detection_map_op.py,
+test_retinanet_target_assign_op.py, test_generate_proposal_labels_op.py,
+test_roi_perspective_transform_op.py.
+"""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from op_test import OpTest
+
+rng = np.random.RandomState(9)
+
+
+class TestDeformableConvZeroOffset(OpTest):
+    op_type = "deformable_conv"
+    # zero offsets + unit mask == plain conv (the identity the
+    # deformable sampler must satisfy)
+    x = rng.randn(1, 2, 5, 5).astype("float32")
+    w = rng.randn(3, 2, 3, 3).astype("float32")
+    offset = np.zeros((1, 2 * 9, 3, 3), "float32")
+    mask = np.ones((1, 9, 3, 3), "float32")
+
+    def _plain_conv(self):
+        out = np.zeros((1, 3, 3, 3), "float32")
+        for o in range(3):
+            for i in range(3):
+                for j in range(3):
+                    patch = self.x[0, :, i:i + 3, j:j + 3]
+                    out[0, o, i, j] = (patch * self.w[o]).sum()
+        return out
+
+    def test_output(self):
+        self.inputs = {"Input": self.x, "Offset": self.offset,
+                       "Mask": self.mask, "Filter": self.w}
+        self.attrs = {"strides": [1, 1], "paddings": [0, 0],
+                      "dilations": [1, 1], "deformable_groups": 1}
+        self.outputs = {"Output": self._plain_conv()}
+        self.check_output(atol=1e-4, rtol=1e-4)
+
+    def test_grad(self):
+        self.inputs = {"Input": self.x, "Offset": self.offset,
+                       "Mask": self.mask, "Filter": self.w}
+        self.attrs = {"strides": [1, 1], "paddings": [0, 0],
+                      "dilations": [1, 1], "deformable_groups": 1}
+        self.outputs = {"Output": self._plain_conv()}
+        self.check_grad(["Input", "Filter"], "Output",
+                        max_relative_error=0.04)
+
+
+class TestDeformableConvV1Shift(OpTest):
+    op_type = "deformable_conv_v1"
+    # constant integer offset (+1 in x) on a 1x1 kernel == shifted input
+    x = rng.randn(1, 1, 4, 4).astype("float32")
+    w = np.ones((1, 1, 1, 1), "float32")
+    offset = np.zeros((1, 2, 4, 4), "float32")
+    offset[:, 1] = 1.0  # x-shift
+
+    def test_output(self):
+        expect = np.zeros((1, 1, 4, 4), "float32")
+        expect[0, 0, :, :3] = self.x[0, 0, :, 1:]
+        self.inputs = {"Input": self.x, "Offset": self.offset,
+                       "Filter": self.w}
+        self.attrs = {"strides": [1, 1], "paddings": [0, 0],
+                      "dilations": [1, 1], "deformable_groups": 1}
+        self.outputs = {"Output": expect}
+        self.check_output(atol=1e-5)
+
+
+class TestPsroiPool(OpTest):
+    op_type = "psroi_pool"
+    # constant per-channel-group values make the PS selection visible
+    oc, ph, pw = 2, 2, 2
+    x = np.tile(
+        np.arange(2 * 4, dtype="float32").reshape(1, 8, 1, 1), (1, 1, 6, 6))
+    rois = np.array([[0.0, 0.0, 5.0, 5.0]], "float32")
+
+    def test_output(self):
+        # bin (i,j) of out-channel c reads channel c*4 + (i*2+j)
+        expect = np.zeros((1, 2, 2, 2), "float32")
+        for c in range(2):
+            for i in range(2):
+                for j in range(2):
+                    expect[0, c, i, j] = c * 4 + i * 2 + j
+        self.inputs = {"X": self.x, "ROIs": self.rois}
+        self.attrs = {"output_channels": 2, "pooled_height": 2,
+                      "pooled_width": 2, "spatial_scale": 1.0}
+        self.outputs = {"Out": expect}
+        self.check_output(atol=1e-4)
+
+
+class TestPrroiPool(OpTest):
+    op_type = "prroi_pool"
+    # constant image -> every bin averages to the constant
+    x = np.full((1, 3, 6, 6), 2.5, "float32")
+    rois = np.array([[1.0, 1.0, 5.0, 5.0]], "float32")
+    inputs = {"X": x, "ROIs": rois}
+    attrs = {"pooled_height": 2, "pooled_width": 2, "spatial_scale": 1.0}
+    outputs = {"Out": np.full((1, 3, 2, 2), 2.5, "float32")}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out", max_relative_error=0.02)
+
+
+class TestRoiPerspectiveIdentity(OpTest):
+    op_type = "roi_perspective_transform"
+    # axis-aligned square quad == crop (identity warp)
+    x = rng.randn(1, 2, 6, 6).astype("float32")
+    rois = np.array([[1.0, 1.0, 3.0, 1.0, 3.0, 3.0, 1.0, 3.0]], "float32")
+
+    def test_output(self):
+        self.inputs = {"X": self.x, "ROIs": self.rois}
+        self.attrs = {"transformed_height": 3, "transformed_width": 3,
+                      "spatial_scale": 1.0}
+        self.outputs = {"Out": self.x[:, :, 1:4, 1:4]}
+        self.check_output(atol=1e-3, rtol=1e-3, no_check_set=(
+            "Mask", "TransformMatrix", "Out2InIdx", "Out2InWeights"))
+
+
+class TestDetectionMapPerfect(OpTest):
+    op_type = "detection_map"
+    # detections exactly match gt -> mAP 100
+    det = np.array([
+        [1, 0.9, 10, 10, 20, 20],
+        [2, 0.8, 30, 30, 40, 40],
+    ], "float32")
+    gt = np.array([
+        [1, 10, 10, 20, 20],
+        [2, 30, 30, 40, 40],
+    ], "float32")
+
+    def test_output(self):
+        self.inputs = {"DetectRes": self.det, "Label": self.gt}
+        self.attrs = {"class_num": 3, "overlap_threshold": 0.5,
+                      "ap_type": "integral"}
+        self.outputs = {"MAP": np.array([100.0], "float32")}
+        self.check_output(atol=1e-3, no_check_set=(
+            "AccumPosCount", "AccumTruePos", "AccumFalsePos"))
+
+    def test_with_false_positive(self):
+        det = np.array([
+            [1, 0.9, 10, 10, 20, 20],   # TP
+            [1, 0.8, 50, 50, 60, 60],   # FP
+        ], "float32")
+        gt = np.array([[1, 10, 10, 20, 20]], "float32")
+        self.inputs = {"DetectRes": det, "Label": gt}
+        self.attrs = {"class_num": 2, "overlap_threshold": 0.5,
+                      "ap_type": "integral"}
+        # AP: recall hits 1.0 at precision 1.0 (first det), stays ->
+        # integral AP = 1.0
+        self.outputs = {"MAP": np.array([100.0], "float32")}
+        self.check_output(atol=1e-3, no_check_set=(
+            "AccumPosCount", "AccumTruePos", "AccumFalsePos"))
+
+
+class TestRetinanetTargetAssign(OpTest):
+    op_type = "retinanet_target_assign"
+    anchors = np.array([
+        [0, 0, 10, 10],     # IoU 1.0 with gt0 -> fg label 3
+        [0, 0, 4, 4],       # low IoU -> bg label 0
+        [0, 0, 8, 11],      # IoU ~0.72 -> fg
+    ], "float32")
+    gtb = np.array([[0, 0, 10, 10]], "float32")
+    gtl = np.array([[3]], "int32")
+
+    def test_output(self):
+        self.inputs = {"Anchor": self.anchors, "GtBoxes": self.gtb,
+                       "GtLabels": self.gtl,
+                       "IsCrowd": np.zeros((1, 1), "int32"),
+                       "ImInfo": np.array([[100, 100, 1]], "float32")}
+        self.attrs = {"positive_overlap": 0.5, "negative_overlap": 0.4}
+        self.outputs = {
+            "TargetLabel": np.array([[3], [0], [3]], "int32"),
+            "ForegroundNumber": np.array([[2]], "int32"),
+        }
+        self.check_output(no_check_set=(
+            "LocationIndex", "ScoreIndex", "TargetBBox",
+            "BBoxInsideWeight"))
+
+
+def test_generate_proposal_labels_sampling():
+    main, startup = fluid.Program(), fluid.Program()
+    R = 8
+    with fluid.program_guard(main, startup):
+        block = main.global_block()
+        mk = lambda n, s, dt="float32": block.create_var(
+            name=n, shape=s, dtype=dt, is_data=True)
+        rois = mk("rois", (R, 4))
+        gtc = mk("gtc", (2, 1), "int32")
+        gtb = mk("gtb", (2, 4))
+        outs = {n: [block.create_var(name=f"gpl_{n}")] for n in
+                ("Rois", "LabelsInt32", "BboxTargets", "BboxInsideWeights",
+                 "BboxOutsideWeights")}
+        block.append_op(
+            type="generate_proposal_labels",
+            inputs={"RpnRois": [rois], "GtClasses": [gtc], "GtBoxes": [gtb]},
+            outputs=outs,
+            attrs={"batch_size_per_im": 4, "fg_fraction": 0.5,
+                   "fg_thresh": 0.5, "bg_thresh_hi": 0.5, "bg_thresh_lo": 0.0})
+    exe = fluid.Executor(fluid.CPUPlace())
+    # 2 proposals overlap gt well (fg), rest are background
+    rois_v = np.array([
+        [0, 0, 10, 10], [1, 1, 10, 10], [50, 50, 60, 60], [70, 70, 80, 80],
+        [90, 90, 99, 99], [20, 20, 30, 30], [40, 40, 45, 45], [5, 60, 15, 70],
+    ], "float32")
+    gtc_v = np.array([[1], [2]], "int32")
+    gtb_v = np.array([[0, 0, 10, 10], [50, 50, 60, 60]], "float32")
+    r, l, t, wi, wo = exe.run(
+        main, feed={"rois": rois_v, "gtc": gtc_v, "gtb": gtb_v},
+        fetch_list=[outs[n][0] for n in
+                    ("Rois", "LabelsInt32", "BboxTargets",
+                     "BboxInsideWeights", "BboxOutsideWeights")])
+    l = np.asarray(l).ravel()
+    assert np.asarray(r).shape == (4, 4)
+    assert (l > 0).sum() == 2, f"expected 2 fg, got labels {l}"
+    wi = np.asarray(wi)
+    np.testing.assert_array_equal(wi[:2], np.ones((2, 4)))
+    np.testing.assert_array_equal(wi[2:], np.zeros((2, 4)))
+
+
+class TestDeformablePsroiPoolZeroTrans(OpTest):
+    op_type = "deformable_psroi_pooling"
+    # zero trans == plain psroi pooling; constant group channels make
+    # the position-sensitive selection visible
+    oc, ph, pw = 1, 2, 2
+    x = np.tile(np.arange(4, dtype="float32").reshape(1, 4, 1, 1),
+                (1, 1, 6, 6))
+    rois = np.array([[0.0, 0.0, 5.0, 5.0]], "float32")
+    trans = np.zeros((1, 2, 2, 2), "float32")
+
+    def test_output(self):
+        expect = np.arange(4, dtype="float32").reshape(1, 1, 2, 2)
+        self.inputs = {"Input": self.x, "ROIs": self.rois,
+                       "Trans": self.trans}
+        self.attrs = {"output_dim": 1, "pooled_height": 2,
+                      "pooled_width": 2, "spatial_scale": 1.0,
+                      "trans_std": 0.1}
+        self.outputs = {"Output": expect}
+        self.check_output(atol=1e-4, no_check_set=("TopCount",))

@@ -163,7 +163,8 @@ class TestFusionRepeatedFCRelu(OpTest):
     b2 = rng.randn(2).astype("float32")
     h1 = np.maximum(x @ w1 + b1, 0)
     inputs = {"X": x, "W": [w1, w2], "Bias": [b1, b2]}
-    outputs = {"ReluOut": [h1], "Out": h1 @ w2 + b2}
+    # reference applies fc_relu to EVERY layer including the last
+    outputs = {"ReluOut": [h1], "Out": np.maximum(h1 @ w2 + b2, 0)}
 
     def test_output(self):
         self.check_output(atol=1e-5)
