@@ -222,7 +222,10 @@ def _orchestrate():
             sys.stderr.write(f"[bench] stage {i + 1}: skipped (relay down)\n")
             continue
         remaining = DEADLINE_S - (time.monotonic() - t_start)
-        reserve = CPU_RESERVE_S if stage["backend"] == "tpu" else 0
+        # a failed TPU stage also burns a COOLDOWN_S sleep before the
+        # next stage runs — reserve it too, or the CPU fallback's slice
+        # gets shaved below its own timeout
+        reserve = (CPU_RESERVE_S + COOLDOWN_S) if stage["backend"] == "tpu" else 0
         budget = min(stage["timeout"], remaining - reserve)
         if budget < 90:
             sys.stderr.write(

@@ -1,0 +1,37 @@
+"""Build helper for the C inference API (paddle_capi.cpp).
+
+Reference: inference/capi/ is compiled into the main inference .so by
+CMake; here a g++ one-liner embeds CPython (no pybind11 in the image).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sysconfig
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "paddle_capi.cpp")
+_SO = os.path.join(_HERE, "build", "libpaddle_capi.so")
+
+
+def embed_flags():
+    inc = sysconfig.get_paths()["include"]
+    libdir = sysconfig.get_config_var("LIBDIR")
+    ver = sysconfig.get_config_var("LDVERSION")
+    return ([f"-I{inc}"], [f"-L{libdir}", f"-lpython{ver}", "-ldl", "-lm"])
+
+
+def build(force: bool = False) -> str:
+    """Compile (if stale) and return the shared-library path."""
+    if (not force and os.path.exists(_SO)
+            and os.path.getmtime(_SO) >= os.path.getmtime(_SRC)):
+        return _SO
+    os.makedirs(os.path.dirname(_SO), exist_ok=True)
+    cflags, ldflags = embed_flags()
+    subprocess.run(
+        ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", _SO]
+        + cflags + ldflags,
+        check=True, capture_output=True,
+    )
+    return _SO

@@ -1,0 +1,266 @@
+// C inference API over the paddle_tpu predictor.
+//
+// Reference: paddle/fluid/inference/capi/paddle_c_api.h + c_api.cc —
+// a C ABI (PD_* functions, opaque handles) so non-C++ hosts (Go, R,
+// plain C services) can serve models. There the C layer wraps the
+// C++ AnalysisPredictor; here the runtime is the Python/JAX stack, so
+// the C layer EMBEDS CPython (Py_Initialize + object calls) and holds
+// the predictor as an opaque PyObject*. All entry points take the GIL
+// (PyGILState), so the handle may be driven from any host thread —
+// matching the reference's clone-per-thread serving pattern.
+//
+// Build: g++ -shared -fPIC paddle_capi.cpp $(python3-config --includes
+//        --ldflags --embed) -o libpaddle_capi.so
+// (paddle_tpu/capi/build.py does this and caches the .so.)
+
+#include <Python.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+
+#define PD_CAPI extern "C" __attribute__((visibility("default")))
+
+namespace {
+
+PyObject *g_inference_mod = nullptr;
+PyObject *g_np_mod = nullptr;
+
+// fetch+clear the current python error into a static buffer
+const char *capture_error() {
+  static char buf[4096];
+  buf[0] = 0;
+  if (!PyErr_Occurred()) return buf;
+  PyObject *type, *value, *tb;
+  PyErr_Fetch(&type, &value, &tb);
+  PyObject *s = value ? PyObject_Str(value) : nullptr;
+  if (s) {
+    const char *c = PyUnicode_AsUTF8(s);
+    if (c) snprintf(buf, sizeof(buf), "%s", c);
+    Py_DECREF(s);
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  return buf;
+}
+
+struct Gil {
+  PyGILState_STATE st;
+  Gil() : st(PyGILState_Ensure()) {}
+  ~Gil() { PyGILState_Release(st); }
+};
+
+}  // namespace
+
+// -- lifecycle ---------------------------------------------------------------
+
+PD_CAPI int PD_Init() {
+  bool we_initialized = false;
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    we_initialized = true;
+  }
+  {
+    Gil gil;
+    if (!g_inference_mod) {
+      g_inference_mod = PyImport_ImportModule("paddle_tpu.inference");
+      if (!g_inference_mod) {
+        fprintf(stderr, "PD_Init: %s\n", capture_error());
+        return -1;
+      }
+    }
+    if (!g_np_mod) {
+      g_np_mod = PyImport_ImportModule("numpy");
+      if (!g_np_mod) return -1;
+    }
+  }
+  if (we_initialized) {
+    // Py_InitializeEx leaves this thread holding the GIL; release it
+    // so other host threads' PyGILState_Ensure can proceed (the
+    // clone-per-thread serving pattern). When embedded inside an
+    // existing Python process (ctypes), the host owns the GIL.
+    PyEval_SaveThread();
+  }
+  return 0;
+}
+
+PD_CAPI const char *PD_GetLastError() { return capture_error(); }
+
+// -- predictor ---------------------------------------------------------------
+
+PD_CAPI void *PD_NewPredictor(const char *model_dir) {
+  Gil gil;
+  PyObject *cfg = PyObject_CallMethod(g_inference_mod, "Config", "s", model_dir);
+  if (!cfg) {
+    fprintf(stderr, "PD_NewPredictor(Config): %s\n", capture_error());
+    return nullptr;
+  }
+  PyObject *pred =
+      PyObject_CallMethod(g_inference_mod, "create_predictor", "O", cfg);
+  Py_DECREF(cfg);
+  if (!pred) {
+    fprintf(stderr, "PD_NewPredictor: %s\n", capture_error());
+    return nullptr;
+  }
+  return pred;
+}
+
+PD_CAPI void *PD_ClonePredictor(void *pred) {
+  Gil gil;
+  return PyObject_CallMethod((PyObject *)pred, "clone", nullptr);
+}
+
+PD_CAPI void PD_DeletePredictor(void *pred) {
+  Gil gil;
+  Py_XDECREF((PyObject *)pred);
+}
+
+// -- IO metadata -------------------------------------------------------------
+
+static int name_list_size(void *pred, const char *method) {
+  Gil gil;
+  PyObject *names = PyObject_CallMethod((PyObject *)pred, method, nullptr);
+  if (!names) return -1;
+  int n = (int)PyList_Size(names);
+  Py_DECREF(names);
+  return n;
+}
+
+// copies the i-th name into out (truncated to cap)
+static int name_at(void *pred, const char *method, int i, char *out, int cap) {
+  Gil gil;
+  PyObject *names = PyObject_CallMethod((PyObject *)pred, method, nullptr);
+  if (!names) return -1;
+  PyObject *item = PyList_GetItem(names, i);  // borrowed
+  const char *s = item ? PyUnicode_AsUTF8(item) : nullptr;
+  int rc = -1;
+  if (s) {
+    snprintf(out, cap, "%s", s);
+    rc = 0;
+  }
+  Py_DECREF(names);
+  return rc;
+}
+
+PD_CAPI int PD_GetInputNum(void *pred) {
+  return name_list_size(pred, "get_input_names");
+}
+PD_CAPI int PD_GetOutputNum(void *pred) {
+  return name_list_size(pred, "get_output_names");
+}
+PD_CAPI int PD_GetInputName(void *pred, int i, char *out, int cap) {
+  return name_at(pred, "get_input_names", i, out, cap);
+}
+PD_CAPI int PD_GetOutputName(void *pred, int i, char *out, int cap) {
+  return name_at(pred, "get_output_names", i, out, cap);
+}
+
+// -- run ---------------------------------------------------------------------
+
+// float32 input tensor by name
+PD_CAPI int PD_SetInputFloat(void *pred, const char *name, const float *data,
+                             const int64_t *shape, int ndim) {
+  Gil gil;
+  int64_t numel = 1;
+  for (int i = 0; i < ndim; ++i) numel *= shape[i];
+  PyObject *shape_t = PyTuple_New(ndim);
+  for (int i = 0; i < ndim; ++i)
+    PyTuple_SetItem(shape_t, i, PyLong_FromLongLong(shape[i]));
+
+  // np.frombuffer(memoryview, dtype=float32).reshape(shape).copy()
+  PyObject *mv = PyMemoryView_FromMemory((char *)data,
+                                         numel * (int64_t)sizeof(float),
+                                         PyBUF_READ);
+  PyObject *arr = PyObject_CallMethod(g_np_mod, "frombuffer", "Os", mv, "float32");
+  Py_DECREF(mv);
+  if (!arr) {
+    Py_DECREF(shape_t);
+    return -1;
+  }
+  PyObject *reshaped = PyObject_CallMethod(arr, "reshape", "O", shape_t);
+  Py_DECREF(arr);
+  Py_DECREF(shape_t);
+  if (!reshaped) return -1;
+  PyObject *copied = PyObject_CallMethod(reshaped, "copy", nullptr);
+  Py_DECREF(reshaped);
+  if (!copied) return -1;
+
+  PyObject *handle =
+      PyObject_CallMethod((PyObject *)pred, "get_input_handle", "s", name);
+  if (!handle) {
+    Py_DECREF(copied);
+    return -1;
+  }
+  PyObject *r = PyObject_CallMethod(handle, "copy_from_cpu", "O", copied);
+  Py_DECREF(copied);
+  Py_DECREF(handle);
+  if (!r) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+PD_CAPI int PD_PredictorRun(void *pred) {
+  Gil gil;
+  PyObject *r = PyObject_CallMethod((PyObject *)pred, "zero_copy_run", nullptr);
+  if (!r) {
+    fprintf(stderr, "PD_PredictorRun: %s\n", capture_error());
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+// copy a float32 output into caller storage; returns numel (or -1).
+// shape_out (cap ndim_cap) receives the dims, *ndim_out the rank.
+PD_CAPI int64_t PD_GetOutputFloat(void *pred, const char *name, float *out,
+                                  int64_t capacity, int64_t *shape_out,
+                                  int ndim_cap, int *ndim_out) {
+  Gil gil;
+  PyObject *handle =
+      PyObject_CallMethod((PyObject *)pred, "get_output_handle", "s", name);
+  if (!handle) return -1;
+  PyObject *arr = PyObject_CallMethod(handle, "copy_to_cpu", nullptr);
+  Py_DECREF(handle);
+  if (!arr) return -1;
+  PyObject *f32 = PyObject_CallMethod(arr, "astype", "s", "float32");
+  Py_DECREF(arr);
+  if (!f32) return -1;
+  PyObject *flat = PyObject_CallMethod(f32, "ravel", nullptr);
+  PyObject *shape = PyObject_GetAttrString(f32, "shape");
+  if (!flat || !shape) {
+    Py_XDECREF(flat);
+    Py_XDECREF(shape);
+    Py_DECREF(f32);
+    return -1;
+  }
+  int nd = (int)PyTuple_Size(shape);
+  if (ndim_out) *ndim_out = nd;
+  for (int i = 0; i < nd && i < ndim_cap; ++i)
+    shape_out[i] = PyLong_AsLongLong(PyTuple_GetItem(shape, i));
+  Py_DECREF(shape);
+
+  // single memcpy out of the contiguous float32 buffer — no per-
+  // element Python boxing on the serving hot path
+  PyObject *contig =
+      PyObject_CallMethod(g_np_mod, "ascontiguousarray", "O", flat);
+  Py_DECREF(flat);
+  Py_DECREF(f32);
+  if (!contig) return -1;
+  Py_buffer view;
+  if (PyObject_GetBuffer(contig, &view, PyBUF_SIMPLE) != 0) {
+    Py_DECREF(contig);
+    return -1;
+  }
+  int64_t n = (int64_t)(view.len / sizeof(float));
+  int64_t ncopy = n < capacity ? n : capacity;
+  memcpy(out, view.buf, (size_t)ncopy * sizeof(float));
+  PyBuffer_Release(&view);
+  Py_DECREF(contig);
+  return n;
+}
+
+PD_CAPI void PD_Finalize() {
+  // embedding hosts usually skip finalization (jax atexit handlers);
+  // provided for completeness.
+}

@@ -8,3 +8,4 @@ light-NAS.
 from . import quantization
 from . import prune
 from . import distillation
+from . import nas

@@ -1,0 +1,212 @@
+"""Light-NAS: simulated-annealing architecture search.
+
+Reference: python/paddle/fluid/contrib/slim/nas/ (light_nas_strategy.py,
+search_space.py, controller_server.py, search_agent.py) and
+slim/searcher/controller.py (SAController). The reference runs a
+distributed token search: a controller server hands out candidate
+token vectors, agents build + short-train the candidate net and report
+a reward.
+
+TPU-native shape: the search LOOP is plain host python (nothing to
+compile); each candidate's train/eval runs through the normal
+Executor/jit path, so one process drives the whole search on one chip
+— and the same JSON-line TCP controller/agent pair as the reference's
+server/agent split is provided for multi-host search.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import socket
+import threading
+
+import numpy as np
+
+__all__ = ["SearchSpace", "SAController", "LightNAS", "ControllerServer",
+           "ControllerClient"]
+
+
+class SearchSpace:
+    """Reference nas/search_space.py contract."""
+
+    def init_tokens(self):
+        """Initial token vector (list<int>)."""
+        raise NotImplementedError
+
+    def range_table(self):
+        """Per-position exclusive upper bounds: tokens[i] in
+        [0, range_table()[i])."""
+        raise NotImplementedError
+
+    def create_net(self, tokens):
+        """Build (train_program, startup_program, eval_fn or fetches)
+        for the candidate described by tokens."""
+        raise NotImplementedError
+
+    def get_model_latency(self, program):
+        """Optional latency model for constraint search."""
+        return 0.0
+
+
+class SAController:
+    """Simulated-annealing token search (reference
+    slim/searcher/controller.py:59): accept a worse candidate with
+    probability exp((reward - best)/T), T decaying geometrically."""
+
+    def __init__(self, range_table=None, reduce_rate=0.85,
+                 init_temperature=1024, max_iter_number=300, seed=0):
+        self._range_table = list(range_table or [])
+        self._reduce_rate = float(reduce_rate)
+        self._init_temperature = float(init_temperature)
+        self._max_iter_number = int(max_iter_number)
+        self._rng = np.random.RandomState(seed)
+        self._constrain_func = None
+        self._reward = -np.inf
+        self._tokens = None
+        self._max_reward = -np.inf
+        self._best_tokens = None
+        self._iter = 0
+
+    def reset(self, range_table, init_tokens, constrain_func=None):
+        self._range_table = list(range_table)
+        self._constrain_func = constrain_func
+        self._tokens = list(init_tokens)
+        self._iter = 0
+
+    @property
+    def best_tokens(self):
+        return self._best_tokens
+
+    @property
+    def max_reward(self):
+        return self._max_reward
+
+    def update(self, tokens, reward):
+        self._iter += 1
+        temperature = self._init_temperature * self._reduce_rate ** self._iter
+        if reward > self._reward or self._rng.random_sample() <= math.exp(
+                min((reward - self._reward) / max(temperature, 1e-10), 0.0)):
+            self._reward = reward
+            self._tokens = list(tokens)
+        if reward > self._max_reward:
+            self._max_reward = reward
+            self._best_tokens = list(tokens)
+
+    def next_tokens(self, control_token=None):
+        tokens = list(control_token if control_token else self._tokens)
+        for _ in range(64):
+            new_tokens = list(tokens)
+            index = int(len(self._range_table) * self._rng.random_sample())
+            r = self._range_table[index]
+            if r > 1:
+                new_tokens[index] = (
+                    new_tokens[index] + self._rng.randint(r - 1) + 1) % r
+            if self._constrain_func is None or self._constrain_func(new_tokens):
+                return new_tokens
+        return tokens  # constraint too tight: stay
+
+
+class LightNAS:
+    """Single-process search driver (reference LightNASStrategy without
+    the compression-Context plumbing): search(space, reward_fn, steps)
+    walks the SA chain; reward_fn(tokens) -> float trains/evals the
+    candidate through the normal Executor path."""
+
+    def __init__(self, search_space, reduce_rate=0.85,
+                 init_temperature=1024, max_iter_number=300,
+                 constrain_func=None, seed=0):
+        self.space = search_space
+        self.controller = SAController(
+            search_space.range_table(), reduce_rate, init_temperature,
+            max_iter_number, seed=seed)
+        self.controller.reset(search_space.range_table(),
+                              search_space.init_tokens(), constrain_func)
+
+    def search(self, reward_fn, steps=10):
+        """Returns (best_tokens, best_reward)."""
+        for _ in range(steps):
+            tokens = self.controller.next_tokens()
+            reward = float(reward_fn(tokens))
+            self.controller.update(tokens, reward)
+        return self.controller.best_tokens, self.controller.max_reward
+
+
+class ControllerServer:
+    """JSON-line TCP controller (reference nas/controller_server.py):
+    agents call next_tokens / update over the wire so the SA chain is
+    shared across hosts."""
+
+    def __init__(self, controller, address=("127.0.0.1", 0)):
+        self.controller = controller
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind(address)
+        self._srv.listen(8)
+        self.address = self._srv.getsockname()
+        self._lock = threading.Lock()
+        self._stop = False
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def start(self):
+        self._thread.start()
+        return self.address
+
+    def close(self):
+        self._stop = True
+        try:
+            # unblock accept
+            socket.create_connection(self.address, timeout=1).close()
+        except OSError:
+            pass
+        self._thread.join(timeout=5)
+        self._srv.close()
+
+    def _loop(self):
+        while not self._stop:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            if self._stop:
+                conn.close()
+                return
+            try:
+                data = conn.makefile("r").readline()
+                if not data:
+                    continue
+                req = json.loads(data)
+                with self._lock:
+                    if req.get("cmd") == "next_tokens":
+                        resp = {"tokens": self.controller.next_tokens()}
+                    elif req.get("cmd") == "update":
+                        self.controller.update(req["tokens"], req["reward"])
+                        resp = {"best_tokens": self.controller.best_tokens,
+                                "max_reward": self.controller.max_reward}
+                    else:
+                        resp = {"error": f"unknown cmd {req.get('cmd')}"}
+                conn.sendall((json.dumps(resp) + "\n").encode())
+            except (OSError, ValueError, KeyError, TypeError):
+                # one bad/broken client must not kill the accept loop
+                pass
+            finally:
+                conn.close()
+
+
+class ControllerClient:
+    """Agent-side stub (reference nas/search_agent.py)."""
+
+    def __init__(self, address):
+        self.address = tuple(address)
+
+    def _call(self, payload):
+        with socket.create_connection(self.address, timeout=30) as conn:
+            conn.sendall((json.dumps(payload) + "\n").encode())
+            return json.loads(conn.makefile("r").readline())
+
+    def next_tokens(self):
+        return self._call({"cmd": "next_tokens"})["tokens"]
+
+    def update(self, tokens, reward):
+        return self._call({"cmd": "update", "tokens": list(tokens),
+                           "reward": float(reward)})

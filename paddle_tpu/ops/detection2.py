@@ -112,6 +112,20 @@ def _deformable_conv_v1(ctx, op, ins):
     return _deformable_conv(ctx, op, ins, with_mask=False)
 
 
+def _iou_corner(a, b):
+    """Pairwise corner-box IoU with the shared 1e-10 area guard (used
+    by detection_map / retinanet_target_assign / generate_proposal
+    _labels below)."""
+    ix1 = jnp.maximum(a[0], b[0])
+    iy1 = jnp.maximum(a[1], b[1])
+    ix2 = jnp.minimum(a[2], b[2])
+    iy2 = jnp.minimum(a[3], b[3])
+    inter = jnp.maximum(ix2 - ix1, 0.0) * jnp.maximum(iy2 - iy1, 0.0)
+    ua = ((a[2] - a[0]) * (a[3] - a[1])
+          + (b[2] - b[0]) * (b[3] - b[1]) - inter)
+    return inter / jnp.maximum(ua, 1e-10)
+
+
 def _roi_batch_idx(ins, R):
     if ins.get("RoisNum"):
         nums = ins["RoisNum"][0]
@@ -340,19 +354,8 @@ def _detection_map(ctx, op, ins):
     dvalid = dl >= 0
     gvalid = gl >= 0
 
-    def iou(a, b):
-        ix1 = jnp.maximum(a[0], b[0])
-        iy1 = jnp.maximum(a[1], b[1])
-        ix2 = jnp.minimum(a[2], b[2])
-        iy2 = jnp.minimum(a[3], b[3])
-        iw = jnp.maximum(ix2 - ix1, 0.0)
-        ih = jnp.maximum(iy2 - iy1, 0.0)
-        inter = iw * ih
-        ua = ((a[2] - a[0]) * (a[3] - a[1])
-              + (b[2] - b[0]) * (b[3] - b[1]) - inter)
-        return inter / jnp.maximum(ua, 1e-10)
-
-    ious = jax.vmap(lambda d: jax.vmap(lambda g: iou(d, g))(gbox))(dbox)
+    ious = jax.vmap(
+        lambda d: jax.vmap(lambda g: _iou_corner(d, g))(gbox))(dbox)
 
     def class_ap(c):
         npos = jnp.sum(gvalid & (gl == c))
@@ -363,7 +366,6 @@ def _detection_map(ctx, op, ins):
         has = jnp.any(matched, axis=1)
         sorted_best = best[order]
         sorted_has = has[order] & dmask[order]
-        first = jnp.zeros((M,), bool)
         seen = jnp.zeros((G,), bool)
 
         def scan_fn(seen, i):
@@ -419,18 +421,13 @@ def _retinanet_target_assign(ctx, op, ins):
     neg_t = float(op.attrs.get("negative_overlap", 0.4))
     A = anchors.shape[0]
 
-    def iou_one(a, b):
-        ix1 = jnp.maximum(a[0], b[0])
-        iy1 = jnp.maximum(a[1], b[1])
-        ix2 = jnp.minimum(a[2], b[2])
-        iy2 = jnp.minimum(a[3], b[3])
-        inter = jnp.maximum(ix2 - ix1, 0.0) * jnp.maximum(iy2 - iy1, 0.0)
-        ua = ((a[2] - a[0]) * (a[3] - a[1])
-              + (b[2] - b[0]) * (b[3] - b[1]) - inter)
-        return inter / jnp.maximum(ua, 1e-10)
-
-    gvalid = (gtl > 0)
-    ious = jax.vmap(lambda a: jax.vmap(lambda g: iou_one(a, g))(gtb))(anchors)
+    # crowd gts are excluded from assignment (reference rpn_target_
+    # assign_op.cc filters is_crowd), like ops/detection.py target_assign
+    crowd = (ins["IsCrowd"][0].reshape(-1) != 0) if ins.get("IsCrowd") \
+        else jnp.zeros(gtl.shape, bool)
+    gvalid = (gtl > 0) & ~crowd
+    ious = jax.vmap(
+        lambda a: jax.vmap(lambda g: _iou_corner(a, g))(gtb))(anchors)
     ious = jnp.where(gvalid[None, :], ious, -1.0)
     best_gt = jnp.argmax(ious, axis=1)
     best_iou = jnp.max(ious, axis=1)
@@ -486,18 +483,11 @@ def _generate_proposal_labels(ctx, op, ins):
     n_fg = max(1, int(bs * fg_frac))
     n_bg = bs - n_fg
 
-    def iou_one(a, b):
-        ix1 = jnp.maximum(a[0], b[0])
-        iy1 = jnp.maximum(a[1], b[1])
-        ix2 = jnp.minimum(a[2], b[2])
-        iy2 = jnp.minimum(a[3], b[3])
-        inter = jnp.maximum(ix2 - ix1, 0.0) * jnp.maximum(iy2 - iy1, 0.0)
-        ua = ((a[2] - a[0]) * (a[3] - a[1])
-              + (b[2] - b[0]) * (b[3] - b[1]) - inter)
-        return inter / jnp.maximum(ua, 1e-10)
-
-    ious = jax.vmap(lambda r: jax.vmap(lambda g: iou_one(r, g))(gtb))(rois)
-    ious = jnp.where((gtc > 0)[None, :], ious, -1.0)
+    ious = jax.vmap(
+        lambda r: jax.vmap(lambda g: _iou_corner(r, g))(gtb))(rois)
+    crowd = (ins["IsCrowd"][0].reshape(-1) != 0) if ins.get("IsCrowd") \
+        else jnp.zeros(gtc.shape, bool)
+    ious = jnp.where(((gtc > 0) & ~crowd)[None, :], ious, -1.0)
     best_gt = jnp.argmax(ious, axis=1)
     best_iou = jnp.max(ious, axis=1)
     is_fg = best_iou >= fg_t

@@ -151,7 +151,8 @@ def _delegate(op, attrs=None):
 
 
 @register_op("fusion_lstm",
-             inputs=("X", "WeightX", "WeightH", "Bias", "H0", "C0"),
+             inputs=("X", "WeightX", "WeightH", "Bias", "H0", "C0", "Length"),
+             no_grad=("Length",),
              outputs=("Hidden", "Cell", "XX", "BatchedInput", "BatchedHidden",
                       "BatchedCell", "ReorderedH0", "ReorderedC0",
                       "CheckedCell"))
@@ -163,7 +164,8 @@ def _fusion_lstm(ctx, op, ins):
     if ins.get("Bias"):
         xx = xx + ins["Bias"][0]
     pre = {"Input": [xx], "Weight": ins["WeightH"],
-           "H0": ins.get("H0", []), "C0": ins.get("C0", [])}
+           "H0": ins.get("H0", []), "C0": ins.get("C0", []),
+           "Length": ins.get("Length", [])}
     r = get_op_def("lstm").lower(ctx, _delegate(op), pre)
     H = ins["WeightH"][0].shape[0]
     B = x.shape[0]
@@ -179,7 +181,8 @@ def _fusion_lstm(ctx, op, ins):
 
 
 @register_op("fusion_gru",
-             inputs=("X", "H0", "WeightX", "WeightH", "Bias"),
+             inputs=("X", "H0", "WeightX", "WeightH", "Bias", "Length"),
+             no_grad=("Length",),
              outputs=("ReorderedH0", "XX", "BatchedInput", "BatchedOut",
                       "Hidden"))
 def _fusion_gru(ctx, op, ins):
@@ -189,7 +192,8 @@ def _fusion_gru(ctx, op, ins):
     xx = jnp.einsum("btd,dk->btk", x, wx)
     if ins.get("Bias"):
         xx = xx + ins["Bias"][0]
-    pre = {"Input": [xx], "Weight": ins["WeightH"], "H0": ins.get("H0", [])}
+    pre = {"Input": [xx], "Weight": ins["WeightH"], "H0": ins.get("H0", []),
+           "Length": ins.get("Length", [])}
     r = get_op_def("gru").lower(ctx, _delegate(op), pre)
     H = ins["WeightH"][0].shape[0]
     B = x.shape[0]

@@ -237,15 +237,17 @@ def _recurrent(ctx, op, ins):
 
 
 def _lstm_scan(xproj, wh, h0, c0, cell_clip=0.0, proj=None, proj_clip=0.0,
-               peephole=None):
+               peephole=None, lengths=None):
     """xproj [T,B,4H]; wh [H,4H] (or [P,4H] with projection);
     peephole = (w_ic, w_fc, w_oc) diagonal weights [H] each (reference
     use_peepholes: i/f gates see c_prev, o gate sees c_new);
-    returns (hs, cs, h_last, c_last) time-major."""
+    lengths [B] freezes h/c past each row's length (dense-padding
+    convention); returns (hs, cs, h_last, c_last) time-major."""
     w_ic, w_fc, w_oc = peephole if peephole is not None else (None,) * 3
 
-    def cell(carry, xp):
+    def cell(carry, scan_in):
         h, c = carry
+        t, xp = scan_in
         gates = xp + h @ wh
         i, f, g, o = jnp.split(gates, 4, axis=-1)
         if w_ic is not None:
@@ -261,9 +263,15 @@ def _lstm_scan(xproj, wh, h0, c0, cell_clip=0.0, proj=None, proj_clip=0.0,
             h_new = h_new @ proj
             if proj_clip:
                 h_new = jnp.clip(h_new, -proj_clip, proj_clip)
+        if lengths is not None:
+            alive = (t < lengths)[:, None]
+            h_new = jnp.where(alive, h_new, h)
+            c_new = jnp.where(alive, c_new, c)
         return (h_new, c_new), (h_new, c_new)
 
-    (h_last, c_last), (hs, cs) = jax.lax.scan(cell, (h0, c0), xproj)
+    T = xproj.shape[0]
+    (h_last, c_last), (hs, cs) = jax.lax.scan(
+        cell, (h0, c0), (jnp.arange(T), xproj))
     return hs, cs, h_last, c_last
 
 
@@ -282,8 +290,9 @@ def _peephole_from_bias(op, ins, H):
 
 @register_op(
     "lstm",
-    inputs=("Input", "H0", "C0", "Weight", "Bias"),
+    inputs=("Input", "H0", "C0", "Weight", "Bias", "Length"),
     outputs=("Hidden", "Cell", "BatchGate", "BatchCellPreAct"),
+    no_grad=("Length",),
 )
 def _lstm(ctx, op, ins):
     x = ins["Input"][0]  # [B, T, 4H] pre-projected gates
@@ -297,8 +306,10 @@ def _lstm(ctx, op, ins):
         xs = xs + ins["Bias"][0].reshape(1, 1, -1)[:, :, : 4 * H]
     h0 = ins["H0"][0] if ins.get("H0") else jnp.zeros((B, H), x.dtype)
     c0 = ins["C0"][0] if ins.get("C0") else jnp.zeros((B, H), x.dtype)
+    ln = ins["Length"][0] if ins.get("Length") else None
     hs, cs, _, _ = _lstm_scan(xs, wh, h0, c0,
-                              peephole=_peephole_from_bias(op, ins, H))
+                              peephole=_peephole_from_bias(op, ins, H),
+                              lengths=ln)
     if bool(op.attrs.get("is_reverse", False)):
         hs, cs = jnp.flip(hs, 0), jnp.flip(cs, 0)
     return {
@@ -344,8 +355,9 @@ def _lstmp(ctx, op, ins):
 
 @register_op(
     "gru",
-    inputs=("Input", "H0", "Weight", "Bias"),
+    inputs=("Input", "H0", "Weight", "Bias", "Length"),
     outputs=("BatchGate", "BatchResetHiddenPrev", "BatchHidden", "Hidden"),
+    no_grad=("Length",),
 )
 def _gru(ctx, op, ins):
     x = ins["Input"][0]  # [B, T, 3H] pre-projected
@@ -361,16 +373,24 @@ def _gru(ctx, op, ins):
     h0 = ins["H0"][0] if ins.get("H0") else jnp.zeros((B, H), x.dtype)
     wh_rz, wh_c = wh[:, : 2 * H], wh[:, 2 * H:]
 
-    def cell(h, xp):
+    ln = ins["Length"][0] if ins.get("Length") else None
+
+    def cell(carry, scan_in):
+        h = carry
+        t, xp = scan_in
         rz = jax.nn.sigmoid(xp[:, : 2 * H] + h @ wh_rz)
         r, z = jnp.split(rz, 2, axis=-1)
         rhp = r * h
         c = jnp.tanh(xp[:, 2 * H:] + rhp @ wh_c)
         # origin_mode (paper-original GRU): h = z*h + (1-z)*c
         h_new = z * h + (1 - z) * c if origin else (1 - z) * h + z * c
+        if ln is not None:
+            h_new = jnp.where((t < ln)[:, None], h_new, h)
         return h_new, (rz, rhp, h_new)
 
-    h_last, (gates, rhps, hs) = jax.lax.scan(cell, h0, xs)
+    Tn = xs.shape[0]
+    h_last, (gates, rhps, hs) = jax.lax.scan(
+        cell, h0, (jnp.arange(Tn), xs))
     if bool(op.attrs.get("is_reverse", False)):
         hs = jnp.flip(hs, 0)
     sw = lambda v: jnp.swapaxes(v, 0, 1)

@@ -1,0 +1,106 @@
+"""Light-NAS tests (contrib/slim/nas.py).
+
+Reference: slim light-NAS (nas/light_nas_strategy.py + searcher
+SAController); test pattern after contrib/slim/tests/test_light_nas.py
+— search a small space and assert the chain finds the optimum, plus a
+real candidate-training loop through the Executor, plus the TCP
+controller round-trip.
+"""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.contrib.slim.nas import (
+    SearchSpace, SAController, LightNAS, ControllerServer, ControllerClient)
+
+
+class ToySpace(SearchSpace):
+    """Tokens = [width_idx, depth_idx]; reward peaks at (2, 1)."""
+
+    widths = [4, 8, 16]
+    depths = [1, 2]
+
+    def init_tokens(self):
+        return [0, 0]
+
+    def range_table(self):
+        return [len(self.widths), len(self.depths)]
+
+    def create_net(self, tokens):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = layers.data("x", [8])
+            h = x
+            for _ in range(self.depths[tokens[1]]):
+                h = layers.fc(h, self.widths[tokens[0]], act="relu")
+            y = layers.data("y", [1])
+            pred = layers.fc(h, 1)
+            loss = layers.mean(layers.square_error_cost(pred, y))
+            fluid.optimizer.Adam(1e-2).minimize(loss)
+        return main, startup, loss
+
+
+def test_sa_controller_finds_optimum():
+    ctl = SAController([3, 2], reduce_rate=0.7, init_temperature=10, seed=3)
+    ctl.reset([3, 2], [0, 0])
+    target = [2, 1]
+    for _ in range(60):
+        t = ctl.next_tokens()
+        reward = -float(np.sum((np.array(t) - target) ** 2))
+        ctl.update(t, reward)
+    assert ctl.best_tokens == target
+    assert ctl.max_reward == 0.0
+
+
+def test_sa_controller_respects_constraint():
+    ctl = SAController([5], seed=1)
+    ctl.reset([5], [0], constrain_func=lambda t: t[0] <= 2)
+    for _ in range(30):
+        t = ctl.next_tokens()
+        assert t[0] <= 2
+        ctl.update(t, -t[0])
+
+
+def test_light_nas_trains_candidates():
+    """End-to-end: each candidate actually trains a few steps; reward =
+    negative final loss. The search must return some valid tokens with
+    a finite reward."""
+    space = ToySpace()
+    rng = np.random.RandomState(0)
+    xv = rng.randn(32, 8).astype("float32")
+    yv = (xv.sum(1, keepdims=True) > 0).astype("float32")
+
+    def reward_fn(tokens):
+        main, startup, loss = space.create_net(tokens)
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            for _ in range(5):
+                (l,) = exe.run(main, feed={"x": xv, "y": yv},
+                               fetch_list=[loss])
+        return -float(np.asarray(l))
+
+    nas = LightNAS(space, seed=0)
+    best, reward = nas.search(reward_fn, steps=4)
+    assert best is not None and len(best) == 2
+    assert np.isfinite(reward)
+
+
+def test_controller_server_roundtrip():
+    ctl = SAController([4, 4], seed=2)
+    ctl.reset([4, 4], [0, 0])
+    server = ControllerServer(ctl)
+    addr = server.start()
+    try:
+        client = ControllerClient(addr)
+        for _ in range(10):
+            t = client.next_tokens()
+            assert all(0 <= v < 4 for v in t)
+            r = client.update(t, -float(sum(t)))
+        assert r["best_tokens"] is not None
+        # best reward is the least-negative sum seen
+        assert r["max_reward"] <= 0.0
+    finally:
+        server.close()

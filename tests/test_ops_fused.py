@@ -320,3 +320,35 @@ class TestFusedEmbeddingFCLstm(OpTest):
             "ReorderedH0": z, "ReorderedC0": z,
         }
         self.check_output(atol=1e-4, rtol=1e-4)
+
+
+class TestFusionLstmLength(OpTest):
+    op_type = "fusion_lstm"
+    # row 1 has length 2 of T=4: its hidden/cell freeze after step 2
+    B, T, D, H = 2, 4, 3, 4
+
+    def test_length_freezes_states(self):
+        x = rng.randn(self.B, self.T, self.D).astype("float32")
+        wx = rng.randn(self.D, 4 * self.H).astype("float32")
+        wh = rng.randn(self.H, 4 * self.H).astype("float32")
+        lengths = np.array([4, 2], "int64")
+        sig = lambda v: 1 / (1 + np.exp(-v))
+        h = np.zeros((self.B, self.H), "float32")
+        c = np.zeros((self.B, self.H), "float32")
+        hs = []
+        for t in range(self.T):
+            g = x[:, t] @ wx + h @ wh
+            i, f, gg, o = np.split(g, 4, axis=-1)
+            c_new = sig(f) * c + sig(i) * np.tanh(gg)
+            h_new = sig(o) * np.tanh(c_new)
+            alive = (t < lengths)[:, None]
+            h = np.where(alive, h_new, h)
+            c = np.where(alive, c_new, c)
+            hs.append(h.copy())
+        hid = np.stack(hs, 1)
+        self.inputs = {"X": x, "WeightX": wx, "WeightH": wh,
+                       "Length": lengths}
+        self.outputs = {"Hidden": hid}
+        self.check_output(atol=1e-4, rtol=1e-4, no_check_set=(
+            "Cell", "XX", "BatchedInput", "BatchedHidden", "BatchedCell",
+            "ReorderedH0", "ReorderedC0", "CheckedCell"))
