@@ -55,6 +55,7 @@ from .initializer import (
 )
 from .data_feeder import DataFeeder
 from .reader import DataLoader
+from .lod_tensor import LoDTensor, create_lod_tensor, create_random_int_lodtensor
 from .io import save, load, save_params, load_params, save_persistables, load_persistables
 from .core import dygraph
 from .core.dygraph import dygraph_guard as _dg

@@ -26,23 +26,27 @@ namespace {
 PyObject *g_inference_mod = nullptr;
 PyObject *g_np_mod = nullptr;
 
-// fetch+clear the current python error into a static buffer
+// Fetch+clear any pending python error into a STICKY thread-local
+// buffer (callers must hold the GIL). Sticky: PD_GetLastError returns
+// the last captured message even after the canonical fprintf path
+// consumed the python-side error state; thread-local so concurrent
+// serving threads don't race on one buffer.
+thread_local char g_err_buf[4096] = {0};
+
 const char *capture_error() {
-  static char buf[4096];
-  buf[0] = 0;
-  if (!PyErr_Occurred()) return buf;
+  if (!PyErr_Occurred()) return g_err_buf;
   PyObject *type, *value, *tb;
   PyErr_Fetch(&type, &value, &tb);
   PyObject *s = value ? PyObject_Str(value) : nullptr;
   if (s) {
     const char *c = PyUnicode_AsUTF8(s);
-    if (c) snprintf(buf, sizeof(buf), "%s", c);
+    if (c) snprintf(g_err_buf, sizeof(g_err_buf), "%s", c);
     Py_DECREF(s);
   }
   Py_XDECREF(type);
   Py_XDECREF(value);
   Py_XDECREF(tb);
-  return buf;
+  return g_err_buf;
 }
 
 struct Gil {
@@ -85,7 +89,10 @@ PD_CAPI int PD_Init() {
   return 0;
 }
 
-PD_CAPI const char *PD_GetLastError() { return capture_error(); }
+PD_CAPI const char *PD_GetLastError() {
+  Gil gil;  // PyErr_* need the GIL like every other entry point
+  return capture_error();
+}
 
 // -- predictor ---------------------------------------------------------------
 
@@ -108,7 +115,9 @@ PD_CAPI void *PD_NewPredictor(const char *model_dir) {
 
 PD_CAPI void *PD_ClonePredictor(void *pred) {
   Gil gil;
-  return PyObject_CallMethod((PyObject *)pred, "clone", nullptr);
+  PyObject *c = PyObject_CallMethod((PyObject *)pred, "clone", nullptr);
+  if (!c) capture_error();  // clear pending state; message kept sticky
+  return c;
 }
 
 PD_CAPI void PD_DeletePredictor(void *pred) {
@@ -131,13 +140,19 @@ static int name_list_size(void *pred, const char *method) {
 static int name_at(void *pred, const char *method, int i, char *out, int cap) {
   Gil gil;
   PyObject *names = PyObject_CallMethod((PyObject *)pred, method, nullptr);
-  if (!names) return -1;
+  if (!names) {
+    capture_error();
+    return -1;
+  }
   PyObject *item = PyList_GetItem(names, i);  // borrowed
   const char *s = item ? PyUnicode_AsUTF8(item) : nullptr;
   int rc = -1;
   if (s) {
     snprintf(out, cap, "%s", s);
     rc = 0;
+  } else {
+    capture_error();  // clear the IndexError — a pending exception
+                      // would poison the next CPython call
   }
   Py_DECREF(names);
   return rc;

@@ -172,6 +172,8 @@ class ControllerServer:
                 conn.close()
                 return
             try:
+                # a dead agent must not wedge the single accept loop
+                conn.settimeout(10.0)
                 data = conn.makefile("r").readline()
                 if not data:
                     continue

@@ -1,0 +1,109 @@
+"""In-program checkpoint ops: save/load/save_combine/load_combine.
+
+Reference: operators/save_op.cc, load_op.cc, save_combine_op.cc,
+load_combine_op.cc — the Executor runs these ops to snapshot/restore
+persistable vars (io.py's save_persistables emits them into a side
+program). The python-side io.py here already covers the host path;
+these lowerings make the OPS themselves real so reference-emitted
+programs containing them execute: the file IO runs as an ordered
+jax host callback (io_callback), values round-trip as .npy/.npz.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import register_op
+
+
+def _save_one(path, arr):
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    np.save(path + ".npy" if not path.endswith(".npy") else path,
+            np.asarray(arr))
+    return np.int32(0)
+
+
+@register_op("save", inputs=("X",), outputs=(), stop_gradient=True)
+def _save(ctx, op, ins):
+    from jax.experimental import io_callback
+
+    path = str(op.attrs.get("file_path", "param"))
+    x = ins["X"][0]
+    if bool(op.attrs.get("save_as_fp16", False)):
+        x = x.astype(jnp.float16)
+    io_callback(lambda a: _save_one(path, a),
+                jax.ShapeDtypeStruct((), jnp.int32), x, ordered=True)
+    return {}
+
+
+@register_op("save_combine", inputs=("X",), outputs=(), stop_gradient=True)
+def _save_combine(ctx, op, ins):
+    from jax.experimental import io_callback
+
+    path = str(op.attrs.get("file_path", "params"))
+    names = list(op.inputs.get("X", []))
+
+    def write(*arrs):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        np.savez(path if path.endswith(".npz") else path + ".npz",
+                 **{n: np.asarray(a) for n, a in zip(names, arrs)})
+        return np.int32(0)
+
+    io_callback(write, jax.ShapeDtypeStruct((), jnp.int32), *ins["X"],
+                ordered=True)
+    return {}
+
+
+def _decl_shape(op, i=0):
+    shapes = op.attrs.get("shape", None)
+    dtypes = op.attrs.get("dtype", "float32")
+    if shapes and isinstance(shapes[0], (list, tuple)):
+        return tuple(int(d) for d in shapes[i]), (
+            dtypes[i] if isinstance(dtypes, (list, tuple)) else dtypes)
+    return tuple(int(d) for d in (shapes or [1])), (
+        dtypes if isinstance(dtypes, str) else dtypes[0])
+
+
+@register_op("load", inputs=(), outputs=("Out",), stop_gradient=True)
+def _load(ctx, op, ins):
+    """XLA needs static result shapes: declare via `shape`/`dtype`
+    attrs (io.py sets them when emitting load ops; reference gets them
+    from the serialized tensor header at runtime instead)."""
+    from jax.experimental import io_callback
+
+    path = str(op.attrs.get("file_path", "param"))
+    shape, dtype = _decl_shape(op)
+
+    def read():
+        p = path + ".npy" if not path.endswith(".npy") else path
+        return np.load(p).astype(dtype).reshape(shape)
+
+    out = io_callback(read, jax.ShapeDtypeStruct(shape, jnp.dtype(dtype)),
+                      ordered=True)
+    return {"Out": [out]}
+
+
+@register_op("load_combine", inputs=(), outputs=("Out",), stop_gradient=True)
+def _load_combine(ctx, op, ins):
+    from jax.experimental import io_callback
+
+    path = str(op.attrs.get("file_path", "params"))
+    names = list(op.outputs.get("Out", []))
+    n = len(names)
+    results = [jax.ShapeDtypeStruct(*(
+        (_decl_shape(op, i)[0], jnp.dtype(_decl_shape(op, i)[1]))))
+        for i in range(n)]
+
+    def read():
+        p = path if path.endswith(".npz") else path + ".npz"
+        z = np.load(p)
+        return tuple(
+            z[name].astype(results[i].dtype).reshape(results[i].shape)
+            for i, name in enumerate(names))
+
+    outs = io_callback(read, tuple(results), ordered=True)
+    return {"Out": list(outs)}

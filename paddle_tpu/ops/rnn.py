@@ -237,13 +237,14 @@ def _recurrent(ctx, op, ins):
 
 
 def _lstm_scan(xproj, wh, h0, c0, cell_clip=0.0, proj=None, proj_clip=0.0,
-               peephole=None, lengths=None):
+               peephole=None, lengths=None, is_reverse=False):
     """xproj [T,B,4H]; wh [H,4H] (or [P,4H] with projection);
     peephole = (w_ic, w_fc, w_oc) diagonal weights [H] each (reference
     use_peepholes: i/f gates see c_prev, o gate sees c_new);
     lengths [B] freezes h/c past each row's length (dense-padding
     convention); returns (hs, cs, h_last, c_last) time-major."""
     w_ic, w_fc, w_oc = peephole if peephole is not None else (None,) * 3
+    T = xproj.shape[0]
 
     def cell(carry, scan_in):
         h, c = carry
@@ -264,12 +265,13 @@ def _lstm_scan(xproj, wh, h0, c0, cell_clip=0.0, proj=None, proj_clip=0.0,
             if proj_clip:
                 h_new = jnp.clip(h_new, -proj_clip, proj_clip)
         if lengths is not None:
-            alive = (t < lengths)[:, None]
+            # inputs were flipped for is_reverse: map back to the
+            # original time index before testing the row's length
+            step = (T - 1 - t) if is_reverse else t
+            alive = (step < lengths)[:, None]
             h_new = jnp.where(alive, h_new, h)
             c_new = jnp.where(alive, c_new, c)
         return (h_new, c_new), (h_new, c_new)
-
-    T = xproj.shape[0]
     (h_last, c_last), (hs, cs) = jax.lax.scan(
         cell, (h0, c0), (jnp.arange(T), xproj))
     return hs, cs, h_last, c_last
@@ -309,7 +311,9 @@ def _lstm(ctx, op, ins):
     ln = ins["Length"][0] if ins.get("Length") else None
     hs, cs, _, _ = _lstm_scan(xs, wh, h0, c0,
                               peephole=_peephole_from_bias(op, ins, H),
-                              lengths=ln)
+                              lengths=ln,
+                              is_reverse=bool(op.attrs.get("is_reverse",
+                                                           False)))
     if bool(op.attrs.get("is_reverse", False)):
         hs, cs = jnp.flip(hs, 0), jnp.flip(cs, 0)
     return {
@@ -374,6 +378,8 @@ def _gru(ctx, op, ins):
     wh_rz, wh_c = wh[:, : 2 * H], wh[:, 2 * H:]
 
     ln = ins["Length"][0] if ins.get("Length") else None
+    rev = bool(op.attrs.get("is_reverse", False))
+    Tn = xs.shape[0]
 
     def cell(carry, scan_in):
         h = carry
@@ -385,10 +391,10 @@ def _gru(ctx, op, ins):
         # origin_mode (paper-original GRU): h = z*h + (1-z)*c
         h_new = z * h + (1 - z) * c if origin else (1 - z) * h + z * c
         if ln is not None:
-            h_new = jnp.where((t < ln)[:, None], h_new, h)
+            # flipped inputs under is_reverse: test the original index
+            step = (Tn - 1 - t) if rev else t
+            h_new = jnp.where((step < ln)[:, None], h_new, h)
         return h_new, (rz, rhp, h_new)
-
-    Tn = xs.shape[0]
     h_last, (gates, rhps, hs) = jax.lax.scan(
         cell, h0, (jnp.arange(Tn), xs))
     if bool(op.attrs.get("is_reverse", False)):

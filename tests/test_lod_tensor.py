@@ -1,0 +1,57 @@
+"""LoDTensor user API tests (paddle_tpu/lod_tensor.py).
+
+Reference: tests/unittests/test_lod_tensor.py over fluid.lod_tensor.
+"""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def test_create_lod_tensor_from_flat():
+    flat = np.arange(12, dtype="float32").reshape(6, 2)
+    t = fluid.create_lod_tensor(flat, [[3, 1, 2]])
+    assert t.shape == (3, 3, 2)  # padded to max_len 3
+    np.testing.assert_array_equal(t.numpy()[0], flat[:3])
+    np.testing.assert_array_equal(t.numpy()[1, 0], flat[3])
+    np.testing.assert_array_equal(t.numpy()[1, 1:], np.zeros((2, 2)))
+    np.testing.assert_array_equal(t.lengths(), [3, 1, 2])
+    assert t.lod() == [[0, 3, 4, 6]]
+    assert t.has_valid_recursive_sequence_lengths()
+
+
+def test_create_lod_tensor_from_list():
+    data = [[[1.0], [2.0]], [[3.0]]]
+    t = fluid.create_lod_tensor(data, [[2, 1]])
+    assert t.shape == (2, 2, 1)
+    assert t.recursive_sequence_lengths() == [[2, 1]]
+
+
+def test_create_random_int_lodtensor():
+    t = fluid.create_random_int_lodtensor([[2, 4]], [1], low=0, high=5)
+    assert t.shape == (2, 4, 1)
+    assert t.numpy().max() <= 5 and t.numpy().min() >= 0
+
+
+def test_invalid_lengths_detected():
+    t = fluid.LoDTensor(np.zeros((3, 2, 1), "f"), [[2, 2]])  # sums to 4 != 3
+    assert not t.has_valid_recursive_sequence_lengths()
+
+
+def test_lod_tensor_feeds_sequence_ops():
+    """The dense carrier drives a sequence op end to end: pad + Length
+    from the LoDTensor reproduce the reference's ragged pooling."""
+    flat = np.arange(10, dtype="float32").reshape(5, 2)
+    t = fluid.create_lod_tensor(flat, [[2, 3]])
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", list(t.shape), append_batch_size=False)
+        ln = layers.data("len", [2], dtype="int64", append_batch_size=False)
+        pooled = layers.sequence_pool(x, "sum", length=ln)
+    exe = fluid.Executor(fluid.CPUPlace())
+    (out,) = exe.run(main, feed={"x": t.numpy(), "len": t.lengths()},
+                     fetch_list=[pooled])
+    np.testing.assert_allclose(
+        np.asarray(out),
+        np.stack([flat[:2].sum(0), flat[2:].sum(0)]), rtol=1e-6)

@@ -545,6 +545,9 @@ COVERED_ELSEWHERE = {
     'deformable_conv', 'deformable_conv_v1', 'deformable_psroi_pooling',
     'psroi_pool', 'prroi_pool', 'roi_perspective_transform',
     'detection_map', 'retinanet_target_assign', 'generate_proposal_labels',
+    'generate_mask_labels',
+    # in-program checkpoint ops: tests/test_ops_persist.py
+    'save', 'load', 'save_combine', 'load_combine',
     # misc/dist-compute batch: tests/test_ops_misc.py
     'flatten', 'squeeze', 'unsqueeze', 'cross_entropy2',
     'match_matrix_tensor', 'tree_conv', 'split_ids', 'merge_ids',

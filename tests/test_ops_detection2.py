@@ -242,3 +242,37 @@ class TestDeformablePsroiPoolZeroTrans(OpTest):
                       "trans_std": 0.1}
         self.outputs = {"Output": expect}
         self.check_output(atol=1e-4, no_check_set=("TopCount",))
+
+
+def test_generate_mask_labels_square():
+    """A square polygon rasterizes to a full mask inside its own roi."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        block = main.global_block()
+        mk = lambda n, s, dt="float32": block.create_var(
+            name=n, shape=s, dtype=dt, is_data=True)
+        rois = mk("m_rois", (1, 4))
+        labels = mk("m_lbl", (1, 1), "int32")
+        segms = mk("m_seg", (1, 4, 2))
+        gtc = mk("m_gtc", (1, 1), "int32")
+        outs = {n: [block.create_var(name=f"gml_{n}")] for n in
+                ("MaskRois", "RoiHasMaskInt32", "MaskInt32")}
+        block.append_op(
+            type="generate_mask_labels",
+            inputs={"Rois": [rois], "LabelsInt32": [labels],
+                    "GtSegms": [segms], "GtClasses": [gtc]},
+            outputs=outs, attrs={"resolution": 4, "num_classes": 3})
+    exe = fluid.Executor(fluid.CPUPlace())
+    square = np.array([[[0, 0], [10, 0], [10, 10], [0, 10]]], "float32")
+    mask, has = exe.run(
+        main,
+        feed={"m_rois": np.array([[2, 2, 8, 8]], "float32"),
+              "m_lbl": np.array([[1]], "int32"),
+              "m_seg": square, "m_gtc": np.array([[1]], "int32")},
+        fetch_list=[outs["MaskInt32"][0], outs["RoiHasMaskInt32"][0]])
+    mask = np.asarray(mask).reshape(1, 3, 16)
+    # roi entirely inside the square: class-1 channel all ones,
+    # other channels -1
+    np.testing.assert_array_equal(mask[0, 1], np.ones(16, "int32"))
+    np.testing.assert_array_equal(mask[0, 0], -np.ones(16, "int32"))
+    assert np.asarray(has)[0, 0] == 1

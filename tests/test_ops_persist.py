@@ -1,0 +1,59 @@
+"""In-program save/load op tests (ops/persist.py)."""
+import numpy as np
+import paddle_tpu as fluid
+
+
+def test_save_load_roundtrip(tmp_path):
+    p = str(tmp_path / "w0")
+    x = np.arange(12, dtype="float32").reshape(3, 4)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        block = main.global_block()
+        xv = block.create_var(name="x", shape=(3, 4), dtype="float32",
+                              is_data=True)
+        block.append_op(type="save", inputs={"X": [xv]}, outputs={},
+                        attrs={"file_path": p})
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(main, feed={"x": x}, fetch_list=[])
+
+    m2, s2 = fluid.Program(), fluid.Program()
+    with fluid.program_guard(m2, s2):
+        block = m2.global_block()
+        out = block.create_var(name="restored")
+        block.append_op(type="load", inputs={}, outputs={"Out": [out]},
+                        attrs={"file_path": p, "shape": [3, 4],
+                               "dtype": "float32"})
+    (r,) = exe.run(m2, feed={}, fetch_list=[out])
+    np.testing.assert_array_equal(np.asarray(r), x)
+
+
+def test_save_combine_load_combine(tmp_path):
+    p = str(tmp_path / "all")
+    a = np.ones((2, 2), "float32")
+    b = np.arange(3, dtype="float32")
+
+    main, _ = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main):
+        block = main.global_block()
+        av = block.create_var(name="a", shape=(2, 2), dtype="float32",
+                              is_data=True)
+        bv = block.create_var(name="b", shape=(3,), dtype="float32",
+                              is_data=True)
+        block.append_op(type="save_combine", inputs={"X": [av, bv]},
+                        outputs={}, attrs={"file_path": p})
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(main, feed={"a": a, "b": b}, fetch_list=[])
+
+    m2, _ = fluid.Program(), fluid.Program()
+    with fluid.program_guard(m2):
+        block = m2.global_block()
+        ra = block.create_var(name="a")   # load_combine keys by name
+        rb = block.create_var(name="b")
+        block.append_op(
+            type="load_combine", inputs={}, outputs={"Out": [ra, rb]},
+            attrs={"file_path": p, "shape": [[2, 2], [3]],
+                   "dtype": ["float32", "float32"]})
+    r1, r2 = exe.run(m2, feed={}, fetch_list=[ra, rb])
+    np.testing.assert_array_equal(np.asarray(r1), a)
+    np.testing.assert_array_equal(np.asarray(r2), b)

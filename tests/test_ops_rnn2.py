@@ -232,3 +232,36 @@ class TestLstmPeephole(OpTest):
         self.outputs = {"Hidden": np.stack(hs, 1), "Cell": np.stack(cs, 1)}
         self.check_output(atol=1e-5, no_check_set=(
             "BatchGate", "BatchCellPreAct"))
+
+
+class TestLstmReverseLength(OpTest):
+    op_type = "lstm"
+    # is_reverse + Length: valid outputs must land at ORIGINAL time
+    # positions (inputs are flipped; freeze test maps back)
+    B, T, H = 2, 4, 3
+
+    def test_output(self):
+        xp = rng.randn(self.B, self.T, 4 * self.H).astype("float32")
+        wh = rng.randn(self.H, 4 * self.H).astype("float32")
+        lengths = np.array([4, 2], "int64")
+        # oracle: run reversed over each row's VALID prefix only
+        hid = np.zeros((self.B, self.T, self.H), "float32")
+        cell_o = np.zeros((self.B, self.T, self.H), "float32")
+        for b in range(self.B):
+            L = lengths[b]
+            h = np.zeros((self.H,), "float32")
+            c = np.zeros((self.H,), "float32")
+            for t in range(self.T - 1, -1, -1):  # reverse scan
+                if t >= L:
+                    continue  # padded step: state unchanged, output 0
+                g = xp[b, t] + h @ wh
+                i, f, gg, o = np.split(g, 4)
+                c = sig(f) * c + sig(i) * np.tanh(gg)
+                h = sig(o) * np.tanh(c)
+                hid[b, t] = h
+                cell_o[b, t] = c
+        self.inputs = {"Input": xp, "Weight": wh, "Length": lengths}
+        self.attrs = {"is_reverse": True}
+        self.outputs = {"Hidden": hid, "Cell": cell_o}
+        self.check_output(atol=1e-4, rtol=1e-4, no_check_set=(
+            "BatchGate", "BatchCellPreAct"))
