@@ -42,6 +42,9 @@ from . import clip
 from . import metrics
 from . import io
 from . import profiler
+from . import average
+from . import evaluator
+from . import install_check
 from .param_attr import ParamAttr, WeightNormParamAttr
 from .initializer import (
     Constant,

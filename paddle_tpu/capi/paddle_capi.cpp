@@ -130,7 +130,10 @@ PD_CAPI void PD_DeletePredictor(void *pred) {
 static int name_list_size(void *pred, const char *method) {
   Gil gil;
   PyObject *names = PyObject_CallMethod((PyObject *)pred, method, nullptr);
-  if (!names) return -1;
+  if (!names) {
+    capture_error();
+    return -1;
+  }
   int n = (int)PyList_Size(names);
   Py_DECREF(names);
   return n;
@@ -191,26 +194,37 @@ PD_CAPI int PD_SetInputFloat(void *pred, const char *name, const float *data,
   Py_DECREF(mv);
   if (!arr) {
     Py_DECREF(shape_t);
+    capture_error();
     return -1;
   }
   PyObject *reshaped = PyObject_CallMethod(arr, "reshape", "O", shape_t);
   Py_DECREF(arr);
   Py_DECREF(shape_t);
-  if (!reshaped) return -1;
+  if (!reshaped) {
+    capture_error();
+    return -1;
+  }
   PyObject *copied = PyObject_CallMethod(reshaped, "copy", nullptr);
   Py_DECREF(reshaped);
-  if (!copied) return -1;
+  if (!copied) {
+    capture_error();
+    return -1;
+  }
 
   PyObject *handle =
       PyObject_CallMethod((PyObject *)pred, "get_input_handle", "s", name);
   if (!handle) {
     Py_DECREF(copied);
+    capture_error();
     return -1;
   }
   PyObject *r = PyObject_CallMethod(handle, "copy_from_cpu", "O", copied);
   Py_DECREF(copied);
   Py_DECREF(handle);
-  if (!r) return -1;
+  if (!r) {
+    capture_error();
+    return -1;
+  }
   Py_DECREF(r);
   return 0;
 }
@@ -234,19 +248,29 @@ PD_CAPI int64_t PD_GetOutputFloat(void *pred, const char *name, float *out,
   Gil gil;
   PyObject *handle =
       PyObject_CallMethod((PyObject *)pred, "get_output_handle", "s", name);
-  if (!handle) return -1;
+  if (!handle) {
+    capture_error();
+    return -1;
+  }
   PyObject *arr = PyObject_CallMethod(handle, "copy_to_cpu", nullptr);
   Py_DECREF(handle);
-  if (!arr) return -1;
+  if (!arr) {
+    capture_error();
+    return -1;
+  }
   PyObject *f32 = PyObject_CallMethod(arr, "astype", "s", "float32");
   Py_DECREF(arr);
-  if (!f32) return -1;
+  if (!f32) {
+    capture_error();
+    return -1;
+  }
   PyObject *flat = PyObject_CallMethod(f32, "ravel", nullptr);
   PyObject *shape = PyObject_GetAttrString(f32, "shape");
   if (!flat || !shape) {
     Py_XDECREF(flat);
     Py_XDECREF(shape);
     Py_DECREF(f32);
+    capture_error();
     return -1;
   }
   int nd = (int)PyTuple_Size(shape);
@@ -261,10 +285,14 @@ PD_CAPI int64_t PD_GetOutputFloat(void *pred, const char *name, float *out,
       PyObject_CallMethod(g_np_mod, "ascontiguousarray", "O", flat);
   Py_DECREF(flat);
   Py_DECREF(f32);
-  if (!contig) return -1;
+  if (!contig) {
+    capture_error();
+    return -1;
+  }
   Py_buffer view;
   if (PyObject_GetBuffer(contig, &view, PyBUF_SIMPLE) != 0) {
     Py_DECREF(contig);
+    capture_error();
     return -1;
   }
   int64_t n = (int64_t)(view.len / sizeof(float));

@@ -1,0 +1,51 @@
+"""Installation self-check.
+
+Reference: python/paddle/fluid/install_check.py:46 run_check() — builds
+a tiny linear model, runs one train step single-device and (when more
+than one device is visible) data-parallel, and prints a verdict.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["run_check"]
+
+
+def run_check():
+    import jax
+
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+
+    prog = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(prog, startup), fluid.unique_name.guard():
+        x = layers.data("inp", [2, 2], append_batch_size=False)
+        linear = layers.fc(x, 4)
+        loss = layers.mean(linear)
+        fluid.optimizer.SGD(0.01).minimize(loss)
+
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        xv = np.random.rand(2, 2).astype("float32")
+        (l1,) = exe.run(prog, feed={"inp": xv}, fetch_list=[loss])
+        assert np.isfinite(float(np.asarray(l1))), "single-device check failed"
+
+    n_dev = len(jax.devices())
+    if n_dev > 1:
+        compiled = fluid.CompiledProgram(prog).with_data_parallel(
+            loss_name=loss.name)
+        scope2 = fluid.Scope()
+        with fluid.scope_guard(scope2):
+            exe2 = fluid.Executor(fluid.TPUPlace())
+            exe2.run(startup)
+            xv2 = np.random.rand(2 * n_dev, 2).astype("float32")
+            (l2,) = exe2.run(compiled, feed={"inp": xv2}, fetch_list=[loss])
+            assert np.isfinite(float(np.asarray(l2))), "multi-device check failed"
+        print(f"Your paddle_tpu works well on {n_dev} devices.")
+    else:
+        print("Your paddle_tpu works well on SINGLE device.")
+    print("install check passed.")

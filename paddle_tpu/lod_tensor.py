@@ -99,10 +99,19 @@ def create_lod_tensor(data, recursive_seq_lens, place=None) -> LoDTensor:
         flat = np.concatenate(rows, axis=0)
     else:
         flat = np.asarray(data)
-    if flat.shape[0] == len(leaf) and flat.ndim >= 2 and flat.shape[0] != sum(leaf):
-        return LoDTensor(flat, lens)  # already padded
+    # already-padded detection: [num_seqs, max_len, ...] with the time
+    # extent matching max(leaf). When all lengths are 1 the flat and
+    # padded interpretations coincide in row count — the time axis
+    # (shape[1] == max == 1 with feature dims after) disambiguates.
+    padded_like = (flat.shape[0] == len(leaf) and flat.ndim >= 2
+                   and flat.shape[1] == max(leaf)
+                   and (flat.shape[0] != sum(leaf) or flat.ndim >= 3))
+    if padded_like:
+        return LoDTensor(flat, lens)
     assert flat.shape[0] == sum(leaf), (
-        f"data rows {flat.shape[0]} != sum(lengths) {sum(leaf)}")
+        f"data rows {flat.shape[0]} match neither sum(lengths) "
+        f"{sum(leaf)} (flat layout) nor a padded "
+        f"[{len(leaf)}, {max(leaf)}, ...] block")
     max_len = max(leaf) if leaf else 0
     out = np.zeros((len(leaf), max_len) + flat.shape[1:], flat.dtype)
     off = 0

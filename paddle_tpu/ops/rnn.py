@@ -337,7 +337,10 @@ def _lstmp(ctx, op, ins):
     B, T, H4 = x.shape
     H = H4 // 4
     P = wp.shape[1]
+    rev = bool(op.attrs.get("is_reverse", False))
     xs = jnp.swapaxes(x, 0, 1)
+    if rev:
+        xs = jnp.flip(xs, 0)
     if ins.get("Bias"):
         xs = xs + ins["Bias"][0].reshape(1, 1, -1)[:, :, : 4 * H]
     h0 = ins["H0"][0] if ins.get("H0") else jnp.zeros((B, P), x.dtype)
@@ -347,7 +350,10 @@ def _lstmp(ctx, op, ins):
         cell_clip=float(op.attrs.get("cell_clip", 0.0)),
         proj=wp, proj_clip=float(op.attrs.get("proj_clip", 0.0)),
         peephole=_peephole_from_bias(op, ins, H),
+        is_reverse=rev,
     )
+    if rev:
+        hs, cs = jnp.flip(hs, 0), jnp.flip(cs, 0)
     return {
         "Projection": [jnp.swapaxes(hs, 0, 1)],
         "Cell": [jnp.swapaxes(cs, 0, 1)],

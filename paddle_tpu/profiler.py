@@ -19,42 +19,82 @@ import time
 def profiler(state="All", sorted_key=None, profile_path="/tmp/profile"):
     import jax
 
+    global _recording
     logdir = profile_path if os.path.isdir(profile_path) else tempfile.mkdtemp(prefix="pt_prof_")
     jax.profiler.start_trace(logdir)
+    _host_events.clear()  # fresh session: no stale events in the trace
+    _recording = True
     t0 = time.time()
     try:
         yield
     finally:
         jax.profiler.stop_trace()
+        _recording = False
         dt = time.time() - t0
+        if profile_path and not os.path.isdir(profile_path):
+            from .tools_timeline import save_chrome_trace
+
+            save_chrome_trace(profile_path, _host_events)
         print(f"[paddle_tpu.profiler] traced {dt:.3f}s -> {logdir} "
               f"(open with tensorboard --logdir or perfetto)")
+
+
+# host-side event log (reference platform/profiler.cc's Event vector):
+# populated by record_event while profiling is on; rendered to a
+# chrome trace by tools/timeline.py
+_host_events: list = []
+_recording = False
 
 
 @contextlib.contextmanager
 def record_event(name: str):
     """RAII event annotation (reference platform/profiler.h:124
-    RecordEvent). Shows up as a named range in the XLA trace."""
+    RecordEvent). Shows up as a named range in the XLA trace AND in the
+    host event log consumed by tools/timeline.py."""
+    import threading
+
     import jax
 
+    t0 = time.time()
     with jax.profiler.TraceAnnotation(name):
-        yield
+        try:
+            yield
+        finally:
+            if _recording:
+                _host_events.append({
+                    "name": name,
+                    "ts": t0,
+                    "dur": time.time() - t0,
+                    "tid": threading.get_ident() % 10_000,
+                })
+
+
+def host_events():
+    return list(_host_events)
 
 
 def start_profiler(state="All"):
     import jax
 
-    global _trace_dir
+    global _trace_dir, _recording
     _trace_dir = tempfile.mkdtemp(prefix="pt_prof_")
+    _host_events.clear()  # fresh session
+    _recording = True
     jax.profiler.start_trace(_trace_dir)
 
 
 def stop_profiler(sorted_key=None, profile_path=None):
     import jax
 
+    global _recording
     jax.profiler.stop_trace()
+    _recording = False
+    if profile_path:
+        from .tools_timeline import save_chrome_trace
+
+        save_chrome_trace(profile_path, _host_events)
     print(f"[paddle_tpu.profiler] trace in {_trace_dir}")
 
 
 def reset_profiler():
-    pass
+    _host_events.clear()

@@ -1,0 +1,42 @@
+"""Chrome-trace writer for host profiler events.
+
+Reference: tools/timeline.py:36 (_ChromeTraceFormatter) / :131
+(Timeline) — converts profiler output to the chrome://tracing JSON
+format. Device-side timing here comes from jax.profiler's
+xplane/perfetto traces; this writer covers the HOST event log
+(profiler.record_event ranges), same viewer."""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List
+
+
+def to_chrome_trace(events: List[Dict]) -> Dict:
+    """events: [{name, ts (s), dur (s), tid}] -> chrome trace dict."""
+    trace_events = [
+        {
+            "name": "process_name",
+            "ph": "M",
+            "pid": 0,
+            "args": {"name": "paddle_tpu host"},
+        }
+    ]
+    t0 = min((e["ts"] for e in events), default=0.0)
+    for e in events:
+        trace_events.append({
+            "name": e["name"],
+            "ph": "X",  # complete event
+            "pid": 0,
+            "tid": int(e.get("tid", 0)),
+            "ts": (e["ts"] - t0) * 1e6,   # microseconds
+            "dur": e["dur"] * 1e6,
+            "cat": "host",
+        })
+    return {"traceEvents": trace_events, "displayTimeUnit": "ms"}
+
+
+def save_chrome_trace(path: str, events: List[Dict]) -> str:
+    with open(path, "w") as f:
+        json.dump(to_chrome_trace(events), f)
+    return path

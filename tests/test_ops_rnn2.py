@@ -265,3 +265,30 @@ class TestLstmReverseLength(OpTest):
         self.outputs = {"Hidden": hid, "Cell": cell_o}
         self.check_output(atol=1e-4, rtol=1e-4, no_check_set=(
             "BatchGate", "BatchCellPreAct"))
+
+
+class TestLstmpReverse(OpTest):
+    op_type = "lstmp"
+    # is_reverse must flip inputs AND outputs (regression: lstmp
+    # previously ignored the attr entirely)
+    B, T, H, P = 2, 3, 4, 2
+
+    def test_output(self):
+        xp = rng.randn(self.B, self.T, 4 * self.H).astype("float32")
+        wh = rng.randn(self.P, 4 * self.H).astype("float32")
+        wp = rng.randn(self.H, self.P).astype("float32")
+        h = np.zeros((self.B, self.P), "float32")
+        c = np.zeros((self.B, self.H), "float32")
+        ps = []
+        for t in range(self.T - 1, -1, -1):  # reverse-time oracle
+            g = xp[:, t] + h @ wh
+            i, f, gg, o = np.split(g, 4, axis=-1)
+            c = sig(f) * c + sig(i) * np.tanh(gg)
+            h = (sig(o) * np.tanh(c)) @ wp
+            ps.append(h.copy())
+        proj = np.stack(ps[::-1], 1)  # back to original order
+        self.inputs = {"Input": xp, "Weight": wh, "ProjWeight": wp}
+        self.attrs = {"is_reverse": True}
+        self.outputs = {"Projection": proj}
+        self.check_output(atol=1e-4, rtol=1e-4, no_check_set=(
+            "Cell", "BatchGate", "BatchCellPreAct", "BatchHidden"))

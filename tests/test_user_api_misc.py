@@ -1,0 +1,107 @@
+"""User-API parity modules: average, evaluator, install_check,
+timeline (reference python/paddle/fluid/{average,evaluator,
+install_check}.py, tools/timeline.py)."""
+
+import json
+import subprocess
+import sys
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def test_weighted_average():
+    wa = fluid.average.WeightedAverage()
+    wa.add(2.0, 1.0)
+    wa.add(4.0, 3.0)
+    assert abs(wa.eval() - (2 + 12) / 4) < 1e-9
+    wa.reset()
+    try:
+        wa.eval()
+        assert False, "expected error on empty average"
+    except ValueError:
+        pass
+
+
+def test_install_check_runs(capsys):
+    fluid.install_check.run_check()
+    out = capsys.readouterr().out
+    assert "install check passed" in out
+
+
+def test_chunk_evaluator_accumulates():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        inf = layers.data("inf", [1, 5], dtype="int64",
+                          append_batch_size=False)
+        lbl = layers.data("lbl", [1, 5], dtype="int64",
+                          append_batch_size=False)
+        ev = fluid.evaluator.ChunkEvaluator(
+            inf, lbl, chunk_scheme="plain", num_chunk_types=2)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        # perfect prediction: P = R = F1 = 1 (bg tag = num_chunk_types
+        # = 2 in the dense plain-scheme convention)
+        seq = np.array([[0, 0, 2, 1, 1]], "int64")
+        exe.run(main, feed={"inf": seq, "lbl": seq},
+                fetch_list=ev.metrics)
+        p, r, f1 = ev.eval(exe)
+        assert float(p) == 1.0 and float(r) == 1.0 and float(f1) == 1.0
+        ev.reset(exe)
+        p2, _, _ = ev.eval(exe)
+        assert float(p2) == 0.0
+
+
+def test_edit_distance_evaluator():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        hyp = layers.data("hyp", [2, 3], dtype="int64",
+                          append_batch_size=False)
+        ref = layers.data("ref", [2, 3], dtype="int64",
+                          append_batch_size=False)
+        ev = fluid.evaluator.EditDistance(hyp, ref)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        h = np.array([[1, 2, 3], [4, 5, 6]], "int64")
+        r = np.array([[1, 2, 3], [4, 5, 7]], "int64")  # row1: 1 edit
+        exe.run(main, feed={"hyp": h, "ref": r}, fetch_list=ev.metrics)
+        avg, ratio = ev.eval(exe)
+        assert abs(float(avg) - 0.5) < 1e-6   # (0 + 1) / 2
+        assert abs(float(ratio) - 0.5) < 1e-6  # 1 of 2 rows wrong
+
+
+def test_timeline_roundtrip(tmp_path):
+    from paddle_tpu.tools_timeline import save_chrome_trace
+
+    events = [{"name": "step", "ts": 1.0, "dur": 0.5, "tid": 1},
+              {"name": "fetch", "ts": 1.5, "dur": 0.1, "tid": 1}]
+    p1 = str(tmp_path / "a.json")
+    save_chrome_trace(p1, events)
+    out = str(tmp_path / "merged.json")
+    subprocess.run(
+        [sys.executable, "tools/timeline.py", "--profile_path", p1,
+         "--timeline_path", out],
+        check=True, capture_output=True, cwd="/root/repo",
+    )
+    with open(out) as f:
+        merged = json.load(f)
+    names = [e["name"] for e in merged["traceEvents"]]
+    assert "step" in names and "fetch" in names
+
+
+def test_record_event_logs_host_events():
+    from paddle_tpu import profiler
+
+    profiler.reset_profiler()
+    profiler.start_profiler()
+    with profiler.record_event("unit_test_event"):
+        np.zeros(4).sum()
+    profiler.stop_profiler()
+    evs = profiler.host_events()
+    assert any(e["name"] == "unit_test_event" for e in evs)

@@ -29,6 +29,11 @@ MODULES = [
     "paddle_tpu.transpiler",
     "paddle_tpu.contrib.mixed_precision",
     "paddle_tpu.layers.distributions",
+    "paddle_tpu.average",
+    "paddle_tpu.evaluator",
+    "paddle_tpu.install_check",
+    "paddle_tpu.lod_tensor",
+    "paddle_tpu.contrib.slim.nas",
 ]
 
 

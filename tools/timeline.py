@@ -1,0 +1,35 @@
+"""Chrome-trace CLI (reference tools/timeline.py): merge host-event
+JSON logs (written by paddle_tpu.profiler.stop_profiler(profile_path))
+into one chrome://tracing file.
+
+Usage: python tools/timeline.py --profile_path a.json,b.json \
+           --timeline_path timeline.json
+"""
+
+import argparse
+import json
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--profile_path", required=True,
+                    help="comma-separated chrome-trace json inputs")
+    ap.add_argument("--timeline_path", default="timeline.json")
+    args = ap.parse_args()
+
+    merged = {"traceEvents": [], "displayTimeUnit": "ms"}
+    for i, p in enumerate(args.profile_path.split(",")):
+        with open(p) as f:
+            t = json.load(f)
+        for ev in t.get("traceEvents", []):
+            ev = dict(ev)
+            ev["pid"] = i  # one process lane per input file
+            merged["traceEvents"].append(ev)
+    with open(args.timeline_path, "w") as f:
+        json.dump(merged, f)
+    print(f"wrote {args.timeline_path} "
+          f"({len(merged['traceEvents'])} events)")
+
+
+if __name__ == "__main__":
+    main()
