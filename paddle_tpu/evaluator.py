@@ -112,11 +112,9 @@ class ChunkEvaluator(Evaluator):
                         outs["F1-Score"][0]]
 
     def eval(self, executor, eval_program=None):
-        scope = executor._current_scope() if hasattr(executor, "_current_scope") \
-            else None
         from .core.executor import global_scope
 
-        sc = scope or global_scope()
+        sc = global_scope()
         infer = float(np.asarray(sc.get_numpy(self.num_infer_chunks.name)))
         label = float(np.asarray(sc.get_numpy(self.num_label_chunks.name)))
         correct = float(np.asarray(sc.get_numpy(self.num_correct_chunks.name)))
@@ -232,7 +230,10 @@ class DetectionMAP(Evaluator):
             outputs=outs,
             attrs={"class_num": class_num or 21,
                    "overlap_threshold": overlap_threshold,
-                   "ap_type": ap_version},
+                   "ap_type": ap_version,
+                   "background_label": background_label,
+                   "evaluate_difficult": bool(evaluate_difficult),
+                   "has_difficult": gt_difficult is not None},
         )
         self.cur_map = outs["MAP"][0]
         self._sum = self._create_state("map_sum")

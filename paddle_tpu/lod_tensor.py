@@ -99,19 +99,25 @@ def create_lod_tensor(data, recursive_seq_lens, place=None) -> LoDTensor:
         flat = np.concatenate(rows, axis=0)
     else:
         flat = np.asarray(data)
-    # already-padded detection: [num_seqs, max_len, ...] with the time
-    # extent matching max(leaf). When all lengths are 1 the flat and
-    # padded interpretations coincide in row count — the time axis
-    # (shape[1] == max == 1 with feature dims after) disambiguates.
-    padded_like = (flat.shape[0] == len(leaf) and flat.ndim >= 2
-                   and flat.shape[1] == max(leaf)
-                   and (flat.shape[0] != sum(leaf) or flat.ndim >= 3))
+    if not leaf:
+        return LoDTensor(flat, lens)  # empty: nothing to repack
+    max_len = max(leaf)
+    # already-padded detection: [num_seqs, time >= max(leaf), ...]
+    # (bucketed batches may pad past max(leaf)). When all lengths are 1
+    # the flat and padded row counts coincide — then only a 3-D+ block
+    # whose time axis is exactly max(leaf) reads as padded.
+    if flat.shape[0] == sum(leaf):  # ambiguous or flat
+        padded_like = (flat.shape[0] == len(leaf) and flat.ndim >= 3
+                       and flat.shape[1] == max_len)
+    else:
+        padded_like = (flat.shape[0] == len(leaf) and flat.ndim >= 2
+                       and flat.shape[1] >= max_len)
     if padded_like:
         return LoDTensor(flat, lens)
     assert flat.shape[0] == sum(leaf), (
         f"data rows {flat.shape[0]} match neither sum(lengths) "
         f"{sum(leaf)} (flat layout) nor a padded "
-        f"[{len(leaf)}, {max(leaf)}, ...] block")
+        f"[{len(leaf)}, >={max_len}, ...] block")
     max_len = max(leaf) if leaf else 0
     out = np.zeros((len(leaf), max_len) + flat.shape[1:], flat.dtype)
     off = 0

@@ -16,3 +16,4 @@ from .gpt import (
     synthetic_lm_batch,
 )
 from .seq2seq import build_seq2seq, beam_search_infer
+from .ctr import build_deepfm, build_wide_deep, synthetic_ctr_batch

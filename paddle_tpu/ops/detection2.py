@@ -344,10 +344,17 @@ def _detection_map(ctx, op, ins):
     iou_t = float(op.attrs.get("overlap_threshold", 0.5))
     ap_type = str(op.attrs.get("ap_type", "integral"))
     class_num = int(op.attrs.get("class_num", 21))
+    bg = int(op.attrs.get("background_label", 0))
+    eval_difficult = bool(op.attrs.get("evaluate_difficult", True))
+    has_difficult = bool(op.attrs.get("has_difficult",
+                                      gt.shape[1] == 6))
     M = det.shape[0]
     G = gt.shape[0]
     gl = gt[:, 0]
     gbox = gt[:, -4:]
+    # VOC convention: with evaluate_difficult=False, difficult gts are
+    # neither counted in npos nor penalized when matched
+    difficult = (gt[:, 1] > 0) if has_difficult else jnp.zeros((G,), bool)
     dl = det[:, 0]
     ds = det[:, 1]
     dbox = det[:, 2:6]
@@ -358,7 +365,8 @@ def _detection_map(ctx, op, ins):
         lambda d: jax.vmap(lambda g: _iou_corner(d, g))(gbox))(dbox)
 
     def class_ap(c):
-        npos = jnp.sum(gvalid & (gl == c))
+        counted = gvalid & (eval_difficult | ~difficult)
+        npos = jnp.sum(counted & (gl == c))
         dmask = dvalid & (dl == c)
         order = jnp.argsort(-jnp.where(dmask, ds, -jnp.inf))
         matched = (ious > iou_t) & (gl[None, :] == c) & gvalid[None, :]
@@ -374,7 +382,10 @@ def _detection_map(ctx, op, ins):
             return seen.at[b].set(seen[b] | sorted_has[i]), tp
 
         seen, tps = jax.lax.scan(scan_fn, seen, jnp.arange(M))
-        fps = dmask[order] & ~tps
+        # matches to skipped difficult gts are ignored entirely
+        ignored = sorted_has & difficult[sorted_best] & (not eval_difficult)
+        tps = tps & ~ignored
+        fps = dmask[order] & ~tps & ~ignored
         ctp = jnp.cumsum(tps.astype(jnp.float32))
         cfp = jnp.cumsum(fps.astype(jnp.float32))
         recall = ctp / jnp.maximum(npos.astype(jnp.float32), 1.0)
@@ -389,7 +400,9 @@ def _detection_map(ctx, op, ins):
             ap = jnp.sum(precision * drecall)
         return jnp.where(npos > 0, ap, jnp.nan)
 
-    aps = jax.vmap(class_ap)(jnp.arange(1, class_num, dtype=jnp.float32))
+    classes = jnp.asarray(
+        [c for c in range(class_num) if c != bg], jnp.float32)
+    aps = jax.vmap(class_ap)(classes)
     mAP = jnp.nanmean(aps) * 100.0
     passthru = lambda s, shape: (ins[s][0] if ins.get(s)
                                  else jnp.zeros(shape, jnp.float32))

@@ -83,11 +83,14 @@ def test_timeline_roundtrip(tmp_path):
               {"name": "fetch", "ts": 1.5, "dur": 0.1, "tid": 1}]
     p1 = str(tmp_path / "a.json")
     save_chrome_trace(p1, events)
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     out = str(tmp_path / "merged.json")
     subprocess.run(
         [sys.executable, "tools/timeline.py", "--profile_path", p1,
          "--timeline_path", out],
-        check=True, capture_output=True, cwd="/root/repo",
+        check=True, capture_output=True, cwd=repo,
     )
     with open(out) as f:
         merged = json.load(f)
