@@ -118,7 +118,6 @@ def create_lod_tensor(data, recursive_seq_lens, place=None) -> LoDTensor:
         f"data rows {flat.shape[0]} match neither sum(lengths) "
         f"{sum(leaf)} (flat layout) nor a padded "
         f"[{len(leaf)}, >={max_len}, ...] block")
-    max_len = max(leaf) if leaf else 0
     out = np.zeros((len(leaf), max_len) + flat.shape[1:], flat.dtype)
     off = 0
     for i, l in enumerate(leaf):

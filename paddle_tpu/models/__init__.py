@@ -17,3 +17,4 @@ from .gpt import (
 )
 from .seq2seq import build_seq2seq, beam_search_infer
 from .ctr import build_deepfm, build_wide_deep, synthetic_ctr_batch
+from .ssd import build_ssd, multi_box_head, ssd_loss, detection_output

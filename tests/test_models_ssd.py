@@ -1,0 +1,51 @@
+"""SSD model tests (models/ssd.py): matching loss trains, NMS
+inference produces decoded detections.
+
+Reference analogue: SSD book/dist models over layers/detection.py
+(multi_box_head + ssd_loss + detection_output).
+"""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.models.ssd import build_ssd, synthetic_det_batch
+
+
+def test_ssd_trains():
+    rng = np.random.RandomState(0)
+    main, startup, feeds, fetches = build_ssd(
+        optimizer=fluid.optimizer.Adam(2e-3))
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        batches = [synthetic_det_batch(rng, 4) for _ in range(8)]
+        losses = []
+        for b in batches * 2:
+            (l,) = exe.run(main, feed=b, fetch_list=[fetches["loss"]])
+            losses.append(float(np.asarray(l)))
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0] * 0.9, losses
+
+
+def test_ssd_inference_shapes():
+    rng = np.random.RandomState(1)
+    main, startup, feeds, fetches = build_ssd()
+    infer = main.clone(for_test=True)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        b = synthetic_det_batch(rng, 2)
+        dets, nums = exe.run(
+            infer, feed=b,
+            fetch_list=[fetches["detections"], fetches["det_nums"]])
+        dets = np.asarray(dets)
+        nums = np.asarray(nums)
+    # dense NMS output: [B, keep_top_k, 6] rows (label, score, x1..y2),
+    # label -1 = padding
+    assert dets.ndim == 3 and dets.shape[2] == 6
+    assert nums.shape[0] == 2
+    valid = dets[dets[:, :, 0] >= 0]
+    if valid.size:
+        assert (valid[:, 1] >= 0).all() and (valid[:, 1] <= 1).all()

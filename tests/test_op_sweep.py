@@ -546,6 +546,7 @@ COVERED_ELSEWHERE = {
     'psroi_pool', 'prroi_pool', 'roi_perspective_transform',
     'detection_map', 'retinanet_target_assign', 'generate_proposal_labels',
     'generate_mask_labels',
+    'ssd_loss_dense',  # tests/test_models_ssd.py (registered lazily)
     # in-program checkpoint ops: tests/test_ops_persist.py
     'save', 'load', 'save_combine', 'load_combine',
     # misc/dist-compute batch: tests/test_ops_misc.py
