@@ -83,7 +83,16 @@ def _conv2d_transpose(ctx, op, ins):
     # maps out_c->in_c), out_c, kh, kw] — i.e. w unswapped (caught by
     # the op sweep: swapping made lhs/rhs channel counts disagree for
     # any in_c != out_c).
-    pad = [(paddings[0], paddings[0]), (paddings[1], paddings[1])]
+    #
+    # jax explicit padding is applied to the TRANSPOSED (output-space)
+    # conv, NOT the forward conv's pad: paddle's
+    # out = (in-1)*stride - 2*pad + k_eff needs jax pad (k_eff-1-pad)
+    # per side (k_eff = (k-1)*dilation + 1). (0,0) explicit would mean
+    # a forward-VALID shape — wrong for every kernel > 1.
+    ke = [(w.shape[2] - 1) * dilations[0] + 1,
+          (w.shape[3] - 1) * dilations[1] + 1]
+    pad = [(ke[0] - 1 - paddings[0], ke[0] - 1 - paddings[0]),
+           (ke[1] - 1 - paddings[1], ke[1] - 1 - paddings[1])]
     out = jax.lax.conv_transpose(
         x,
         w,

@@ -142,17 +142,22 @@ def _lstm_unit(ctx, op, ins):
     outputs=("Gate", "ResetHiddenPrev", "Hidden"),
 )
 def _gru_unit(ctx, op, ins):
-    # reference gru_unit_op.cc: Input [B,3H] (x proj), Weight [H,3H]
+    # reference gru_unit_op.cc: Input [B,3H] (x proj), Weight [H,3H];
+    # activation/gate_activation attrs select the nonlinearities
+    acts = {"sigmoid": jax.nn.sigmoid, "tanh": jnp.tanh,
+            "relu": jax.nn.relu, "identity": lambda v: v}
+    act = acts[str(op.attrs.get("activation", "tanh"))]
+    gate_act = acts[str(op.attrs.get("gate_activation", "sigmoid"))]
     xp, hp = ins["Input"][0], ins["HiddenPrev"][0]
     w = ins["Weight"][0]
     H = hp.shape[-1]
     if ins.get("Bias"):
         xp = xp + ins["Bias"][0]
     w_rz, w_c = w[:, : 2 * H], w[:, 2 * H :]
-    rz = jax.nn.sigmoid(xp[:, : 2 * H] + hp @ w_rz)
+    rz = gate_act(xp[:, : 2 * H] + hp @ w_rz)
     r, z = jnp.split(rz, 2, axis=-1)
     rhp = r * hp
-    c = jnp.tanh(xp[:, 2 * H :] + rhp @ w_c)
+    c = act(xp[:, 2 * H :] + rhp @ w_c)
     h = (1 - z) * hp + z * c
     gate = jnp.concatenate([rz, c], axis=-1)
     return {"Gate": [gate], "ResetHiddenPrev": [rhp], "Hidden": [h]}

@@ -53,8 +53,13 @@ def _conv3d_transpose(ctx, op, ins):
     s = _tup(op.attrs.get("strides", [1, 1, 1]), 3)
     p = _tup(op.attrs.get("paddings", [0, 0, 0]), 3)
     d = _tup(op.attrs.get("dilations", [1, 1, 1]), 3)
+    # jax explicit padding is output-space: paddle pad -> (k_eff-1-pad)
+    # per side (see conv2d_transpose in ops/nn.py)
+    ke = [(w.shape[2 + i] - 1) * d[i] + 1 for i in range(3)]
     out = jax.lax.conv_transpose(
-        x, w, strides=s, padding=[(pi, pi) for pi in p], rhs_dilation=d,
+        x, w, strides=s,
+        padding=[(ke[i] - 1 - p[i], ke[i] - 1 - p[i]) for i in range(3)],
+        rhs_dilation=d,
         dimension_numbers=("NCDHW", "OIDHW", "NCDHW"), transpose_kernel=True,
     )
     if ins.get("Bias"):
@@ -71,11 +76,14 @@ def _depthwise_conv2d_transpose(ctx, op, ins):
     x, w = ins["Input"][0], ins["Filter"][0]  # [N,C,H,W], [C,1,kh,kw]
     s = _tup(op.attrs.get("strides", [1, 1]), 2)
     p = _tup(op.attrs.get("paddings", [0, 0]), 2)
+    ke = [w.shape[2] , w.shape[3]]  # dilation 1 path
 
     def one_ch(xc, wc):
-        # xc [N,1,H,W], wc [1,1,kh,kw]
+        # xc [N,1,H,W], wc [1,1,kh,kw]; output-space padding (see
+        # conv2d_transpose note in ops/nn.py)
         return jax.lax.conv_transpose(
-            xc, wc, strides=s, padding=[(pi, pi) for pi in p],
+            xc, wc, strides=s,
+            padding=[(ke[i] - 1 - p[i], ke[i] - 1 - p[i]) for i in range(2)],
             dimension_numbers=("NCHW", "OIHW", "NCHW"), transpose_kernel=True,
         )
 

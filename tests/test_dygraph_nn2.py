@@ -1,0 +1,140 @@
+"""New dygraph nn classes (dygraph/nn.py additions): every class runs
+forward eagerly; the differentiable ones backprop into their params.
+
+Reference: python/paddle/fluid/dygraph/nn.py classes + their
+tests/unittests/test_imperative_* coverage.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core import dygraph
+from paddle_tpu.dygraph import nn
+from paddle_tpu.dygraph.base import VarBase, to_variable
+
+rng = np.random.RandomState(4)
+
+
+def _bp(out):
+    loss = out
+    while len(loss.shape):
+        from paddle_tpu.dygraph.base import _trace
+
+        (loss,) = _trace("reduce_mean", {"X": [loss]}, ["Out"],
+                         {"dim": [0], "reduce_all": True, "keep_dim": False})
+    loss.backward()
+    return loss
+
+
+def test_conv2d_transpose_forward_backward():
+    with dygraph.dygraph_guard():
+        layer = nn.Conv2DTranspose(3, 5, 3)
+        x = to_variable(rng.randn(2, 3, 6, 6).astype("float32"))
+        out = layer(x)
+        assert out.shape[1] == 5
+        _bp(out)
+        assert layer.weight.gradient is not None
+
+
+def test_conv3d_forward_backward():
+    with dygraph.dygraph_guard():
+        layer = nn.Conv3D(2, 4, 3, padding=1)
+        x = to_variable(rng.randn(1, 2, 5, 5, 5).astype("float32"))
+        out = layer(x)
+        assert tuple(out.shape) == (1, 4, 5, 5, 5)
+        _bp(out)
+        assert layer.weight.gradient is not None
+
+
+def test_conv3d_transpose_forward():
+    with dygraph.dygraph_guard():
+        layer = nn.Conv3DTranspose(2, 3, 1)
+        x = to_variable(rng.randn(1, 2, 4, 4, 4).astype("float32"))
+        out = layer(x)
+        assert out.shape[1] == 3
+
+
+def test_gru_unit_step():
+    with dygraph.dygraph_guard():
+        H = 4
+        layer = nn.GRUUnit(3 * H)
+        xp = to_variable(rng.randn(2, 3 * H).astype("float32"))
+        h0 = to_variable(np.zeros((2, H), "float32"))
+        h, r, g = layer(xp, h0)
+        assert tuple(h.shape) == (2, H)
+
+
+def test_prelu_modes():
+    with dygraph.dygraph_guard():
+        x = to_variable(rng.randn(2, 3, 4, 4).astype("float32"))
+        for mode, kw in (("all", {}), ("channel", {"channel": 3})):
+            layer = nn.PRelu(mode=mode, **kw)
+            out = layer(x)
+            assert tuple(out.shape) == (2, 3, 4, 4)
+            _bp(out)
+
+
+def test_bilinear_tensor_product():
+    with dygraph.dygraph_guard():
+        layer = nn.BilinearTensorProduct(3, 4, 5)
+        x = to_variable(rng.randn(2, 3).astype("float32"))
+        y = to_variable(rng.randn(2, 4).astype("float32"))
+        out = layer(x, y)
+        assert tuple(out.shape) == (2, 5)
+        _bp(out)
+        assert layer.weight.gradient is not None
+
+
+def test_sequence_conv():
+    with dygraph.dygraph_guard():
+        layer = nn.SequenceConv(num_filters=6, filter_size=3, input_dim=4)
+        x = to_variable(rng.randn(2, 5, 4).astype("float32"))
+        out = layer(x)
+        assert tuple(out.shape) == (2, 5, 6)
+
+
+def test_row_conv():
+    with dygraph.dygraph_guard():
+        layer = nn.RowConv(4, future_context_size=2)
+        x = to_variable(rng.randn(2, 6, 4).astype("float32"))
+        out = layer(x)
+        assert tuple(out.shape) == (2, 6, 4)
+
+
+def test_group_norm():
+    with dygraph.dygraph_guard():
+        layer = nn.GroupNorm(4, groups=2)
+        x = to_variable(rng.randn(2, 4, 3, 3).astype("float32"))
+        out = layer(x)
+        assert tuple(out.shape) == (2, 4, 3, 3)
+        # normalized per group: overall mean ~ 0
+        assert abs(float(np.asarray(out.numpy()).mean())) < 0.2
+
+
+def test_spectral_norm():
+    with dygraph.dygraph_guard():
+        w = to_variable(rng.randn(6, 4).astype("float32"))
+        layer = nn.SpectralNorm([6, 4], power_iters=2)
+        out = layer(w)
+        # spectral norm of the output is ~1
+        s = np.linalg.svd(np.asarray(out.numpy()), compute_uv=False)
+        assert s[0] < 2.0
+
+
+def test_tree_conv():
+    with dygraph.dygraph_guard():
+        layer = nn.TreeConv(4, 5)
+        nodes = to_variable(rng.randn(1, 3, 4).astype("float32"))
+        edges = to_variable(np.array([[[0, 1], [0, 2]]], "int32"))
+        out = layer(nodes, edges)
+        assert tuple(out.shape) == (1, 3, 5)
+
+
+def test_nce_loss():
+    with dygraph.dygraph_guard():
+        layer = nn.NCE(num_total_classes=20, dim=6, num_neg_samples=4)
+        x = to_variable(rng.randn(3, 6).astype("float32"))
+        lbl = to_variable(rng.randint(0, 20, (3, 1)).astype("int64"))
+        cost = layer(x, lbl)
+        assert np.all(np.isfinite(np.asarray(cost.numpy())))

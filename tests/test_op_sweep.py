@@ -14,6 +14,7 @@ import numpy as np
 import pytest
 
 import paddle_tpu as fluid
+import paddle_tpu.models  # registers model-level ops (ssd_loss_dense)
 from paddle_tpu.core.registry import registered_ops
 
 rng = np.random.RandomState(0)

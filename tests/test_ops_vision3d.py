@@ -196,3 +196,56 @@ class TestDepthwiseConv2dTranspose(OpTest):
 
     def test_grad(self):
         self.check_grad(["Input"], "Output")
+
+
+class TestConv2dTranspose3x3Shape(OpTest):
+    op_type = "conv2d_transpose"
+    # paddle formula: out = (in-1)*stride - 2*pad + k. The 1x1-kernel
+    # tests could not catch jax's output-space padding semantics
+    # (regression: explicit (0,0) produced forward-VALID shapes).
+    x = np.ones((1, 1, 4, 4), "float32")
+    w = np.ones((1, 1, 3, 3), "float32")
+
+    def test_shape_and_values(self):
+        import paddle_tpu as fluid
+
+        for pad, expect_hw in ((0, 6), (1, 4)):
+            main, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main, startup):
+                block = main.global_block()
+                xv = block.create_var(name="x", shape=self.x.shape,
+                                      dtype="float32", is_data=True)
+                wv = block.create_var(name="w", shape=self.w.shape,
+                                      dtype="float32", is_data=True)
+                out = block.create_var(name=f"o{pad}")
+                block.append_op(
+                    type="conv2d_transpose",
+                    inputs={"Input": [xv], "Filter": [wv]},
+                    outputs={"Output": [out]},
+                    attrs={"strides": [1, 1], "paddings": [pad, pad]})
+            exe = fluid.Executor(fluid.CPUPlace())
+            (r,) = exe.run(main, feed={"x": self.x, "w": self.w},
+                           fetch_list=[out])
+            r = np.asarray(r)
+            assert r.shape == (1, 1, expect_hw, expect_hw), (pad, r.shape)
+            if pad == 0:
+                # center of the full-overlap region sums all 9 taps
+                assert abs(r[0, 0, 2, 2] - 9.0) < 1e-5
+                assert abs(r[0, 0, 0, 0] - 1.0) < 1e-5  # corner: 1 tap
+
+
+class TestConv3dTranspose3Shape(OpTest):
+    op_type = "conv3d_transpose"
+    x = np.ones((1, 1, 3, 3, 3), "float32")
+    w = np.ones((1, 1, 2, 2, 2), "float32")
+    inputs = {"Input": x, "Filter": w}
+    attrs = {"strides": [1, 1, 1], "paddings": [0, 0, 0]}
+    # out = 3 - 1 + 2 = 4 per dim; corner touched by exactly 1 tap
+    def test_output(self):
+        # conv_transpose of ones == count of overlapping taps per cell:
+        # separable, so the 1-D tap count self-outer-products to 3-D
+        ones = np.ones((3,), "float32")
+        c1 = np.convolve(ones, np.ones(2))  # [1,2,2,1]
+        expect = c1[:, None, None] * c1[None, :, None] * c1[None, None, :]
+        self.outputs = {"Output": expect[None, None]}
+        self.check_output(atol=1e-5)
