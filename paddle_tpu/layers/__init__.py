@@ -21,6 +21,16 @@ from .collective import (
 from .detection import iou_similarity, box_coder, prior_box
 from .sequence import *  # noqa: F401,F403
 from .py_func_registry import py_func
+from .extras import *  # noqa: F401,F403
+
+# auto-generated wrappers fill remaining reference layer names; hand-
+# written layers above always win on name conflicts
+from . import auto as _auto
+
+for _n in _auto.__all__:
+    if _n not in globals():
+        globals()[_n] = getattr(_auto, _n)
+del _auto, _n
 from .rnn import (
     dynamic_lstm,
     dynamic_gru,

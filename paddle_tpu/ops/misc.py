@@ -328,3 +328,53 @@ def _py_func_grad(ctx, op, ins):
         *xs, *ins.get("Out@GRAD", []),
     )
     return {"X@GRAD": list(grads)}
+
+
+@register_op("expand_pred_like", inputs=("X", "Y"), outputs=("Out",),
+             no_grad=("X", "Y"), stop_gradient=True)
+def _expand_pred_like(ctx, op, ins):
+    # broadcast a (scalar or row) boolean predicate to Y's shape — the
+    # select-based control-flow sugar's helper (layers/extras.py)
+    p = ins["X"][0].astype(bool)
+    y = ins["Y"][0]
+    while p.ndim < y.ndim:
+        p = p[..., None]
+    return {"Out": [jnp.broadcast_to(p, y.shape)]}
+
+
+@register_op("brelu", inputs=("X",), outputs=("Out",))
+def _brelu(ctx, op, ins):
+    # bounded relu (reference activation_op.cc BRelu)
+    t_min = float(op.attrs.get("t_min", 0.0))
+    t_max = float(op.attrs.get("t_max", 24.0))
+    return {"Out": [jnp.clip(ins["X"][0], t_min, t_max)]}
+
+
+@register_op("has_inf", inputs=("X",), outputs=("Out",), stop_gradient=True)
+def _has_inf(ctx, op, ins):
+    return {"Out": [jnp.any(jnp.isinf(ins["X"][0])).reshape(1)]}
+
+
+@register_op("has_nan", inputs=("X",), outputs=("Out",), stop_gradient=True)
+def _has_nan(ctx, op, ins):
+    return {"Out": [jnp.any(jnp.isnan(ins["X"][0])).reshape(1)]}
+
+
+@register_op("npair_loss", inputs=("Anchor", "Positive", "Labels"),
+             outputs=("Out",), no_grad=("Labels",))
+def _npair_loss(ctx, op, ins):
+    """N-pair metric loss (reference layers/loss.py composition):
+    softmax CE over anchor.positive^T similarities with same-label
+    targets, plus l2 regularization on the embeddings."""
+    a, p = ins["Anchor"][0], ins["Positive"][0]
+    labels = ins["Labels"][0].reshape(-1)
+    l2 = float(op.attrs.get("l2_reg", 0.002))
+    sim = a @ p.T  # [B, B]
+    tgt = (labels[:, None] == labels[None, :]).astype(sim.dtype)
+    tgt = tgt / jnp.maximum(tgt.sum(1, keepdims=True), 1.0)
+    logp = jax.nn.log_softmax(sim, -1)
+    ce = -jnp.mean(jnp.sum(tgt * logp, -1))
+    # reference layers/loss.py npair_loss scales the l2 term by 0.25
+    reg = l2 * 0.25 * (jnp.mean(jnp.sum(a * a, 1))
+                       + jnp.mean(jnp.sum(p * p, 1)))
+    return {"Out": [(ce + reg).reshape(1)]}

@@ -65,6 +65,15 @@ SPECS = {
     "norm": spec({"X": F(2, 3)}, {"axis": 1}),
     "diag": spec({"Diagonal": F(4)}),
     "rnn_memory_helper": spec({"X": F(2, 3)}, grads=["X"]),
+    "brelu": spec({"X": F(2, 3)}, {"t_min": 0.0, "t_max": 5.0},
+                  grads=["X"]),
+    "has_inf": spec({"X": F(2, 3)}),
+    "has_nan": spec({"X": F(2, 3)}),
+    "npair_loss": spec(
+        {"Anchor": F(4, 6), "Positive": F(4, 6),
+         "Labels": I32(4, hi=3).astype("int64")},
+        {"l2_reg": 0.002}, grads=["Anchor", "Positive"]),
+    "expand_pred_like": spec({"X": B8(1), "Y": F(3, 4)}),
     "get_places": spec({}, {"device_count": 2}),
     # misc/dist-compute batch
     "fill_zeros_like2": spec({"X": F(2, 3)}),
