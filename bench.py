@@ -241,7 +241,11 @@ def _orchestrate():
                "PT_BENCH_SEQ": str(stage["seq"]),
                "PT_BENCH_STEPS": str(stage["steps"]),
                "PT_BENCH_WARMUP": str(stage["warmup"]),
-               "PT_BENCH_FLASH": "1" if stage.get("flash", True) else "0"}
+               "PT_BENCH_FLASH": "1" if stage.get("flash", True) else "0",
+               # no-flash fallback stages also disable the other Pallas
+               # kernels: smallest possible compile surface on the relay
+               "PADDLE_TPU_FUSED_KERNELS":
+                   "1" if stage.get("flash", True) else "0"}
         env.pop("PT_BENCH_AXON_IPS", None)
         if stage["backend"] == "tpu" and axon_ips:
             env["PALLAS_AXON_POOL_IPS"] = axon_ips  # child claims the relay

@@ -6,7 +6,12 @@ fused set is implemented as Pallas kernels (BASELINE north star names
 attention/ffn/layer_norm/adam/softmax-ce):
 
   * flash_attention — blockwise attention, no [B,H,S,S] materialization
-  * fused_softmax_cross_entropy — via XLA (already fuses well)
+  * fused layer_norm — single-pass row kernel, fwd + bwd
+    (kernels/layer_norm.py), wired into the layer_norm lowering
+  * fused softmax cross-entropy — loss+lse row kernel, fused backward
+    (kernels/softmax_xent.py), wired into softmax_with_cross_entropy
+  * adam — deliberately NOT a kernel: a pure elementwise chain that
+    XLA already fuses into one loop (verified in lowered HLO)
 
 Kernels degrade gracefully: on non-TPU backends (CPU tests) they fall
 back to the pure-XLA implementation with identical numerics
@@ -14,3 +19,5 @@ back to the pure-XLA implementation with identical numerics
 """
 
 from .flash_attention import flash_attention, flash_attention_layer
+from .layer_norm import fused_layer_norm, layer_norm_pallas
+from .softmax_xent import fused_softmax_xent

@@ -163,6 +163,35 @@ def _softmax_with_cross_entropy(ctx, op, ins):
     axis = int(op.attrs.get("axis", -1))
     soft_label = bool(op.attrs.get("soft_label", False))
     ignore_index = int(op.attrs.get("ignore_index", -100))
+
+    from ..kernels.layer_norm import kernels_enabled
+    from ..kernels.softmax_xent import fused_softmax_xent
+
+    from ..kernels.softmax_xent import MAX_C as _XENT_MAX_C
+
+    last = axis in (-1, logits.ndim - 1)
+    if (kernels_enabled() and not soft_label
+            and 2 <= logits.shape[-1] <= _XENT_MAX_C and last):
+        # fused Pallas kernel (north-star fused set) owns the LOSS
+        # path; the Softmax slot comes from XLA's softmax so grads
+        # through it are exact (the kernel's lse has no pullback) —
+        # XLA CSEs the shared exp work when both are consumed.
+        C = logits.shape[-1]
+        lead = logits.shape[:-1]
+        l2 = logits.reshape(-1, C)
+        lbl = label
+        if lbl.ndim == logits.ndim and lbl.shape[-1] == 1:
+            lbl = jnp.squeeze(lbl, -1)
+        flat_lbl = lbl.reshape(-1)
+        safe = jnp.where(flat_lbl == ignore_index, 0,
+                         flat_lbl).astype(jnp.int32)
+        loss_flat = fused_softmax_xent(l2, safe)
+        keep = (flat_lbl != ignore_index)
+        loss_flat = jnp.where(keep, loss_flat, 0.0)
+        softmax = jax.nn.softmax(logits, axis=-1)
+        return {"Softmax": [softmax],
+                "Loss": [loss_flat.reshape(tuple(lead) + (1,))]}
+
     logp = jax.nn.log_softmax(logits, axis=axis)
     softmax = jnp.exp(logp)
     if soft_label:
@@ -314,6 +343,20 @@ def _layer_norm(ctx, op, ins):
     x = ins["X"][0]
     eps = float(op.attrs.get("epsilon", 1e-5))
     bna = int(op.attrs.get("begin_norm_axis", 1))
+    from ..kernels.layer_norm import kernels_enabled, layer_norm_pallas
+
+    if kernels_enabled() and x.ndim >= 2 and jnp.issubdtype(
+            x.dtype, jnp.floating):
+        # fused Pallas row kernel (north-star fused set); identical
+        # numerics, no separate mean/var passes in HBM. Returns None
+        # past the VMEM bound -> fall through to XLA.
+        scale = ins["Scale"][0] if ins.get("Scale") else None
+        bias = ins["Bias"][0] if ins.get("Bias") else None
+        res = layer_norm_pallas(x, scale, bias, eps, bna)
+        if res is not None:
+            y, mean, var = res
+            return {"Y": [y], "Mean": [mean], "Variance": [var]}
+
     axes = tuple(range(bna, x.ndim))
     mean = jnp.mean(x, axis=axes, keepdims=True)
     var = jnp.var(x, axis=axes, keepdims=True)
