@@ -202,7 +202,14 @@ class ParameterServer:
             return {"ok": True}
         if verb == P.SHUTDOWN:
             self._shutdown.set()
-            threading.Thread(target=self._server.shutdown, daemon=True).start()
+
+            def _stop(server=self._server):
+                server.shutdown()
+                server.server_close()  # release the LISTEN socket — a
+                # leaked listener makes later binds EADDRINUSE and
+                # clients hang against the dead port
+
+            threading.Thread(target=_stop, daemon=True).start()
             return {"ok": True}
         return {"ok": False, "error": f"unknown verb {verb}"}
 

@@ -19,3 +19,21 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax
 
 jax.config.update("jax_default_matmul_precision", "highest")
+
+
+
+def alloc_free_ports(n):
+    """Kernel-assigned free localhost ports for PS tests (shared
+    allocator — hand-picked bases collided across test files)."""
+    import socket
+
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return [f"127.0.0.1:{p}" for p in ports]

@@ -10,13 +10,7 @@ from paddle_tpu.ps.downpour import DownpourSGD
 from paddle_tpu.transpiler import DistributeTranspiler, DistributeTranspilerConfig
 from paddle_tpu.ps.transpile import launch_pservers, PSTrainer
 
-_PORT = [6470]
-
-
-def _ports(n):
-    base = _PORT[0]
-    _PORT[0] += n
-    return [f"127.0.0.1:{p}" for p in range(base, base + n)]
+from conftest import alloc_free_ports as _ports
 
 
 def _sparse_model(seed=5):
