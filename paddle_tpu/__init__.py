@@ -60,8 +60,10 @@ from .data_feeder import DataFeeder
 from .reader import DataLoader
 from .lod_tensor import LoDTensor, create_lod_tensor, create_random_int_lodtensor
 from .io import save, load, save_params, load_params, save_persistables, load_persistables
-from .core import dygraph
 from .core.dygraph import dygraph_guard as _dg
+# the user-facing fluid.dygraph is the full package (Layer, nn classes,
+# schedulers, guard/enabled from base.py)
+from . import dygraph
 from .flags import get_flags, set_flags
 from . import debugger
 from . import flags
@@ -106,3 +108,106 @@ __all__ = [
     "DataFeeder",
     "DataLoader",
 ]
+
+
+# -- reference framework.py helpers ---------------------------------------
+
+def cpu_places(device_count=None):
+    """Reference framework.py cpu_places."""
+    n = device_count or 1
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    """Reference cuda_places: accelerator places — TPU devices here."""
+    import jax
+
+    ids = device_ids if device_ids is not None else range(len(jax.devices()))
+    return [TPUPlace(i) for i in ids]
+
+
+def cuda_pinned_places(device_count=None):
+    # pinned host memory is a CUDA notion; host places stand in
+    return cpu_places(device_count)
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def device_guard(device=None):
+    """Reference device_guard: pin following ops to a device. XLA owns
+    placement (whole-block compilation); accepted for parity, no-op."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def _g():
+        yield
+
+    return _g()
+
+
+def require_version(min_version, max_version=None):
+    """Reference framework.py require_version."""
+    from . import __version__ as _v
+
+    def parse(s):
+        parts = []
+        for x in str(s).split(".")[:3]:
+            digits = "".join(ch for ch in x if ch.isdigit())
+            parts.append(int(digits or 0))
+        while len(parts) < 3:
+            parts.append(0)  # pad: '0.1' allows any 0.1.x (reference)
+        return tuple(parts)
+
+    cur = parse(_v)
+    if parse(min_version) > cur:
+        raise Exception(
+            f"paddle_tpu version {_v} < required {min_version}")
+    if max_version is not None and parse(max_version) < cur:
+        raise Exception(
+            f"paddle_tpu version {_v} > allowed {max_version}")
+
+
+def load_op_library(lib_path):
+    """Reference framework.py load_op_library (custom C++ op .so).
+    Custom ops here are python modules calling
+    core.registry.register_op; a path to a .py registers its ops, and a
+    native .so is loaded via ctypes for host kernels used by py_func."""
+    import ctypes
+    import runpy
+
+    if str(lib_path).endswith(".py"):
+        runpy.run_path(str(lib_path))
+        return None
+    return ctypes.CDLL(str(lib_path))
+
+
+class ParallelExecutor:
+    """Reference parallel_executor.py ParallelExecutor — thin shim over
+    CompiledProgram.with_data_parallel + Executor (the reference's own
+    newer API does the same internally)."""
+
+    def __init__(self, use_cuda=True, loss_name=None, main_program=None,
+                 share_vars_from=None, exec_strategy=None,
+                 build_strategy=None, num_trainers=1, trainer_id=0,
+                 scope=None):
+        from .core.framework import default_main_program
+
+        self._program = main_program or default_main_program()
+        self._compiled = CompiledProgram(self._program).with_data_parallel(
+            loss_name=loss_name, build_strategy=build_strategy,
+            exec_strategy=exec_strategy)
+        self._exe = Executor(TPUPlace())
+        self._scope = scope
+
+    def run(self, fetch_list, feed=None, feed_dict=None, return_numpy=True):
+        import contextlib
+
+        feed = feed if feed is not None else feed_dict
+        cm = (scope_guard(self._scope) if self._scope is not None
+              else contextlib.nullcontext())
+        with cm:
+            return self._exe.run(self._compiled, feed=feed,
+                                 fetch_list=fetch_list,
+                                 return_numpy=return_numpy)

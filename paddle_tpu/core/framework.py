@@ -111,6 +111,13 @@ class _UniqueNameModule:
         finally:
             self._generator = old
 
+    def switch(self, new_generator=None):
+        """Reference unique_name.switch: swap the live generator,
+        returning the previous one (callers restore it themselves)."""
+        old = self._generator
+        self._generator = new_generator or _UniqueNameGenerator()
+        return old
+
 
 unique_name = _UniqueNameModule()
 

@@ -35,3 +35,7 @@ from .checkpoint import save_dygraph, load_dygraph
 from .jit import TracedLayer, to_static
 from .dygraph_to_static import declarative, convert_to_static
 from .container import Sequential, LayerList, ParameterList
+from .learning_rate_scheduler import (
+    LearningRateDecay, PiecewiseDecay, NaturalExpDecay, ExponentialDecay,
+    InverseTimeDecay, PolynomialDecay, CosineDecay, NoamDecay,
+)

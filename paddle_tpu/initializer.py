@@ -185,4 +185,23 @@ Bilinear = BilinearInitializer
 
 
 def force_init_on_cpu() -> bool:
-    return False
+    # True inside a `with init_on_cpu():` block (reference contract);
+    # placement itself is XLA's, so this is purely the observable flag
+    return bool(globals().get("_force_init_on_cpu", False))
+
+
+import contextlib as _contextlib
+
+
+@_contextlib.contextmanager
+def init_on_cpu():
+    """Reference initializer.py init_on_cpu: force init ops onto CPU.
+    Device placement is XLA's job here (init compiles like any block),
+    so this guard only flips the force_init_on_cpu flag for parity."""
+    global _force_init_on_cpu
+    prev = globals().get("_force_init_on_cpu", False)
+    globals()["_force_init_on_cpu"] = True
+    try:
+        yield
+    finally:
+        globals()["_force_init_on_cpu"] = prev

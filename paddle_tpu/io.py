@@ -24,6 +24,8 @@ from .core.executor import Executor, Scope, global_scope
 from .core.framework import Program, Variable
 
 __all__ = [
+    "get_program_parameter", "get_program_persistable_vars",
+    "load_program_state", "set_program_state", "batch",
     "save_vars",
     "save_params",
     "save_persistables",
@@ -266,3 +268,73 @@ def latest_checkpoint(dirname):
         return None
     steps = [int(d) for d in os.listdir(dirname) if d.isdigit()]
     return max(steps) if steps else None
+
+
+def get_program_parameter(program):
+    """Reference io.py: all Parameters of a program."""
+    from .core.framework import Parameter
+
+    return [v for v in program.global_block().vars.values()
+            if isinstance(v, Parameter)]
+
+
+def get_program_persistable_vars(program):
+    return _persistable_vars(program)
+
+
+def load_program_state(model_path, var_list=None):
+    """Reference io.py:2004-ish — read a saved state into a dict."""
+    import os
+
+    import numpy as np
+
+    state = {}
+    # accept: exact file, <path>.npz, fluid.save's <path>.pdparams.npz,
+    # or a directory of per-var .npy files
+    candidates = [model_path, model_path + ".npz",
+                  model_path + ".pdparams.npz", model_path + ".pdparams"]
+    archive = next((c for c in candidates if os.path.isfile(c)), None)
+    if archive is not None:
+        z = np.load(archive)
+        state = {k: z[k] for k in z.files}
+    else:
+        for fn in os.listdir(model_path):
+            if fn.endswith(".npy"):
+                state[fn[:-4]] = np.load(os.path.join(model_path, fn))
+    if var_list is not None:
+        names = {v.name if hasattr(v, "name") else str(v) for v in var_list}
+        state = {k: v for k, v in state.items() if k in names}
+    return state
+
+
+def set_program_state(program, state_dict):
+    """Reference io.py set_program_state: write values into the current
+    scope for the program's matching persistables."""
+    import jax.numpy as jnp
+
+    from .core.executor import global_scope
+
+    scope = global_scope()
+    n = 0
+    for v in _persistable_vars(program):
+        if v.name in state_dict:
+            scope.set_var(v.name, jnp.asarray(state_dict[v.name]))
+            n += 1
+    return n
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Reference fluid.io.batch (paddle.batch): group a sample reader
+    into batches."""
+
+    def batched():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batched

@@ -8,6 +8,7 @@ from __future__ import annotations
 import numpy as np
 
 __all__ = [
+    "ChunkEvaluator", "DetectionMAP",
     "MetricBase",
     "CompositeMetric",
     "Precision",
@@ -155,3 +156,58 @@ class EditDistance(MetricBase):
             self.total_distance / self.seq_num,
             self.instance_error / self.seq_num,
         )
+
+
+class ChunkEvaluator(MetricBase):
+    """Host-side accumulated chunk P/R/F1 (reference metrics.py
+    ChunkEvaluator; feed it the chunk_eval op's count outputs)."""
+
+    def __init__(self, name=None):
+        super().__init__(name or "chunk")
+        self.reset()
+
+    def reset(self):
+        self.num_infer_chunks = 0
+        self.num_label_chunks = 0
+        self.num_correct_chunks = 0
+
+    def update(self, num_infer_chunks, num_label_chunks, num_correct_chunks):
+        import numpy as np
+
+        self.num_infer_chunks += int(np.asarray(num_infer_chunks).sum())
+        self.num_label_chunks += int(np.asarray(num_label_chunks).sum())
+        self.num_correct_chunks += int(np.asarray(num_correct_chunks).sum())
+        precision = (self.num_correct_chunks / self.num_infer_chunks
+                     if self.num_infer_chunks else 0.0)
+        recall = (self.num_correct_chunks / self.num_label_chunks
+                  if self.num_label_chunks else 0.0)
+        f1 = (2 * precision * recall / (precision + recall)
+              if precision + recall else 0.0)
+        return precision, recall, f1
+
+    def eval(self):
+        return self.update(0, 0, 0)
+
+
+class DetectionMAP(MetricBase):
+    """Host-side streaming mean of per-batch mAP values (reference
+    metrics.py DetectionMAP over the detection_map op's MAP output)."""
+
+    def __init__(self, name=None):
+        super().__init__(name or "map")
+        self.reset()
+
+    def reset(self):
+        self._sum = 0.0
+        self._count = 0
+
+    def update(self, value, weight=1):
+        import numpy as np
+
+        self._sum += float(np.asarray(value).sum()) * weight
+        self._count += weight
+
+    def eval(self):
+        if not self._count:
+            raise ValueError("DetectionMAP.eval() before any update()")
+        return self._sum / self._count
