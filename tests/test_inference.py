@@ -46,3 +46,51 @@ def test_predictor_handles_and_clone(tmp_path):
     p2 = pred.clone()
     (got2,) = p2.run([xv])
     np.testing.assert_allclose(got2, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_predictor_clone_per_thread_concurrent(tmp_path):
+    """Reference AnalysisPredictor serving pattern: one clone per
+    thread, concurrent zero-copy runs, every iteration's output must
+    match that thread's single-threaded oracle (shared weights +
+    compiled executable, isolated IO handles)."""
+    import threading
+
+    _export_model(tmp_path)
+    base = create_predictor(Config(str(tmp_path)))
+    in_name = base.get_input_names()[0]
+    out_name = base.get_output_names()[0]
+
+    rng = np.random.RandomState(0)
+    inputs = [rng.randn(5, 6).astype("float32") for _ in range(8)]
+    # single-threaded oracle through the base predictor
+    oracles = []
+    for a in inputs:
+        base.get_input_handle(in_name).copy_from_cpu(a)
+        base.run()
+        oracles.append(np.array(base.get_output_handle(out_name).copy_to_cpu()))
+
+    errors = []
+
+    def worker(i):
+        try:
+            p = base.clone()
+            for _ in range(3):  # hammer the shared executable
+                p.get_input_handle(in_name).copy_from_cpu(inputs[i])
+                p.run()
+                got = np.array(p.get_output_handle(out_name).copy_to_cpu())
+                # assert EVERY iteration: transient cross-thread
+                # corruption must not hide behind a clean last run
+                np.testing.assert_allclose(got, oracles[i], rtol=1e-5,
+                                           atol=1e-6)
+        except Exception as e:
+            errors.append((i, repr(e)))
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(len(inputs))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    hung = [t.name for t in threads if t.is_alive()]
+    assert not hung, f"deadlocked serving threads: {hung}"
+    assert not errors, errors
