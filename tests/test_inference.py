@@ -85,7 +85,7 @@ def test_predictor_clone_per_thread_concurrent(tmp_path):
         except Exception as e:
             errors.append((i, repr(e)))
 
-    threads = [threading.Thread(target=worker, args=(i,))
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
                for i in range(len(inputs))]
     for t in threads:
         t.start()
