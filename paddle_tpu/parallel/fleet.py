@@ -283,6 +283,23 @@ class DistributedOptimizer:
             loss, startup_program, parameter_list, no_grad_set
         )
         program = loss.block.program
+        if self._strategy.sharding:
+            # ZeRO-1: shard optimizer accumulators over the dp axis
+            # (reference sharding strategy / kReduce mode)
+            import logging
+
+            import jax
+
+            from .sharding import shard_optimizer_states
+
+            n_sharded = shard_optimizer_states(program, len(jax.devices()))
+            if n_sharded == 0:
+                logging.getLogger("paddle_tpu.fleet").warning(
+                    "DistributedStrategy.sharding=True sharded NOTHING: "
+                    "no optimizer accumulator dim-0 is divisible by the "
+                    "%d devices — training stays fully replicated "
+                    "(pad the hidden sizes or change device count)",
+                    len(jax.devices()))
         self._fleet._origin_program = program
         compiled = CompiledProgram(program, self._strategy.build_strategy)
         compiled.with_data_parallel(loss_name=loss.name)

@@ -62,3 +62,45 @@ def test_fleet_ps_mode_transpiles(monkeypatch):
     assert art.grad_to_param  # grads mapped to params
     # trainer program has no optimizer ops
     assert not any(op.type == "sgd" for op in art.trainer_program.global_block().ops)
+
+
+def test_fleet_strategy_sharding_applies_zero():
+    """DistributedStrategy.sharding=True must actually shard the
+    optimizer accumulators (ZeRO-1), not just record the flag."""
+    import jax
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu.parallel import fleet as fleet_mod
+
+    fleet = fleet_mod.fleet
+    role = fleet_mod.UserDefinedRoleMaker(
+        current_id=0, role=fleet_mod.Role.WORKER, worker_num=1,
+        server_endpoints=[])
+    fleet.init(role)
+    strategy = fleet_mod.DistributedStrategy()
+    strategy.sharding = True
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data("x", [8 * len(jax.devices())])
+        y = fluid.layers.data("y", [1])
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(
+            fluid.layers.fc(x, 1), y))
+        opt = fleet.distributed_optimizer(
+            fluid.optimizer.Adam(1e-3), strategy)
+        opt.minimize(loss)
+    block = main.global_block()
+    sharded = [n for n in block.vars
+               if "moment" in n and block.var(n).sharding is not None]
+    assert sharded, "sharding=True did not annotate any optimizer state"
+    # and it still trains
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        d = 8 * len(jax.devices())
+        xv = np.random.randn(2 * len(jax.devices()), d).astype("float32")
+        (l,) = exe.run(fleet.main_program, feed={"x": xv, "y": xv[:, :1]},
+                       fetch_list=[loss])
+        assert np.isfinite(float(np.asarray(l)))
