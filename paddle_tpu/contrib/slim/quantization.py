@@ -69,6 +69,37 @@ class QuantizationTransformPass:
                         attrs={"bit_length": bits, "op_role": OpRole.Forward},
                     )
                 )
+            elif self._act_type == "range_abs_max":
+                # sliding-window scale: the window ring buffer and the
+                # step counter are persistable vars threaded in/out of
+                # the op each step (the reference mutates OutScales in
+                # place; this functional framework round-trips it)
+                window = 10000
+                in_scale = self._persistable_scalar(block, f"{name}.q_scale", 1.0)
+                it = self._persistable_scalar(block, f"{name}.q_iter", 0.0)
+                scales = self._persistable_scalar(
+                    block, f"{name}.q_scales", 0.0, shape=(window,))
+                out_ops.append(
+                    Operator(
+                        block,
+                        "fake_quantize_range_abs_max",
+                        inputs={"X": [name], "InScale": [in_scale.name],
+                                "Iter": [it.name],
+                                "InScales": [scales.name]},
+                        outputs={"Out": [qname],
+                                 "OutScale": [in_scale.name],
+                                 "OutScales": [scales.name]},
+                        attrs={"bit_length": bits, "window_size": window,
+                               "op_role": OpRole.Forward},
+                    )
+                )
+                out_ops.append(
+                    Operator(
+                        block, "increment", inputs={"X": [it.name]},
+                        outputs={"Out": [it.name]},
+                        attrs={"step": 1.0, "op_role": OpRole.Forward},
+                    )
+                )
             else:
                 # moving-average scale: persistable state vars
                 state = self._persistable_scalar(block, f"{name}.q_state", 1.0)
@@ -119,13 +150,13 @@ class QuantizationTransformPass:
         program._bump()
         return program
 
-    def _persistable_scalar(self, block, name, value):
+    def _persistable_scalar(self, block, name, value, shape=(1,)):
         name = unique_name.generate(name)
-        v = block.create_var(name=name, shape=(1,), persistable=True, stop_gradient=True)
+        v = block.create_var(name=name, shape=shape, persistable=True, stop_gradient=True)
         sp = self._startup_program
         if sp is not None:
             sv = sp.global_block().create_var(
-                name=name, shape=(1,), persistable=True
+                name=name, shape=shape, persistable=True
             )
             ConstantInitializer(value)(sv, sp.global_block())
             sp._bump()

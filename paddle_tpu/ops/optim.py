@@ -488,10 +488,11 @@ def _proximal_adagrad(ctx, op, ins):
     l1 = float(op.attrs.get("l1", 0.0))
     l2 = float(op.attrs.get("l2", 0.0))
     m_new = m + g * g
-    eff_lr = lr / jnp.sqrt(m_new)
-    prox = p - eff_lr * g
-    out = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - eff_lr * l1, 0.0) / (
-        1.0 + eff_lr * l2)
+    # the proximal step uses the per-element effective lr, but the l1/l2
+    # shrinkage uses the base scalar lr (proximal_adagrad_op.h:52-63)
+    prox = p - (lr / jnp.sqrt(m_new)) * g
+    out = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0) / (
+        1.0 + lr * l2)
     return {"ParamOut": [out], "MomentOut": [m_new]}
 
 
@@ -502,9 +503,11 @@ def _proximal_adagrad(ctx, op, ins):
              no_grad=("LearningRate", "current_step", "nranks"),
              stop_gradient=True)
 def _dgc_momentum(ctx, op, ins):
-    # reference optimizers/dgc_momentum_op.cc: before rampup_begin_step
-    # run plain SGD on grad/nranks; after it, momentum (the compressed-
-    # grad path). Branchless via where — both are cheap.
+    # reference optimizers/dgc_momentum_op.h: MOMENTUM while
+    # current_step < rampup_begin_step, plain SGD after (DGC folds the
+    # momentum correction into dgc_op once compression starts). Both
+    # branches consume the RAW grad; Grad_out is ALWAYS grad/nranks
+    # (dgc_op multiplies by nranks downstream). Branchless via where.
     p, g, v = ins["Param"][0], ins["Grad"][0], ins["Velocity"][0]
     lr = ins["LearningRate"][0].reshape(())
     step = ins["current_step"][0].reshape(()).astype(jnp.float32)
@@ -513,18 +516,22 @@ def _dgc_momentum(ctx, op, ins):
     mu = float(op.attrs.get("mu", 0.9))
     use_nesterov = bool(op.attrs.get("use_nesterov", False))
     rampup = float(op.attrs.get("rampup_begin_step", 0.0))
+    if int(rampup) < 0:
+        # disabled-DGC sentinel: no-op (dgc_momentum_op.h:33-36 returns
+        # before touching any output)
+        return {"ParamOut": [p], "VelocityOut": [v], "Grad_out": [g]}
 
-    # momentum branch
+    # pre-rampup momentum branch
     v_new = mu * v + g
     p_mom = (p - lr * (g + mu * v_new)) if use_nesterov else (p - lr * v_new)
-    # pre-rampup sgd branch (grad averaged over ranks)
-    p_sgd = p - lr * (g / nranks)
+    # post-rampup sgd branch (raw grad; dgc_op handled averaging)
+    p_sgd = p - lr * g
 
-    use_sgd = step < rampup
+    use_momentum = step < rampup
     return {
-        "ParamOut": [jnp.where(use_sgd, p_sgd, p_mom)],
-        "VelocityOut": [jnp.where(use_sgd, v, v_new)],
-        "Grad_out": [jnp.where(use_sgd, g / nranks, g)],
+        "ParamOut": [jnp.where(use_momentum, p_mom, p_sgd)],
+        "VelocityOut": [jnp.where(use_momentum, v_new, v)],
+        "Grad_out": [g / nranks],
     }
 
 

@@ -93,25 +93,53 @@ def _fake_dequantize_max_abs(ctx, op, ins):
 
 
 @register_op("fake_quantize_range_abs_max",
-             inputs=("X", "InScale", "Iter"),
+             inputs=("X", "InScale", "Iter", "InScales"),
              outputs=("Out", "OutScale", "OutScales"),
-             no_grad=("InScale", "Iter"))
+             no_grad=("InScale", "Iter", "InScales"))
 def _fake_quantize_range_abs_max(ctx, op, ins):
-    # windowed abs-max (reference fake_quantize_op.cc
-    # FakeQuantizeRangeAbsMaxOp): training keeps the max of the current
-    # batch vs the running in-scale; inference uses InScale as-is.
+    # sliding-window abs-max (reference fake_quantize_op.cc
+    # FindRangeAbsMaxFunctor:119-142): a window_size ring buffer of
+    # per-batch maxima indexed Iter % window_size; the scale is the max
+    # over the window, so an early outlier DECAYS once it rotates out.
+    # The window buffer round-trips through OutScales→InScales (the
+    # reference mutates its scales_arr in place; this framework is
+    # functional, so the next iteration feeds OutScales back in).
+    # Without InScales, falls back to the monotone max(cur, InScale).
+    # Inference (is_test) uses InScale as-is.
     x = ins["X"][0]
     bits = int(op.attrs.get("bit_length", 8))
     is_test = bool(op.attrs.get("is_test", False))
     in_scale = ins["InScale"][0].reshape(()) if ins.get("InScale") else jnp.asarray(0.0, x.dtype)
+    in_scales = (ins["InScales"][0].reshape(-1) if ins.get("InScales")
+                 else None)
     if is_test:
         scale = in_scale
+        out_scales = in_scales if in_scales is not None else scale.reshape(1)
+    elif in_scales is not None:
+        cur = jnp.max(jnp.abs(x))
+        it = (ins["Iter"][0].reshape(()).astype(jnp.int32)
+              if ins.get("Iter") else jnp.asarray(0, jnp.int32))
+        idx = jnp.mod(it, in_scales.shape[0])
+        removed = in_scales[idx]
+        arr = in_scales.at[idx].set(cur)
+        # exact FindRangeAbsMaxFunctor logic, incl. warm start: keep
+        # last_scale (InScale) unless the new batch max beats it or the
+        # evicted slot WAS the max (then recompute over the window;
+        # unfilled slots are 0 and scales are non-negative, so max over
+        # the whole buffer equals max over filled slots)
+        scale = jnp.where(
+            cur > in_scale, cur,
+            jnp.where(jnp.abs(removed - in_scale) < 1e-6,
+                      jnp.max(arr), in_scale))
+        out_scales = arr
     else:
+        # no window threaded (bare op use): monotone running max
         scale = jnp.maximum(jnp.max(jnp.abs(x)), in_scale)
+        out_scales = scale.reshape(1)
     return {
         "Out": [_quant_dequant(x, scale, bits)],
         "OutScale": [scale.reshape(1)],
-        "OutScales": [scale.reshape(1)],
+        "OutScales": [out_scales],
     }
 
 

@@ -380,8 +380,9 @@ def _gru(ctx, op, ins):
     B, T, H3 = x.shape
     H = H3 // 3
     origin = bool(op.attrs.get("origin_mode", False))
+    rev = bool(op.attrs.get("is_reverse", False))
     xs = jnp.swapaxes(x, 0, 1)
-    if bool(op.attrs.get("is_reverse", False)):
+    if rev:
         xs = jnp.flip(xs, 0)
     if ins.get("Bias"):
         xs = xs + ins["Bias"][0].reshape(1, 1, -1)
@@ -389,7 +390,6 @@ def _gru(ctx, op, ins):
     wh_rz, wh_c = wh[:, : 2 * H], wh[:, 2 * H:]
 
     ln = ins["Length"][0] if ins.get("Length") else None
-    rev = bool(op.attrs.get("is_reverse", False))
     Tn = xs.shape[0]
 
     def cell(carry, scan_in):
@@ -408,8 +408,10 @@ def _gru(ctx, op, ins):
         return h_new, (rz, rhp, h_new)
     h_last, (gates, rhps, hs) = jax.lax.scan(
         cell, h0, (jnp.arange(Tn), xs))
-    if bool(op.attrs.get("is_reverse", False)):
-        hs = jnp.flip(hs, 0)
+    if rev:
+        # all time-indexed outputs share the original time order
+        hs, gates, rhps = (jnp.flip(hs, 0), jnp.flip(gates, 0),
+                           jnp.flip(rhps, 0))
     sw = lambda v: jnp.swapaxes(v, 0, 1)
     return {
         "BatchGate": [sw(gates)],
@@ -511,17 +513,24 @@ def _cudnn_lstm(ctx, op, ins):
         off += 4 * H
         return wx, wh, b1 + b2, off
 
-    def run_dir(xs, off):
+    # user-provided initial states [num_directions, B, H]
+    # (cudnn_lstm_op.cc uses init_h/init_c as the starting states)
+    init_h = ins["InitH"][0] if ins.get("InitH") else None
+    init_c = ins["InitC"][0] if ins.get("InitC") else None
+
+    def run_dir(xs, off, d):
         wx, wh, b, off = unpack(off)
-        h0 = jnp.zeros((B, H), x.dtype)
-        c0 = jnp.zeros((B, H), x.dtype)
+        h0 = (init_h.reshape(-1, B, H)[d] if init_h is not None
+              else jnp.zeros((B, H), x.dtype))
+        c0 = (init_c.reshape(-1, B, H)[d] if init_c is not None
+              else jnp.zeros((B, H), x.dtype))
         xp = xs.reshape(T * B, D) @ wx + b
         hs, cs, h_l, c_l = _lstm_scan(xp.reshape(T, B, 4 * H), wh, h0, c0)
         return hs, h_l, c_l, off
 
-    hs_f, h_f, c_f, off = run_dir(x, 0)
+    hs_f, h_f, c_f, off = run_dir(x, 0, 0)
     if bidi:
-        hs_b, h_b, c_b, _ = run_dir(jnp.flip(x, 0), off)
+        hs_b, h_b, c_b, _ = run_dir(jnp.flip(x, 0), off, 1)
         out = jnp.concatenate([hs_f, jnp.flip(hs_b, 0)], -1)
         last_h = jnp.stack([h_f, h_b])
         last_c = jnp.stack([c_f, c_b])

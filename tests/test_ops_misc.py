@@ -184,9 +184,10 @@ class TestProximalAdagrad(OpTest):
     lr = np.array([0.1], "float32")
     l1, l2 = 0.05, 0.05
     m2 = m + g * g
-    elr = 0.1 / np.sqrt(m2)
-    prox = p - elr * g
-    expect = np.sign(prox) * np.maximum(np.abs(prox) - elr * l1, 0) / (1 + elr * l2)
+    # proximal step uses effective lr, but l1/l2 shrinkage uses the base
+    # scalar lr (reference proximal_adagrad_op.h:52-63)
+    prox = p - (0.1 / np.sqrt(m2)) * g
+    expect = np.sign(prox) * np.maximum(np.abs(prox) - 0.1 * l1, 0) / (1 + 0.1 * l2)
     inputs = {"Param": p, "Moment": m, "Grad": g, "LearningRate": lr}
     attrs = {"l1": l1, "l2": l2}
     outputs = {"ParamOut": expect, "MomentOut": m2}
@@ -202,26 +203,30 @@ class TestDgcMomentum(OpTest):
     v = rng.randn(3).astype("float32")
     lr = np.array([0.1], "float32")
 
-    def test_pre_rampup_sgd(self):
+    def test_pre_rampup_momentum(self):
+        # reference dgc_momentum_op.h:65-71: MOMENTUM while
+        # current_step < rampup_begin_step; Grad_out is always g/nranks
+        v2 = 0.9 * self.v + self.g
         self.inputs = {"Param": self.p, "Grad": self.g, "Velocity": self.v,
                        "LearningRate": self.lr,
                        "current_step": np.array([1.0], "float32"),
                        "nranks": np.array([2.0], "float32")}
         self.attrs = {"mu": 0.9, "rampup_begin_step": 10.0}
-        self.outputs = {"ParamOut": self.p - 0.1 * self.g / 2,
-                        "VelocityOut": self.v,
+        self.outputs = {"ParamOut": self.p - 0.1 * v2,
+                        "VelocityOut": v2,
                         "Grad_out": self.g / 2}
         self.check_output(atol=1e-6)
 
-    def test_post_rampup_momentum(self):
-        v2 = 0.9 * self.v + self.g
+    def test_post_rampup_sgd(self):
+        # plain SGD on the RAW grad after rampup (dgc_op already folded
+        # in momentum correction + averaging); Grad_out still g/nranks
         self.inputs = {"Param": self.p, "Grad": self.g, "Velocity": self.v,
                        "LearningRate": self.lr,
                        "current_step": np.array([20.0], "float32"),
                        "nranks": np.array([2.0], "float32")}
         self.attrs = {"mu": 0.9, "rampup_begin_step": 10.0}
-        self.outputs = {"ParamOut": self.p - 0.1 * v2,
-                        "VelocityOut": v2, "Grad_out": self.g}
+        self.outputs = {"ParamOut": self.p - 0.1 * self.g,
+                        "VelocityOut": self.v, "Grad_out": self.g / 2}
         self.check_output(atol=1e-6)
 
 
@@ -374,3 +379,60 @@ def test_py_func_backward():
     xv = rng.randn(2, 3).astype("float32")
     (gv,) = exe.run(main, feed={"x": xv}, fetch_list=[gx])
     np.testing.assert_allclose(np.asarray(gv), 2 * xv / 6, rtol=1e-5)
+
+
+class TestRangeAbsMaxSlidingWindow(OpTest):
+    op_type = "fake_quantize_range_abs_max"
+    # advisor r2: the scale must DECAY once an early outlier rotates out
+    # of the window_size ring buffer (reference FindRangeAbsMaxFunctor,
+    # fake_quantize_op.cc:119-142) — not a monotone running max
+
+    def _step(self, x, in_scale, it, in_scales, window=3):
+        self.inputs = {"X": x, "InScale": in_scale,
+                       "Iter": np.array([it], "int64"),
+                       "InScales": in_scales}
+        self.attrs = {"bit_length": 8, "window_size": window}
+        cur = np.max(np.abs(x))
+        arr = in_scales.copy()
+        arr[it % window] = cur
+        scale = np.max(arr)
+        q = np.round(x / scale * 127.0)
+        self.outputs = {"Out": np.clip(q, -127, 127) * scale / 127.0,
+                        "OutScale": np.array([scale], "float32"),
+                        "OutScales": arr}
+        self.check_output(atol=1e-5, rtol=1e-5)
+        return arr, np.array([scale], "float32")
+
+    def test_outlier_decays(self):
+        window = 3
+        arr = np.zeros(window, "float32")
+        scale = np.array([0.0], "float32")
+        maxima = [10.0, 1.0, 1.5, 0.5, 2.0]  # outlier at step 0
+        scales = []
+        for it, m in enumerate(maxima):
+            x = (rng.rand(4, 4).astype("float32") - 0.5) * 2 * m
+            x.flat[0] = m  # pin the batch max
+            arr, scale = self._step(x, scale, it, arr, window)
+            scales.append(float(scale[0]))
+        assert scales[0] == 10.0
+        assert scales[2] == 10.0  # still inside the window
+        assert scales[3] < 10.0  # outlier rotated out -> decay
+        assert abs(scales[3] - 1.5) < 1e-6
+
+    def test_warm_start_keeps_seeded_scale(self):
+        # checkpoint-resume: a seeded InScale larger than anything in
+        # the (empty) window must persist until beaten or evicted
+        window = 3
+        x = (rng.rand(4, 4).astype("float32") - 0.5)  # |x| < 0.5
+        cur = np.max(np.abs(x))
+        self.inputs = {"X": x, "InScale": np.array([5.0], "float32"),
+                       "Iter": np.array([0], "int64"),
+                       "InScales": np.zeros(window, "float32")}
+        self.attrs = {"bit_length": 8, "window_size": window}
+        arr = np.zeros(window, "float32")
+        arr[0] = cur
+        q = np.round(x / 5.0 * 127.0)
+        self.outputs = {"Out": np.clip(q, -127, 127) * 5.0 / 127.0,
+                        "OutScale": np.array([5.0], "float32"),
+                        "OutScales": arr}
+        self.check_output(atol=1e-5, rtol=1e-5)

@@ -292,3 +292,65 @@ class TestLstmpReverse(OpTest):
         self.outputs = {"Projection": proj}
         self.check_output(atol=1e-4, rtol=1e-4, no_check_set=(
             "Cell", "BatchGate", "BatchCellPreAct", "BatchHidden"))
+
+
+class TestGruReverseOutputOrdering(OpTest):
+    op_type = "gru"
+    # regression (advisor r2): with is_reverse, BatchGate and
+    # BatchResetHiddenPrev must come back in ORIGINAL time order like
+    # BatchHidden/Hidden do — all time-indexed outputs share one order
+    B, T, H = 2, 3, 3
+
+    def test_output(self):
+        xp = rng.randn(self.B, self.T, 3 * self.H).astype("float32")
+        wh = rng.randn(self.H, 3 * self.H).astype("float32")
+        H = self.H
+        h = np.zeros((self.B, H), "float32")
+        hs, gates, rhps = [], [], []
+        for t in range(self.T - 1, -1, -1):  # reverse-time oracle
+            x_t = xp[:, t]
+            rz = sig(x_t[:, : 2 * H] + h @ wh[:, : 2 * H])
+            r, z = rz[:, :H], rz[:, H:]
+            rhp = r * h
+            c = np.tanh(x_t[:, 2 * H:] + rhp @ wh[:, 2 * H:])
+            h = (1 - z) * h + z * c
+            hs.append(h.copy())
+            gates.append(rz.copy())
+            rhps.append(rhp.copy())
+        to_orig = lambda seq: np.stack(seq[::-1], 1)
+        self.inputs = {"Input": xp, "Weight": wh}
+        self.attrs = {"is_reverse": True}
+        self.outputs = {
+            "Hidden": to_orig(hs),
+            "BatchHidden": to_orig(hs),
+            "BatchGate": to_orig(gates),
+            "BatchResetHiddenPrev": to_orig(rhps),
+        }
+        self.check_output(atol=1e-4, rtol=1e-4)
+
+
+class TestCudnnLstmInitStates(OpTest):
+    op_type = "cudnn_lstm"
+    # regression (advisor r2): InitH/InitC must seed the scan, not be
+    # silently ignored (reference cudnn_lstm_op uses init_h/init_c)
+    T, B, D, H = 3, 2, 3, 4
+
+    def test_initial_states_used(self):
+        x = rng.randn(self.T, self.B, self.D).astype("float32")
+        wx = rng.randn(self.D, 4 * self.H).astype("float32")
+        wh = rng.randn(self.H, 4 * self.H).astype("float32")
+        b1 = rng.randn(4 * self.H).astype("float32")
+        b2 = rng.randn(4 * self.H).astype("float32")
+        w = np.concatenate([wx.ravel(), wh.ravel(), b1, b2])
+        h0 = rng.randn(1, self.B, self.H).astype("float32")
+        c0 = rng.randn(1, self.B, self.H).astype("float32")
+        xp = np.einsum("tbd,dk->tbk", x, wx) + b1 + b2
+        hid, cell = lstm_ref(xp.transpose(1, 0, 2), wh, h0[0], c0[0])
+        self.inputs = {"Input": x, "W": w, "InitH": h0, "InitC": c0}
+        self.attrs = {"hidden_size": self.H}
+        self.outputs = {
+            "Out": hid.transpose(1, 0, 2),
+            "last_h": hid[:, -1][None],
+            "last_c": cell[:, -1][None],
+        }
+        self.check_output(atol=1e-4, rtol=1e-4)

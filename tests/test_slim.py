@@ -49,6 +49,45 @@ def test_qat_trains_and_stays_close_to_fp32():
     assert float(l) < first * 0.7, (first, float(l))
 
 
+def test_qat_range_abs_max_threads_window():
+    # range_abs_max act-quant: the pass must thread the window ring
+    # buffer + iter counter through persistable vars so the scale can
+    # DECAY (reference FindRangeAbsMaxFunctor semantics)
+    from paddle_tpu.contrib.slim.quantization import QuantizationTransformPass
+
+    rng = np.random.RandomState(1)
+    main, startup, logits, loss = _classifier()
+    qpass = QuantizationTransformPass(
+        startup_program=startup, activation_quantize_type="range_abs_max")
+    qpass.apply(main)
+    blk = main.global_block()
+    qops = [op for op in blk.ops if op.type == "fake_quantize_range_abs_max"]
+    assert qops, {op.type for op in blk.ops}
+    for op in qops:
+        assert op.inputs.get("InScales") and op.inputs.get("Iter")
+        nm = lambda v: v if isinstance(v, str) else v.name
+        # window round-trips through the same persistable var
+        assert nm(op.inputs["InScales"][0]) == nm(op.outputs["OutScales"][0])
+    nm = lambda v: v if isinstance(v, str) else v.name
+    it_name = nm(qops[0].inputs["Iter"][0])
+    scale_name = nm(qops[0].outputs["OutScale"][0])
+
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        scales = []
+        for i in range(3):
+            xb = rng.randn(16, 8).astype("float32") * (10.0 if i == 0 else 1.0)
+            yb = np.zeros((16, 1), "int64")
+            _, s, it = exe.run(main, feed={"x": xb, "y": yb},
+                               fetch_list=[loss, scale_name, it_name])
+            scales.append(float(np.asarray(s)[0]))
+        assert float(np.asarray(it)[0]) == 3.0  # counter advanced
+        # the big first batch dominates and stays inside the window
+        assert scales[1] == scales[0] and scales[2] == scales[0]
+
+
 def test_quant_dequant_identity_within_step():
     # int8 quant-dequant error bounded by scale/127
     from paddle_tpu.ops import quant  # noqa: F401
