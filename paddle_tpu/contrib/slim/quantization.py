@@ -75,7 +75,12 @@ class QuantizationTransformPass:
                 # the op each step (the reference mutates OutScales in
                 # place; this functional framework round-trips it)
                 window = 10000
-                in_scale = self._persistable_scalar(block, f"{name}.q_scale", 1.0)
+                # seed tiny (reference transform pass uses 0.001): the
+                # seed is never stored in the window ring buffer, so a
+                # seed LARGER than real activations would pin the scale
+                # forever (the evicted-slot==max decay test never fires)
+                in_scale = self._persistable_scalar(
+                    block, f"{name}.q_scale", 0.001)
                 it = self._persistable_scalar(block, f"{name}.q_iter", 0.0)
                 scales = self._persistable_scalar(
                     block, f"{name}.q_scales", 0.0, shape=(window,))

@@ -1,4 +1,5 @@
-"""Blockwise (flash) attention for TPU via Pallas — forward AND backward.
+"""Blockwise (flash) attention for TPU via Pallas — forward AND backward,
+with key-padding mask and additive attention bias (BiasQK).
 
 Design: grid (batch, heads, seq_block); each program brings one Q (or
 K/V) block plus the full opposing sequence for its (b,h) into VMEM and
@@ -8,16 +9,30 @@ works on the MXU. For the sequence lengths the flagship configs use
 naive XLA attention is never materializing [B,H,S,S] in HBM. Longer
 sequences route to ring attention (parallel/ring_attention.py).
 
+Masking (reference operators/fused/multihead_matmul_op.cu:441 takes a
+BiasQK input for exactly this):
+  mask  — [B, S] key-padding mask, bool (True = attend) or additive
+          float (0 / -inf). O(B*S) HBM: the cheap form covering the
+          padded-batch BERT case without an O(S^2) tensor.
+  bias  — [B|1, H|1, S, S] additive attention bias (the general BiasQK
+          / relative-position case). Differentiable: dbias is emitted
+          blockwise by the dQ kernel and reduced over broadcast dims.
+Sequence lengths that don't divide the q/k block are zero-padded up to
+the block multiple; padded KEY positions are force-masked (even when
+the caller passed no mask), padded QUERY rows are sliced off.
+
 Backward (FlashAttention-2 style, no O(S^2) residuals):
   forward additionally emits LSE = m + log(sum exp(s - m)) per row;
   delta = rowsum(dO * O) is a cheap XLA elementwise;
   dQ kernel  (grid b,h,q_block):  recompute P from Q_i,K,LSE_i;
-      dP = dO_i V^T; dS = P*(dP - delta_i)*scale; dQ_i = dS K.
+      dP = dO_i V^T; dS = P*(dP - delta_i)*scale; dQ_i = dS K;
+      [has_bias] dBias_i = P*(dP - delta_i)  (the logits cotangent).
   dKV kernel (grid b,h,k_block):  P^T from K_j,Q,LSE;
       dV_j = P^T dO; dP^T = V_j dO^T; dS^T = P^T*(dP^T - delta)*scale;
       dK_j = dS^T Q.
 Residual memory is O(S) per (b,h) — the [B,H,S,S] blocks never exist,
-in forward or backward.
+in forward or backward (except the dbias output itself when a dense
+bias is used, which is inherently O(S^2)).
 
 Set PADDLE_TPU_FLASH_INTERPRET=1 to run the Pallas kernels in
 interpreter mode on any backend (how tests/test_flash_attention.py
@@ -42,15 +57,20 @@ _logger = logging.getLogger("paddle_tpu.flash_attention")
 
 NEG_INF = -1e30
 LANES = 128  # TPU minor-dim tile; lse/delta are stored lane-replicated
+DEFAULT_BLK = 256
 
 
-def _reference_attention(q, k, v, sm_scale, causal):
-    # [B, H, S, D]
+def _reference_attention(q, k, v, sm_scale, causal, mask=None, bias=None):
+    # [B, H, S, D]; mask additive [B, S]; bias [B|1, H|1, S, S]
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * sm_scale
+    if bias is not None:
+        s = s + bias
+    if mask is not None:
+        s = s + mask[:, None, None, :]
     if causal:
         S = q.shape[2]
-        mask = jnp.tril(jnp.ones((S, S), bool))
-        s = jnp.where(mask[None, None], s, NEG_INF)
+        cm = jnp.tril(jnp.ones((S, S), bool))
+        s = jnp.where(cm[None, None], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", p, v)
 
@@ -63,13 +83,28 @@ def _pallas_mode() -> Optional[str]:
     return None
 
 
+def _bias_index(Bb: int, Hb: int):
+    """Index map for a broadcastable [B|1, H|1, ...] bias block."""
+    def idx(b, h, i):
+        return (b if Bb > 1 else 0, h if Hb > 1 else 0, i, 0)
+    return idx
+
+
 # -- forward ----------------------------------------------------------------
 
 
-def _make_fwd_kernel(blk_q: int, causal: bool, sm_scale: float, with_lse: bool):
+def _make_fwd_kernel(blk_q: int, causal: bool, sm_scale: float,
+                     with_lse: bool, has_mask: bool, has_bias: bool):
     from jax.experimental import pallas as pl
 
-    def kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None):
+    def kernel(*refs):
+        it = iter(refs)
+        q_ref, k_ref, v_ref = next(it), next(it), next(it)
+        mask_ref = next(it) if has_mask else None
+        bias_ref = next(it) if has_bias else None
+        o_ref = next(it)
+        lse_ref = next(it) if with_lse else None
+
         qi = pl.program_id(2)
         q = q_ref[0, 0].astype(jnp.float32)  # [blk_q, D]
         k = k_ref[0, 0].astype(jnp.float32)  # [S, D]
@@ -77,6 +112,10 @@ def _make_fwd_kernel(blk_q: int, causal: bool, sm_scale: float, with_lse: bool):
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * sm_scale  # [blk_q, S]
+        if has_bias:
+            s = s + bias_ref[0, 0].astype(jnp.float32)
+        if has_mask:
+            s = s + mask_ref[0].astype(jnp.float32)[None, :]
         if causal:
             rows = qi * blk_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
@@ -99,17 +138,33 @@ def _make_fwd_kernel(blk_q: int, causal: bool, sm_scale: float, with_lse: bool):
     return kernel
 
 
-def _flash_fwd_pallas(q, k, v, sm_scale, causal, interpret, blk_q=256,
-                      with_lse=True):
+def _flash_fwd_pallas(q, k, v, mask, bias, sm_scale, causal, interpret,
+                      blk_q=DEFAULT_BLK, with_lse=True):
     """with_lse=False is the inference path: no residual output, no
-    HBM write of the [B,H,S,128] lse buffer."""
+    HBM write of the [B,H,S,128] lse buffer. mask/bias may be None."""
     from jax.experimental import pallas as pl
 
     B, H, S, D = q.shape
     blk_q = min(blk_q, S)
     assert S % blk_q == 0, f"seq {S} not divisible by q block {blk_q}"
     grid = (B, H, S // blk_q)
-    kernel = _make_fwd_kernel(blk_q, causal, sm_scale, with_lse)
+    has_mask, has_bias = mask is not None, bias is not None
+    kernel = _make_fwd_kernel(blk_q, causal, sm_scale, with_lse,
+                              has_mask, has_bias)
+    in_specs = [
+        pl.BlockSpec((1, 1, blk_q, D), lambda b, h, i: (b, h, i, 0)),
+        pl.BlockSpec((1, 1, S, D), lambda b, h, i: (b, h, 0, 0)),
+        pl.BlockSpec((1, 1, S, D), lambda b, h, i: (b, h, 0, 0)),
+    ]
+    args = [q, k, v]
+    if has_mask:
+        in_specs.append(pl.BlockSpec((1, S), lambda b, h, i: (b, 0)))
+        args.append(mask)
+    if has_bias:
+        Bb, Hb = bias.shape[0], bias.shape[1]
+        in_specs.append(
+            pl.BlockSpec((1, 1, blk_q, S), _bias_index(Bb, Hb)))
+        args.append(bias)
     out_shape = [jax.ShapeDtypeStruct(q.shape, q.dtype)]
     out_specs = [pl.BlockSpec((1, 1, blk_q, D), lambda b, h, i: (b, h, i, 0))]
     if with_lse:
@@ -121,25 +176,38 @@ def _flash_fwd_pallas(q, k, v, sm_scale, causal, interpret, blk_q=256,
         kernel,
         out_shape=tuple(out_shape),
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 1, blk_q, D), lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, S, D), lambda b, h, i: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, S, D), lambda b, h, i: (b, h, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=tuple(out_specs),
         interpret=interpret,
-    )(q, k, v)
+    )(*args)
     return res if with_lse else (res[0], None)
 
 
 # -- backward ---------------------------------------------------------------
 
 
-def _make_dq_kernel(blk_q: int, causal: bool, sm_scale: float):
+def _make_dq_kernel(blk_q: int, causal: bool, sm_scale: float,
+                    has_mask: bool, has_bias: bool, qi_axis: int = 2,
+                    accum_pred=None):
+    """qi_axis: which grid axis walks the q blocks (2 for the plain
+    (B,H,nq) grid; 0 for the bias grids, which put bias-broadcast dims
+    innermost so same-output-block revisits are consecutive).
+    accum_pred: None -> each grid cell owns its dbias block (full-rank
+    bias); else a () -> bool fn that is True on a block's FIRST visit
+    (later visits accumulate — how a broadcast bias's grad is reduced
+    in-kernel instead of via an [B,H,S,S] HBM intermediate)."""
     from jax.experimental import pallas as pl
 
-    def kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref):
-        qi = pl.program_id(2)
+    def kernel(*refs):
+        it = iter(refs)
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = (
+            next(it), next(it), next(it), next(it), next(it), next(it))
+        mask_ref = next(it) if has_mask else None
+        bias_ref = next(it) if has_bias else None
+        dq_ref = next(it)
+        dbias_ref = next(it) if has_bias else None
+
+        qi = pl.program_id(qi_axis)
         q = q_ref[0, 0].astype(jnp.float32)        # [blk_q, D]
         k = k_ref[0, 0].astype(jnp.float32)        # [S, D]
         v = v_ref[0, 0].astype(jnp.float32)        # [S, D]
@@ -149,6 +217,10 @@ def _make_dq_kernel(blk_q: int, causal: bool, sm_scale: float):
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * sm_scale  # [blk_q, S]
+        if has_bias:
+            s = s + bias_ref[0, 0].astype(jnp.float32)
+        if has_mask:
+            s = s + mask_ref[0].astype(jnp.float32)[None, :]
         if causal:
             rows = qi * blk_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
@@ -157,19 +229,41 @@ def _make_dq_kernel(blk_q: int, causal: bool, sm_scale: float):
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [blk_q, S]
-        ds = p * (dp - delta) * sm_scale
+        dlogits = p * (dp - delta)                 # [blk_q, S]
+        ds = dlogits * sm_scale
         dq = jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )  # [blk_q, D]
         dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+        if has_bias:
+            if accum_pred is None:
+                dbias_ref[0, 0] = dlogits.astype(dbias_ref.dtype)
+            else:
+                first = accum_pred()
+
+                @pl.when(first)
+                def _init():
+                    dbias_ref[0, 0] = dlogits.astype(dbias_ref.dtype)
+
+                @pl.when(jnp.logical_not(first))
+                def _accum():
+                    dbias_ref[0, 0] += dlogits.astype(dbias_ref.dtype)
 
     return kernel
 
 
-def _make_dkv_kernel(blk_k: int, causal: bool, sm_scale: float):
+def _make_dkv_kernel(blk_k: int, causal: bool, sm_scale: float,
+                     has_mask: bool, has_bias: bool):
     from jax.experimental import pallas as pl
 
-    def kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref):
+    def kernel(*refs):
+        it = iter(refs)
+        k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref = (
+            next(it), next(it), next(it), next(it), next(it), next(it))
+        mask_ref = next(it) if has_mask else None
+        bias_ref = next(it) if has_bias else None
+        dk_ref, dv_ref = next(it), next(it)
+
         ki = pl.program_id(2)
         k = k_ref[0, 0].astype(jnp.float32)        # [blk_k, D]
         v = v_ref[0, 0].astype(jnp.float32)        # [blk_k, D]
@@ -180,6 +274,11 @@ def _make_dkv_kernel(blk_k: int, causal: bool, sm_scale: float):
         st = jax.lax.dot_general(
             k, q, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * sm_scale  # [blk_k, S]  (s transposed: rows=k, cols=q)
+        if has_bias:
+            # bias block is [S_q, blk_k] — transpose to the st layout
+            st = st + bias_ref[0, 0].astype(jnp.float32).T
+        if has_mask:
+            st = st + mask_ref[0].astype(jnp.float32)[:, None]
         if causal:
             rows = ki * blk_k + jax.lax.broadcasted_iota(jnp.int32, st.shape, 0)
             cols = jax.lax.broadcasted_iota(jnp.int32, st.shape, 1)
@@ -201,93 +300,222 @@ def _make_dkv_kernel(blk_k: int, causal: bool, sm_scale: float):
     return kernel
 
 
-def _flash_bwd_pallas(q, k, v, o, lse, g, sm_scale, causal, interpret,
-                      blk_q=256, blk_k=256):
+def _flash_bwd_pallas(q, k, v, mask, bias, o, lse, g, sm_scale, causal,
+                      interpret, blk_q=DEFAULT_BLK, blk_k=DEFAULT_BLK):
     from jax.experimental import pallas as pl
 
     B, H, S, D = q.shape
     blk_q = min(blk_q, S)
     blk_k = min(blk_k, S)
     assert S % blk_q == 0 and S % blk_k == 0
+    has_mask, has_bias = mask is not None, bias is not None
     delta = jnp.broadcast_to(
         jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)[..., None],
         (B, H, S, LANES),
     )
 
-    dq = pl.pallas_call(
-        _make_dq_kernel(blk_q, causal, sm_scale),
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
-        grid=(B, H, S // blk_q),
-        in_specs=[
+    if not has_bias:
+        # plain grid (B, H, nq): every cell owns its outputs
+        dq_in_specs = [
             pl.BlockSpec((1, 1, blk_q, D), lambda b, h, i: (b, h, i, 0)),
             pl.BlockSpec((1, 1, S, D), lambda b, h, i: (b, h, 0, 0)),
             pl.BlockSpec((1, 1, S, D), lambda b, h, i: (b, h, 0, 0)),
             pl.BlockSpec((1, 1, blk_q, D), lambda b, h, i: (b, h, i, 0)),
             pl.BlockSpec((1, 1, blk_q, LANES), lambda b, h, i: (b, h, i, 0)),
             pl.BlockSpec((1, 1, blk_q, LANES), lambda b, h, i: (b, h, i, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, 1, blk_q, D), lambda b, h, i: (b, h, i, 0)),
-        interpret=interpret,
-    )(q, k, v, g, lse, delta)
+        ]
+        dq_args = [q, k, v, g, lse, delta]
+        if has_mask:
+            dq_in_specs.append(pl.BlockSpec((1, S), lambda b, h, i: (b, 0)))
+            dq_args.append(mask)
+        dq = pl.pallas_call(
+            _make_dq_kernel(blk_q, causal, sm_scale, has_mask, False),
+            out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+            grid=(B, H, S // blk_q),
+            in_specs=dq_in_specs,
+            out_specs=pl.BlockSpec((1, 1, blk_q, D),
+                                   lambda b, h, i: (b, h, i, 0)),
+            interpret=interpret,
+        )(*dq_args)
+        dbias = None
+    else:
+        # bias grid: q-blocks outermost, bias-BROADCAST dims innermost,
+        # so every revisit of a shared dbias block is consecutive and
+        # the kernel can accumulate in place (dbias stays bias-shaped —
+        # no [B,H,S,S] HBM intermediate for a [1,H,S,S] bias).
+        Bb, Hb = bias.shape[0], bias.shape[1]
+        if Bb == 1 and Hb > 1:
+            # batch is the broadcast dim -> innermost
+            to_bh = lambda i, a, c: (c, a)   # (grid a=head, c=batch)
+            d1, d2 = H, B
+        else:
+            to_bh = lambda i, a, c: (a, c)   # (grid a=batch, c=head)
+            d1, d2 = B, H
+        full = Bb > 1 and Hb > 1
 
+        def spec(shape_blk, which):
+            def idx(i, a, c):
+                b_, h_ = to_bh(i, a, c)
+                return {"q": (b_, h_, i, 0), "kv": (b_, h_, 0, 0),
+                        "mask": (b_, 0),
+                        "bias": (b_ if Bb > 1 else 0,
+                                 h_ if Hb > 1 else 0, i, 0)}[which]
+            return pl.BlockSpec(shape_blk, idx)
+
+        dq_in_specs = [
+            spec((1, 1, blk_q, D), "q"),
+            spec((1, 1, S, D), "kv"),
+            spec((1, 1, S, D), "kv"),
+            spec((1, 1, blk_q, D), "q"),
+            spec((1, 1, blk_q, LANES), "q"),
+            spec((1, 1, blk_q, LANES), "q"),
+        ]
+        dq_args = [q, k, v, g, lse, delta]
+        if has_mask:
+            dq_in_specs.append(spec((1, S), "mask"))
+            dq_args.append(mask)
+        dq_in_specs.append(spec((1, 1, blk_q, S), "bias"))
+        dq_args.append(bias)
+
+        if full:
+            accum_pred = None
+        else:
+            def accum_pred():
+                # first visit of the shared block: the innermost
+                # (broadcast) axis is at 0 — and when BOTH dims are
+                # broadcast, the middle axis must be at 0 too
+                first = pl.program_id(2) == 0
+                if Bb == 1 and Hb == 1:
+                    first = jnp.logical_and(first, pl.program_id(1) == 0)
+                return first
+
+        res = pl.pallas_call(
+            _make_dq_kernel(blk_q, causal, sm_scale, has_mask, True,
+                            qi_axis=0, accum_pred=accum_pred),
+            out_shape=(
+                jax.ShapeDtypeStruct(q.shape, q.dtype),
+                jax.ShapeDtypeStruct((Bb, Hb, S, S), jnp.float32),
+            ),
+            grid=(S // blk_q, d1, d2),
+            in_specs=dq_in_specs,
+            out_specs=(
+                spec((1, 1, blk_q, D), "q"),
+                spec((1, 1, blk_q, S), "bias"),
+            ),
+            interpret=interpret,
+        )(*dq_args)
+        dq, dbias = res
+        dbias = dbias.astype(bias.dtype)
+
+    dkv_in_specs = [
+        pl.BlockSpec((1, 1, blk_k, D), lambda b, h, j: (b, h, j, 0)),
+        pl.BlockSpec((1, 1, blk_k, D), lambda b, h, j: (b, h, j, 0)),
+        pl.BlockSpec((1, 1, S, D), lambda b, h, j: (b, h, 0, 0)),
+        pl.BlockSpec((1, 1, S, D), lambda b, h, j: (b, h, 0, 0)),
+        pl.BlockSpec((1, 1, S, LANES), lambda b, h, j: (b, h, 0, 0)),
+        pl.BlockSpec((1, 1, S, LANES), lambda b, h, j: (b, h, 0, 0)),
+    ]
+    dkv_args = [k, v, q, g, lse, delta]
+    if has_mask:
+        dkv_in_specs.append(pl.BlockSpec((1, blk_k), lambda b, h, j: (b, j)))
+        dkv_args.append(mask)
+    if has_bias:
+        Bb, Hb = bias.shape[0], bias.shape[1]
+        dkv_in_specs.append(pl.BlockSpec(
+            (1, 1, S, blk_k),
+            lambda b, h, j: (b if Bb > 1 else 0, h if Hb > 1 else 0, 0, j)))
+        dkv_args.append(bias)
     dk, dv = pl.pallas_call(
-        _make_dkv_kernel(blk_k, causal, sm_scale),
+        _make_dkv_kernel(blk_k, causal, sm_scale, has_mask, has_bias),
         out_shape=(
             jax.ShapeDtypeStruct(k.shape, k.dtype),
             jax.ShapeDtypeStruct(v.shape, v.dtype),
         ),
         grid=(B, H, S // blk_k),
-        in_specs=[
-            pl.BlockSpec((1, 1, blk_k, D), lambda b, h, j: (b, h, j, 0)),
-            pl.BlockSpec((1, 1, blk_k, D), lambda b, h, j: (b, h, j, 0)),
-            pl.BlockSpec((1, 1, S, D), lambda b, h, j: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, S, D), lambda b, h, j: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, S, LANES), lambda b, h, j: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, S, LANES), lambda b, h, j: (b, h, 0, 0)),
-        ],
+        in_specs=dkv_in_specs,
         out_specs=(
             pl.BlockSpec((1, 1, blk_k, D), lambda b, h, j: (b, h, j, 0)),
             pl.BlockSpec((1, 1, blk_k, D), lambda b, h, j: (b, h, j, 0)),
         ),
         interpret=interpret,
-    )(k, v, q, g, lse, delta)
-    return dq, dk, dv
+    )(*dkv_args)
+    return dq, dk, dv, dbias
 
 
-# -- public API -------------------------------------------------------------
+# -- padding + normalization ------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def flash_attention(q, k, v, causal: bool = False, sm_scale: Optional[float] = None):
-    """q,k,v: [B, H, S, D] -> [B, H, S, D]."""
-    # primal (inference) path: skip the lse residual entirely — it is
-    # only needed by the backward (the fwd RULE below computes it)
-    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
+def _normalize_mask(mask, B, S, dtype=jnp.float32):
+    """bool (True=valid) or additive float [B, S] -> additive f32."""
+    if mask is None:
+        return None
+    mask = jnp.asarray(mask)
+    if mask.dtype == jnp.bool_:
+        mask = jnp.where(mask, 0.0, NEG_INF).astype(dtype)
+    else:
+        mask = mask.astype(dtype)
+    # accept [S], [B,S] or paddle-style [B,1,1,S]
+    return jnp.broadcast_to(mask.reshape(-1, S), (B, S))
+
+
+def _pad_amount(S: int, blk: int = DEFAULT_BLK) -> int:
+    if S <= blk:
+        return 0  # single block: any length works
+    return (-S) % blk
+
+
+def _pad_qkv(q, k, v, mask, bias, pad):
+    """Zero-pad the seq dim; padded keys are force-masked."""
+    if pad == 0:
+        return q, k, v, mask, bias
+    B, H, S, D = q.shape
+    padded = lambda x: jnp.pad(x, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    q, k, v = padded(q), padded(k), padded(v)
+    if mask is None:
+        mask = jnp.zeros((B, S), jnp.float32)
+    mask = jnp.pad(mask, ((0, 0), (0, pad)), constant_values=NEG_INF)
+    if bias is not None:
+        bias = jnp.pad(bias, ((0, 0), (0, 0), (0, pad), (0, pad)))
+    return q, k, v, mask, bias
+
+
+# -- custom-vjp core --------------------------------------------------------
+# One core covers every mask/bias combination: a None primal is an
+# empty pytree to custom_vjp, and its cotangent slot is simply None —
+# so absent operands cost nothing and need no duplicate plumbing.
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
+def _core(q, k, v, mask, bias, causal, sm_scale):
+    o, _ = _run_fwd(q, k, v, mask, bias, causal, sm_scale, with_lse=False)
+    return o
+
+
+def _core_fwd(q, k, v, mask, bias, causal, sm_scale):
+    o, lse = _run_fwd(q, k, v, mask, bias, causal, sm_scale)
+    return o, (q, k, v, mask, bias, o, lse)
+
+
+def _core_bwd(causal, sm_scale, res, g):
+    q, k, v, mask, bias, o, lse = res
+    dq, dk, dv, dbias = _run_bwd(q, k, v, mask, bias, o, lse, g, causal,
+                                 sm_scale)
+    # the padding mask is 0/-inf: no meaningful cotangent
+    dmask = jnp.zeros_like(mask) if mask is not None else None
+    return dq, dk, dv, dmask, dbias
+
+
+_core.defvjp(_core_fwd, _core_bwd)
+
+
+def _run_fwd(q, k, v, mask, bias, causal, sm_scale, with_lse=True):
     mode = _pallas_mode()
     if mode is not None:
         try:
-            o, _ = _flash_fwd_pallas(
-                q, k, v, scale, causal, interpret=(mode == "interpret"),
-                with_lse=False,
+            return _flash_fwd_pallas(
+                q, k, v, mask, bias, sm_scale, causal,
+                interpret=(mode == "interpret"), with_lse=with_lse,
             )
-            return o
-        except Exception:
-            _logger.warning(
-                "flash_attention Pallas forward failed; falling back to "
-                "naive XLA attention", exc_info=True,
-            )
-    return _reference_attention(q, k, v, scale, causal)
-
-
-def _fa_fwd(q, k, v, causal, sm_scale):
-    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
-    mode = _pallas_mode()
-    if mode is not None:
-        try:
-            o, lse = _flash_fwd_pallas(
-                q, k, v, scale, causal, interpret=(mode == "interpret")
-            )
-            return o, (q, k, v, o, lse)
         except Exception:
             # a Pallas regression must not silently change what the
             # bench measures (round-1 verdict weak #6)
@@ -295,20 +523,18 @@ def _fa_fwd(q, k, v, causal, sm_scale):
                 "flash_attention Pallas forward failed; falling back to "
                 "naive XLA attention", exc_info=True,
             )
-    o = _reference_attention(q, k, v, scale, causal)
-    return o, (q, k, v, None, None)
+    o = _reference_attention(q, k, v, sm_scale, causal, mask, bias)
+    return o, None
 
 
-def _fa_bwd(causal, sm_scale, res, g):
-    q, k, v, o, lse = res
-    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
+def _run_bwd(q, k, v, mask, bias, o, lse, g, causal, sm_scale):
     # lse present <=> the forward took the Pallas path (mode is
     # re-derived, not stashed: residuals must be jax types)
     mode = _pallas_mode() if lse is not None else None
     if mode is not None:
         try:
             return _flash_bwd_pallas(
-                q, k, v, o, lse, g, scale, causal,
+                q, k, v, mask, bias, o, lse, g, sm_scale, causal,
                 interpret=(mode == "interpret"),
             )
         except Exception:
@@ -317,29 +543,73 @@ def _fa_bwd(causal, sm_scale, res, g):
                 "naive XLA attention backward", exc_info=True,
             )
 
-    def ref(q, k, v):
-        return _reference_attention(q, k, v, scale, causal)
+    def ref(q, k, v, bias):
+        return _reference_attention(q, k, v, sm_scale, causal, mask, bias)
 
-    _, vjp = jax.vjp(ref, q, k, v)
-    return vjp(g)
+    if bias is not None:
+        _, vjp = jax.vjp(ref, q, k, v, bias)
+        return vjp(g)
+    _, vjp = jax.vjp(lambda q, k, v: ref(q, k, v, None), q, k, v)
+    return vjp(g) + (None,)
 
 
-flash_attention.defvjp(_fa_fwd, _fa_bwd)
+# -- public API -------------------------------------------------------------
 
 
-def flash_attention_layer(q_var, k_var, v_var, num_heads: int, causal: bool = False):
+def flash_attention(q, k, v, causal: bool = False,
+                    sm_scale: Optional[float] = None,
+                    mask=None, bias=None):
+    """q,k,v: [B, H, S, D] -> [B, H, S, D].
+
+    mask: optional [B, S] key-padding mask — bool (True = attend) or
+    additive float (0 valid / -inf masked). bias: optional additive
+    attention bias broadcastable as [B|1, H|1, S, S] (the reference's
+    BiasQK, multihead_matmul_op.cu:441); differentiable. Sequence
+    lengths that don't divide the 256 block are padded internally.
+    """
+    B, H, S, D = q.shape
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(D)
+    mask = _normalize_mask(mask, B, S)
+    if bias is not None:
+        bias = jnp.asarray(bias)
+        if bias.ndim != 4:
+            raise ValueError(
+                f"flash_attention bias must be rank-4 [B|1, H|1, S, S], "
+                f"got shape {bias.shape}")
+    pad = _pad_amount(S)
+    q2, k2, v2, mask, bias = _pad_qkv(q, k, v, mask, bias, pad)
+    o = _core(q2, k2, v2, mask, bias, causal, scale)
+    return o[:, :, :S] if pad else o
+
+
+def flash_attention_layer(q_var, k_var, v_var, num_heads: int,
+                          causal: bool = False, mask_var=None,
+                          bias_var=None, mask_type: str = "binary"):
     """Program-level layer emitting the fused attention op (reference
-    layers would compose ~10 ops; this is one)."""
+    layers would compose ~10 ops; this is one). mask_var: [B, S]
+    key-padding mask — mask_type="binary" (default) means 1 = attend /
+    0 = padding; mask_type="additive" means the float values are added
+    to the logits directly (0 / -inf). bias_var: [B|1, H|1, S, S]
+    additive bias."""
     from ..layer_helper import LayerHelper
     from ..layers.nn import _out
 
+    if mask_type not in ("binary", "additive"):
+        raise ValueError(f"mask_type must be 'binary' or 'additive', "
+                         f"got {mask_type!r}")
     helper = LayerHelper("flash_attention")
     out = _out(helper, q_var, shape=q_var.shape)
+    inputs = {"Q": [q_var], "K": [k_var], "V": [v_var]}
+    if mask_var is not None:
+        inputs["Mask"] = [mask_var]
+    if bias_var is not None:
+        inputs["BiasQK"] = [bias_var]
     helper.append_op(
         type="flash_attention",
-        inputs={"Q": [q_var], "K": [k_var], "V": [v_var]},
+        inputs=inputs,
         outputs={"Out": [out]},
-        attrs={"num_heads": num_heads, "causal": causal},
+        attrs={"num_heads": num_heads, "causal": causal,
+               "mask_type": mask_type},
     )
     return out
 
@@ -348,7 +618,8 @@ def flash_attention_layer(q_var, k_var, v_var, num_heads: int, causal: bool = Fa
 from ..core.registry import register_op
 
 
-@register_op("flash_attention", inputs=("Q", "K", "V"), outputs=("Out",))
+@register_op("flash_attention", inputs=("Q", "K", "V", "Mask", "BiasQK"),
+             outputs=("Out",), no_grad=("Mask",))
 def _flash_attention_op(ctx, op, ins):
     q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
     h = int(op.attrs["num_heads"])
@@ -359,5 +630,14 @@ def _flash_attention_op(ctx, op, ins):
     def split(x):
         return x.reshape(B, S, h, D).transpose(0, 2, 1, 3)
 
-    o = flash_attention(split(q), split(k), split(v), causal, None)
+    mask = ins["Mask"][0] if ins.get("Mask") else None
+    if mask is not None and mask.dtype != jnp.bool_:
+        if op.attrs.get("mask_type", "binary") == "binary":
+            # 1 = attend / 0 = padding -> additive 0 / -inf
+            mask = jnp.where(mask.reshape(B, S) > 0.5, 0.0, NEG_INF)
+        else:
+            mask = mask.reshape(B, S)  # already-additive float values
+    bias = ins["BiasQK"][0] if ins.get("BiasQK") else None
+    o = flash_attention(split(q), split(k), split(v), causal, None,
+                        mask=mask, bias=bias)
     return {"Out": [o.transpose(0, 2, 1, 3).reshape(B, S, HD)]}

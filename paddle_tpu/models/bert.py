@@ -63,7 +63,8 @@ def _attr(name, std):
     return ParamAttr(name=name, initializer=NormalInitializer(0.0, std))
 
 
-def _encoder_layer(x, cfg: BertConfig, idx: int, is_test=False):
+def _encoder_layer(x, cfg: BertConfig, idx: int, is_test=False,
+                   input_mask=None):
     h = cfg.hidden_size
     std = cfg.initializer_range
     pre = f"enc{idx}"
@@ -76,11 +77,13 @@ def _encoder_layer(x, cfg: BertConfig, idx: int, is_test=False):
     if cfg.use_flash_attention:
         from ..kernels import flash_attention_layer
 
-        ctx = flash_attention_layer(q, k, v, cfg.num_heads)
+        ctx = flash_attention_layer(q, k, v, cfg.num_heads,
+                                    mask_var=input_mask)
     else:
         ctx = nets.scaled_dot_product_attention(
             q, k, v, num_heads=cfg.num_heads,
             dropout_rate=0.0 if is_test else cfg.attention_dropout,
+            padding_mask=input_mask,
         )
     proj = layers.fc(
         ctx, h, num_flatten_dims=2,
@@ -123,9 +126,12 @@ def build_bert_pretrain(
 ):
     """Returns (main_program, startup_program, feeds dict, fetch dict).
 
-    Feeds: src_ids [B,S] int64, pos_ids [B,S] int64, labels [B,S] int64.
-    Loss: full-softmax LM cross-entropy at every position (pretraining
-    FLOPs profile of MLM with dense prediction).
+    Feeds: src_ids [B,S] int64, pos_ids [B,S] int64, labels [B,S] int64,
+    input_mask [B,S] float32 (1 = real token, 0 = padding — the
+    reference's BiasQK padding-mask capability,
+    fused/multihead_matmul_op.cu:441, expressed as the cheap [B,S]
+    key-mask form).
+    Loss: full-softmax LM cross-entropy, masked mean over real tokens.
     """
     main, startup = Program(), Program()
     std = cfg.initializer_range
@@ -133,6 +139,7 @@ def build_bert_pretrain(
         src = layers.data("src_ids", [seq_len], dtype="int64")
         pos = layers.data("pos_ids", [seq_len], dtype="int64")
         labels = layers.data("labels", [seq_len], dtype="int64")
+        input_mask = layers.data("input_mask", [seq_len], dtype="float32")
         word_emb = layers.embedding(
             src, [cfg.vocab_size, cfg.hidden_size],
             param_attr=_attr("word_embedding", std),
@@ -151,16 +158,21 @@ def build_bert_pretrain(
             x = layers.dropout(x, cfg.hidden_dropout,
                                dropout_implementation="upscale_in_train")
         for i in range(cfg.num_layers):
-            x = _encoder_layer(x, cfg, i, is_test)
+            x = _encoder_layer(x, cfg, i, is_test, input_mask=input_mask)
         logits = layers.fc(
             x, cfg.vocab_size, num_flatten_dims=2,
             param_attr=_attr("lm_head.w", std), bias_attr=ParamAttr(name="lm_head.b"),
         )
         lbl = layers.unsqueeze(labels, [2])
-        loss = layers.mean(layers.softmax_with_cross_entropy(logits, lbl))
+        ce = layers.softmax_with_cross_entropy(logits, lbl)  # [B, S, 1]
+        ce = layers.elementwise_mul(layers.squeeze(ce, [2]), input_mask)
+        # masked mean over real tokens only
+        loss = layers.elementwise_div(
+            layers.reduce_sum(ce), layers.reduce_sum(input_mask))
         if optimizer is not None and not is_test:
             optimizer.minimize(loss)
-    return main, startup, {"src_ids": src, "pos_ids": pos, "labels": labels}, {
+    return main, startup, {"src_ids": src, "pos_ids": pos,
+                           "labels": labels, "input_mask": input_mask}, {
         "loss": loss, "logits": logits,
     }
 
@@ -185,18 +197,26 @@ def apply_megatron_sharding(program: Program, mp_axis: str = "mp", dp_axis: str 
             var.sharding = (mp_axis, None) if name == "word_embedding" else (None, mp_axis)
         # optimizer accumulators inherit their param's sharding
     for name, var in gb.vars.items():
-        for suffix in ("_moment1_", "_moment2_", "_velocity_"):
-            if suffix in name:
-                base = name.split(suffix)[0]
-                if base in gb.vars and gb.vars[base].sharding is not None and (
-                    var.shape == gb.vars[base].shape
-                ):
-                    var.sharding = gb.vars[base].sharding
+        owner = getattr(var, "accumulator_owner", None)
+        if owner and owner in gb.vars:
+            base = gb.vars[owner]
+            if base.sharding is not None and var.shape == base.shape:
+                var.sharding = base.sharding
     return program
 
 
-def synthetic_batch(rng: np.random.RandomState, batch: int, seq_len: int, vocab: int):
+def synthetic_batch(rng: np.random.RandomState, batch: int, seq_len: int,
+                    vocab: int, min_len: Optional[int] = None):
+    """min_len=None: full-length rows (throughput benchmarking).
+    min_len=k: per-row lengths uniform in [k, seq_len] — a realistic
+    padded batch exercising the attention mask."""
     src = rng.randint(0, vocab, (batch, seq_len)).astype("int64")
     pos = np.tile(np.arange(seq_len, dtype="int64"), (batch, 1))
     labels = np.roll(src, -1, axis=1)
-    return {"src_ids": src, "pos_ids": pos, "labels": labels}
+    if min_len is None:
+        mask = np.ones((batch, seq_len), "float32")
+    else:
+        lengths = rng.randint(min_len, seq_len + 1, batch)
+        mask = (np.arange(seq_len)[None, :] < lengths[:, None]).astype("float32")
+    return {"src_ids": src, "pos_ids": pos, "labels": labels,
+            "input_mask": mask}

@@ -100,11 +100,14 @@ def glu(input, dim=-1):
 
 
 def scaled_dot_product_attention(
-    queries, keys, values, num_heads=1, dropout_rate=0.0, causal=False
+    queries, keys, values, num_heads=1, dropout_rate=0.0, causal=False,
+    padding_mask=None,
 ):
     """Multi-head attention from program-level ops (reference nets.py).
     The fused Pallas path is paddle_tpu.kernels.flash_attention, used by
-    the transformer models; this version keeps op-graph parity."""
+    the transformer models; this version keeps op-graph parity.
+    padding_mask: [B, S] float (1 = real token, 0 = padding) — keys at
+    padded positions get -1e9 added to their logits."""
     d_key = queries.shape[-1] // num_heads
 
     def _split_heads(x):
@@ -122,6 +125,11 @@ def scaled_dot_product_attention(
     v = _split_heads(values)
     scaled = layers.scale(q, scale=d_key**-0.5)
     logits = layers.matmul(scaled, k, transpose_y=True)
+    if padding_mask is not None:
+        # (1 - mask) * -1e9 broadcast over [B, H, S_q, S_k]'s key dim
+        neg = layers.scale(padding_mask, scale=1e9, bias=-1e9)  # 0 / -1e9
+        neg = layers.unsqueeze(neg, [1, 2])  # [B, 1, 1, S]
+        logits = layers.elementwise_add(logits, neg)
     if causal:
         import numpy as _np
 

