@@ -311,27 +311,48 @@ def build_pipeline_fn(
 
         stage_fns = [make_stage(s) for s in range(S)]
 
-        def run(dv):
-            aux0 = tuple(
-                jax.ShapeDtypeStruct((), jnp.float32) for _ in aux_names
-            )
-            aux_sum = pipeline_schedule(
+        aux0 = tuple(
+            jax.ShapeDtypeStruct((), jnp.float32) for _ in aux_names
+        )
+        schedule = getattr(program, "_pipeline_schedule", "gpipe")
+        if schedule == "1f1b":
+            from ..parallel.pipeline import pipeline_schedule_1f1b
+
+            aux_sum, grads = pipeline_schedule_1f1b(
                 stage_fns,
-                (dv, aux_state, step_key),
+                diff_vals,
+                (aux_state, step_key),
                 feeds_mb,
                 tuple(boundary_structs),
                 aux0,
                 mesh,
                 axis_name=axis_name,
+                loss_index=aux_names.index(loss_name),
+                grad_scale=(1.0 / M if _aux_is_mean(loss_name) else 1.0),
             )
             aux = {
                 n: (v / M if _aux_is_mean(n) else v)
                 for n, v in zip(aux_names, aux_sum)
             }
-            loss = jnp.reshape(aux[loss_name], ())
-            return loss, aux
+        else:
+            def run(dv):
+                aux_sum = pipeline_schedule(
+                    stage_fns,
+                    (dv, aux_state, step_key),
+                    feeds_mb,
+                    tuple(boundary_structs),
+                    aux0,
+                    mesh,
+                    axis_name=axis_name,
+                )
+                aux = {
+                    n: (v / M if _aux_is_mean(n) else v)
+                    for n, v in zip(aux_names, aux_sum)
+                }
+                loss = jnp.reshape(aux[loss_name], ())
+                return loss, aux
 
-        (_, aux), grads = jax.value_and_grad(run, has_aux=True)(diff_vals)
+            (_, aux), grads = jax.value_and_grad(run, has_aux=True)(diff_vals)
 
         for n in aux_names:
             v = aux[n]

@@ -20,6 +20,7 @@ _FLAG_DEFS: Dict[str, Any] = {
     # live flags
     "check_nan_inf": False,            # per-op nan/inf scan (details/nan_inf_utils.h)
     "benchmark": False,                # Executor.run sync + wall-time print
+    "print_op_shape_errors": False,    # escalate swallowed layer shape-inference failures
     "eager_delete_tensor_gb": 0.0,     # inert: XLA frees by liveness
     # accepted-but-inert parity flags (reference platform/flags.cc)
     "fraction_of_gpu_memory_to_use": 0.92,

@@ -44,6 +44,13 @@ class GPTConfig:
                          num_heads=4, ffn_size=256, max_position=128,
                          hidden_dropout=0.0, attention_dropout=0.0)
 
+    @staticmethod
+    def gpt3_1p3b():
+        """GPT-3 XL shape (paper table 2.1): 24 layers, d_model 2048,
+        16 heads x 128; ~1.3B params (BASELINE config 5)."""
+        return GPTConfig(hidden_size=2048, num_layers=24, num_heads=16,
+                         ffn_size=8192, max_position=1024)
+
 
 def _attr(name, std):
     return ParamAttr(name=name, initializer=NormalInitializer(0.0, std))

@@ -225,6 +225,159 @@ def pipeline_schedule(
     return wrapped(params, feeds_mb)
 
 
+def pipeline_schedule_1f1b(
+    stage_fns,
+    diff_params,
+    rest_params,
+    feeds_mb,
+    boundary0,
+    aux0,
+    mesh,
+    axis_name: str = "pp",
+    loss_index: int = 0,
+    grad_scale: float = 1.0,
+):
+    """1F1B schedule for S heterogeneous Program stages — the
+    hand-scheduled analogue of autodiff-through-`pipeline_schedule`
+    (reference SectionWorker's steady-state F/B overlap,
+    framework/section_worker.cc).
+
+    Same stage contract as `pipeline_schedule`:
+    ``f_s((dv, *rest), boundary_in, mb_feeds, mb_idx) -> (b_out, aux)``
+    except params arrive split: ``diff_params`` (the pytree to
+    differentiate) and ``rest_params`` (tuple appended verbatim).
+    The backward of each micro-op is jax.vjp of the stage against its
+    stashed boundary INPUT (feeds are re-sliced by index, so only the
+    boundary rings — O(S) slots, not O(M) — persist between ticks); the
+    loss gradient is seeded at the last stage through the aux output
+    slot ``loss_index`` scaled by ``grad_scale``.
+
+    Returns (aux_sums, grads): aux summed over microbatches (last
+    stage), grads = d(grad_scale * sum_mb loss)/d(diff_params); both
+    replicated.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    n_stages = mesh.shape[axis_name]
+    if len(stage_fns) != n_stages:
+        raise ValueError(
+            f"{len(stage_fns)} pipeline stages but mesh axis {axis_name!r} "
+            f"has {n_stages} devices — they must match"
+        )
+    tmap = jax.tree_util.tree_map
+    M = jax.tree_util.tree_leaves(feeds_mb)[0].shape[0]
+    R = 2 * n_stages
+    total = one_f_one_b_ticks(M, n_stages)
+    fwd_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    bwd_perm = [(i, (i - 1) % n_stages) for i in range(n_stages)]
+    n_aux = len(aux0)
+
+    def per_device(dv, rest, feeds):
+        idx = lax.axis_index(axis_name)
+        vary = lambda a: a + (idx * 0).astype(a.dtype)
+        stash0 = tuple(
+            vary(jnp.zeros((R,) + tuple(a.shape), a.dtype)) for a in boundary0)
+        fwd0 = tuple(vary(jnp.zeros(tuple(a.shape), a.dtype)) for a in boundary0)
+        bwd0 = tuple(vary(jnp.zeros(tuple(a.shape), a.dtype)) for a in boundary0)
+        aux_acc0 = tuple(vary(jnp.zeros((), jnp.float32)) for _ in range(n_aux))
+        gacc0 = tmap(lambda p: vary(jnp.zeros_like(p)), dv)
+
+        def mb_at(i):
+            return tmap(
+                lambda a: lax.dynamic_index_in_dim(a, i, 0, keepdims=False),
+                feeds)
+
+        fwd_branches = [
+            (lambda f: lambda d, b, m, i: tmap(vary, f((d,) + tuple(rest),
+                                                       b, m, i)))(f)
+            for f in stage_fns
+        ]
+
+        def mk_bwd(s):
+            is_last = s == n_stages - 1
+
+            def branch(d, b_saved, m, i, dy):
+                def primal(d_, b_):
+                    return stage_fns[s]((d_,) + tuple(rest), b_, m, i)
+
+                _, vjp = jax.vjp(primal, d, b_saved)
+                aux_seed = tuple(
+                    jnp.asarray(
+                        grad_scale if (is_last and j == loss_index) else 0.0,
+                        jnp.float32)
+                    for j in range(n_aux))
+                # the last stage's boundary output is constant zeros, so
+                # its (garbage) incoming dy contributes nothing
+                dd, db = vjp((dy, aux_seed))
+                return tmap(vary, dd), tmap(vary, db)
+
+            return branch
+
+        bwd_branches = [mk_bwd(s) for s in range(n_stages)]
+
+        def tick(t, carry):
+            stash, fwd_in, bwd_in, gacc, aux_acc = carry
+            # ---- forward micro-op: microbatch f = t - idx
+            f = t - idx
+            f_act = (f >= 0) & (f < M)
+            fc = jnp.clip(f, 0, M - 1)
+            b_out, aux = lax.switch(idx, fwd_branches, dv, fwd_in,
+                                    mb_at(fc), fc)
+            slot_f = jnp.mod(fc, R)
+            stash = tuple(
+                lax.dynamic_update_index_in_dim(
+                    st,
+                    jnp.where(
+                        f_act, bi,
+                        lax.dynamic_index_in_dim(st, slot_f, 0, False)),
+                    slot_f, 0)
+                for st, bi in zip(stash, fwd_in))
+            take = f_act & (idx == n_stages - 1)
+            aux_acc = tuple(
+                acc + jnp.where(take, jnp.reshape(a, ()), 0.0)
+                for acc, a in zip(aux_acc, aux))
+
+            # ---- backward micro-op: microbatch b = t - 2(S-1) + idx
+            b = t - 2 * (n_stages - 1) + idx
+            b_act = (b >= 0) & (b < M)
+            bc = jnp.clip(b, 0, M - 1)
+            b_saved = tuple(
+                lax.dynamic_index_in_dim(st, jnp.mod(bc, R), 0, False)
+                for st in stash)
+            dd, db = lax.switch(idx, bwd_branches, dv, b_saved, mb_at(bc),
+                                bc, bwd_in)
+            gacc = tmap(
+                lambda acc, g: acc + jnp.where(b_act, g, jnp.zeros_like(g)),
+                gacc, dd)
+
+            fwd_next = lax.ppermute(
+                tuple(jnp.where(f_act, y, o) for y, o in zip(b_out, fwd_in)),
+                axis_name, fwd_perm)
+            bwd_next = lax.ppermute(
+                tuple(jnp.where(b_act, y, o) for y, o in zip(db, bwd_in)),
+                axis_name, bwd_perm)
+            return (stash, fwd_next, bwd_next, gacc, aux_acc)
+
+        carry = (stash0, fwd0, bwd0, gacc0, aux_acc0)
+        _, _, _, gacc, aux_acc = lax.fori_loop(0, total, tick, carry)
+        # aux lives on the last device; each device's gacc holds its own
+        # stage's contribution to the replicated params' grads
+        aux_out = tuple(
+            lax.psum(jnp.where(idx == n_stages - 1, a, 0.0), axis_name)
+            for a in aux_acc)
+        grads = tmap(lambda g: lax.psum(g, axis_name), gacc)
+        return aux_out, grads
+
+    smap = _shard_map()
+    kwargs = {"mesh": mesh, "in_specs": (P(), P(), P()),
+              "out_specs": (P(), P())}
+    try:
+        wrapped = smap(per_device, check_vma=False, **kwargs)
+    except TypeError:
+        wrapped = smap(per_device, check_rep=False, **kwargs)
+    return wrapped(diff_params, tuple(rest_params), feeds_mb)
+
+
 def pipeline_train_step(
     stage_fn: Callable,
     loss_fn: Callable,
@@ -240,5 +393,136 @@ def pipeline_train_step(
             return loss_fn(outs, targets)
 
         return jax.value_and_grad(loss_of)(stage_params)
+
+    return step
+
+
+def one_f_one_b_ticks(n_microbatches: int, n_stages: int) -> int:
+    """Trip count of the 1F1B schedule: M + 2(S-1) lockstep ticks (each
+    tick a device does its F and/or its B micro-op). GPipe-by-autodiff
+    runs M+S-1 forward ticks THEN M+S-1 backward ticks = 2(M+S-1): 1F1B
+    saves M-1 ticks of bubble (reference section_worker.cc's async
+    section threads achieve the same overlap with queues)."""
+    return n_microbatches + 2 * (n_stages - 1)
+
+
+def pipeline_train_step_1f1b(
+    stage_fn: Callable,
+    loss_fn: Callable,
+    mesh,
+    axis_name: str = "pp",
+):
+    """1F1B pipeline train step — the schedule the reference's
+    SectionWorker threads approximate (framework/section_worker.cc,
+    trainer_desc.proto:74-95), compiled as one SPMD loop.
+
+    Unlike `pipeline_train_step` (GPipe: autodiff through the fill/
+    drain loop — forward of ALL M microbatches, then backward of all),
+    this interleaves: device s runs the backward of microbatch b at the
+    tick its cotangent arrives, so steady-state ticks do one F and one
+    B each, the loop has M + 2(S-1) ticks instead of 2(M+S-1), and the
+    stash of saved stage inputs is a ring of 2S slots — O(S), NOT O(M):
+    activation memory stays flat as microbatch count grows.
+
+    The backward of each micro-op is jax.vjp of the stage with its
+    stashed input (recompute-from-boundary, the 1F1B analogue of the
+    GPipe path's jax.checkpoint).
+
+    stage_fn(params, x) -> y (same activation shape in/out);
+    loss_fn(y_mb, target_mb) -> scalar (per-microbatch); the step loss
+    is the mean over microbatches.
+
+    Returns f(stage_params, microbatches, targets) -> (loss, grads)
+    with grads matching `pipeline_train_step` whose loss_fn is the
+    microbatch mean of this one.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    tmap = jax.tree_util.tree_map
+
+    def step(stage_params, microbatches, targets):
+        n_stages = mesh.shape[axis_name]
+        M = microbatches.shape[0]
+        R = 2 * n_stages  # ring capacity > max in-flight 2(S-1)
+        total = one_f_one_b_ticks(M, n_stages)
+        fwd_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+        bwd_perm = [(i, (i - 1) % n_stages) for i in range(n_stages)]
+
+        def per_device(params, mb, tgt):
+            params = tmap(lambda a: a[0], params)
+            idx = lax.axis_index(axis_name)
+            vary = lambda a: a + (idx * 0).astype(a.dtype)
+
+            x_shape = mb.shape[1:]
+            stash0 = vary(jnp.zeros((R,) + x_shape, mb.dtype))
+            fwd0 = vary(jnp.zeros(x_shape, mb.dtype))
+            bwd0 = vary(jnp.zeros(x_shape, mb.dtype))
+            gacc0 = tmap(lambda p: vary(jnp.zeros_like(p)), params)
+            loss0 = vary(jnp.zeros((), jnp.float32))
+
+            def last_stage_seed(y, t_idx):
+                # loss + dL/dy for the microbatch the last stage just
+                # finished (its F and B land on the same tick)
+                tg = lax.dynamic_index_in_dim(tgt, t_idx, 0, keepdims=False)
+                return jax.value_and_grad(lambda yy: loss_fn(yy, tg))(y)
+
+            def tick(t, carry):
+                stash, fwd_in, bwd_in, gacc, loss_acc = carry
+                # ---- forward micro-op: microbatch f = t - idx
+                f = t - idx
+                f_act = (f >= 0) & (f < M)
+                fc = jnp.clip(f, 0, M - 1)
+                mb_f = lax.dynamic_index_in_dim(mb, fc, 0, keepdims=False)
+                x_in = jnp.where(idx == 0, mb_f, fwd_in)
+                y = stage_fn(params, x_in)
+                slot_f = jnp.mod(fc, R)
+                old = lax.dynamic_index_in_dim(stash, slot_f, 0, keepdims=False)
+                stash = lax.dynamic_update_index_in_dim(
+                    stash, jnp.where(f_act, x_in, old), slot_f, 0)
+                loss_f, dy_last = last_stage_seed(y, fc)
+                loss_acc = loss_acc + jnp.where(
+                    f_act & (idx == n_stages - 1), loss_f, 0.0)
+
+                # ---- backward micro-op: microbatch b = t - 2(S-1) + idx
+                b = t - 2 * (n_stages - 1) + idx
+                b_act = (b >= 0) & (b < M)
+                bc = jnp.clip(b, 0, M - 1)
+                # at the last stage b == f: seed from this tick's loss
+                dy = jnp.where(idx == n_stages - 1, dy_last, bwd_in)
+                x_saved = lax.dynamic_index_in_dim(
+                    stash, jnp.mod(bc, R), 0, keepdims=False)
+                _, vjp = jax.vjp(stage_fn, params, x_saved)
+                dp, dx = vjp(dy.astype(y.dtype))
+                gacc = tmap(
+                    lambda acc, g: acc + jnp.where(b_act, g, jnp.zeros_like(g)),
+                    gacc, dp)
+
+                fwd_next = lax.ppermute(
+                    jnp.where(f_act, y, fwd_in), axis_name, fwd_perm)
+                bwd_next = lax.ppermute(
+                    jnp.where(b_act, dx, bwd_in), axis_name, bwd_perm)
+                return (stash, fwd_next, bwd_next, gacc, loss_acc)
+
+            carry = (stash0, fwd0, bwd0, gacc0, loss0)
+            _, _, _, gacc, loss_acc = lax.fori_loop(0, total, tick, carry)
+            # loss lives on the last device; grads are per-stage (this
+            # device's slice of the stacked [S, ...] param tree)
+            loss = lax.psum(
+                jnp.where(idx == n_stages - 1, loss_acc, 0.0), axis_name) / M
+            grads = tmap(lambda g: (g / M)[None], gacc)
+            return loss, grads
+
+        smap = _shard_map()
+        pspec = tmap(lambda _: P(axis_name), stage_params)
+        kwargs = {
+            "mesh": mesh,
+            "in_specs": (pspec, P(), P()),
+            "out_specs": (P(), pspec),
+        }
+        try:
+            wrapped = smap(per_device, check_vma=False, **kwargs)
+        except TypeError:
+            wrapped = smap(per_device, check_rep=False, **kwargs)
+        return wrapped(stage_params, microbatches, targets)
 
     return step

@@ -97,7 +97,8 @@ def _pipe_mlp(width=32):
     return loss, [h1, h2, h3]
 
 
-def _train_program_pipeline(pipelined, steps=4, batch=16, width=32):
+def _train_program_pipeline(pipelined, steps=4, batch=16, width=32,
+                            schedule="gpipe"):
     import paddle_tpu as fluid
 
     main, startup = fluid.Program(), fluid.Program()
@@ -106,7 +107,8 @@ def _train_program_pipeline(pipelined, steps=4, batch=16, width=32):
         loss, cuts = _pipe_mlp(width)
         if pipelined:
             fluid.optimizer.PipelineOptimizer(
-                fluid.optimizer.SGD(0.1), cut_list=cuts, num_microbatches=4
+                fluid.optimizer.SGD(0.1), cut_list=cuts, num_microbatches=4,
+                schedule=schedule,
             ).minimize(loss)
         else:
             fluid.optimizer.SGD(0.1).minimize(loss)
@@ -175,3 +177,98 @@ def test_program_pipeline_rejects_bad_stage_count():
                 },
                 fetch_list=[loss],
             )
+
+
+def test_program_pipeline_1f1b_training_parity():
+    """1F1B schedule (reference section_worker.cc's F/B overlap) must
+    train exactly like the unpipelined program AND like GPipe."""
+    _need_devices(4)
+    base_losses, base_params = _train_program_pipeline(pipelined=False)
+    pp_losses, pp_params = _train_program_pipeline(
+        pipelined=True, schedule="1f1b")
+    np.testing.assert_allclose(pp_losses, base_losses, rtol=1e-4, atol=1e-5)
+    assert base_params.keys() == pp_params.keys() and base_params
+    for n in base_params:
+        np.testing.assert_allclose(
+            pp_params[n], base_params[n], rtol=1e-4, atol=1e-5, err_msg=n
+        )
+
+
+def test_1f1b_step_matches_gpipe_and_beats_its_tick_count():
+    """Homogeneous-stage 1F1B: exact grad parity with GPipe-by-autodiff,
+    M + 2(S-1) ticks (vs 2(M+S-1)), and an O(S) — not O(M) — stash."""
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+    from paddle_tpu.parallel.pipeline import (
+        pipeline_train_step, pipeline_train_step_1f1b, one_f_one_b_ticks)
+
+    S, M, mb, D = 4, 12, 2, 16  # M != 2S so ring vs data shapes differ
+    _need_devices(S)
+    mesh = Mesh(np.array(jax.devices()[:S]), ("pp",))
+    rng = np.random.RandomState(0)
+    params = {"w": jnp.asarray(rng.randn(S, D, D) * 0.3, jnp.float32),
+              "b": jnp.asarray(rng.randn(S, D) * 0.1, jnp.float32)}
+    x = jnp.asarray(rng.randn(M, mb, D), jnp.float32)
+    tgt = jnp.asarray(rng.randn(M, mb, D), jnp.float32)
+
+    def stage(p, xx):
+        return jnp.tanh(xx @ p["w"] + p["b"])
+
+    step_g = jax.jit(pipeline_train_step(
+        stage, lambda outs, t: jnp.mean((outs - t) ** 2), mesh))
+    step_1 = jax.jit(pipeline_train_step_1f1b(
+        stage, lambda y, t: jnp.mean((y - t) ** 2), mesh))
+    lg, gg = step_g(params, x, tgt)
+    l1, g1 = step_1(params, x, tgt)
+    np.testing.assert_allclose(float(lg), float(l1), rtol=1e-5)
+    for k in gg:
+        np.testing.assert_allclose(np.asarray(gg[k]), np.asarray(g1[k]),
+                                   atol=1e-5, rtol=1e-4, err_msg=k)
+
+    # schedule properties: 1F1B runs M-1 fewer ticks than fwd-all-then-
+    # bwd-all, and its stash ring is R = 2S slots — a function of S
+    # only, so activation residency stays flat as M grows (the memory
+    # property GPipe-by-autodiff lacks)
+    assert one_f_one_b_ticks(M, S) == M + 2 * (S - 1)
+    assert one_f_one_b_ticks(M, S) < 2 * (M + S - 1)
+    jaxpr = jax.make_jaxpr(step_1)(params, x, tgt)
+
+    def find_loop_carries(jx, out):
+        for eqn in jx.eqns:
+            if eqn.primitive.name == "scan":
+                nc = eqn.params["num_consts"]
+                ncar = eqn.params["num_carry"]
+                out.extend(v.aval.shape
+                           for v in eqn.invars[nc:nc + ncar]
+                           if hasattr(v, "aval"))
+            elif eqn.primitive.name == "while":
+                out.extend(v.aval.shape for v in eqn.invars
+                           if hasattr(v, "aval"))
+            for p in eqn.params.values():
+                inner = p if hasattr(p, "eqns") else getattr(p, "jaxpr", None)
+                if inner is not None and hasattr(inner, "eqns"):
+                    find_loop_carries(inner, out)
+        return out
+
+    carries = find_loop_carries(jaxpr.jaxpr, [])
+    assert (2 * S, mb, D) in carries, carries  # the ring stash
+    assert not any(c and c[0] == M for c in carries), (
+        "loop carry scales with M", carries)
+
+
+def test_pipeline_optimizer_rejects_bn_running_stats_at_minimize():
+    """The no-persistable-writes constraint must error at the user API
+    (PipelineOptimizer.minimize), not deep in lowering."""
+    import paddle_tpu as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data("x", [8])
+        h = fluid.layers.fc(x, 8)
+        h = fluid.layers.batch_norm(h)  # train mode: writes running stats
+        h2 = fluid.layers.fc(h, 8, act="relu")
+        loss = fluid.layers.mean(fluid.layers.fc(h2, 1))
+        with pytest.raises(NotImplementedError, match="batch_norm|persistable"):
+            fluid.optimizer.PipelineOptimizer(
+                fluid.optimizer.SGD(0.1), cut_list=[h], num_microbatches=2
+            ).minimize(loss)

@@ -72,8 +72,15 @@ def capture(deadline=840):
             rec["captured_at"] = _now()
             hist = []
             if os.path.exists(EVIDENCE):
-                with open(EVIDENCE) as f:
-                    hist = json.load(f)
+                try:
+                    with open(EVIDENCE) as f:
+                        hist = json.load(f)
+                except (json.JSONDecodeError, OSError):
+                    # a session killed mid-write leaves a truncated
+                    # file — never let that discard the NEW result
+                    os.replace(EVIDENCE, EVIDENCE + ".corrupt")
+                    _log_probe("evidence file corrupt; moved aside")
+                    hist = []
             hist.append(rec)
             with open(EVIDENCE, "w") as f:
                 json.dump(hist, f, indent=1)
