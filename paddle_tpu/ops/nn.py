@@ -866,7 +866,8 @@ def _selu(ctx, op, ins):
 
 @register_op("l1_norm", inputs=("X",), outputs=("Out",))
 def _l1_norm(ctx, op, ins):
-    return {"Out": [jnp.sum(jnp.abs(ins["X"][0]))]}
+    # shape [1] like the reference (l1_norm_op.cc InferShape sets {1})
+    return {"Out": [jnp.sum(jnp.abs(ins["X"][0])).reshape(1)]}
 
 
 @register_op("clip_by_norm", inputs=("X",), outputs=("Out",))

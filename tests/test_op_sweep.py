@@ -24,9 +24,12 @@ I32 = lambda *s, hi=4: rng.randint(0, hi, s).astype("int32")
 B8 = lambda *s: (rng.rand(*s) > 0.5)
 
 
-def spec(inputs=None, attrs=None, grads=(), n_out=None):
+def spec(inputs=None, attrs=None, grads=(), n_out=None, fd=True, tol=1e-5):
+    """fd=False disables the directional finite-difference grad check
+    (stochastic ops / ops whose loss is piecewise-constant in ways that
+    make FD meaningless). tol: oracle comparison tolerance."""
     return {"inputs": inputs or {}, "attrs": attrs or {}, "grads": list(grads),
-            "n_out": n_out or {}}
+            "n_out": n_out or {}, "fd": fd, "tol": tol}
 
 
 _boxes = np.array([[0, 0, 4, 4], [1, 1, 5, 5], [8, 8, 12, 12]], "float32")
@@ -104,10 +107,12 @@ SPECS = {
     "delete_var": spec({"X": F(2,)}, n_out={}),
     # quant family additions
     "fake_quantize_range_abs_max": spec(
-        {"X": F(3, 4), "InScale": POS(1)}, {"bit_length": 8}, grads=["X"]),
+        {"X": F(3, 4), "InScale": POS(1)}, {"bit_length": 8}, grads=["X"],
+        fd=False),  # straight-through estimator: true FD is ~0
     "fake_quantize_moving_average_abs_max": spec(
         {"X": F(3, 4), "InScale": POS(1), "InAccum": POS(1),
-         "InState": POS(1)}, {"bit_length": 8}, grads=["X"]),
+         "InState": POS(1)}, {"bit_length": 8}, grads=["X"],
+        fd=False),  # straight-through estimator
     "moving_average_abs_max_scale": spec(
         {"X": F(3, 4), "InAccum": POS(1), "InState": POS(1)}, grads=["X"]),
     "fake_channel_wise_dequantize_max_abs": spec(
@@ -198,7 +203,9 @@ SPECS = {
     ),
     # losses
     "cross_entropy": spec(
-        {"X": np.full((4, 3), 1 / 3, "float32"), "Label": I32(4, 1, hi=3)},
+        {"X": (lambda p: p / p.sum(1, keepdims=True))(
+            rng.rand(4, 3).astype("float32") + 0.1),
+         "Label": I32(4, 1, hi=3)},
     ),
     "sigmoid_cross_entropy_with_logits": spec(
         {"X": F(4, 3), "Label": rng.rand(4, 3).astype("float32")}, grads=["X"],
@@ -456,6 +463,7 @@ SPECS = {
     "nce": spec(
         {"Input": F(4, 8), "Label": I32(4, 1, hi=10), "Weight": F(10, 8),
          "Bias": F(10)}, {"num_neg_samples": 3}, grads=["Input", "Weight"],
+        fd=False,  # negatives are resampled per run
     ),
     "hierarchical_sigmoid": spec(
         {"X": F(4, 8), "W": F(7, 8), "Label": I32(4, 1, hi=8),
@@ -587,6 +595,426 @@ COVERED_ELSEWHERE = {
 }
 
 
+# --------------------------------------------------------------------------
+# Oracle tier (round-2 verdict weak #6): numpy expectations for sweep ops.
+# An entry receives (ins, attrs) where ins maps slot -> [arrays] (the exact
+# feed) and returns either {slot: array-or-[arrays]} or a bare array for the
+# op's first output slot. Ops without an entry stay in the execute tier;
+# tests/test_op_sweep.py::test_verified_tier_is_at_least_80_percent ratchets
+# the fraction. Reference discipline: tests/unittests/op_test.py:57.
+
+from math import erf as _erf
+
+_sig = lambda x: 1.0 / (1.0 + np.exp(-x))
+_X = lambda ins: ins["X"][0]
+
+
+def _softmax(x, axis=-1):
+    e = np.exp(x - x.max(axis=axis, keepdims=True))
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+def _iou(a, b):
+    ax1, ay1, ax2, ay2 = a
+    bx1, by1, bx2, by2 = b
+    iw = max(0.0, min(ax2, bx2) - max(ax1, bx1))
+    ih = max(0.0, min(ay2, by2) - max(ay1, by1))
+    inter = iw * ih
+    ua = (ax2 - ax1) * (ay2 - ay1) + (bx2 - bx1) * (by2 - by1) - inter
+    return inter / ua if ua > 0 else 0.0
+
+
+def _mha(q, k, v, heads):
+    B, S, HD = q.shape
+    D = HD // heads
+    sp = lambda x: x.reshape(B, S, heads, D).transpose(0, 2, 1, 3)
+    qh, kh, vh = sp(q), sp(k), sp(v)
+    s = np.einsum("bhqd,bhkd->bhqk", qh, kh) / np.sqrt(D)
+    p = _softmax(s)
+    o = np.einsum("bhqk,bhkd->bhqd", p, vh)
+    return o.transpose(0, 2, 1, 3).reshape(B, S, HD)
+
+
+ORACLES = {
+    # unary activations / math
+    "ceil": lambda ins, at: np.ceil(_X(ins)),
+    "floor": lambda ins, at: np.floor(_X(ins)),
+    "round": lambda ins, at: np.round(_X(ins)),
+    "cos": lambda ins, at: np.cos(_X(ins)),
+    "sin": lambda ins, at: np.sin(_X(ins)),
+    "erf": lambda ins, at: np.vectorize(_erf)(_X(ins)).astype("float32"),
+    "elu": lambda ins, at: np.where(
+        _X(ins) > 0, _X(ins), at["alpha"] * (np.exp(_X(ins)) - 1)),
+    "relu6": lambda ins, at: np.clip(_X(ins), 0, 6),
+    "leaky_relu": lambda ins, at: np.maximum(_X(ins), at["alpha"] * _X(ins)),
+    "logsigmoid": lambda ins, at: np.log(_sig(_X(ins))),
+    "hard_shrink": lambda ins, at: np.where(
+        np.abs(_X(ins)) > at["threshold"], _X(ins), 0.0),
+    "hard_sigmoid": lambda ins, at: np.clip(
+        at["slope"] * _X(ins) + at["offset"], 0, 1),
+    "hard_swish": lambda ins, at: _X(ins) * np.clip(_X(ins) + 3, 0, 6) / 6,
+    "soft_relu": lambda ins, at: np.log1p(np.exp(np.clip(_X(ins), -40, 40))),
+    "softsign": lambda ins, at: _X(ins) / (1 + np.abs(_X(ins))),
+    "stanh": lambda ins, at: at["scale_b"] * np.tanh(at["scale_a"] * _X(ins)),
+    "swish": lambda ins, at: _X(ins) * _sig(at["beta"] * _X(ins)),
+    "thresholded_relu": lambda ins, at: np.where(
+        _X(ins) > at["threshold"], _X(ins), 0.0),
+    "reciprocal": lambda ins, at: 1.0 / _X(ins),
+    "rsqrt": lambda ins, at: 1.0 / np.sqrt(_X(ins)),
+    "pow": lambda ins, at: _X(ins) ** at["factor"],
+    "clip": lambda ins, at: np.clip(_X(ins), at["min"], at["max"]),
+    "cumsum": lambda ins, at: np.cumsum(_X(ins), axis=at["axis"]),
+    "squared_l2_norm": lambda ins, at: np.array(
+        [np.sum(_X(ins) ** 2)], "float32"),
+    "sign": lambda ins, at: np.sign(_X(ins)),
+    "selu": lambda ins, at: 1.0507009873554805 * np.where(
+        _X(ins) > 0, _X(ins),
+        1.6732632423543772 * (np.exp(_X(ins)) - 1)),
+    "l1_norm": lambda ins, at: np.array([np.abs(_X(ins)).sum()], "float32"),
+    "clip_by_norm": lambda ins, at: _X(ins) * min(
+        1.0, at["max_norm"] / np.sqrt((_X(ins) ** 2).sum())),
+    "label_smooth": lambda ins, at: (
+        (1 - at["epsilon"]) * _X(ins)
+        + at["epsilon"] / _X(ins).shape[-1]),
+    "brelu": lambda ins, at: np.clip(_X(ins), at["t_min"], at["t_max"]),
+    "fill_zeros_like2": lambda ins, at: np.zeros_like(_X(ins)),
+    "rnn_memory_helper": lambda ins, at: _X(ins),
+    "size": lambda ins, at: np.asarray(ins["Input"][0].size),
+    "shape": lambda ins, at: np.asarray(ins["Input"][0].shape, "int32"),
+    "diag": lambda ins, at: np.diag(ins["Diagonal"][0]),
+    "eye": lambda ins, at: np.eye(at["num_rows"], dtype="float32"),
+    "fill": lambda ins, at: np.asarray(
+        at["value"], "float32").reshape(at["shape"]),
+    "fill_any_like": lambda ins, at: np.full_like(_X(ins), at["value"]),
+    "reverse": lambda ins, at: np.flip(_X(ins), axis=tuple(at["axis"])),
+    "l2_normalize": lambda ins, at: _X(ins) / np.sqrt(
+        (np.asarray(_X(ins), "float64") ** 2).sum(at["axis"], keepdims=True)
+    ).astype("float32"),
+    "minus": lambda ins, at: _X(ins) - ins["Y"][0],
+    # binary / comparison / logical
+    "elementwise_floordiv": lambda ins, at: _X(ins) // ins["Y"][0],
+    "elementwise_min": lambda ins, at: np.minimum(_X(ins), ins["Y"][0]),
+    "elementwise_pow": lambda ins, at: _X(ins) ** ins["Y"][0],
+    "greater_equal": lambda ins, at: _X(ins) >= ins["Y"][0],
+    "less_equal": lambda ins, at: _X(ins) <= ins["Y"][0],
+    "not_equal": lambda ins, at: _X(ins) != ins["Y"][0],
+    "logical_xor": lambda ins, at: _X(ins) ^ ins["Y"][0],
+    "matmul_v2": lambda ins, at: _X(ins) @ ins["Y"][0],
+    # reduces / argedness
+    "reduce_max": lambda ins, at: _X(ins).max(tuple(at["dim"])),
+    "reduce_min": lambda ins, at: _X(ins).min(tuple(at["dim"])),
+    "reduce_prod": lambda ins, at: _X(ins).prod(tuple(at["dim"])),
+    "reduce_all": lambda ins, at: _X(ins).all(tuple(at["dim"])),
+    "reduce_any": lambda ins, at: _X(ins).any(tuple(at["dim"])),
+    "arg_max": lambda ins, at: _X(ins).argmax(at["axis"]),
+    "arg_min": lambda ins, at: _X(ins).argmin(at["axis"]),
+    "argsort": lambda ins, at: {
+        "Out": np.sort(_X(ins), axis=at["axis"]),
+        "Indices": np.argsort(_X(ins), axis=at["axis"], kind="stable")},
+    "top_k_v2": lambda ins, at: {
+        "Out": -np.sort(-_X(ins), axis=-1)[:, :at["k"]],
+        "Indices": np.argsort(-_X(ins), axis=-1, kind="stable")[:, :at["k"]]},
+    # shape manipulation
+    "reshape": lambda ins, at: _X(ins).reshape(at["shape"]),
+    "squeeze2": lambda ins, at: {"Out": np.squeeze(
+        _X(ins), axis=tuple(at["axes"]))},
+    "flatten2": lambda ins, at: {"Out": _X(ins).reshape(
+        int(np.prod(_X(ins).shape[:at["axis"]])), -1)},
+    "transpose": lambda ins, at: _X(ins).transpose(at["axis"]),
+    "stack": lambda ins, at: np.stack(ins["X"], axis=at["axis"]),
+    "unstack": lambda ins, at: {"Y": [
+        a for a in np.moveaxis(_X(ins), at["axis"], 0)]},
+    "tile": lambda ins, at: np.tile(_X(ins), at["repeat_times"]),
+    "expand": lambda ins, at: np.tile(_X(ins), at["expand_times"]),
+    "expand_as": lambda ins, at: np.broadcast_to(
+        _X(ins), ins["target_tensor"][0].shape),
+    "pad": lambda ins, at: np.pad(
+        _X(ins),
+        [(at["paddings"][2 * i], at["paddings"][2 * i + 1])
+         for i in range(_X(ins).ndim)],
+        constant_values=at["pad_value"]),
+    "pad2d": lambda ins, at: np.pad(
+        _X(ins),
+        [(0, 0), (0, 0), (at["paddings"][0], at["paddings"][1]),
+         (at["paddings"][2], at["paddings"][3])]),
+    "strided_slice": lambda ins, at: ins["Input"][0][0:4:2, 1:5:2],
+    "gather": lambda ins, at: _X(ins)[ins["Index"][0]],
+    "gather_nd": lambda ins, at: _X(ins)[tuple(ins["Index"][0].T)],
+    "scatter": lambda ins, at: _scatter_oracle(ins),
+    "scatter_nd_add": lambda ins, at: _scatter_nd_add_oracle(ins),
+    "shard_index": lambda ins, at: np.where(
+        _X(ins) // (at["index_num"] // at["nshards"]) == at["shard_id"],
+        _X(ins) % (at["index_num"] // at["nshards"]), at["ignore_value"]),
+    "one_hot_v2": lambda ins, at: np.eye(at["depth"], dtype="float32")[
+        _X(ins)],
+    "crop": lambda ins, at: _X(ins)[1:3, 1:4],
+    "crop_tensor": lambda ins, at: _X(ins)[1:3, 1:4],
+    "pad_constant_like": lambda ins, at: np.pad(
+        ins["Y"][0],
+        [(0, dx - dy) for dx, dy in zip(_X(ins).shape, ins["Y"][0].shape)],
+        constant_values=at["pad_value"]),
+    "multiplex": lambda ins, at: np.stack(
+        [ins["X"][int(ins["Ids"][0][i, 0])][i]
+         for i in range(ins["Ids"][0].shape[0])]),
+    "partial_concat": lambda ins, at: np.concatenate(
+        [a[:, at["start_index"]:at["start_index"] + at["length"]]
+         for a in ins["X"]], axis=1),
+    "partial_sum": lambda ins, at: sum(
+        a[:, at["start_index"]:at["start_index"] + at["length"]]
+        for a in ins["X"]),
+    "is_empty": lambda ins, at: np.asarray(False),
+    "linspace": lambda ins, at: np.linspace(0, 1, 5).astype("float32"),
+    "range": lambda ins, at: np.arange(0, 5, 1).astype("float32"),
+    # losses
+    "cross_entropy": lambda ins, at: -np.log(np.take_along_axis(
+        _X(ins), ins["Label"][0].astype(np.int64), 1)),
+    "sigmoid_cross_entropy_with_logits": lambda ins, at: (
+        np.maximum(_X(ins), 0) - _X(ins) * ins["Label"][0]
+        + np.log1p(np.exp(-np.abs(_X(ins))))),
+    "huber_loss": lambda ins, at: {"Out": _huber_oracle(ins, at)},
+    "log_loss": lambda ins, at: (
+        -ins["Labels"][0] * np.log(ins["Predicted"][0] + at["epsilon"])
+        - (1 - ins["Labels"][0])
+        * np.log(1 - ins["Predicted"][0] + at["epsilon"])),
+    "squared_l2_distance": lambda ins, at: {"Out": (
+        (_X(ins) - ins["Y"][0]) ** 2).sum(1, keepdims=True)},
+    "hinge_loss": lambda ins, at: np.maximum(
+        0.0, 1 - (2 * ins["Labels"][0] - 1) * ins["Logits"][0]),
+    "margin_rank_loss": lambda ins, at: {"Out": np.maximum(
+        0.0, -ins["Label"][0] * (ins["X1"][0] - ins["X2"][0])
+        + at["margin"])},
+    "rank_loss": lambda ins, at: (
+        np.log1p(np.exp(ins["Left"][0] - ins["Right"][0]))
+        - ins["Label"][0] * (ins["Left"][0] - ins["Right"][0])),
+    "bpr_loss": lambda ins, at: _bpr_oracle(ins),
+    "cos_sim": lambda ins, at: {"Out": (
+        (_X(ins) * ins["Y"][0]).sum(1, keepdims=True)
+        / np.linalg.norm(_X(ins), axis=1, keepdims=True)
+        / np.linalg.norm(ins["Y"][0], axis=1, keepdims=True))},
+    # nn
+    "prelu": lambda ins, at: np.where(
+        _X(ins) > 0, _X(ins), ins["Alpha"][0].reshape(()) * _X(ins)),
+    # out channel c = max over input channels c*groups..c*groups+g-1
+    # (math/maxouting.cc:44-49)
+    "maxout": lambda ins, at: _X(ins).reshape(
+        _X(ins).shape[0], _X(ins).shape[1] // at["groups"],
+        at["groups"], *_X(ins).shape[2:]).max(2),
+    "shuffle_channel": lambda ins, at: _X(ins).reshape(
+        _X(ins).shape[0], at["group"], _X(ins).shape[1] // at["group"],
+        *_X(ins).shape[2:]).swapaxes(1, 2).reshape(_X(ins).shape),
+    "pixel_shuffle": lambda ins, at: _pixel_shuffle_oracle(ins, at),
+    "space_to_depth": lambda ins, at: _space_to_depth_oracle(ins, at),
+    "affine_channel": lambda ins, at: (
+        _X(ins) * ins["Scale"][0].reshape(1, -1, 1, 1)
+        + ins["Bias"][0].reshape(1, -1, 1, 1)),
+    "fsp": lambda ins, at: np.einsum(
+        "nchw,ndhw->ncd", _X(ins), ins["Y"][0]).astype("float32")
+        / (_X(ins).shape[2] * _X(ins).shape[3]),
+    "bilinear_tensor_product": lambda ins, at: (
+        np.einsum("bi,kij,bj->bk", _X(ins), ins["Weight"][0], ins["Y"][0])
+        + ins["Bias"][0][None, :]),
+    "temporal_shift": lambda ins, at: _temporal_shift_oracle(ins, at),
+    "group_norm": lambda ins, at: {"Y": _group_norm_oracle(ins, at)},
+    "instance_norm": lambda ins, at: {"Y": _group_norm_oracle(
+        ins, {"groups": _X(ins).shape[1], "epsilon": at["epsilon"]})},
+    # sequence (dense pad + Length mask)
+    "sequence_mask": lambda ins, at: (
+        np.arange(at["maxlen"])[None, :] < _X(ins)[:, None]),
+    "sequence_reverse": lambda ins, at: _seq_reverse_oracle(ins),
+    "sequence_concat": lambda ins, at: np.concatenate(ins["X"], axis=1),
+    "sequence_pool": lambda ins, at: _seq_pool_avg_oracle(ins),
+    # collectives are identity in a single-process program
+    "allreduce": lambda ins, at: _X(ins),
+    "broadcast": lambda ins, at: _X(ins),
+    "c_allreduce_sum": lambda ins, at: _X(ins),
+    "c_allreduce_max": lambda ins, at: _X(ins),
+    "c_allreduce_min": lambda ins, at: _X(ins),
+    "c_allreduce_prod": lambda ins, at: _X(ins),
+    "c_broadcast": lambda ins, at: _X(ins),
+    "c_reducescatter": lambda ins, at: _X(ins),
+    "c_sync_calc_stream": lambda ins, at: _X(ins),
+    "c_sync_comm_stream": lambda ins, at: _X(ins),
+    "print": lambda ins, at: ins["In"][0],
+    # quant (simple scales)
+    "dequantize_abs_max": lambda ins, at: (
+        _X(ins) * ins["Scale"][0].reshape(()) / at["max_range"]),
+    "fake_dequantize_max_abs": lambda ins, at: (
+        _X(ins) * ins["Scale"][0].reshape(()) / at["max_range"]),
+    # detection (geometric formulas)
+    "iou_similarity": lambda ins, at: np.array(
+        [[_iou(a, b) for b in ins["Y"][0]] for a in _X(ins)], "float32"),
+    "box_clip": lambda ins, at: np.clip(
+        ins["Input"][0],
+        0, np.array([9.0, 9.0, 9.0, 9.0], "float32")),
+    # attention (numpy MHA)
+    "flash_attention": lambda ins, at: _mha(
+        ins["Q"][0], ins["K"][0], ins["V"][0], at["num_heads"]),
+    # finiteness probes (isfinite_op.cc reduces to one bool; the _v2
+    # form is elementwise)
+    "isfinite": lambda ins, at: np.asarray(np.isfinite(_X(ins)).all()),
+    "isfinite_v2": lambda ins, at: np.isfinite(_X(ins)),
+    "has_inf": lambda ins, at: np.asarray([np.isinf(_X(ins)).any()]),
+    "has_nan": lambda ins, at: np.asarray([np.isnan(_X(ins)).any()]),
+    "expand_pred_like": lambda ins, at: np.broadcast_to(
+        _X(ins).astype(bool).reshape(()), ins["Y"][0].shape),
+    # int8 quant chain (mkldnn quantize/dequantize/requantize ops;
+    # default is_negative_input False -> uint8)
+    "quantize": lambda ins, at: np.clip(
+        np.round(ins["Input"][0] * at["Scale"]), 0, 255).astype("uint8"),
+    "dequantize": lambda ins, at: ins["Input"][0].astype(
+        "float32") / at["Scale"],
+    "requantize": lambda ins, at: np.clip(
+        np.round(ins["Input"][0].astype("float32")
+                 * (at["Scale_out"] / at["Scale_in"])),
+        -128, 127).astype("int8"),
+    # norm op Out == l2_normalize
+    "norm": lambda ins, at: {"Out": _X(ins) / np.sqrt(
+        (np.asarray(_X(ins), "float64") ** 2).sum(at["axis"], keepdims=True)
+    ).astype("float32")},
+    "lod_reset": lambda ins, at: _X(ins),
+    "max_sequence_len": lambda ins, at: np.asarray(
+        ins["RankTable"][0].shape[1], "int32"),
+    "cvm": lambda ins, at: {"Y": np.concatenate([
+        np.log(_X(ins)[:, :1] + 1),
+        np.log(_X(ins)[:, 1:2] + 1) - np.log(_X(ins)[:, :1] + 1),
+        _X(ins)[:, 2:]], 1)},
+    # step 5 >= rampup 0 -> clipped (dgc_clip_by_norm_op.cc)
+    "dgc_clip_by_norm": lambda ins, at: _X(ins) * (
+        at["max_norm"] / max(np.sqrt((_X(ins) ** 2).sum()),
+                             at["max_norm"])),
+    "smooth_l1_loss": lambda ins, at: {"Out": np.where(
+        np.abs(_X(ins) - ins["Y"][0]) < 1.0,
+        0.5 * (_X(ins) - ins["Y"][0]) ** 2,
+        np.abs(_X(ins) - ins["Y"][0]) - 0.5).sum(1, keepdims=True)},
+    "modified_huber_loss": lambda ins, at: {"Out": _mod_huber_oracle(ins)},
+    "kldiv_loss": lambda ins, at: np.asarray(np.where(
+        ins["Target"][0] > 0,
+        ins["Target"][0] * (np.log(np.clip(ins["Target"][0], 1e-10, None))
+                            - _X(ins)),
+        0.0).mean(), "float32"),
+    "sequence_softmax": lambda ins, at: _seq_softmax_oracle(ins),
+    "mean_iou": lambda ins, at: {"OutMeanIou": _mean_iou_oracle(ins, at)},
+}
+
+
+def _scatter_oracle(ins):
+    out = ins["X"][0].copy()
+    out[ins["Ids"][0]] = ins["Updates"][0]
+    return out
+
+
+def _scatter_nd_add_oracle(ins):
+    out = ins["X"][0].copy()
+    for i, idx in enumerate(ins["Index"][0]):
+        out[tuple(idx)] += ins["Updates"][0][i]
+    return out
+
+
+def _huber_oracle(ins, at):
+    d = at["delta"]
+    z = np.abs(ins["Y"][0] - ins["X"][0])
+    return np.where(z <= d, 0.5 * z * z, d * (z - 0.5 * d))
+
+
+def _bpr_oracle(ins):
+    x, lbl = ins["X"][0], ins["Label"][0][:, 0]
+    out = np.zeros((x.shape[0], 1), "float32")
+    for i in range(x.shape[0]):
+        o = 0.0
+        for j in range(x.shape[1]):
+            if j != lbl[i]:
+                o += np.log1p(np.exp(-(x[i, lbl[i]] - x[i, j])))
+        out[i, 0] = o / (x.shape[1] - 1)
+    return out
+
+
+def _pixel_shuffle_oracle(ins, at):
+    x = ins["X"][0]
+    n, c, h, w = x.shape
+    r = at["upscale_factor"]
+    return (x.reshape(n, c // (r * r), r, r, h, w)
+            .transpose(0, 1, 4, 2, 5, 3)
+            .reshape(n, c // (r * r), h * r, w * r))
+
+
+def _space_to_depth_oracle(ins, at):
+    x = ins["X"][0]
+    n, c, h, w = x.shape
+    b = at["blocksize"]
+    return (x.reshape(n, c, h // b, b, w // b, b)
+            .transpose(0, 3, 5, 1, 2, 4)
+            .reshape(n, c * b * b, h // b, w // b))
+
+
+def _temporal_shift_oracle(ins, at):
+    x = ins["X"][0]
+    nt, c, h, w = x.shape
+    t = at["seg_num"]
+    n = nt // t
+    fold = int(c * at["shift_ratio"])
+    y = x.reshape(n, t, c, h, w)
+    out = np.zeros_like(y)
+    out[:, :-1, :fold] = y[:, 1:, :fold]          # shift left
+    out[:, 1:, fold:2 * fold] = y[:, :-1, fold:2 * fold]  # shift right
+    out[:, :, 2 * fold:] = y[:, :, 2 * fold:]
+    return out.reshape(nt, c, h, w)
+
+
+def _group_norm_oracle(ins, at):
+    x = np.asarray(ins["X"][0], "float64")
+    n, c, h, w = x.shape
+    g = at["groups"]
+    xg = x.reshape(n, g, c // g, h, w)
+    mu = xg.mean(axis=(2, 3, 4), keepdims=True)
+    var = xg.var(axis=(2, 3, 4), keepdims=True)
+    y = ((xg - mu) / np.sqrt(var + at["epsilon"])).reshape(n, c, h, w)
+    return (y * ins["Scale"][0].reshape(1, -1, 1, 1)
+            + ins["Bias"][0].reshape(1, -1, 1, 1)).astype("float32")
+
+
+def _seq_reverse_oracle(ins):
+    x, ln = ins["X"][0], ins["Length"][0]
+    out = x.copy()
+    for b in range(x.shape[0]):
+        out[b, :ln[b]] = x[b, :ln[b]][::-1]
+    return out
+
+
+def _mod_huber_oracle(ins):
+    z = (2.0 * ins["Y"][0] - 1.0) * _X(ins)
+    return np.where(z < -1.0, -4.0 * z,
+                    np.where(z < 1.0, (1.0 - z) ** 2, 0.0))
+
+
+def _seq_softmax_oracle(ins):
+    x, ln = _X(ins), ins["Length"][0]
+    out = np.zeros_like(x)
+    for b in range(x.shape[0]):
+        out[b, :ln[b]] = _softmax(x[b, :ln[b]], axis=0)
+    return out
+
+
+def _mean_iou_oracle(ins, at):
+    pred = ins["Predictions"][0].reshape(-1)
+    lbl = ins["Labels"][0].reshape(-1)
+    C = at["num_classes"]
+    ious = []
+    for c in range(C):
+        inter = ((pred == c) & (lbl == c)).sum()
+        union = ((pred == c) | (lbl == c)).sum()
+        if union > 0:
+            ious.append(inter / union)
+    return np.asarray(np.mean(ious), "float32")
+
+
+def _seq_pool_avg_oracle(ins):
+    x, ln = ins["X"][0], ins["Length"][0]
+    out = np.zeros((x.shape[0], x.shape[2]), "float32")
+    for b in range(x.shape[0]):
+        out[b] = x[b, :ln[b]].mean(0)
+    return out
+
+
 def _run_spec(op_type, sp):
     from paddle_tpu.core.registry import get_op_def
 
@@ -618,20 +1046,83 @@ def _run_spec(op_type, sp):
         block.append_op(type=op_type, inputs=in_vars, outputs=out_vars,
                         attrs=dict(sp["attrs"]))
         fetch = [v for vs in out_vars.values() for v in vs]
-        grad_fetch = []
+        grad_fetch, grad_slots, target = [], [], None
         if sp["grads"]:
             first_out = fetch[0]
             target = fluid.layers.mean(
                 fluid.layers.cast(first_out, "float32"))
             gs = fluid.gradients(
                 target, [in_vars[s][0] for s in sp["grads"]])
+            grad_slots = [s for s, g in zip(sp["grads"], gs) if g is not None]
             grad_fetch = [g for g in gs if g is not None]
     exe = fluid.Executor(fluid.CPUPlace())
-    outs = exe.run(main, feed=feed, fetch_list=fetch + grad_fetch)
+    tfetch = [target] if target is not None else []
+    outs = exe.run(main, feed=feed, fetch_list=fetch + grad_fetch + tfetch)
     for v, name in zip(outs, [f.name for f in fetch + grad_fetch]):
         arr = np.asarray(v)
         if np.issubdtype(arr.dtype, np.floating):
             assert np.all(np.isfinite(arr)), f"{op_type}: {name} non-finite"
+
+    # ---- oracle tier: compare outputs against the numpy expectation
+    oracle = ORACLES.get(op_type)
+    if oracle is not None:
+        ins = {s: [np.asarray(a) for a in (v if isinstance(v, list) else [v])]
+               for s, v in sp["inputs"].items()}
+        expected = oracle(ins, dict(sp["attrs"]))
+        if not isinstance(expected, dict):
+            expected = {od.output_slots[0]: expected}
+        outs_by_slot, k = {}, 0
+        for slot in od.output_slots:
+            n = sp["n_out"].get(slot, 1)
+            outs_by_slot[slot] = [np.asarray(outs[k + i]) for i in range(n)]
+            k += n
+        for slot, exp in expected.items():
+            exp_list = exp if isinstance(exp, list) else [exp]
+            for i, e in enumerate(exp_list):
+                got = outs_by_slot[slot][i]
+                e = np.asarray(e)
+                assert tuple(got.shape) == tuple(e.shape), (
+                    f"{op_type} {slot}[{i}] shape {got.shape} != "
+                    f"oracle {e.shape}")
+                if np.issubdtype(e.dtype, np.floating):
+                    np.testing.assert_allclose(
+                        got.astype(e.dtype), e,
+                        atol=sp["tol"], rtol=sp["tol"],
+                        err_msg=f"{op_type} oracle mismatch on {slot}[{i}]")
+                else:
+                    np.testing.assert_array_equal(
+                        got, e,
+                        err_msg=f"{op_type} oracle mismatch on {slot}[{i}]")
+
+    # ---- gradient tier: directional finite-difference check of every
+    # analytic grad (reference op_test.py get_numeric_gradient:57 — the
+    # cheap directional form: <grad, v> vs (L(x+eps v) - L(x-eps v))/2eps)
+    if sp["grads"] and grad_fetch and sp["fd"]:
+        L0 = float(np.asarray(outs[len(fetch) + len(grad_fetch)]))
+        assert np.isfinite(L0)
+        drng = np.random.RandomState(7)
+        for gi, s in enumerate(grad_slots):
+            name = f"{op_type}_{s}_0"
+            x = feed[name]
+            if not np.issubdtype(np.asarray(x).dtype, np.floating):
+                continue
+            g = np.asarray(outs[len(fetch) + gi])
+            v = drng.randn(*x.shape).astype(x.dtype)
+            eps = 1e-3 * max(1.0, float(np.abs(x).max()))
+            fp, fm = {}, {}
+            fp.update(feed); fm.update(feed)
+            fp[name] = (x + eps * v).astype(x.dtype)
+            fm[name] = (x - eps * v).astype(x.dtype)
+            Lp = float(np.asarray(exe.run(
+                main, feed=fp, fetch_list=[target])[0]))
+            Lm = float(np.asarray(exe.run(
+                main, feed=fm, fetch_list=[target])[0]))
+            numeric = (Lp - Lm) / (2 * eps)
+            analytic = float(np.sum(g.reshape(v.shape) * v))
+            scale = max(abs(numeric), abs(analytic), 1e-2)
+            assert abs(numeric - analytic) <= 0.06 * scale, (
+                f"{op_type}: directional FD grad mismatch for input {s!r}: "
+                f"numeric {numeric:.6g} vs analytic {analytic:.6g}")
 
 
 @pytest.mark.parametrize("op_type", sorted(SPECS))
@@ -766,3 +1257,22 @@ def test_specs_actually_exercised_their_ops():
         _run_spec(op_type, SPECS[op_type])
     done = set(exercised_ops())
     assert {"ceil", "matmul_v2", "gather", "multiclass_nms2"} <= done
+
+
+def test_verified_tier_is_at_least_80_percent():
+    """Round-2 verdict weak #6 ratchet: the sweep must distinguish
+    'executes finite' from 'numerically verified'. Verified =
+    dedicated numeric test elsewhere (COVERED_ELSEWHERE), a numpy
+    oracle here (ORACLES), or a setup no-op with nothing to verify.
+    The directional-FD grad check additionally runs for every spec
+    with grads. Floor: 80% of registered forward lowerings verified."""
+    fwd = {t for t in registered_ops() if not t.endswith("_grad")}
+    verified = (COVERED_ELSEWHERE | (set(ORACLES) & set(SPECS))
+                | set(NOOP_OPS)) & fwd
+    frac = len(verified) / len(fwd)
+    assert frac >= 0.80, (
+        f"verified tier {len(verified)}/{len(fwd)} = {frac:.1%} < 80% — "
+        "add numpy oracles to ORACLES or dedicated tests")
+    # hygiene: every oracle key must be a real spec (else it's dead)
+    dead = sorted(set(ORACLES) - set(SPECS))
+    assert not dead, f"ORACLES entries without a spec: {dead}"
