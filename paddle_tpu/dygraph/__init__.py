@@ -32,7 +32,8 @@ from . import nn
 from .nn import Linear, Conv2D, Pool2D, BatchNorm, Embedding, LayerNorm, Dropout
 from .parallel import DataParallel, prepare_context, ParallelEnv
 from .checkpoint import save_dygraph, load_dygraph
-from .jit import TracedLayer, to_static
+from .jit import (TracedLayer, to_static, dygraph_to_static_graph,
+                  dygraph_to_static_output)
 from .dygraph_to_static import declarative, convert_to_static
 from .container import Sequential, LayerList, ParameterList
 from .learning_rate_scheduler import (

@@ -83,21 +83,53 @@ def _wrap_like(new_vals, templates):
     return tuple(out)
 
 
-def _check_no_undef(vals):
-    if any(isinstance(v, _Undef) for v in vals):
-        raise NotImplementedError(
-            "to_static: a variable assigned in only one branch of a "
-            "traced if/else must be defined before it"
-        )
+def _is_missing(v):
+    return v is None or isinstance(v, _Undef)
 
 
-def convert_ifelse(pred, true_fn, false_fn, init):
+def _tree_zeros_like(v):
+    return jax.tree_util.tree_map(
+        lambda a: jnp.zeros_like(jnp.asarray(_unwrap(a))), v)
+
+
+def _reconcile(t_vals, f_vals, allow_substitute):
+    """Align branch outputs for select. With allow_substitute (this if
+    participates in the early-return transform — its assigned names
+    include the done flag), a position defined in only one branch gets
+    zeros_like of the defined side: sound because the done-flag gating
+    guarantees the undefined side is never the FINAL value along any
+    consistent path. For ORDINARY user ifs a mismatch raises — a
+    silent zeros substitute would make `y = None; if c: y = ...`
+    return 0.0 instead of None under jit. Both-missing positions stay
+    None (the name remains undefined)."""
+    t2, f2 = list(t_vals), list(f_vals)
+    for i, (a, b) in enumerate(zip(t_vals, f_vals)):
+        am, bm = _is_missing(a), _is_missing(b)
+        if am and bm:
+            t2[i] = f2[i] = None
+        elif am or bm:
+            if not allow_substitute:
+                raise NotImplementedError(
+                    "to_static: a variable assigned in only one branch "
+                    "of a traced if/else must be defined before it"
+                )
+            if am:
+                t2[i] = _tree_zeros_like(b)
+            else:
+                f2[i] = _tree_zeros_like(a)
+    return tuple(t2), tuple(f2)
+
+
+def convert_ifelse(pred, true_fn, false_fn, init, names=()):
     """Branch fns take the tuple of assigned names' CURRENT values (a
     branch that reads a name it also assigns would otherwise hit
     UnboundLocalError — python makes assigned names function-local) and
-    return the updated tuple."""
+    return the updated tuple. `names` lets the traced paths tell the
+    early-return transform's generated ifs (which assign the done
+    flag) from ordinary user ifs."""
     from .base import VarBase
 
+    allow_substitute = _DONE in names
     if not _is_traced(pred):
         p = _unwrap(pred)
         p = bool(np.asarray(p).reshape(())) if hasattr(p, "reshape") or hasattr(
@@ -111,11 +143,15 @@ def convert_ifelse(pred, true_fn, false_fn, init):
         # the eager-API-under-jit corner)
         template = true_fn(init)
         f_template = false_fn(init)
-        _check_no_undef(template + f_template)
-        t_vals = tuple(_unwrap(v) for v in template)
-        f_vals = tuple(_unwrap(v) for v in f_template)
+        t_vals, f_vals = _reconcile(
+            tuple(_unwrap(v) for v in template),
+            tuple(_unwrap(v) for v in f_template), allow_substitute)
         out = jax.lax.cond(_to_pred(pred), lambda: t_vals, lambda: f_vals)
-        return _wrap_like(out, template)
+        # wrap positions by whichever branch defined them
+        merged = tuple(
+            t if not _is_missing(t) else f
+            for t, f in zip(template, f_template))
+        return _wrap_like(out, merged)
     # pure-array path: a REAL lazy cond — XLA executes only the taken
     # branch, so `if use_aux: big_network(x)` costs nothing when False
     defined_idx = [i for i, v in enumerate(init) if not isinstance(v, _Undef)]
@@ -125,16 +161,23 @@ def convert_ifelse(pred, true_fn, false_fn, init):
         full = list(init)
         for j, i in enumerate(defined_idx):
             full[i] = c[j]
-        res = branch_fn(tuple(full))
-        _check_no_undef(res)
-        return tuple(res)
+        return tuple(branch_fn(tuple(full)))
 
-    return jax.lax.cond(
-        _to_pred(pred),
-        lambda c: run(true_fn, c),
-        lambda c: run(false_fn, c),
-        raw,
-    )
+    try:
+        return jax.lax.cond(
+            _to_pred(pred),
+            lambda c: run(true_fn, c),
+            lambda c: run(false_fn, c),
+            raw,
+        )
+    except TypeError:
+        # branch outputs differ structurally (a name defined in only
+        # one branch — the early-return transform produces this):
+        # evaluate both and select with zeros substitution
+        t_vals, f_vals = _reconcile(run(true_fn, raw), run(false_fn, raw),
+                                    allow_substitute)
+        return jax.lax.cond(
+            _to_pred(pred), lambda: t_vals, lambda: f_vals)
 
 
 def convert_while(cond_fn, body_fn, init):
@@ -289,15 +332,21 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                       ast.Name(id=tname, ctx=ast.Load()),
                       ast.Name(id=fname, ctx=ast.Load()),
                       _grab_expr(names)],
-                keywords=[],
+                keywords=[ast.keyword(
+                    arg="names",
+                    value=ast.Tuple(
+                        elts=[ast.Constant(value=n) for n in names],
+                        ctx=ast.Load()))],
             ),
         )
         return [tfn, ffn, call]
 
     def visit_While(self, node):
         self.generic_visit(node)
-        if node.orelse:
-            raise NotImplementedError("to_static: while/else is not supported")
+        # break is unsupported inside converted loops, so a while/else's
+        # else suite ALWAYS runs — it simply follows the loop
+        orelse = list(node.orelse)
+        node.orelse = []
         if _contains_return(node.body):
             raise NotImplementedError(
                 "to_static: `return` inside a converted while is not supported"
@@ -337,7 +386,7 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                 keywords=[],
             ),
         )
-        return [cfn, bfn, call]
+        return [cfn, bfn, call] + orelse
 
     # NOTE: and/or/not are rewritten ONLY inside if/while TESTS
     # (_transform_test below). A value-position boolop like
@@ -408,6 +457,58 @@ def _grab_expr(names):
 
 _CACHE = {}
 
+_RV, _DONE = "_jst_ret_val", "_jst_done"
+
+
+def _lower_returns(stmts):
+    """Rewrite `return` inside if/else into done-flag + value carries
+    (the reference's return_transformer.py): after this pass the only
+    `return` left in the suite is a trailing top-level one. Returns
+    (new_stmts, had_early_return)."""
+    out, early = [], False
+    for idx, st in enumerate(stmts):
+        rest = stmts[idx + 1:]
+        if isinstance(st, ast.Return):
+            val = st.value if st.value is not None else ast.Constant(value=None)
+            out.append(ast.Assign(
+                targets=[ast.Name(id=_RV, ctx=ast.Store())], value=val))
+            out.append(ast.Assign(
+                targets=[ast.Name(id=_DONE, ctx=ast.Store())],
+                value=ast.Constant(value=True)))
+            return out, True  # anything after is dead code
+        if isinstance(st, ast.If):
+            tb, te = _lower_returns(st.body)
+            fb, fe = _lower_returns(st.orelse)
+            st.body = tb or [ast.Pass()]
+            st.orelse = fb
+            out.append(st)
+            if te or fe:
+                new_rest, _ = _lower_returns(rest)
+                if new_rest:
+                    out.append(ast.If(
+                        test=ast.UnaryOp(
+                            op=ast.Not(),
+                            operand=ast.Name(id=_DONE, ctx=ast.Load())),
+                        body=new_rest, orelse=[]))
+                return out, True
+            continue
+        out.append(st)
+    return out, early
+
+
+def _apply_return_transform(fdef):
+    body, had = _lower_returns(fdef.body)
+    if not had:
+        return
+    inits = [
+        ast.Assign(targets=[ast.Name(id=_DONE, ctx=ast.Store())],
+                   value=ast.Constant(value=False)),
+        ast.Assign(targets=[ast.Name(id=_RV, ctx=ast.Store())],
+                   value=ast.Constant(value=None)),
+    ]
+    fdef.body = inits + body + [
+        ast.Return(value=ast.Name(id=_RV, ctx=ast.Load()))]
+
 
 def convert_to_static(fn):
     """Source-to-source conversion (reference cache_program.py caches
@@ -418,14 +519,17 @@ def convert_to_static(fn):
     tree = ast.parse(src)
     fdef = tree.body[0]
     fdef.decorator_list = []  # drop @declarative/@to_static
+    _apply_return_transform(fdef)
     new_tree = _ControlFlowTransformer().visit(tree)
     ast.fix_missing_locations(new_tree)
     import sys
 
     # exec into the LIVE module globals (not a copy) so forward
     # references and monkeypatched globals keep working; only _jst is
-    # injected (collision-checked)
-    ns = fn.__globals__
+    # injected (collision-checked). Closures exec into a COPY with the
+    # free variables re-read from the cells at every call (they may be
+    # rebound between calls).
+    ns = dict(fn.__globals__) if fn.__closure__ else fn.__globals__
     me = sys.modules[__name__]
     if "_jst" in ns and ns["_jst"] is not me:
         raise RuntimeError(
@@ -437,13 +541,18 @@ def convert_to_static(fn):
     ast.fix_missing_locations(new_tree)
     code = compile(new_tree, filename=f"<to_static:{fn.__name__}>", mode="exec")
     exec(code, ns)
-    converted = ns.pop(fdef.name)
-    converted.__name__ = converted_name
+    inner = ns.pop(fdef.name)
+    inner.__name__ = converted_name
     if fn.__closure__:
-        raise NotImplementedError(
-            "to_static: closures over local variables are not supported — "
-            "pass them as arguments"
-        )
+        free, cells = fn.__code__.co_freevars, fn.__closure__
+
+        @functools.wraps(fn)
+        def converted(*args, **kwargs):
+            for n, c in zip(free, cells):
+                ns[n] = c.cell_contents
+            return inner(*args, **kwargs)
+    else:
+        converted = inner
     _CACHE[fn] = converted
     return converted
 

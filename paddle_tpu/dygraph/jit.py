@@ -92,3 +92,16 @@ def to_static(fn: Callable = None):
 
 
 declarative = to_static
+
+
+def dygraph_to_static_graph(fn=None):
+    """Reference fluid/dygraph/jit.py alias: AST-convert a dygraph
+    function so data-dependent python control flow compiles (same entry
+    as @declarative; the reference's graph/output variants differ only
+    in what they return, which the executor surface here unifies)."""
+    from .dygraph_to_static import declarative
+
+    return declarative(fn) if fn is not None else declarative
+
+
+dygraph_to_static_output = dygraph_to_static_graph

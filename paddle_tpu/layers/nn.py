@@ -114,6 +114,26 @@ __all__ = [
 ]
 
 
+def _maybe_eager(op_type, ins, out_slots, attrs):
+    """Dygraph bridge: when eager mode is on and the inputs are
+    VarBase, run the op NOW through the tape-recording tracer
+    (dygraph/base._trace) instead of appending to a Program — the
+    reference's imperative tracer dispatch that lets fluid.layers.*
+    work inside dygraph code (and converted @declarative functions).
+    Returns the flat output list, or None for the graph path."""
+    from ..core.dygraph import in_dygraph_mode
+
+    if not in_dygraph_mode():
+        return None
+    from ..dygraph.base import VarBase, _trace
+
+    if not any(isinstance(v, VarBase)
+               for vs in ins.values() for v in vs if v is not None):
+        return None
+    ins = {s: [v for v in vs if v is not None] for s, vs in ins.items()}
+    return _trace(op_type, ins, list(out_slots), dict(attrs))
+
+
 def _out(helper, x, shape=None, dtype=None, stop_gradient=False):
     return helper.create_variable_for_type_inference(
         dtype=dtype or (x.dtype if isinstance(x, Variable) else "float32"),
@@ -822,6 +842,9 @@ reduce_prod = _make_reduce("reduce_prod")
 
 
 def mean(x, name=None):
+    eager = _maybe_eager("mean", {"X": [x]}, ["Out"], {})
+    if eager is not None:
+        return eager[0]
     helper = LayerHelper("mean", name=name)
     out = _out(helper, x, shape=())
     helper.append_op(type="mean", inputs={"X": [x]}, outputs={"Out": [out]})
@@ -943,6 +966,14 @@ def argsort(x, axis=-1, descending=False, name=None):
 
 
 def reshape(x, shape, actual_shape=None, act=None, inplace=False, name=None):
+    shape = [int(s) for s in shape]
+    eager = _maybe_eager("reshape2", {"X": [x]}, ["Out", "XShape"],
+                         {"shape": shape})
+    if eager is not None:
+        out = eager[0]
+        if act:
+            out = _maybe_eager(act, {"X": [out]}, ["Out"], {})[0]
+        return out
     helper = LayerHelper("reshape2", act=act, name=name)
     new_shape = []
     for i, s in enumerate(shape):
