@@ -1,0 +1,2 @@
+from .beam_search_decoder import (InitState, StateCell, TrainingDecoder,
+                                  BeamSearchDecoder)

@@ -16,6 +16,15 @@ from . import mnist
 from . import uci_housing
 from . import imdb
 from . import cifar
+from . import wmt14
+from . import wmt16
+from . import movielens
+from . import conll05
+from . import imikolov
+from . import sentiment
+from . import flowers
+from . import voc2012
+from . import mq2007
 from .common import batch, shuffle, cache, firstn, map_readers
 
 __all__ = ["mnist", "uci_housing", "imdb", "cifar", "batch", "shuffle"]

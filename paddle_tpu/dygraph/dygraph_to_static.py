@@ -170,10 +170,12 @@ def convert_ifelse(pred, true_fn, false_fn, init, names=()):
             lambda c: run(false_fn, c),
             raw,
         )
-    except TypeError:
+    except (TypeError, NameError):
         # branch outputs differ structurally (a name defined in only
-        # one branch — the early-return transform produces this):
-        # evaluate both and select with zeros substitution
+        # one branch — the early-return transform produces this; a
+        # fresh _Undef carry surfaces as NameError from its use traps
+        # during cond tracing): evaluate both, select with zeros
+        # substitution
         t_vals, f_vals = _reconcile(run(true_fn, raw), run(false_fn, raw),
                                     allow_substitute)
         return jax.lax.cond(
@@ -460,6 +462,37 @@ _CACHE = {}
 _RV, _DONE = "_jst_ret_val", "_jst_done"
 
 
+def finalize_ret(rv, done):
+    """Final-return hook for functions whose body can FALL OFF THE END
+    while other paths return a value: python semantics say the
+    fall-through path returns None. Eagerly the done flag is concrete
+    and we honor that; under trace a None-or-value return cannot exist,
+    so fail loudly instead of silently returning the zeros
+    substitute."""
+    if _is_traced(done):
+        raise NotImplementedError(
+            "to_static: this function returns a value on some paths and "
+            "falls through (implicit None) on others — that mix is not "
+            "jittable; add an explicit return at the end"
+        )
+    import numpy as np
+
+    return rv if bool(np.asarray(_unwrap(done)).reshape(())) else None
+
+
+def _guarantees_return(stmts):
+    """True when every path through the suite ends in return/raise."""
+    if not stmts:
+        return False
+    last = stmts[-1]
+    if isinstance(last, (ast.Return, ast.Raise)):
+        return True
+    if isinstance(last, ast.If):
+        return (_guarantees_return(last.body)
+                and _guarantees_return(last.orelse))
+    return False
+
+
 def _lower_returns(stmts):
     """Rewrite `return` inside if/else into done-flag + value carries
     (the reference's return_transformer.py): after this pass the only
@@ -497,6 +530,7 @@ def _lower_returns(stmts):
 
 
 def _apply_return_transform(fdef):
+    guaranteed = _guarantees_return(fdef.body)
     body, had = _lower_returns(fdef.body)
     if not had:
         return
@@ -506,8 +540,17 @@ def _apply_return_transform(fdef):
         ast.Assign(targets=[ast.Name(id=_RV, ctx=ast.Store())],
                    value=ast.Constant(value=None)),
     ]
-    fdef.body = inits + body + [
-        ast.Return(value=ast.Name(id=_RV, ctx=ast.Load()))]
+    if guaranteed:
+        final = ast.Return(value=ast.Name(id=_RV, ctx=ast.Load()))
+    else:
+        # fall-off-the-end is reachable: route through finalize_ret so
+        # eager returns None on that path and jit fails loudly
+        final = ast.Return(value=ast.Call(
+            func=_jst_attr("finalize_ret"),
+            args=[ast.Name(id=_RV, ctx=ast.Load()),
+                  ast.Name(id=_DONE, ctx=ast.Load())],
+            keywords=[]))
+    fdef.body = inits + body + [final]
 
 
 def convert_to_static(fn):

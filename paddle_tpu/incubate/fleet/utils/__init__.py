@@ -1,0 +1,1 @@
+from .fleet_util import FleetUtil

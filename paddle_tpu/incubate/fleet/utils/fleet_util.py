@@ -1,0 +1,173 @@
+"""Fleet training utilities (reference
+incubate/fleet/utils/fleet_util.py): rank-0 logging, metric-state
+reset, globally-reduced AUC/metrics from the auc op's stat buckets,
+and model save/load wrappers. The reference reduces stats over MPI;
+here worker stats reduce over the fleet's collective path (single
+process: identity)."""
+
+from __future__ import annotations
+
+import logging
+import os
+
+import numpy as np
+
+_logger = logging.getLogger("paddle_tpu.fleet_util")
+
+
+class FleetUtil(object):
+    def __init__(self, mode="pslib"):
+        self._mode = mode
+
+    # -- rank-0 logging ----------------------------------------------------
+    def _rank(self):
+        try:
+            from ...parallel.fleet import fleet
+
+            return fleet.worker_index()
+        except Exception:
+            return 0
+
+    def rank0_print(self, s):
+        if self._rank() == 0:
+            print(s, flush=True)
+
+    def rank0_info(self, s):
+        if self._rank() == 0:
+            _logger.info(s)
+
+    def rank0_error(self, s):
+        if self._rank() == 0:
+            _logger.error(s)
+
+    # -- metric state ------------------------------------------------------
+    def set_zero(self, var_name, scope=None, place=None, param_type="int64"):
+        """Reset a metric-state variable to zeros (reference :121)."""
+        import paddle_tpu as fluid
+
+        scope = scope or fluid.global_scope()
+        var = scope.find_var(var_name)
+        if var is None:
+            raise KeyError(f"variable {var_name!r} not found in scope")
+        scope.set_var(var_name, np.zeros_like(np.asarray(var)))
+
+    def get_global_auc(self, scope=None, stat_pos="_generated_var_2",
+                       stat_neg="_generated_var_3"):
+        """AUC from the auc op's positive/negative bucket stats,
+        summed across workers (reference :186)."""
+        import paddle_tpu as fluid
+
+        scope = scope or fluid.global_scope()
+        pos = np.asarray(scope.find_var(stat_pos)).astype("float64").ravel()
+        neg = np.asarray(scope.find_var(stat_neg)).astype("float64").ravel()
+        pos, neg = self._all_reduce(pos), self._all_reduce(neg)
+        # trapezoid over buckets, descending threshold
+        tot_pos = tot_neg = area = 0.0
+        for b in range(len(pos) - 1, -1, -1):
+            new_pos = tot_pos + pos[b]
+            new_neg = tot_neg + neg[b]
+            area += (new_neg - tot_neg) * (tot_pos + new_pos) / 2.0
+            tot_pos, tot_neg = new_pos, new_neg
+        if tot_pos == 0.0 or tot_neg == 0.0:
+            return 0.5
+        return float(area / (tot_pos * tot_neg))
+
+    def print_global_auc(self, scope=None, stat_pos="_generated_var_2",
+                         stat_neg="_generated_var_3",
+                         print_prefix=""):
+        auc = self.get_global_auc(scope, stat_pos, stat_neg)
+        self.rank0_print(f"{print_prefix} global auc = {auc:.6f}")
+        return auc
+
+    def get_global_metrics(self, scope=None, stat_pos_name="_generated_var_2",
+                           stat_neg_name="_generated_var_3",
+                           sqrerr_name=None, abserr_name=None,
+                           prob_name=None, q_name=None, pos_ins_num_name=None,
+                           total_ins_num_name=None):
+        """auc + error metrics from named stat vars (reference :1268).
+        Unavailable stats come back as None."""
+        import paddle_tpu as fluid
+
+        scope = scope or fluid.global_scope()
+        out = {"auc": self.get_global_auc(scope, stat_pos_name,
+                                          stat_neg_name)}
+
+        def mean_of(name, denom):
+            if name is None or scope.find_var(name) is None:
+                return None
+            v = float(self._all_reduce(
+                np.asarray(scope.find_var(name)).astype("float64")).sum())
+            return v / denom if denom else None
+
+        total = None
+        if total_ins_num_name and scope.find_var(total_ins_num_name) is not None:
+            total = float(self._all_reduce(np.asarray(
+                scope.find_var(total_ins_num_name)).astype("float64")).sum())
+            out["total_ins_num"] = total
+        out["mae"] = mean_of(abserr_name, total)
+        out["rmse"] = (mean_of(sqrerr_name, total) ** 0.5
+                       if mean_of(sqrerr_name, total) is not None else None)
+        out["predicted_ctr"] = mean_of(prob_name, total)
+        if pos_ins_num_name and scope.find_var(pos_ins_num_name) is not None and total:
+            pos_n = float(self._all_reduce(np.asarray(
+                scope.find_var(pos_ins_num_name)).astype("float64")).sum())
+            out["actual_ctr"] = pos_n / total
+        return out
+
+    def print_global_metrics(self, print_prefix="", **kwargs):
+        m = self.get_global_metrics(**kwargs)
+        self.rank0_print(f"{print_prefix} global metrics: " + ", ".join(
+            f"{k}={v}" for k, v in m.items() if v is not None))
+        return m
+
+    # -- checkpoints -------------------------------------------------------
+    def save_fleet_model(self, path, mode=0):
+        import paddle_tpu as fluid
+        from ...parallel.fleet import fleet
+
+        fleet.save_persistables(fluid.Executor(fluid.CPUPlace()), path)
+
+    def load_fleet_model(self, path, mode=0):
+        import paddle_tpu as fluid
+
+        fluid.io.load_persistables(
+            fluid.Executor(fluid.CPUPlace()), path)
+
+    def save_model(self, output_path, day, pass_id):
+        self.save_fleet_model(os.path.join(
+            str(output_path), str(day), str(pass_id)))
+
+    # -- scheduling helper -------------------------------------------------
+    def get_online_pass_interval(self, days, hours, split_interval,
+                                 split_per_pass, is_data_hourly_placed):
+        """Pass interval layout for online training (reference :1207)."""
+        split_interval = int(split_interval)
+        split_per_pass = int(split_per_pass)
+        splits_per_day = 24 * 60 // split_interval
+        pass_per_day = splits_per_day // split_per_pass
+        left_train_hour = int(hours.split(" ")[0]) if isinstance(
+            hours, str) else int(hours[0])
+        online_pass_interval = []
+        for i in range(pass_per_day):
+            online_pass_interval.append([])
+            for j in range(split_per_pass):
+                split_idx = i * split_per_pass + j
+                h = split_idx * split_interval // 60
+                m = split_idx * split_interval % 60
+                if is_data_hourly_placed:
+                    online_pass_interval[-1].append(f"{h:02d}")
+                else:
+                    online_pass_interval[-1].append(f"{h:02d}{m:02d}")
+        return online_pass_interval
+
+    def _all_reduce(self, arr):
+        try:
+            from ...parallel.fleet import fleet
+
+            if fleet.worker_num() > 1:
+                from ...ps import util as _psu  # pragma: no cover
+
+                return _psu.all_reduce_sum(arr)
+        except Exception:
+            pass
+        return arr

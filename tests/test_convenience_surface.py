@@ -1,0 +1,199 @@
+"""Convenience-surface parity (round-2 verdict missing #4/#5): dataset
+corpus readers, FleetUtil helpers, and the contrib BeamSearchDecoder
+class family. Reference: python/paddle/dataset/,
+incubate/fleet/utils/fleet_util.py,
+contrib/decoder/beam_search_decoder.py."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import datasets
+
+
+def test_wmt14_reader():
+    r = datasets.wmt14.train(1000)
+    src, trg_in, trg_next = next(iter(r()))
+    assert trg_in[0] == datasets.wmt14.START
+    assert trg_next[-1] == datasets.wmt14.END
+    assert len(trg_in) == len(trg_next)
+    assert all(0 <= w < 1000 for w in src)
+    d1, d2 = datasets.wmt14.get_dict(1000)
+    assert d1[0] == "<s>" and len(d1) == 1000
+
+
+def test_wmt16_reader():
+    r = datasets.wmt16.test(500, 600, src_lang="de")
+    src, trg_in, trg_next = next(iter(r()))
+    assert all(w < 500 for w in src) and all(w < 600 for w in trg_next)
+    d = datasets.wmt16.get_dict("en", 100)
+    assert d["<s>"] == datasets.wmt16.START
+
+
+def test_movielens_reader():
+    sample = next(iter(datasets.movielens.train()()))
+    uid, gender, age_id, job, mid, cats, title, rating = sample
+    assert 1 <= uid <= datasets.movielens.max_user_id()
+    assert 1 <= mid <= datasets.movielens.max_movie_id()
+    assert 0 <= job <= datasets.movielens.max_job_id()
+    assert 1.0 <= rating[0] <= 5.0
+    assert datasets.movielens.age_table[0] == 1
+    assert len(datasets.movielens.movie_categories()) == 18
+
+
+def test_conll05_reader():
+    w, c2, c1, c0, p1, p2, verb, mark, lbl = next(
+        iter(datasets.conll05.test()()))
+    n = len(w)
+    assert all(len(x) == n for x in (c2, c1, c0, p1, p2, verb, mark, lbl))
+    assert sum(mark) == 1  # exactly one verb position marked
+    wd, vd, ld = datasets.conll05.get_dict()
+    assert len(ld) == datasets.conll05.LABEL_DICT_LEN
+    emb = datasets.conll05.get_embedding()
+    assert emb.shape == (datasets.conll05.WORD_DICT_LEN,
+                         datasets.conll05.EMB_DIM)
+
+
+def test_imikolov_sentiment_flowers_voc_mq2007():
+    wd = datasets.imikolov.build_dict()
+    grams = list(datasets.imikolov.train(wd, 5)())[:10]
+    assert all(len(g) == 5 for g in grams)
+    ids, lbl = next(iter(datasets.sentiment.train()()))
+    assert lbl in (0, 1) and all(w < datasets.sentiment.VOCAB for w in ids)
+    img, label = next(iter(datasets.flowers.train()()))
+    assert img.shape == (3, 224, 224) and 0 <= label < 102
+    img, mask = next(iter(datasets.voc2012.train()()))
+    assert mask.shape == img.shape[1:] and mask.max() < 21
+    hi, lo = next(iter(datasets.mq2007.train(format="pairwise")()))
+    assert hi.shape == (datasets.mq2007.FEATURE_DIM,)
+
+
+def test_fleet_util_auc_and_logging(capsys):
+    from paddle_tpu.incubate.fleet.utils import FleetUtil
+
+    fu = FleetUtil()
+    fu.rank0_print("hello-fleet")
+    assert "hello-fleet" in capsys.readouterr().out
+
+    # perfect separation -> auc 1; uniform -> 0.5
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        pos = np.zeros(128); neg = np.zeros(128)
+        pos[100] = 50  # positives at high score buckets
+        neg[10] = 50
+        scope.set_var("sp", pos.astype("int64"))
+        scope.set_var("sn", neg.astype("int64"))
+        auc = fu.get_global_auc(scope, "sp", "sn")
+        assert auc > 0.99, auc
+        fu.set_zero("sp", scope)
+        assert np.asarray(scope.find_var("sp")).sum() == 0
+    iv = fu.get_online_pass_interval("", "0", 30, 2, False)
+    assert len(iv) == 24 and len(iv[0]) == 2
+
+
+def test_training_decoder_trains():
+    """TrainingDecoder + StateCell teacher forcing on a toy GRU-ish
+    cell: loss falls (the reference's machine_translation demo shape)."""
+    from paddle_tpu.contrib.decoder import InitState, StateCell, TrainingDecoder
+
+    V, E, H, T = 30, 8, 16, 5
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 5
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        tgt_in = fluid.layers.data("tgt_in", [T], dtype="int64")
+        tgt_out = fluid.layers.data("tgt_out", [T], dtype="int64")
+        emb = fluid.layers.embedding(
+            tgt_in, size=[V, E], param_attr=fluid.ParamAttr(name="dec_emb"))
+        boot = fluid.layers.fill_constant_batch_size_like(
+            emb, [1, H], "float32", 0.0)
+        init = InitState(init=boot)
+        cell = StateCell(inputs={"x": None}, states={"h": init},
+                         out_state="h")
+
+        @cell.state_updater
+        def updater(cell):
+            x = cell.get_input("x")
+            h = cell.get_state("h")
+            nh = fluid.layers.fc(
+                fluid.layers.concat([x, h], axis=1), H, act="tanh",
+                param_attr=fluid.ParamAttr(name="dec_cell.w"),
+                bias_attr=fluid.ParamAttr(name="dec_cell.b"))
+            cell.set_state("h", nh)
+
+        decoder = TrainingDecoder(cell)
+        with decoder.block():
+            cur = decoder.step_input(emb)
+            cell.compute_state(inputs={"x": cur})
+            h = cell.get_state("h")
+            logits = fluid.layers.fc(
+                h, V, param_attr=fluid.ParamAttr(name="dec_head.w"),
+                bias_attr=fluid.ParamAttr(name="dec_head.b"))
+            cell.update_states()
+            decoder.output(logits)
+        seq_logits = decoder()
+        loss = fluid.layers.mean(fluid.layers.softmax_with_cross_entropy(
+            seq_logits, fluid.layers.unsqueeze(tgt_out, [2])))
+        fluid.optimizer.Adam(5e-3).minimize(loss)
+
+    rng = np.random.RandomState(0)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        losses = []
+        t_in = rng.randint(0, V, (8, T)).astype("int64")
+        t_out = np.roll(t_in, -1, 1)
+        for _ in range(40):  # memorize one batch: loss must fall
+            (l,) = exe.run(main, feed={"tgt_in": t_in, "tgt_out": t_out},
+                           fetch_list=[loss])
+            losses.append(float(l))
+    assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+
+
+def test_beam_search_decoder_produces_translations():
+    from paddle_tpu.contrib.decoder import InitState, StateCell, BeamSearchDecoder
+
+    V, E, H, beam, max_len = 12, 6, 8, 3, 4
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        init_ids = fluid.layers.data("init_ids", [beam], dtype="int64")
+        init_scores = fluid.layers.data("init_scores", [beam])
+        boot = fluid.layers.data("boot_h", [H])
+        big = fluid.layers.reshape(
+            fluid.layers.expand(fluid.layers.unsqueeze(boot, [1]),
+                                [1, beam, 1]), [-1, H])
+        cell = StateCell(inputs={"x": None},
+                         states={"h": InitState(init=big)}, out_state="h")
+
+        @cell.state_updater
+        def updater(cell):
+            x = cell.get_input("x")
+            h = cell.get_state("h")
+            nh = fluid.layers.fc(
+                fluid.layers.concat([x, h], axis=1), H, act="tanh",
+                param_attr=fluid.ParamAttr(name="bsd_cell.w"),
+                bias_attr=fluid.ParamAttr(name="bsd_cell.b"))
+            cell.set_state("h", nh)
+
+        decoder = BeamSearchDecoder(
+            cell, init_ids, init_scores, target_dict_dim=V, word_dim=E,
+            max_len=max_len, beam_size=beam, end_id=1,
+            word_emb_param_name="bsd_emb")
+        decoder.decode()
+        trans_ids, trans_scores = decoder()
+
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        B = 2
+        ids0 = np.zeros((B, beam), "int64")
+        sc0 = np.full((B, beam), -1e9, "float32"); sc0[:, 0] = 0.0
+        out_ids, out_scores = exe.run(
+            main, feed={"init_ids": ids0, "init_scores": sc0,
+                        "boot_h": np.random.RandomState(0)
+                        .randn(B, H).astype("float32")},
+            fetch_list=[trans_ids, trans_scores])
+        out_ids, out_scores = np.asarray(out_ids), np.asarray(out_scores)
+    assert out_ids.ndim >= 2 and np.isfinite(out_scores).all()
+    assert (out_ids >= 0).all() and (out_ids < V).all()

@@ -34,6 +34,17 @@ MODULES = [
     "paddle_tpu.install_check",
     "paddle_tpu.lod_tensor",
     "paddle_tpu.contrib.slim.nas",
+    "paddle_tpu.contrib.decoder",
+    "paddle_tpu.incubate.fleet.utils",
+    "paddle_tpu.datasets.wmt14",
+    "paddle_tpu.datasets.wmt16",
+    "paddle_tpu.datasets.movielens",
+    "paddle_tpu.datasets.conll05",
+    "paddle_tpu.datasets.imikolov",
+    "paddle_tpu.datasets.sentiment",
+    "paddle_tpu.datasets.flowers",
+    "paddle_tpu.datasets.voc2012",
+    "paddle_tpu.datasets.mq2007",
 ]
 
 
