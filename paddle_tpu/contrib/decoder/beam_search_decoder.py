@@ -298,15 +298,12 @@ def _reorder_by_parent(state, parents, beam_size):
     batch row (the reference's array reorder by LoD parent index)."""
     H = state.shape[-1]
     grouped = layers.reshape(state, [-1, beam_size, H])
-    idx = layers.unsqueeze(parents, [2])  # [B, beam, 1]
-    picked = layers.gather_nd_by_row(grouped, idx) if hasattr(
-        layers, "gather_nd_by_row") else _row_gather(grouped, parents)
+    picked = _row_gather(grouped, parents)
     return layers.reshape(picked, [-1, H])
 
 
 def _row_gather(grouped, parents):
     """grouped [B, beam, H] indexed per-row by parents [B, beam]."""
-    B_like = layers.shape(grouped)
     # one_hot over the beam dim keeps it a dense matmul (MXU-friendly,
     # no dynamic gather): out[b, j] = sum_k onehot[b, j, k] * g[b, k]
     oh = layers.one_hot(layers.unsqueeze(parents, [2]),

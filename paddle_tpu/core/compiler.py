@@ -94,6 +94,48 @@ class CompiledProgram:
         self._in_shardings = shardings
         return self
 
+    def with_sequence_parallel(self, sp: int, dp: int = 1,
+                               places=None) -> "CompiledProgram":
+        """Sequence (context) parallelism: shard dim 1 — the sequence
+        axis of [B, S, ...] data vars — over an `sp` mesh axis,
+        optionally combined with batch sharding over `dp`. The fused
+        flash_attention op detects the sp axis at lowering time and
+        runs as ring attention (parallel/ring_attention.py): K/V
+        shards rotate over ICI via ppermute, so the attention works on
+        sequences far longer than one chip's HBM could hold. Beyond
+        the reference (SURVEY §5: it has no long-context parallelism).
+        """
+        import jax
+        from jax.sharding import Mesh, PartitionSpec as P
+        import numpy as np
+
+        devs = np.array(places_to_devices(places) if places else jax.devices())
+        need = sp * dp
+        if devs.size < need:
+            raise ValueError(
+                f"sequence parallel needs dp*sp={need} devices, "
+                f"have {devs.size}")
+        if dp > 1:
+            self._mesh = Mesh(devs[:need].reshape(dp, sp), ("dp", "sp"))
+        else:
+            self._mesh = Mesh(devs[:sp], ("sp",))
+        shardings = {}
+        for v in self._program.global_block().vars.values():
+            if not (getattr(v, "is_data", False) and v.shape):
+                continue
+            lead = "dp" if dp > 1 else None
+            # only dim 1 sizes divisible by sp are sequence-sharded; a
+            # [B, 1] label or odd-sized side input stays replicated on
+            # that dim instead of failing the jit sharding check
+            if len(v.shape) >= 2 and v.shape[1] % sp == 0:
+                shardings[v.name] = P(
+                    *((lead, "sp") + (None,) * (len(v.shape) - 2)))
+            elif lead:
+                shardings[v.name] = P(
+                    *((lead,) + (None,) * (len(v.shape) - 1)))
+        self._in_shardings = shardings
+        return self
+
     def with_pipeline(self, places=None) -> "CompiledProgram":
         """Attach a `pp` mesh sized to the program's pipeline stages
         (PipelineOptimizer cut_list). The executor then compiles the

@@ -5,6 +5,8 @@ Reference: python/paddle/dataset/cifar.py train10()/test10().
 
 from __future__ import annotations
 
+from . import common
+
 import numpy as np
 
 TRAIN_SIZE = 4096
@@ -25,7 +27,7 @@ def train10():
         for i in range(TRAIN_SIZE):
             yield _sample(i)
 
-    return reader
+    return common.synthetic("cifar", reader)
 
 
 def test10():
@@ -33,4 +35,4 @@ def test10():
         for i in range(TEST_SIZE):
             yield _sample(TRAIN_SIZE + i)
 
-    return reader
+    return common.synthetic("cifar", reader)

@@ -4,6 +4,27 @@
 from __future__ import annotations
 
 import random
+import warnings
+
+_synthetic_warned = set()
+
+
+def synthetic(name, reader):
+    """Wrap a synthetic dataset reader: warn once per dataset on first
+    iteration. These readers reproduce the reference paddle.dataset
+    APIs but yield deterministic synthetic samples (zero-egress build);
+    a ported training script must not silently train on random data."""
+
+    def wrapped():
+        if name not in _synthetic_warned:
+            _synthetic_warned.add(name)
+            warnings.warn(
+                f"paddle_tpu.datasets.{name}: yielding SYNTHETIC data "
+                "(this build cannot download the real corpus); metrics "
+                "will not match real-data training", stacklevel=2)
+        return reader()
+
+    return wrapped
 
 
 def batch(reader, batch_size: int, drop_last: bool = False):

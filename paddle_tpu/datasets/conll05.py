@@ -8,6 +8,8 @@ label_dict); get_embedding() the pretrained table.
 
 from __future__ import annotations
 
+from . import common
+
 import numpy as np
 
 WORD_DICT_LEN = 44068
@@ -48,4 +50,4 @@ def test():
         for i in range(TEST_SIZE):
             yield _sample(i)
 
-    return reader
+    return common.synthetic("conll05", reader)

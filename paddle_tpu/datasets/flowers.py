@@ -6,6 +6,8 @@ yield (3x224x224 float image, label in [0,102)).
 
 from __future__ import annotations
 
+from . import common
+
 import numpy as np
 
 N_CLASSES = 102
@@ -26,7 +28,7 @@ def _make(base, count):
         for i in range(count):
             yield _sample(base + i)
 
-    return reader
+    return common.synthetic("flowers", reader)
 
 
 def train(mapper=None, buffered_size=1024, use_xmap=False, cycle=False):

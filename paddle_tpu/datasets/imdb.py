@@ -7,6 +7,8 @@ sentiment signal; sequence lengths vary like the real data.
 
 from __future__ import annotations
 
+from . import common
+
 import numpy as np
 
 VOCAB_SIZE = 5147  # roughly the reference's cutoff dict size
@@ -37,7 +39,7 @@ def train(word_idx=None):
         for i in range(TRAIN_SIZE):
             yield _sample(i)
 
-    return reader
+    return common.synthetic("imdb", reader)
 
 
 def test(word_idx=None):
@@ -45,4 +47,4 @@ def test(word_idx=None):
         for i in range(TEST_SIZE):
             yield _sample(TRAIN_SIZE + i)
 
-    return reader
+    return common.synthetic("imdb", reader)

@@ -7,6 +7,8 @@ or (src_seq, trg_seq) in SEQ mode.
 
 from __future__ import annotations
 
+from . import common
+
 import numpy as np
 
 
@@ -41,7 +43,7 @@ def _make(base, count, word_idx, n, data_type):
             else:
                 yield s[:-1], s[1:]
 
-    return reader
+    return common.synthetic("imikolov", reader)
 
 
 def train(word_idx, n, data_type=DataType.NGRAM):

@@ -8,6 +8,8 @@ convergence tests behave like the real set.
 
 from __future__ import annotations
 
+from . import common
+
 import numpy as np
 
 TRAIN_SIZE = 8192
@@ -29,7 +31,7 @@ def train():
         for i in range(TRAIN_SIZE):
             yield _sample(i)
 
-    return reader
+    return common.synthetic("mnist", reader)
 
 
 def test():
@@ -37,4 +39,4 @@ def test():
         for i in range(TEST_SIZE):
             yield _sample(TRAIN_SIZE + i)
 
-    return reader
+    return common.synthetic("mnist", reader)

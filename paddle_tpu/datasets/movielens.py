@@ -9,6 +9,8 @@ user_info/movie_info/get_movie_title_dict).
 
 from __future__ import annotations
 
+from . import common
+
 import numpy as np
 
 age_table = [1, 18, 25, 35, 45, 50, 56]
@@ -75,7 +77,7 @@ def train():
         for i in range(TRAIN_SIZE):
             yield _sample(i)
 
-    return reader
+    return common.synthetic("movielens", reader)
 
 
 def test():
@@ -83,4 +85,4 @@ def test():
         for i in range(TEST_SIZE):
             yield _sample(TRAIN_SIZE + i)
 
-    return reader
+    return common.synthetic("movielens", reader)

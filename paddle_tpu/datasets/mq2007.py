@@ -7,6 +7,8 @@ format= 'pointwise' (feature, score), 'pairwise' (d_high, d_low) or
 
 from __future__ import annotations
 
+from . import common
+
 import numpy as np
 
 FEATURE_DIM = 46
@@ -37,7 +39,7 @@ def _make(base, n_queries, format):
             else:  # listwise
                 yield labels.tolist(), list(feats)
 
-    return reader
+    return common.synthetic("mq2007", reader)
 
 
 def train(format="pairwise"):

@@ -6,6 +6,8 @@ train()/test() yield (word_ids, 0/1 label).
 
 from __future__ import annotations
 
+from . import common
+
 from . import imdb as _imdb
 
 VOCAB = 2048
@@ -22,7 +24,7 @@ def train():
             ids, lbl = _imdb._sample(90000 + i)
             yield [w % VOCAB for w in ids], lbl
 
-    return reader
+    return common.synthetic("sentiment", reader)
 
 
 def test():
@@ -31,4 +33,4 @@ def test():
             ids, lbl = _imdb._sample(90000 + TRAIN_SIZE + i)
             yield [w % VOCAB for w in ids], lbl
 
-    return reader
+    return common.synthetic("sentiment", reader)

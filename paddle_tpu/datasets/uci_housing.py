@@ -7,6 +7,8 @@ with fixed ground-truth weights, deterministic.
 
 from __future__ import annotations
 
+from . import common
+
 import numpy as np
 
 _W = np.array(
@@ -29,7 +31,7 @@ def train():
         for i in range(TRAIN_SIZE):
             yield _sample(i)
 
-    return reader
+    return common.synthetic("uci_housing", reader)
 
 
 def test():
@@ -37,4 +39,4 @@ def test():
         for i in range(TEST_SIZE):
             yield _sample(TRAIN_SIZE + i)
 
-    return reader
+    return common.synthetic("uci_housing", reader)

@@ -6,6 +6,8 @@ yield (3xHxW image, HxW int32 segmentation mask with 21 classes).
 
 from __future__ import annotations
 
+from . import common
+
 import numpy as np
 
 N_CLASSES = 21
@@ -31,7 +33,7 @@ def _make(base, count):
         for i in range(count):
             yield _sample(base + i)
 
-    return reader
+    return common.synthetic("voc2012", reader)
 
 
 def train():

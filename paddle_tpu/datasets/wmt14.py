@@ -9,6 +9,8 @@ src/trg length correlation real translation data has.
 
 from __future__ import annotations
 
+from . import common
+
 import numpy as np
 
 START, END, UNK = 0, 1, 2
@@ -31,7 +33,7 @@ def train(dict_size):
         for i in range(TRAIN_SIZE):
             yield _sample(i, dict_size)
 
-    return reader
+    return common.synthetic("wmt14", reader)
 
 
 def test(dict_size):
@@ -39,7 +41,7 @@ def test(dict_size):
         for i in range(TEST_SIZE):
             yield _sample(TRAIN_SIZE + i, dict_size)
 
-    return reader
+    return common.synthetic("wmt14", reader)
 
 
 def get_dict(dict_size, reverse=True):

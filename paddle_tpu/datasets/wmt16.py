@@ -7,6 +7,8 @@ train/test/validation(src_dict_size, trg_dict_size, src_lang) yield
 
 from __future__ import annotations
 
+from . import common
+
 import numpy as np
 
 from .wmt14 import START, END, UNK
@@ -28,7 +30,7 @@ def _make(base, count, src_dict_size, trg_dict_size):
         for i in range(count):
             yield _sample(base + i, src_dict_size, trg_dict_size)
 
-    return reader
+    return common.synthetic("wmt16", reader)
 
 
 def train(src_dict_size, trg_dict_size, src_lang="en"):

@@ -182,20 +182,50 @@ def convert_ifelse(pred, true_fn, false_fn, init, names=()):
             _to_pred(pred), lambda: t_vals, lambda: f_vals)
 
 
-def convert_while(cond_fn, body_fn, init):
+def convert_while(cond_fn, body_fn, init, names=()):
     """cond_fn(carry_tuple) -> scalar; body_fn(carry_tuple) -> carry
-    tuple. Dispatches on whether the condition of the INITIAL carry is
-    traced."""
-    first = cond_fn(init)
-    if not _is_traced(first) and not any(_is_traced(v) for v in init):
-        carry = init
-        while bool(np.asarray(_unwrap(cond_fn(carry))).reshape(())):
-            carry = body_fn(carry)
-        return carry
-    if any(isinstance(v, _Undef) for v in init):
+    tuple. Hybrid dispatch, re-checked EVERY evaluation: while the
+    condition comes back concrete, run python iterations (this also
+    unrolls loops whose trip count is static but whose carry is traced
+    — the static `for i in range(n)` / layer-list case, where the
+    reference leaves the loop un-converted too); the moment the
+    condition evaluates to a tracer, hand the current carry to
+    lax.while_loop."""
+    carry = tuple(init)
+    while True:
+        c = cond_fn(carry)
+        if _is_traced(c):
+            return _traced_while(cond_fn, body_fn, carry, names)
+        if not bool(np.asarray(_unwrap(c)).reshape(())):
+            return carry
+        carry = body_fn(carry)
+
+
+def _traced_while(cond_fn, body_fn, init, names):
+    # zeros-substitution is sound ONLY for the done-flag machinery's
+    # own slots (_RV/_DONE, gated by the done flag); a user variable
+    # first assigned inside the loop must still fail loudly — zeros
+    # would silently stand in where python raises NameError
+    missing = {
+        i for i, v in enumerate(init)
+        if _is_missing(v) and i < len(names) and names[i] in (_RV, _DONE)
+    }
+    if any(_is_missing(v) and i not in missing
+           for i, v in enumerate(init)):
         raise NotImplementedError(
             "to_static: every variable a traced while assigns must be "
             "defined before the loop (it is part of the loop carry)"
+        )
+    if missing:
+        # done-flag machinery (early return lowered into the loop): a
+        # missing carry slot (e.g. _jst_ret_val=None) takes zeros shaped
+        # like the body's output for it — sound because the done flag
+        # guarantees the substitute is never the final value. The probe
+        # trace is discarded; XLA dead-code-eliminates it.
+        probe = body_fn(init)
+        init = tuple(
+            _tree_zeros_like(t) if i in missing and not _is_missing(t) else v
+            for i, (v, t) in enumerate(zip(init, probe))
         )
     template = init
     raw = tuple(_unwrap(v) for v in init)
@@ -208,6 +238,71 @@ def convert_while(cond_fn, body_fn, init):
 
     out = jax.lax.while_loop(cond, body, raw)
     return _wrap_like(out, template)
+
+
+# -- for-loop sequence protocol ---------------------------------------------
+
+
+class _RangeSeq:
+    """range(...) whose bounds may be tracers (python range() rejects
+    those)."""
+
+    def __init__(self, start, stop, step):
+        self.start, self.stop, self.step = start, stop, step
+
+
+def to_seq_range(*args):
+    if len(args) == 1:
+        return _RangeSeq(0, args[0], 1)
+    if len(args) == 2:
+        return _RangeSeq(args[0], args[1], 1)
+    return _RangeSeq(args[0], args[1], args[2])
+
+
+def to_seq(x):
+    x = _unwrap(x)
+    if isinstance(x, range):
+        return _RangeSeq(x.start, x.stop, x.step)
+    return x
+
+
+def seq_len(seq):
+    if isinstance(seq, _RangeSeq):
+        s, e, st = (_unwrap(seq.start), _unwrap(seq.stop), _unwrap(seq.step))
+        if not any(map(_is_traced, (s, e, st))):
+            return len(range(int(s), int(e), int(st)))
+        # floor-division identity, valid for either step sign
+        return jnp.maximum(0, -((s - e) // st))
+    if hasattr(seq, "shape"):
+        if not seq.shape:
+            raise TypeError("to_static: cannot iterate a 0-d tensor")
+        return int(seq.shape[0])  # static shapes: python int
+    return len(seq)
+
+
+def seq_get(seq, i):
+    if isinstance(seq, _RangeSeq):
+        return seq.start + i * seq.step
+    if isinstance(seq, (list, tuple)):
+        if _is_traced(i):
+            raise NotImplementedError(
+                "to_static: cannot index a python list with a traced loop "
+                "index — iterate a stacked tensor instead"
+            )
+        return seq[int(np.asarray(_unwrap(i)).reshape(()))]
+    return seq[i]
+
+
+def seq_template(seq, n):
+    """Pre-loop binding for the loop target so a traced loop has a
+    defined carry. For a provably-empty concrete sequence the target
+    stays undefined (python semantics); otherwise the element at index
+    0 serves as the template (after an empty TRACED loop the target
+    keeps this value — python-undefined is not expressible in a traced
+    carry)."""
+    if not _is_traced(n) and int(np.asarray(_unwrap(n)).reshape(())) == 0:
+        return UNDEF
+    return seq_get(seq, 0)
 
 
 def convert_logical_and(a, b_fn):
@@ -281,11 +376,62 @@ def _name_tuple(names, ctx):
     return ast.Tuple(elts=[ast.Name(id=n, ctx=ctx()) for n in names], ctx=ctx())
 
 
+def _lower_break_continue(stmts, brk, cnt):
+    """Rewrite break/continue into flag assignments + rest-gating (the
+    reference's break_continue_transformer.py): `break` sets the brk
+    flag (the loop condition gains `and not brk`), `continue` sets the
+    cnt flag (reset at the top of each iteration); statements after
+    either, at any If nesting depth, are gated on the flags being
+    unset. Does not descend into nested FunctionDefs (converted inner
+    loops are already function defs by the time this runs, so any
+    remaining Break/Continue belongs to THIS loop).
+    Returns (new_stmts, uses_brk, uses_cnt)."""
+    out, uses_brk, uses_cnt = [], False, False
+    for idx, st in enumerate(stmts):
+        rest = stmts[idx + 1:]
+        if isinstance(st, ast.Break):
+            out.append(ast.Assign(
+                targets=[ast.Name(id=brk, ctx=ast.Store())],
+                value=ast.Constant(value=True)))
+            return out, True, uses_cnt  # rest of suite is dead code
+        if isinstance(st, ast.Continue):
+            out.append(ast.Assign(
+                targets=[ast.Name(id=cnt, ctx=ast.Store())],
+                value=ast.Constant(value=True)))
+            return out, uses_brk, True
+        if isinstance(st, ast.If):
+            tb, tbrk, tcnt = _lower_break_continue(st.body, brk, cnt)
+            fb, fbrk, fcnt = _lower_break_continue(st.orelse, brk, cnt)
+            st.body = tb or [ast.Pass()]
+            st.orelse = fb
+            out.append(st)
+            if tbrk or fbrk or tcnt or fcnt:
+                uses_brk = uses_brk or tbrk or fbrk
+                uses_cnt = uses_cnt or tcnt or fcnt
+                new_rest, rbrk, rcnt = _lower_break_continue(rest, brk, cnt)
+                uses_brk, uses_cnt = uses_brk or rbrk, uses_cnt or rcnt
+                if new_rest:
+                    flags = []
+                    if tbrk or fbrk:
+                        flags.append(ast.Name(id=brk, ctx=ast.Load()))
+                    if tcnt or fcnt:
+                        flags.append(ast.Name(id=cnt, ctx=ast.Load()))
+                    test = flags[0] if len(flags) == 1 else ast.BoolOp(
+                        op=ast.Or(), values=flags)
+                    out.append(ast.If(
+                        test=ast.UnaryOp(op=ast.Not(), operand=test),
+                        body=new_rest, orelse=[]))
+                return out, uses_brk, uses_cnt
+            continue
+        out.append(st)
+    return out, uses_brk, uses_cnt
+
+
 class _ControlFlowTransformer(ast.NodeTransformer):
-    """Rewrites If/While whose condition may be traced. Mirrors the
-    reference's IfElseTransformer/LoopTransformer at the scope the
-    framework supports (no return/break/continue inside converted
-    blocks — same early-scope limits the reference documents)."""
+    """Rewrites If/While/For whose condition may be traced. Mirrors the
+    reference's IfElseTransformer/LoopTransformer
+    (dygraph_to_static/loop_transformer.py:115 visit_For, :121
+    visit_While) with break/continue and early-return support."""
 
     def __init__(self):
         self._count = 0
@@ -344,21 +490,51 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         return [tfn, ffn, call]
 
     def visit_While(self, node):
-        self.generic_visit(node)
-        # break is unsupported inside converted loops, so a while/else's
-        # else suite ALWAYS runs — it simply follows the loop
+        # ORDER MATTERS: lower break/continue on the RAW body first —
+        # once generic_visit converts inner ifs into function defs, a
+        # Break inside them would be 'break outside loop'. Nested
+        # While/For still own their breaks (_lower_break_continue does
+        # not descend into them); they convert during generic_visit
+        # below.
         orelse = list(node.orelse)
         node.orelse = []
         if _contains_return(node.body):
+            # _apply_return_transform lowers return-in-loop before this
+            # runs; anything left (e.g. conversion invoked on a raw
+            # fragment) still fails loudly
             raise NotImplementedError(
                 "to_static: `return` inside a converted while is not supported"
             )
+        k = self._uid()
+        brk, cnt = f"_jst_brk_{k}", f"_jst_cnt_{k}"
+        body, uses_brk, uses_cnt = _lower_break_continue(node.body, brk, cnt)
+        pre = []
+        test = node.test
+        if uses_cnt:
+            # reset at the top of each iteration
+            body = [ast.Assign(targets=[ast.Name(id=cnt, ctx=ast.Store())],
+                               value=ast.Constant(value=False))] + body
+            pre.append(ast.Assign(
+                targets=[ast.Name(id=cnt, ctx=ast.Store())],
+                value=ast.Constant(value=False)))
+        if uses_brk:
+            test = ast.BoolOp(op=ast.And(), values=[
+                test,
+                ast.UnaryOp(op=ast.Not(),
+                            operand=ast.Name(id=brk, ctx=ast.Load()))])
+            pre.append(ast.Assign(
+                targets=[ast.Name(id=brk, ctx=ast.Store())],
+                value=ast.Constant(value=False)))
+        node.body = body
+        node.test = test
+        ast.fix_missing_locations(node)
+        self.generic_visit(node)
+        test = node.test
         names = _assigned_names(node.body)
         if not names:
             raise NotImplementedError(
                 "to_static: converted while must assign at least one variable"
             )
-        k = self._uid()
         carry = f"_jst_carry_{k}"
         cname, bname = f"_jst_cond_{k}", f"_jst_body_{k}"
         unpack = ast.Assign(
@@ -369,7 +545,7 @@ class _ControlFlowTransformer(ast.NodeTransformer):
 
         cfn = ast.FunctionDef(
             name=cname, args=_one_arg(carry),
-            body=[unpack, ast.Return(value=_transform_test(node.test))],
+            body=[unpack, ast.Return(value=_transform_test(test))],
             decorator_list=[],
         )
         bfn = ast.FunctionDef(
@@ -385,10 +561,133 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                 args=[ast.Name(id=cname, ctx=ast.Load()),
                       ast.Name(id=bname, ctx=ast.Load()),
                       _grab_expr(names)],
-                keywords=[],
+                keywords=[ast.keyword(
+                    arg="names",
+                    value=ast.Tuple(
+                        elts=[ast.Constant(value=n) for n in names],
+                        ctx=ast.Load()))],
             ),
         )
-        return [cfn, bfn, call] + orelse
+        # a while/else's else suite runs unless the loop broke; the
+        # else suite was detached before generic_visit, so convert it
+        # here (visit may return a list per statement)
+        def _flat_visit(stmts):
+            out = []
+            for s in stmts:
+                r = self.visit(s)
+                out.extend(r if isinstance(r, list) else [r])
+            return out
+
+        if orelse and uses_brk:
+            gate = ast.If(
+                test=ast.UnaryOp(op=ast.Not(),
+                                 operand=ast.Name(id=brk, ctx=ast.Load())),
+                body=orelse, orelse=[])
+            ast.fix_missing_locations(gate)
+            lowered_gate = self.visit_If(gate)
+            orelse = (lowered_gate if isinstance(lowered_gate, list)
+                      else [lowered_gate])
+        elif orelse:
+            orelse = _flat_visit(orelse)
+        return [cfn, bfn] + pre + [call] + orelse
+
+    def visit_For(self, node):
+        """Lower `for target in ITER:` to the while machinery through a
+        sequence protocol (reference loop_transformer.py:115 visit_For):
+
+            seq = _jst.to_seq(ITER)        # range() -> _jst.to_seq_range
+            n = _jst.seq_len(seq)
+            i = 0
+            target = _jst.seq_template(seq, n)
+            while i < n:
+                target = _jst.seq_get(seq, i)
+                i = i + 1        # BEFORE the body: continue must not skip it
+                <body>
+
+        Supports range(...) with traced bounds, tensor iteration (dim
+        0), python lists (unrolled), and enumerate(...) over any of
+        those. python-vs-lax.while_loop dispatch happens at runtime in
+        convert_while."""
+        import copy
+
+        k = self._uid()
+        seq_n, n_n, i_n = f"_jst_seq_{k}", f"_jst_n_{k}", f"_jst_it_{k}"
+        iter_expr, target = node.iter, node.target
+
+        enum_start = None
+        if (isinstance(iter_expr, ast.Call)
+                and isinstance(iter_expr.func, ast.Name)
+                and iter_expr.func.id == "enumerate"):
+            if not (isinstance(target, ast.Tuple) and len(target.elts) == 2):
+                raise NotImplementedError(
+                    "to_static: `for ... in enumerate(...)` needs a "
+                    "2-name target (idx, item)")
+            enum_start = ast.Constant(value=0)
+            if len(iter_expr.args) > 1:
+                enum_start = iter_expr.args[1]
+            for kw in iter_expr.keywords:
+                if kw.arg == "start":
+                    enum_start = kw.value
+            inner_iter = iter_expr.args[0]
+            idx_target, item_target = target.elts[0], target.elts[1]
+        else:
+            inner_iter = iter_expr
+
+        if (isinstance(inner_iter, ast.Call)
+                and isinstance(inner_iter.func, ast.Name)
+                and inner_iter.func.id == "range"):
+            seq_value = ast.Call(func=_jst_attr("to_seq_range"),
+                                 args=list(inner_iter.args), keywords=[])
+        else:
+            seq_value = ast.Call(func=_jst_attr("to_seq"),
+                                 args=[inner_iter], keywords=[])
+
+        def assign(tgt, value):
+            return ast.Assign(targets=[tgt], value=value)
+
+        def name(n, ctx=ast.Load):
+            return ast.Name(id=n, ctx=ctx())
+
+        get_call = ast.Call(func=_jst_attr("seq_get"),
+                            args=[name(seq_n), name(i_n)], keywords=[])
+        if enum_start is not None:
+            head = [
+                assign(copy.deepcopy(idx_target),
+                       ast.BinOp(left=name(i_n), op=ast.Add(),
+                                 right=enum_start)),
+                assign(copy.deepcopy(item_target), get_call),
+            ]
+            template_tgts = [
+                assign(copy.deepcopy(idx_target), ast.Constant(value=0)),
+                assign(copy.deepcopy(item_target),
+                       ast.Call(func=_jst_attr("seq_template"),
+                                args=[name(seq_n), name(n_n)], keywords=[])),
+            ]
+        else:
+            head = [assign(copy.deepcopy(target), get_call)]
+            template_tgts = [
+                assign(copy.deepcopy(target),
+                       ast.Call(func=_jst_attr("seq_template"),
+                                args=[name(seq_n), name(n_n)], keywords=[])),
+            ]
+        head.append(assign(name(i_n, ast.Store),
+                           ast.BinOp(left=name(i_n), op=ast.Add(),
+                                     right=ast.Constant(value=1))))
+        pre = [
+            assign(name(seq_n, ast.Store), seq_value),
+            assign(name(n_n, ast.Store),
+                   ast.Call(func=_jst_attr("seq_len"), args=[name(seq_n)],
+                            keywords=[])),
+            assign(name(i_n, ast.Store), ast.Constant(value=0)),
+        ] + template_tgts
+        new_while = ast.While(
+            test=ast.Compare(left=name(i_n), ops=[ast.Lt()],
+                             comparators=[name(n_n)]),
+            body=head + list(node.body),
+            orelse=list(node.orelse),
+        )
+        lowered = self.visit_While(new_while)
+        return pre + (lowered if isinstance(lowered, list) else [lowered])
 
     # NOTE: and/or/not are rewritten ONLY inside if/while TESTS
     # (_transform_test below). A value-position boolop like
@@ -494,10 +793,13 @@ def _guarantees_return(stmts):
 
 
 def _lower_returns(stmts):
-    """Rewrite `return` inside if/else into done-flag + value carries
-    (the reference's return_transformer.py): after this pass the only
-    `return` left in the suite is a trailing top-level one. Returns
-    (new_stmts, had_early_return)."""
+    """Rewrite `return` inside if/else AND inside while/for into
+    done-flag + value carries (the reference's return_transformer.py):
+    a return in a loop becomes RV/done assignment + `break` (the
+    break/continue lowering then turns that into loop exit), and
+    statements after the loop are gated on the done flag. After this
+    pass the only `return` left in the suite is a trailing top-level
+    one. Returns (new_stmts, had_early_return)."""
     out, early = [], False
     for idx, st in enumerate(stmts):
         rest = stmts[idx + 1:]
@@ -509,6 +811,23 @@ def _lower_returns(stmts):
                 targets=[ast.Name(id=_DONE, ctx=ast.Store())],
                 value=ast.Constant(value=True)))
             return out, True  # anything after is dead code
+        if isinstance(st, (ast.While, ast.For)):
+            nb, ne = _lower_returns_in_loop(st.body)
+            if ne:
+                st.body = nb
+                out.append(st)
+                # while/else: a return exits immediately — the break
+                # that implements it also (correctly) skips the else
+                new_rest, _ = _lower_returns(rest)
+                if new_rest:
+                    out.append(ast.If(
+                        test=ast.UnaryOp(
+                            op=ast.Not(),
+                            operand=ast.Name(id=_DONE, ctx=ast.Load())),
+                        body=new_rest, orelse=[]))
+                return out, True
+            out.append(st)
+            continue
         if isinstance(st, ast.If):
             tb, te = _lower_returns(st.body)
             fb, fe = _lower_returns(st.orelse)
@@ -527,6 +846,50 @@ def _lower_returns(stmts):
             continue
         out.append(st)
     return out, early
+
+
+def _lower_returns_in_loop(stmts):
+    """Lower `return` within a loop body: RV/done assignment followed
+    by `break`. After an If that may have returned, `if done: break`
+    exits this loop level; a nested loop that returned gets the same
+    gate right after it so the break propagates outward level by
+    level. Returns (new_stmts, had_return)."""
+    out, had = [], False
+    done_break = lambda: ast.If(
+        test=ast.Name(id=_DONE, ctx=ast.Load()),
+        body=[ast.Break()], orelse=[])
+    for st in stmts:
+        if isinstance(st, ast.Return):
+            val = st.value if st.value is not None else ast.Constant(value=None)
+            out.append(ast.Assign(
+                targets=[ast.Name(id=_RV, ctx=ast.Store())], value=val))
+            out.append(ast.Assign(
+                targets=[ast.Name(id=_DONE, ctx=ast.Store())],
+                value=ast.Constant(value=True)))
+            out.append(ast.Break())
+            return out, True  # rest of the suite is dead code
+        if isinstance(st, (ast.While, ast.For)):
+            nb, ne = _lower_returns_in_loop(st.body)
+            if ne:
+                st.body = nb
+                out.append(st)
+                out.append(done_break())
+                had = True
+                continue
+            out.append(st)
+            continue
+        if isinstance(st, ast.If):
+            tb, te = _lower_returns_in_loop(st.body)
+            fb, fe = _lower_returns_in_loop(st.orelse)
+            st.body = tb or [ast.Pass()]
+            st.orelse = fb
+            out.append(st)
+            if te or fe:
+                out.append(done_break())
+                had = True
+            continue
+        out.append(st)
+    return out, had
 
 
 def _apply_return_transform(fdef):

@@ -6,8 +6,11 @@ K/V) block plus the full opposing sequence for its (b,h) into VMEM and
 works on the MXU. For the sequence lengths the flagship configs use
 (<= 2k) a full [S, D] K/V panel fits comfortably in VMEM (S*D*4B =
 512KB at S=2048, D=64), so no innermost loop is needed; the win over
-naive XLA attention is never materializing [B,H,S,S] in HBM. Longer
-sequences route to ring attention (parallel/ring_attention.py).
+naive XLA attention is never materializing [B,H,S,S] in HBM. When the
+executor compiles over a mesh with an `sp` axis (sequence
+parallelism), the flash_attention op routes to ring attention instead
+(parallel/ring_attention.py via _sequence_parallel_mesh below): each
+device keeps its local S/sp shard and K/V rotate over ICI.
 
 Masking (reference operators/fused/multihead_matmul_op.cu:441 takes a
 BiasQK input for exactly this):
@@ -572,10 +575,12 @@ def flash_attention(q, k, v, causal: bool = False,
     mask = _normalize_mask(mask, B, S)
     if bias is not None:
         bias = jnp.asarray(bias)
-        if bias.ndim != 4:
+        if (bias.ndim != 4 or bias.shape[2:] != (S, S)
+                or bias.shape[0] not in (1, B) or bias.shape[1] not in (1, H)):
             raise ValueError(
-                f"flash_attention bias must be rank-4 [B|1, H|1, S, S], "
-                f"got shape {bias.shape}")
+                f"flash_attention bias must be [B|1, H|1, S, S] = "
+                f"[{B}|1, {H}|1, {S}, {S}], got shape "
+                f"{tuple(bias.shape)}")
     pad = _pad_amount(S)
     q2, k2, v2, mask, bias = _pad_qkv(q, k, v, mask, bias, pad)
     o = _core(q2, k2, v2, mask, bias, causal, scale)
@@ -638,6 +643,45 @@ def _flash_attention_op(ctx, op, ins):
         else:
             mask = mask.reshape(B, S)  # already-additive float values
     bias = ins["BiasQK"][0] if ins.get("BiasQK") else None
-    o = flash_attention(split(q), split(k), split(v), causal, None,
-                        mask=mask, bias=bias)
+    o = None
+    sp_mesh = _sequence_parallel_mesh(ctx)
+    if sp_mesh is not None:
+        if bias is not None:
+            _logger.warning(
+                "flash_attention: BiasQK is dense [S, S] and cannot ride "
+                "the ring; falling back to the flash kernel (GSPMD will "
+                "all-gather K/V across the sp axis)")
+        else:
+            from ..parallel.ring_attention import make_ring_attention_fn
+
+            ring = make_ring_attention_fn(
+                sp_mesh, "sp", causal=causal, with_mask=mask is not None)
+            qs, ks, vs = split(q), split(k), split(v)
+            if mask is not None:
+                # bool or [B,1,1,S]-shaped masks must become additive
+                # [B, S] before the shard_map in_spec P(None, 'sp')
+                o = ring(qs, ks, vs, _normalize_mask(mask, B, S))
+            else:
+                o = ring(qs, ks, vs)
+    if o is None:
+        o = flash_attention(split(q), split(k), split(v), causal, None,
+                            mask=mask, bias=bias)
     return {"Out": [o.transpose(0, 2, 1, 3).reshape(B, S, HD)]}
+
+
+def _sequence_parallel_mesh(ctx):
+    """The routing contract the module docstring promises: when the
+    executor compiles over a mesh with an `sp` axis of size > 1, the
+    fused attention op runs as ring attention (sequence parallelism,
+    parallel/ring_attention.py) instead of the single-chip flash
+    kernel. Sequence shards then rotate K/V over ICI and the [S, S]
+    score matrix never exists, globally or locally."""
+    mesh = getattr(ctx, "mesh", None)
+    if mesh is None:
+        return None
+    try:
+        if dict(mesh.shape).get("sp", 1) > 1:
+            return mesh
+    except (TypeError, AttributeError):
+        return None
+    return None

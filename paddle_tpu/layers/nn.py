@@ -966,7 +966,19 @@ def argsort(x, axis=-1, descending=False, name=None):
 
 
 def reshape(x, shape, actual_shape=None, act=None, inplace=False, name=None):
-    shape = [int(s) for s in shape]
+    coerced = []
+    for s in shape:
+        try:
+            coerced.append(int(s))
+        except (TypeError, ValueError):
+            # the reference fluid.layers.reshape accepts Variable dims;
+            # this build is static-shape by design (SURVEY §2 LoDTensor
+            # stance), so fail loudly instead of a confusing TypeError
+            raise NotImplementedError(
+                "reshape: Variable entries in `shape` are unsupported in "
+                "the static-shape TPU build; pass python ints (got "
+                f"{type(s).__name__})")
+    shape = coerced
     eager = _maybe_eager("reshape2", {"X": [x]}, ["Out", "XShape"],
                          {"shape": shape})
     if eager is not None:

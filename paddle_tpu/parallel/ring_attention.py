@@ -30,10 +30,14 @@ def ring_attention(
     axis_name: str,
     causal: bool = False,
     sm_scale: Optional[float] = None,
+    mask: Optional[jax.Array] = None,
 ):
     """q,k,v: [B, H, S_local, D] (already sharded on S over axis_name).
-    Returns [B, H, S_local, D]. Must run inside shard_map with
-    axis_name in the mesh."""
+    mask: optional additive key mask [B, S_local] (0 valid / -inf
+    masked), sharded on S like k — it rotates around the ring with its
+    keys. Returns [B, H, S_local, D]. Must run inside shard_map with
+    axis_name in the mesh. Differentiable: jax AD flows through the
+    scan and ppermute (ppermute transposes to the inverse ring)."""
     B, H, S, D = q.shape
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(D)
     axis_size = lax.psum(1, axis_name)
@@ -42,8 +46,10 @@ def ring_attention(
 
     q32 = q.astype(jnp.float32)
 
-    def block(q_blk, k_blk, v_blk, kv_idx):
+    def block(q_blk, k_blk, v_blk, mask_blk, kv_idx):
         s = jnp.einsum("bhqd,bhkd->bhqk", q_blk, k_blk.astype(jnp.float32)) * scale
+        if mask_blk is not None:
+            s = s + mask_blk[:, None, None, :]
         if causal:
             # global positions: row = my_idx*S + i, col = kv_idx*S + j
             rows = my_idx * S + jnp.arange(S)[:, None]
@@ -56,8 +62,8 @@ def ring_attention(
         return m_blk, l_blk, o_blk
 
     def step(carry, _):
-        o, m, l, k_cur, v_cur, kv_idx = carry
-        m_blk, l_blk, o_blk = block(q32, k_cur, v_cur, kv_idx)
+        o, m, l, k_cur, v_cur, mask_cur, kv_idx = carry
+        m_blk, l_blk, o_blk = block(q32, k_cur, v_cur, mask_cur, kv_idx)
         m_new = jnp.maximum(m, m_blk)
         alpha = jnp.exp(m - m_new)
         beta = jnp.exp(m_blk - m_new)
@@ -65,23 +71,28 @@ def ring_attention(
         o_new = o * alpha + o_blk * beta
         k_nxt = lax.ppermute(k_cur, axis_name, perm)
         v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        mask_nxt = (lax.ppermute(mask_cur, axis_name, perm)
+                    if mask_cur is not None else None)
         kv_nxt = (kv_idx - 1) % axis_size
-        return (o_new, m_new, l_new, k_nxt, v_nxt, kv_nxt), None
+        return (o_new, m_new, l_new, k_nxt, v_nxt, mask_nxt, kv_nxt), None
 
     # derive initial carry from q so its "varying over axis" type
     # matches the loop outputs (shard_map vma typing)
     o0 = jnp.zeros_like(q32)
     m0 = jnp.full_like(q32[..., :1], -jnp.inf)
     l0 = jnp.zeros_like(q32[..., :1])
-    (o, m, l, _, _, _), _ = lax.scan(
-        step, (o0, m0, l0, k, v, my_idx), None, length=axis_size
+    (o, m, l, _, _, _, _), _ = lax.scan(
+        step, (o0, m0, l0, k, v, mask, my_idx), None, length=axis_size
     )
     return (o / jnp.maximum(l, 1e-30)).astype(q.dtype)
 
 
-def make_ring_attention_fn(mesh, axis_name: str = "sp", causal: bool = False):
+def make_ring_attention_fn(mesh, axis_name: str = "sp", causal: bool = False,
+                           sm_scale: Optional[float] = None,
+                           with_mask: bool = False):
     """Wrap ring_attention in shard_map over the given mesh: takes
-    full [B, H, S, D] arrays sharded on S."""
+    full [B, H, S, D] arrays sharded on S (and, if with_mask, an
+    additive [B, S] key mask sharded on S)."""
     from jax.sharding import PartitionSpec as P
 
     smap = getattr(jax, "shard_map", None)
@@ -89,13 +100,25 @@ def make_ring_attention_fn(mesh, axis_name: str = "sp", causal: bool = False):
         from jax.experimental.shard_map import shard_map as smap
 
     spec = P(None, None, axis_name, None)
+    mspec = P(None, axis_name)
+    core = functools.partial(ring_attention, axis_name=axis_name,
+                             causal=causal, sm_scale=sm_scale)
 
-    def fn(q, k, v):
-        return smap(
-            functools.partial(ring_attention, axis_name=axis_name, causal=causal),
-            mesh=mesh,
-            in_specs=(spec, spec, spec),
-            out_specs=spec,
-        )(q, k, v)
+    if with_mask:
+        def fn(q, k, v, mask):
+            return smap(
+                lambda q, k, v, m: core(q, k, v, mask=m),
+                mesh=mesh,
+                in_specs=(spec, spec, spec, mspec),
+                out_specs=spec,
+            )(q, k, v, mask)
+    else:
+        def fn(q, k, v):
+            return smap(
+                core,
+                mesh=mesh,
+                in_specs=(spec, spec, spec),
+                out_specs=spec,
+            )(q, k, v)
 
     return fn

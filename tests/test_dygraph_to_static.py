@@ -340,3 +340,225 @@ def test_eager_reshape_applies_act():
             np.array([[-1.0, 4.0]], "float32"))
         out = fluid.layers.reshape(x, [2], act="relu")
         np.testing.assert_allclose(np.asarray(out.value), [0.0, 4.0])
+
+
+# -- loops: for / break / continue / return-in-loop (round-3 verdict
+# next-step #4; reference loop_transformer.py visit_For/visit_While +
+# break_continue_transformer + return_transformer) -----------------------
+
+
+@declarative
+def _for_range(x, n):
+    s = x * 0.0
+    for i in range(n):
+        s = s + x * i
+    return s
+
+
+@declarative
+def _for_traced_range(x):
+    m = (jnp.sum(x) > 0).astype(jnp.int32) * 3 + 2
+    s = x * 0.0
+    for _ in range(m):
+        s = s + x
+    return s
+
+
+@declarative
+def _for_tensor(xs):
+    s = xs[0] * 0.0
+    for row in xs:
+        s = s + row
+    return s
+
+
+@declarative
+def _for_enumerate(xs):
+    s = xs[0] * 0.0
+    for i, row in enumerate(xs, 1):
+        s = s + row * i
+    return s
+
+
+@declarative
+def _while_break(x):
+    i = 0
+    s = x * 0.0
+    while i < 10:
+        s = s + x
+        i = i + 1
+        if i >= 3:
+            break
+    return s
+
+
+@declarative
+def _for_continue(n):
+    s = 0
+    for i in range(n):
+        if i % 2 == 0:
+            continue
+        s = s + i
+    return s
+
+
+@declarative
+def _return_in_while(x):
+    i = 0
+    while i < 100:
+        x = x + 1.0
+        if jnp.sum(x) > 5:
+            return x
+        i = i + 1
+    return x * 0.0
+
+
+@declarative
+def _for_else_break(n, limit):
+    found = -1
+    for i in range(n):
+        if i == limit:
+            found = i
+            break
+    else:
+        found = -2
+    return found
+
+
+@declarative
+def _nested_for_return(xs):
+    for row in xs:
+        for v in row:
+            if v > 5.0:
+                return v
+    return jnp.float32(-1.0)
+
+
+@declarative
+def _while_break_traced(x, n):
+    i = jnp.int32(0)
+    s = x * 0.0
+    while i < n:
+        s = s + x
+        if jnp.sum(s) > 20.0:
+            break
+        i = i + 1
+    return s
+
+
+def test_for_range_static_and_jit():
+    x = jnp.arange(4.0)
+    np.testing.assert_allclose(_for_range(x, 3), np.asarray(x) * 3)
+    np.testing.assert_allclose(
+        jax.jit(lambda x: _for_range(x, 3))(x), np.asarray(x) * 3)
+
+
+def test_for_traced_range_bound():
+    x = jnp.arange(4.0)
+    np.testing.assert_allclose(_for_traced_range(x), np.asarray(x) * 5)
+    np.testing.assert_allclose(jax.jit(_for_traced_range)(x),
+                               np.asarray(x) * 5)
+
+
+def test_for_tensor_iteration():
+    xs = jnp.arange(12.0).reshape(3, 4)
+    want = np.asarray(xs).sum(0)
+    np.testing.assert_allclose(_for_tensor(xs), want)
+    np.testing.assert_allclose(jax.jit(_for_tensor)(xs), want)
+
+
+def test_for_enumerate():
+    xs = jnp.arange(12.0).reshape(3, 4)
+    want = sum(np.asarray(xs)[i] * (i + 1) for i in range(3))
+    np.testing.assert_allclose(_for_enumerate(xs), want)
+    np.testing.assert_allclose(jax.jit(_for_enumerate)(xs), want)
+
+
+def test_while_break():
+    x = jnp.arange(4.0)
+    np.testing.assert_allclose(_while_break(x), np.asarray(x) * 3)
+    np.testing.assert_allclose(jax.jit(_while_break)(x), np.asarray(x) * 3)
+
+
+def test_for_continue():
+    assert _for_continue(7) == 1 + 3 + 5
+
+
+def test_return_inside_while():
+    x = jnp.arange(4.0)  # sum 6 > 5 after one +1.0-per-element step
+    want = np.asarray(x) + 1.0
+    np.testing.assert_allclose(_return_in_while(x), want)
+    np.testing.assert_allclose(jax.jit(_return_in_while)(x), want)
+
+
+def test_for_else_with_break():
+    assert _for_else_break(5, 2) == 2    # break taken -> else skipped
+    assert _for_else_break(5, 9) == -2   # no break -> else runs
+
+
+def test_nested_for_with_return():
+    xs = jnp.arange(12.0).reshape(3, 4)
+    assert float(_nested_for_return(xs)) == 6.0
+    assert float(jax.jit(_nested_for_return)(xs)) == 6.0
+    assert float(_nested_for_return(xs * 0.0)) == -1.0
+    assert float(jax.jit(_nested_for_return)(xs * 0.0)) == -1.0
+
+
+def test_while_break_on_traced_condition():
+    x = jnp.arange(4.0)  # sum 6 per step -> breaks at sum>20: 4 steps
+    want = np.asarray(x) * 4
+    np.testing.assert_allclose(_while_break_traced(x, 50), want)
+    np.testing.assert_allclose(
+        jax.jit(_while_break_traced)(x, jnp.int32(50)), want)
+
+
+def test_for_empty_concrete_sequence_leaves_target_unbound():
+    @declarative
+    def f(xs):
+        out = 0.0
+        for v in xs:
+            out = out + v
+        return out
+
+    assert f([]) == 0.0
+    assert f([1.0, 2.0]) == 3.0
+
+
+def test_for_python_list_of_callables_unrolls():
+    # the layer-list pattern: python iterable + traced carry must
+    # unroll, not hit lax.while_loop (a list can't be traced-indexed)
+    layers = [lambda x: x + 1.0, lambda x: x * 2.0]
+
+    @declarative
+    def f(x):
+        for fn in layers:
+            x = fn(x)
+        return x
+
+    x = jnp.arange(3.0)
+    want = (np.asarray(x) + 1.0) * 2.0
+    np.testing.assert_allclose(f(x), want)
+    np.testing.assert_allclose(jax.jit(f)(x), want)
+
+
+@declarative
+def _loop_cond_assign_with_return(x):
+    i = 0
+    while i < 5:
+        if jnp.sum(x) > 100.0:
+            found = x
+        if jnp.sum(x) > 1000.0:
+            return found
+        i = i + 1
+    return x
+
+
+def test_traced_loop_conditional_assignment_still_raises():
+    """Review finding r4: the done-flag zeros-substitution must stay
+    restricted to _RV/_DONE — a USER variable first assigned inside a
+    traced loop still fails loudly rather than silently becoming 0."""
+    x = jnp.arange(4.0)
+    np.testing.assert_allclose(_loop_cond_assign_with_return(x),
+                               np.asarray(x))  # eager: no branch taken
+    with pytest.raises(NotImplementedError, match="must be defined before"):
+        jax.jit(_loop_cond_assign_with_return)(x)

@@ -1,0 +1,210 @@
+"""Pallas kernel validation + timing on real TPU (round-3 verdict
+next-step #2: the kernels have only ever run under interpret=True on
+CPU; tiling, VMEM budgets and the blk=256 default are unvalidated).
+
+Run standalone with the axon env as the ONLY claimant of the
+single-claim relay (tools/tpu_evidence.py spawns it after a successful
+bench capture):
+    python tools/kernel_bench.py
+
+Measures, compiled (interpret=False), bf16:
+  - flash attention forward, blk_q in {128, 256, 512}, S in {512, 2048}
+  - flash attention fwd+bwd (train step shape) vs XLA-native attention
+  - fused layer_norm and softmax_xent vs their XLA-native forms
+Writes every measurement incrementally to KERNEL_BENCH_TPU.json so a
+mid-run relay death still leaves a partial table.
+
+Timing discipline: through the axon tunnel `block_until_ready` does
+NOT block — every timing forces a `np.asarray` readback.
+"""
+
+import json
+import os
+import sys
+import time
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, HERE)
+OUT = os.path.join(HERE, "KERNEL_BENCH_TPU.json")
+DEADLINE = float(os.environ.get("PT_KERNEL_BENCH_DEADLINE", "780"))
+T0 = time.time()
+
+RESULTS = {"device": None, "backend": None, "rows": [], "started_at": None}
+
+
+def _save():
+    with open(OUT, "w") as f:
+        json.dump(RESULTS, f, indent=1)
+
+
+def _left():
+    return DEADLINE - (time.time() - T0)
+
+
+def main():
+    import datetime
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    RESULTS["started_at"] = datetime.datetime.now(
+        datetime.timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
+    backend = jax.default_backend()
+    RESULTS["backend"] = backend
+    if backend == "cpu":
+        print("backend is cpu; refusing to record non-TPU kernel numbers")
+        _save()
+        return 1
+    RESULTS["device"] = str(jax.devices()[0].device_kind)
+    _save()
+
+    from paddle_tpu.kernels import flash_attention as fa
+    from paddle_tpu.kernels.layer_norm import fused_layer_norm
+    from paddle_tpu.kernels.softmax_xent import fused_softmax_xent
+
+    rng = np.random.RandomState(0)
+
+    def bench(fn, args, iters=20, warmup=2):
+        """Compile + time; returns (ms_per_iter, compile_s)."""
+        c0 = time.time()
+        out = fn(*args)
+        np.asarray(jax.tree_util.tree_leaves(out)[0])  # force through tunnel
+        compile_s = time.time() - c0
+        for _ in range(warmup - 1):
+            out = fn(*args)
+        np.asarray(jax.tree_util.tree_leaves(out)[0])
+        t0 = time.time()
+        for _ in range(iters):
+            out = fn(*args)
+        np.asarray(jax.tree_util.tree_leaves(out)[0])
+        return (time.time() - t0) / iters * 1e3, compile_s
+
+    def row(name, **kw):
+        kw["name"] = name
+        RESULTS["rows"].append(kw)
+        _save()
+        print(json.dumps(kw))
+
+    def mk_qkv(B, H, S, D):
+        shape = (B, H, S, D)
+        mk = lambda: jnp.asarray(rng.randn(*shape), jnp.bfloat16) * 0.1
+        return mk(), mk(), mk()
+
+    # -- flash attention: blk_q sweep, forward, causal -----------------
+    H, D = 12, 64
+    for S, B in ((512, 8), (2048, 2)):
+        if _left() < 120:
+            row("SKIPPED_DEADLINE", detail=f"flash S={S}")
+            continue
+        q, k, v = mk_qkv(B, H, S, D)
+        sm = 1.0 / (D ** 0.5)
+
+        # XLA-native reference first: the number to beat.
+        ref = jax.jit(lambda q, k, v: fa._reference_attention(
+            q, k, v, sm, True))
+        try:
+            ms, cs = bench(ref, (q, k, v))
+            row("xla_attention_fwd", S=S, B=B, ms=ms, compile_s=cs)
+        except Exception as e:  # noqa: BLE001
+            row("xla_attention_fwd", S=S, B=B, error=repr(e)[:300])
+
+        for blk in (128, 256, 512):
+            if blk > S or _left() < 90:
+                continue
+            f = jax.jit(lambda q, k, v, blk=blk: fa._flash_fwd_pallas(
+                q, k, v, None, None, sm, True, interpret=False,
+                blk_q=blk, with_lse=False)[0])
+            try:
+                ms, cs = bench(f, (q, k, v))
+                row("flash_fwd", S=S, B=B, blk_q=blk, ms=ms, compile_s=cs)
+            except Exception as e:  # noqa: BLE001
+                row("flash_fwd", S=S, B=B, blk_q=blk, error=repr(e)[:300])
+
+        # numerics on-device: compiled kernel vs XLA reference
+        try:
+            got = np.asarray(jax.jit(
+                lambda q, k, v: fa._flash_fwd_pallas(
+                    q, k, v, None, None, sm, True, interpret=False,
+                    with_lse=False)[0])(q, k, v), np.float32)
+            want = np.asarray(ref(q, k, v), np.float32)
+            err = float(np.max(np.abs(got - want)))
+            row("flash_fwd_numerics", S=S, max_abs_err=err,
+                ok=bool(err < 5e-2))
+        except Exception as e:  # noqa: BLE001
+            row("flash_fwd_numerics", S=S, error=repr(e)[:300])
+
+    # -- flash attention: fwd+bwd (training shape) ---------------------
+    for S, B in ((512, 8), (2048, 2)):
+        if _left() < 150:
+            row("SKIPPED_DEADLINE", detail=f"flash_bwd S={S}")
+            continue
+        q, k, v = mk_qkv(B, H, S, D)
+
+        def loss_flash(q, k, v):
+            return fa.flash_attention(q, k, v, causal=True).astype(
+                jnp.float32).sum()
+
+        def loss_xla(q, k, v):
+            sm = 1.0 / (D ** 0.5)
+            return fa._reference_attention(q, k, v, sm, True).astype(
+                jnp.float32).sum()
+
+        for name, fn in (("flash_train", loss_flash),
+                         ("xla_attention_train", loss_xla)):
+            g = jax.jit(jax.grad(fn, argnums=(0, 1, 2)))
+            try:
+                ms, cs = bench(g, (q, k, v), iters=10)
+                row(name, S=S, B=B, ms=ms, compile_s=cs)
+            except Exception as e:  # noqa: BLE001
+                row(name, S=S, B=B, error=repr(e)[:300])
+
+    # -- fused layer_norm ----------------------------------------------
+    if _left() > 90:
+        R, C = 8 * 512, 768
+        x = jnp.asarray(rng.randn(R, C), jnp.float32)
+        gmm = jnp.ones((C,), jnp.float32)
+        bta = jnp.zeros((C,), jnp.float32)
+
+        def ln_xla(x, g, b):
+            m = x.mean(-1, keepdims=True)
+            v = ((x - m) ** 2).mean(-1, keepdims=True)
+            return (x - m) * jax.lax.rsqrt(v + 1e-5) * g + b
+
+        for name, fn in (
+                ("layer_norm_pallas",
+                 jax.jit(lambda x, g, b: fused_layer_norm(x, g, b, 1e-5)[0])),
+                ("layer_norm_xla", jax.jit(ln_xla))):
+            try:
+                ms, cs = bench(fn, (x, gmm, bta))
+                row(name, rows=R, cols=C, ms=ms, compile_s=cs)
+            except Exception as e:  # noqa: BLE001
+                row(name, rows=R, cols=C, error=repr(e)[:300])
+
+    # -- fused softmax_xent --------------------------------------------
+    if _left() > 90:
+        R, V = 8 * 512, 30522
+        logits = jnp.asarray(rng.randn(R, V), jnp.float32)
+        labels = jnp.asarray(rng.randint(0, V, (R, 1)), jnp.int32)
+
+        def sx_xla(s, lbl):
+            lse = jax.scipy.special.logsumexp(s, -1, keepdims=True)
+            return jnp.take_along_axis(lse - s, lbl, 1)
+
+        for name, fn in (
+                ("softmax_xent_pallas",
+                 jax.jit(lambda s, l: fused_softmax_xent(s, l)[0])),
+                ("softmax_xent_xla", jax.jit(sx_xla))):
+            try:
+                ms, cs = bench(fn, (logits, labels))
+                row(name, rows=R, vocab=V, ms=ms, compile_s=cs)
+            except Exception as e:  # noqa: BLE001
+                row(name, rows=R, vocab=V, error=repr(e)[:300])
+
+    RESULTS["wall_s"] = time.time() - T0
+    _save()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

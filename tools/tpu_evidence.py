@@ -90,16 +90,77 @@ def capture(deadline=840):
     return None
 
 
-if __name__ == "__main__":
+def run_kernel_bench(timeout=900):
+    """Run the Pallas kernel benchmark (tools/kernel_bench.py) as its own
+    axon-claiming child; it writes KERNEL_BENCH_TPU.json itself."""
+    script = os.path.join(HERE, "tools", "kernel_bench.py")
+    if not os.path.exists(script):
+        return False
+    try:
+        proc = subprocess.run(
+            [sys.executable, script], capture_output=True, text=True,
+            timeout=timeout, env=dict(os.environ),
+        )
+    except subprocess.TimeoutExpired:
+        _log_probe("kernel_bench=TIMEOUT")
+        return False
+    ok = proc.returncode == 0
+    _log_probe("kernel_bench=OK" if ok
+               else f"kernel_bench=FAIL rc={proc.returncode} "
+                    f"err={proc.stderr[-300:]!r}")
+    return ok
+
+
+def _once():
     import time
 
     if not probe():
         print("relay down (logged)")
-        sys.exit(1)
+        return 1
     time.sleep(45)  # probe child must release the single-claim relay
     rec = capture()
     if rec is None:
         print("bench produced no result (logged)")
-        sys.exit(2)
+        return 2
     print(json.dumps(rec))
-    sys.exit(0 if rec.get("backend") == "tpu" else 3)
+    if rec.get("backend") == "tpu":
+        time.sleep(45)
+        run_kernel_bench()
+        return 0
+    return 3
+
+
+def _loop(interval):
+    """Continuous capture (round-3 verdict next-step #1): probe every
+    `interval` s for the whole round; fire the full ladder at every
+    up-window. A builder needing the relay for manual work touches
+    .bench_evidence/pause; the loop logs the skip and stays clear of
+    the single-claim relay."""
+    import time
+
+    pause = os.path.join(HERE, ".bench_evidence", "pause")
+    _log_probe(f"loop=START interval={interval}s pid={os.getpid()}")
+    while True:
+        if os.path.exists(pause):
+            _log_probe("loop=PAUSED (pause file present)")
+            time.sleep(interval)
+            continue
+        try:
+            rc = _once()
+        except Exception as e:  # noqa: BLE001 — the loop must survive
+            _log_probe(f"loop=ERROR {type(e).__name__}: {e}")
+            rc = -1
+        if rc == 0:
+            # Got a real TPU number + kernel bench. Keep re-capturing at
+            # a relaxed cadence in case later code improves the number,
+            # and to prove the window stayed usable.
+            _log_probe("loop=TPU_CAPTURE_OK relaxing cadence")
+            time.sleep(max(interval, 1800))
+        else:
+            time.sleep(interval)
+
+
+if __name__ == "__main__":
+    if len(sys.argv) >= 3 and sys.argv[1] == "--loop":
+        _loop(int(sys.argv[2]))
+    sys.exit(_once())
