@@ -1,5 +1,12 @@
-"""Contrib: mixed precision (AMP), slim (compression) — reference
+"""Contrib: mixed precision (AMP), slim (compression), contrib layers,
+decoupled weight decay, memory/model statistics — reference
 python/paddle/fluid/contrib/."""
 
 from . import mixed_precision
 from . import slim
+from . import layers
+from . import extend_optimizer
+from .extend_optimizer import extend_with_decoupled_weight_decay
+from .memory_usage_calc import memory_usage
+from .model_stat import summary
+from .op_frequence import op_freq_statistic

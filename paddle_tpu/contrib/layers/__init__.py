@@ -1,0 +1,10 @@
+"""fluid.contrib.layers (reference
+python/paddle/fluid/contrib/layers/__init__.py)."""
+
+from .nn import *  # noqa: F401,F403
+from .rnn_impl import *  # noqa: F401,F403
+from .metric_op import *  # noqa: F401,F403
+
+from . import nn, rnn_impl, metric_op
+
+__all__ = nn.__all__ + rnn_impl.__all__ + metric_op.__all__

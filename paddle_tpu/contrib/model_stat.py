@@ -1,0 +1,55 @@
+"""Param/FLOPs summary table (reference
+python/paddle/fluid/contrib/model_stat.py:40 summary)."""
+
+from __future__ import annotations
+
+__all__ = ["summary"]
+
+
+def summary(main_prog):
+    """Print a per-layer table of params + FLOPs for conv/fc/pool ops
+    (reference model_stat.py); returns (total_params, total_flops)."""
+    total_params = 0
+    total_flops = 0
+    rows = []
+    block = main_prog.global_block()
+    for op in block.ops:
+        if op.type not in ("conv2d", "depthwise_conv2d", "mul", "matmul",
+                           "matmul_v2", "pool2d"):
+            continue
+        params = 0
+        flops = 0
+        try:
+            if op.type in ("conv2d", "depthwise_conv2d"):
+                w = block.var(op.inputs["Filter"][0].name
+                              if hasattr(op.inputs["Filter"][0], "name")
+                              else op.inputs["Filter"][0])
+                out = op.outputs["Output"][0]
+                oshape = getattr(out, "shape", None) or block.var(
+                    getattr(out, "name", out)).shape
+                k = 1
+                for d in w.shape:
+                    k *= d
+                params = k
+                spatial = 1
+                for d in (oshape or ())[2:]:
+                    spatial *= d
+                flops = 2 * k * spatial
+            elif op.type in ("mul", "matmul", "matmul_v2"):
+                y = op.inputs["Y"][0]
+                yshape = getattr(y, "shape", ())
+                k = 1
+                for d in yshape:
+                    k *= d
+                params = k
+                flops = 2 * k
+        except (KeyError, AttributeError, IndexError):
+            pass
+        total_params += params
+        total_flops += flops
+        rows.append((op.type, params, flops))
+    print(f"{'op':24s}{'params':>14s}{'flops':>16s}")
+    for t, p, f in rows:
+        print(f"{t:24s}{p:14d}{f:16d}")
+    print(f"{'TOTAL':24s}{total_params:14d}{total_flops:16d}")
+    return total_params, total_flops

@@ -1256,31 +1256,45 @@ def cumsum(x, axis=-1, exclusive=False, reverse=False):
     return out
 
 
-def image_resize(input, out_shape=None, scale=None, resample="BILINEAR", name=None):
+def image_resize(input, out_shape=None, scale=None, resample="BILINEAR",
+                 name=None, align_corners=True, align_mode=1,
+                 actual_shape=None):
+    """align_corners defaults TRUE and align_mode 1 like the reference
+    interpolate API (layers/nn.py image_resize)."""
     op = "bilinear_interp" if resample.upper() == "BILINEAR" else "nearest_interp"
     helper = LayerHelper(op, name=name)
     n, c = input.shape[0], input.shape[1]
     if out_shape:
         oh, ow = out_shape
-    else:
+    elif scale:
         oh = int(input.shape[2] * scale)
         ow = int(input.shape[3] * scale)
+    else:
+        raise NotImplementedError(
+            "image_resize: pass out_shape or scale — a runtime "
+            "actual_shape Variable cannot size a static-shape build")
     out = _out(helper, input, shape=(n, c, oh, ow))
     helper.append_op(
         type=op,
         inputs={"X": [input]},
         outputs={"Out": [out]},
-        attrs={"out_h": oh, "out_w": ow, "scale": float(scale or 0.0)},
+        attrs={"out_h": oh, "out_w": ow, "scale": float(scale or 0.0),
+               "align_corners": bool(align_corners),
+               "align_mode": int(align_mode)},
     )
     return out
 
 
-def resize_nearest(input, out_shape=None, scale=None, name=None):
-    return image_resize(input, out_shape, scale, "NEAREST", name)
+def resize_nearest(input, out_shape=None, scale=None, name=None,
+                   actual_shape=None, align_corners=True):
+    return image_resize(input, out_shape, scale, "NEAREST", name,
+                        align_corners=align_corners)
 
 
-def resize_bilinear(input, out_shape=None, scale=None, name=None):
-    return image_resize(input, out_shape, scale, "BILINEAR", name)
+def resize_bilinear(input, out_shape=None, scale=None, name=None,
+                    actual_shape=None, align_corners=True, align_mode=1):
+    return image_resize(input, out_shape, scale, "BILINEAR", name,
+                        align_corners=align_corners, align_mode=align_mode)
 
 
 def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):

@@ -260,14 +260,16 @@ def _teacher_student_sigmoid_loss(ctx, op, ins):
     mixes a hard click signal with a soft teacher score."""
     x = ins["X"][0].reshape(-1)
     lbl = ins["Label"][0].reshape(-1)
-    softplus = jax.nn.softplus
-    # teacher part: label<-1 -> 0; -1<=label<0 -> (1+label) weighting;
-    # simple faithful form: hard = sigmoid ce with (label>0); soft =
-    # sigmoid ce with fractional part where 0<label<1
-    hard = softplus(x) - jnp.where(lbl > 0.0, x, 0.0)
-    frac = jnp.clip(lbl, 0.0, 1.0)
-    soft = softplus(x) - frac * x
-    out = jnp.where((lbl > 0.0) & (lbl < 1.0), soft, hard)
+    # stable softplus(x) = max(x,0) + log1p(exp(-|x|)), the reference's
+    # own spelling. Label encodes (clk z, teacher score z'):
+    #   lbl < -1 : no z', z=0  ->  sp(x)
+    #   lbl < 0  : no z', z=1  ->  sp(x) - x
+    #   lbl >= 0 : z' present, z = (lbl>=1), z' = lbl - z
+    #              -> [sp(x) - z*x] + [sp(x) - z'*x] = 2*sp(x) - lbl*x
+    sp = jnp.maximum(x, 0.0) + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    out = jnp.where(
+        lbl < -1.0, sp,
+        jnp.where(lbl < 0.0, sp - x, 2.0 * sp - x * lbl))
     return {"Y": [out.reshape(-1, 1)]}
 
 

@@ -130,22 +130,41 @@ def _sample_logits(ctx, op, ins):
 @register_op("similarity_focus", inputs=("X",), outputs=("Out",),
              stop_gradient=True)
 def _similarity_focus(ctx, op, ins):
-    """Similarity-focus mask (reference similarity_focus_op.cc): for
-    each selected channel of [B, C, A, B'] pick per-row and per-column
-    argmax cells; output is an indicator over the full X shape."""
+    """Similarity-focus mask (reference similarity_focus_op.h:74-104):
+    for each selected channel of [B, C, H, W], walk cells in
+    DESCENDING value order, greedily selecting a cell iff neither its
+    row nor its column was already taken (stop once min(H, W) cells
+    are selected — equivalent to exhausting the walk); the mask marks
+    the selected (h, w) cells across ALL channels, unioned over the
+    requested index channels."""
     x = ins["X"][0]
     axis = int(op.attrs.get("axis", 1))
     idxs = [int(i) for i in op.attrs.get("indexes", [0])]
     assert axis == 1, "similarity_focus lowered for channel axis=1"
     B, C, H, W = x.shape
-    mask = jnp.zeros_like(x)
+
+    def greedy(ch_flat):  # [H*W] one batch row, one index channel
+        order = jnp.argsort(-ch_flat)
+
+        def step(carry, idx):
+            rtag, ctag, sel = carry
+            r, c = idx // W, idx % W
+            ok = jnp.logical_and(~rtag[r], ~ctag[c])
+            rtag = rtag.at[r].set(rtag[r] | ok)
+            ctag = ctag.at[c].set(ctag[c] | ok)
+            sel = sel.at[idx].set(ok)
+            return (rtag, ctag, sel), None
+
+        init = (jnp.zeros(H, bool), jnp.zeros(W, bool),
+                jnp.zeros(H * W, bool))
+        (_, _, sel), _ = jax.lax.scan(step, init, order)
+        return sel
+
+    mask = jnp.zeros((B, H * W), bool)
     for ci in idxs:
-        ch = x[:, ci]  # [B, H, W]
-        rmax = (ch == ch.max(axis=2, keepdims=True))
-        cmax = (ch == ch.max(axis=1, keepdims=True))
-        sel = (rmax | cmax).astype(x.dtype)  # [B, H, W]
-        mask = mask + sel[:, None, :, :]
-    return {"Out": [jnp.minimum(mask, 1.0)]}
+        mask = mask | jax.vmap(greedy)(x[:, ci].reshape(B, H * W))
+    sel = mask.reshape(B, 1, H, W).astype(x.dtype)
+    return {"Out": [jnp.broadcast_to(sel, x.shape)]}
 
 
 @register_op("filter_by_instag", inputs=("Ins", "Ins_tag", "Filter_tag"),
@@ -238,7 +257,15 @@ def _tree_conv(ctx, op, ins):
         cvec = jnp.take(bnodes, children, axis=0)       # [E, D]
         msg = (cvec @ wl) * eta_l[:, None] + (cvec @ wr) * eta_r[:, None]
         agg = jnp.zeros((N, wl.shape[1]), nodes.dtype).at[parents].add(msg)
-        return jnp.tanh(bnodes @ wt + agg)
+        pre = bnodes @ wt + agg
+        # contrib.layers.tree_conv adds bias then applies act OUTSIDE
+        # the op (reference tree_conv layer), so it emits act="identity"
+        act = str(op.attrs.get("act", "tanh"))
+        if act == "tanh":
+            return jnp.tanh(pre)
+        if act == "relu":
+            return jax.nn.relu(pre)
+        return pre
 
     return {"Out": [jax.vmap(one)(nodes, edges)]}
 

@@ -71,12 +71,6 @@ def _conv2d_transpose(ctx, op, ins):
     paddings = _pair(op.attrs.get("paddings", [0, 0]))
     dilations = _pair(op.attrs.get("dilations", [1, 1]))
     groups = int(op.attrs.get("groups", 1))
-    if groups != 1:
-        raise NotImplementedError(
-            "conv2d_transpose with groups != 1 is not lowered yet — "
-            "running ungrouped would silently produce out_c/groups "
-            "channels with full connectivity"
-        )
     # reference filter layout for transpose conv: [in_c, out_c/g, kh, kw].
     # With transpose_kernel=True jax wants the FORWARD conv's kernel,
     # whose OIHW is exactly [in_c(=O_fwd... the conv being transposed
@@ -93,15 +87,35 @@ def _conv2d_transpose(ctx, op, ins):
           (w.shape[3] - 1) * dilations[1] + 1]
     pad = [(ke[0] - 1 - paddings[0], ke[0] - 1 - paddings[0]),
            (ke[1] - 1 - paddings[1], ke[1] - 1 - paddings[1])]
-    out = jax.lax.conv_transpose(
-        x,
-        w,
-        strides=strides,
-        padding=pad,
-        rhs_dilation=dilations,
-        dimension_numbers=("NCHW", "OIHW", "NCHW"),
-        transpose_kernel=True,
-    )
+
+    def one(xi, wi):
+        return jax.lax.conv_transpose(
+            xi,
+            wi,
+            strides=strides,
+            padding=pad,
+            rhs_dilation=dilations,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            transpose_kernel=True,
+        )
+
+    if groups == 1:
+        out = one(x, w)
+    else:
+        # grouped decomposition (reference conv_transpose_op.cc supports
+        # groups; jax conv_transpose has no feature_group_count): group
+        # g's input channels [in_c/g] see only filter rows
+        # [g*in_c/g:(g+1)*in_c/g] producing out_c/g channels each,
+        # concatenated along channels. Static group count: XLA fuses
+        # the per-group convs.
+        if x.shape[1] % groups or w.shape[0] != x.shape[1]:
+            raise ValueError(
+                f"conv2d_transpose: in_c {x.shape[1]} and filter dim0 "
+                f"{w.shape[0]} must be divisible/equal for groups={groups}")
+        out = jnp.concatenate(
+            [one(xi, wi) for xi, wi in
+             zip(jnp.split(x, groups, axis=1), jnp.split(w, groups, axis=0))],
+            axis=1)
     if ins.get("Bias"):
         out = out + ins["Bias"][0].reshape((1, -1, 1, 1))
     return {"Output": [out]}
@@ -525,33 +539,70 @@ def _kldiv_loss(ctx, op, ins):
     return {"Loss": [loss]}
 
 
-@register_op("interp_nearest", inputs=("X",), outputs=("Out",))
-@register_op("nearest_interp", inputs=("X",), outputs=("Out",))
-def _nearest_interp(ctx, op, ins):
-    x = ins["X"][0]  # NCHW
+def _interp_out_hw(x, op):
     oh = int(op.attrs.get("out_h", 0))
     ow = int(op.attrs.get("out_w", 0))
     scale = op.attrs.get("scale", 0.0)
     if (not oh or not ow) and scale:
         oh, ow = int(x.shape[2] * scale), int(x.shape[3] * scale)
-    return {
-        "Out": [
-            jax.image.resize(x, x.shape[:2] + (oh, ow), method="nearest")
-        ]
-    }
+    return oh, ow
+
+
+@register_op("interp_nearest", inputs=("X",), outputs=("Out",))
+@register_op("nearest_interp", inputs=("X",), outputs=("Out",))
+def _nearest_interp(ctx, op, ins):
+    """Reference interpolate_op (nearest): align_corners defaults TRUE
+    — src index round(k*(in-1)/(out-1)); False — floor(k*in/out)."""
+    x = ins["X"][0]  # NCHW
+    oh, ow = _interp_out_hw(x, op)
+    ac = bool(op.attrs.get("align_corners", True))
+
+    def idx(out_len, in_len):
+        k = jnp.arange(out_len, dtype=jnp.float32)
+        if out_len == in_len:
+            return k.astype(jnp.int32)
+        if ac:
+            r = (in_len - 1) / max(out_len - 1, 1)
+            return jnp.floor(r * k + 0.5).astype(jnp.int32)
+        return jnp.floor(k * in_len / out_len).astype(jnp.int32)
+
+    iy, ix = idx(oh, x.shape[2]), idx(ow, x.shape[3])
+    return {"Out": [x[:, :, iy][:, :, :, ix]]}
 
 
 @register_op("bilinear_interp", inputs=("X",), outputs=("Out",))
 def _bilinear_interp(ctx, op, ins):
+    """Reference interpolate_op (bilinear): align_corners defaults TRUE
+    — src = k*(in-1)/(out-1); align_corners False uses align_mode:
+    mode 0 = half-pixel ((k+0.5)*in/out - 0.5), mode 1 = k*in/out."""
     x = ins["X"][0]
-    oh = int(op.attrs.get("out_h", 0))
-    ow = int(op.attrs.get("out_w", 0))
-    scale = op.attrs.get("scale", 0.0)
-    if (not oh or not ow) and scale:
-        oh, ow = int(x.shape[2] * scale), int(x.shape[3] * scale)
-    return {
-        "Out": [jax.image.resize(x, x.shape[:2] + (oh, ow), method="bilinear")]
-    }
+    oh, ow = _interp_out_hw(x, op)
+    ac = bool(op.attrs.get("align_corners", True))
+    mode = int(op.attrs.get("align_mode", 1))
+
+    def src(out_len, in_len):
+        k = jnp.arange(out_len, dtype=jnp.float32)
+        if ac:
+            return k * ((in_len - 1) / max(out_len - 1, 1))
+        if mode == 0:
+            return jnp.clip((k + 0.5) * in_len / out_len - 0.5, 0,
+                            in_len - 1)
+        return jnp.clip(k * in_len / out_len, 0, in_len - 1)
+
+    def lerp_axis(v, out_len, in_len, axis):
+        s = src(out_len, in_len)
+        lo = jnp.floor(s).astype(jnp.int32)
+        hi = jnp.minimum(lo + 1, in_len - 1)
+        w = (s - lo).astype(x.dtype)
+        shape = [1] * v.ndim
+        shape[axis] = out_len
+        w = w.reshape(shape)
+        return (jnp.take(v, lo, axis=axis) * (1 - w)
+                + jnp.take(v, hi, axis=axis) * w)
+
+    out = lerp_axis(x, oh, x.shape[2], 2)
+    out = lerp_axis(out, ow, x.shape[3], 3)
+    return {"Out": [out]}
 
 
 from ..core import registry as _registry
@@ -569,9 +620,16 @@ def _add_position_encoding(ctx, op, ins):
     alpha = float(op.attrs.get("alpha", 1.0))
     beta = float(op.attrs.get("beta", 1.0))
     B, T, D = x.shape
+    half = D // 2
     pos = jnp.arange(T, dtype=jnp.float32)[:, None]
-    i = jnp.arange(D // 2, dtype=jnp.float32)[None, :]
-    angle = pos / jnp.power(10000.0, 2.0 * i / D)
+    i = jnp.arange(half, dtype=jnp.float32)[None, :]
+    # reference add_position_encoding_op.h:73: denominator exponent is
+    # k/(half-1) (not the transformer paper's 2k/D); half==1 divides
+    # by the full 10000
+    if half > 1:
+        angle = pos / jnp.power(10000.0, i / (half - 1))
+    else:
+        angle = pos / 10000.0
     pe = jnp.concatenate([jnp.sin(angle), jnp.cos(angle)], axis=1)
     return {"Out": [alpha * x + beta * pe[None, :, :D].astype(x.dtype)]}
 

@@ -90,6 +90,10 @@ def _fused_gru(ctx, op, ins):
     h0 = ins["H0"][0] if ins.get("H0") else jnp.zeros((B, H), x.dtype)
     ln = ins["Length"][0] if ins.get("Length") else None
     is_reverse = bool(op.attrs.get("is_reverse", False))
+    # reference gru_unit_op.h:116: origin_mode True -> h = u*h_p +
+    # (1-u)*c (the contrib BasicGRUUnit convention); False (default) ->
+    # h = u*c + (1-u)*h_p
+    origin_mode = bool(op.attrs.get("origin_mode", False))
 
     xs = jnp.swapaxes(x, 0, 1)
     if is_reverse:
@@ -108,7 +112,10 @@ def _fused_gru(ctx, op, ins):
         rz = jax.nn.sigmoid(rz_x + h @ wh_rz)
         r, z = jnp.split(rz, 2, axis=-1)
         c = jnp.tanh(c_x + (r * h) @ wh_c)
-        h_new = (1 - z) * h + z * c
+        if origin_mode:
+            h_new = z * h + (1 - z) * c
+        else:
+            h_new = (1 - z) * h + z * c
         if ln is not None:
             step = T - 1 - t if is_reverse else t
             alive = (step < ln)[:, None]

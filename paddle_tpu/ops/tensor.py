@@ -586,13 +586,15 @@ def _multiplex(ctx, op, ins):
 
 @register_op("partial_concat", inputs=("X",), outputs=("Out",))
 def _partial_concat(ctx, op, ins):
-    # concat column slices [start, start+length) of each input
+    # concat column slices [start, start+length) of each input;
+    # negative start counts from the end (reference partial_concat_op)
     start = int(op.attrs.get("start_index", 0))
     length = int(op.attrs.get("length", -1))
     parts = []
     for x in ins["X"]:
-        end = x.shape[1] if length < 0 else start + length
-        parts.append(x[:, start:end])
+        s = start if start >= 0 else x.shape[1] + start
+        end = x.shape[1] if length < 0 else s + length
+        parts.append(x[:, s:end])
     return {"Out": [jnp.concatenate(parts, axis=1)]}
 
 
@@ -602,8 +604,9 @@ def _partial_sum(ctx, op, ins):
     length = int(op.attrs.get("length", -1))
     tot = None
     for x in ins["X"]:
-        end = x.shape[1] if length < 0 else start + length
-        s = x[:, start:end]
+        b = start if start >= 0 else x.shape[1] + start
+        end = x.shape[1] if length < 0 else b + length
+        s = x[:, b:end]
         tot = s if tot is None else tot + s
     return {"Out": [tot]}
 

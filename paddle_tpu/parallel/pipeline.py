@@ -397,6 +397,107 @@ def pipeline_train_step(
     return step
 
 
+def pipeline_train_step_3d(
+    stage_fn: Callable,
+    mesh,
+    param_specs,
+    pp_axis: str = "pp",
+    dp_axis: str = "dp",
+    remat: bool = True,
+):
+    """Full 3D parallelism on ONE mesh (round-3 verdict next-step #6:
+    each axis was only ever proven alone): GPipe pipeline over
+    ``pp_axis``, tensor parallelism INSIDE ``stage_fn`` (which receives
+    its local parameter shards and performs its own psum over the
+    tensor axis, megatron-style), and batch sharding over ``dp_axis``.
+
+    stage_fn(params_local, x_local) -> y_local: one stage on one
+        device's param shard; activation batch dim is the dp shard.
+    param_specs: pytree of PartitionSpec matching stage_params — leading
+        dim must be the stage axis (pp), tensor dims may name the mp
+        axis; dp must NOT appear (params are dp-replicated, shard_map's
+        transpose then psums the data-parallel gradient reduction).
+
+    Returns step(stage_params, microbatches, targets) -> (loss, grads):
+    microbatches/targets [M, mb, ...] sharded P(None, dp_axis, ...);
+    loss is the GLOBAL mean of (y - target)^2, identical on every
+    device; grads are sharded exactly like the params.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    if remat:
+        stage_fn = jax.checkpoint(stage_fn)
+    n_stages = mesh.shape[pp_axis]
+    dp = mesh.shape[dp_axis]
+
+    def _check_stage_dims(stage_params):
+        bad = {a.shape[0] for a in jax.tree_util.tree_leaves(stage_params)
+               if a.shape[0] != n_stages}
+        if bad:
+            raise ValueError(
+                f"stage_params leading (stage) dims {sorted(bad)} must equal "
+                f"mesh axis {pp_axis!r} size {n_stages} — the per-device "
+                "shard keeps only its first slice, so extra stages would "
+                "silently never run")
+
+    def per_device_loss(params, mb, tgt):
+        params = jax.tree_util.tree_map(lambda a: a[0], params)
+        idx = lax.axis_index(pp_axis)
+        M = mb.shape[0]
+        total = M + n_stages - 1
+        fwd_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+        # derive the carries from mb so their varying-manual-axes type
+        # (dp from the batch sharding) matches the loop outputs; the
+        # idx term adds the pp variance
+        x0 = mb[0] * 0 + jnp.zeros_like(mb[0]) * idx.astype(mb.dtype)
+        outs0 = mb * 0 + jnp.zeros_like(mb) * idx.astype(mb.dtype)
+
+        def tick(t, carry):
+            inflight, outs = carry
+            mb_t = lax.dynamic_index_in_dim(mb, jnp.clip(t, 0, M - 1), 0,
+                                            keepdims=False)
+            x_in = jnp.where(idx == 0, mb_t, inflight)
+            active = (t - idx >= 0) & (t - idx < M)
+            y = stage_fn(params, x_in)
+            y = jnp.where(active, y, inflight)
+            out_slot = jnp.clip(t - (n_stages - 1), 0, M - 1)
+            write = active & (idx == n_stages - 1)
+            outs = lax.dynamic_update_index_in_dim(
+                outs,
+                jnp.where(write, y,
+                          lax.dynamic_index_in_dim(outs, out_slot, 0, False)),
+                out_slot, 0,
+            )
+            return (lax.ppermute(y, pp_axis, fwd_perm), outs)
+
+        _, outs = lax.fori_loop(0, total, tick, (x0, outs0))
+        masked = jnp.where(idx == n_stages - 1, outs, jnp.zeros_like(outs))
+        outs = lax.psum(masked, pp_axis)  # replicated over pp (+ grad path)
+        # global mean: psum the dp-local sum; denominator is static
+        local_sum = jnp.sum((outs - tgt) ** 2)
+        global_n = outs.size * dp
+        return lax.psum(local_sum, dp_axis) / global_n
+
+    smap = _shard_map()
+    mb_spec = P(None, dp_axis)
+
+    def step(stage_params, microbatches, targets):
+        _check_stage_dims(stage_params)
+
+        def loss_of(params):
+            return smap(
+                per_device_loss,
+                mesh=mesh,
+                in_specs=(param_specs, mb_spec, mb_spec),
+                out_specs=P(),
+            )(params, microbatches, targets)
+
+        return jax.value_and_grad(loss_of)(stage_params)
+
+    return step
+
+
 def one_f_one_b_ticks(n_microbatches: int, n_stages: int) -> int:
     """Trip count of the 1F1B schedule: M + 2(S-1) lockstep ticks (each
     tick a device does its F and/or its B micro-op). GPipe-by-autodiff

@@ -99,6 +99,7 @@ class PSClient:
     def shutdown_servers(self):
         for ep in self.endpoints:
             try:
-                P.request(_addr(ep), {"verb": P.SHUTDOWN})
+                # no retry: an already-gone server IS a shutdown
+                P.request(_addr(ep), {"verb": P.SHUTDOWN}, retries=0)
             except ConnectionError:
                 pass

@@ -31,7 +31,9 @@ class _ShardState:
         self.value = value.astype(np.float32)
         self.spec = optimizer_spec
         self.state: Dict[str, np.ndarray] = {}
-        self.pending: List[np.ndarray] = []
+        # sync rounds: trainer_id -> pending grad (dict keying makes
+        # client retries idempotent)
+        self.pending: Dict[int, Any] = {}
 
     def apply(self, grad: np.ndarray):
         kind = self.spec.get("type", "sgd")
@@ -120,7 +122,7 @@ class ParameterServer:
         self._trainers = trainers
         self._sync = sync_mode
         self._lock = threading.Lock()
-        self._barrier_count = 0
+        self._barrier_arrived: set = set()
         self._barrier_generation = 0
         self._barrier_cond = threading.Condition(self._lock)
         self._last_seen: Dict[int, float] = {}
@@ -145,9 +147,14 @@ class ParameterServer:
             with self._lock:
                 sh = self._shards[name]
                 if self._sync:
-                    sh.pending.append(grad)
+                    # keyed by trainer_id, not arrival-counted: a
+                    # client RETRY (ps/protocol.py request backoff)
+                    # replaces the same trainer's entry instead of
+                    # double-counting it
+                    sh.pending[tid] = grad
                     if len(sh.pending) >= self._trainers:
-                        mean_grad = np.mean(sh.pending, axis=0)
+                        mean_grad = np.mean(list(sh.pending.values()),
+                                            axis=0)
                         sh.apply(mean_grad)
                         sh.pending.clear()
                 else:
@@ -167,25 +174,32 @@ class ParameterServer:
                 rows = msg["rows"].astype(np.int64)
                 grad = msg["grad"]
                 if self._sync and self._trainers > 1:
-                    # accumulate (rows, grad) per barrier round; apply
-                    # once when every trainer reported (mean semantics,
-                    # matching the dense sync path)
-                    sh.pending.append((rows, grad / self._trainers))
+                    # per-trainer (rows, grad) for the round, keyed by
+                    # trainer_id so a client retry replaces rather than
+                    # double-counts; apply once all trainers reported
+                    # (mean semantics, matching the dense sync path)
+                    sh.pending[tid] = (rows, grad / self._trainers)
                     if len(sh.pending) >= self._trainers:
-                        all_rows = np.concatenate([r for r, _ in sh.pending])
-                        all_grads = np.concatenate([g for _, g in sh.pending])
+                        all_rows = np.concatenate(
+                            [r for r, _ in sh.pending.values()])
+                        all_grads = np.concatenate(
+                            [g for _, g in sh.pending.values()])
                         sh.apply_sparse(all_rows, all_grads)
                         sh.pending.clear()
                 else:
                     sh.apply_sparse(rows, grad)
             return {"ok": True}
         if verb == P.BARRIER:
+            tid = int(msg.get("trainer_id", 0))
             deadline = time.time() + 300.0
             with self._barrier_cond:
-                self._barrier_count += 1
+                # arrivals tracked per trainer_id: a retried barrier
+                # request from the same trainer must not release the
+                # round early (ps/protocol.py request backoff)
+                self._barrier_arrived.add(tid)
                 my_gen = self._barrier_generation
-                if self._barrier_count >= self._trainers:
-                    self._barrier_count = 0
+                if len(self._barrier_arrived) >= self._trainers:
+                    self._barrier_arrived.clear()
                     self._barrier_generation += 1
                     self._barrier_cond.notify_all()
                     return {"ok": True}

@@ -1,0 +1,345 @@
+"""Contrib layers + incubate data_generator (round-3 verdict
+next-step #7; reference python/paddle/fluid/contrib/layers/*.py and
+incubate/data_generator/__init__.py)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.contrib import layers as cl
+
+
+def _run(build, feeds):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 5
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        fetches = build()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        return [np.asarray(a) for a in
+                exe.run(main, feed=feeds, fetch_list=fetches)]
+
+
+def test_contrib_nn_layers_emit_and_run():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 6).astype("float32")
+    y = rng.randn(2, 6).astype("float32")
+
+    def build():
+        xv = fluid.layers.data("x", [6])
+        yv = fluid.layers.data("y", [6])
+        # functor_list[0] is the OUTER functor (reference
+        # fused_elemwise_activation_op.h): relu(add(x, y))
+        fused = cl.fused_elemwise_activation(
+            xv, yv, ["relu", "elementwise_add"])
+        pc = cl.partial_concat([xv, yv], start_index=1, length=3)
+        ps = cl.partial_sum([xv, yv], start_index=0, length=2)
+        return [fused, pc, ps]
+
+    fused, pc, ps = _run(build, {"x": x, "y": y})
+    np.testing.assert_allclose(fused, np.maximum(x + y, 0), atol=1e-6)
+    np.testing.assert_allclose(
+        pc, np.concatenate([x[:, 1:4], y[:, 1:4]], 1), atol=1e-6)
+    np.testing.assert_allclose(ps, x[:, :2] + y[:, :2], atol=1e-6)
+
+
+def test_contrib_match_matrix_and_topk_pooling():
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 3, 4).astype("float32")
+    y = rng.randn(2, 5, 4).astype("float32")
+
+    def build():
+        xv = fluid.layers.data("x", [3, 4])
+        yv = fluid.layers.data("y", [5, 4])
+        out, tmp = cl.match_matrix_tensor(xv, yv, channel_num=2)
+        return [out, tmp]
+
+    out, tmp = _run(build, {"x": x, "y": y})
+    assert out.shape == (2, 2, 3, 5) and tmp.shape == (2, 2, 3, 4)
+    assert np.all(np.isfinite(out))
+
+
+def test_contrib_var_conv_2d_and_tree_conv():
+    rng = np.random.RandomState(2)
+    grid = rng.randn(2, 3, 5, 5).astype("float32")
+    nodes = rng.randn(2, 4, 6).astype("float32")
+    edges = np.array([[[0, 1], [0, 2]], [[1, 2], [1, 3]]], "int32")
+
+    def build():
+        g = fluid.layers.data("grid", [3, 5, 5])
+        row = fluid.layers.data("row", [], dtype="int32")
+        col = fluid.layers.data("col", [], dtype="int32")
+        vc = cl.var_conv_2d(g, row, col, input_channel=3, output_channel=4,
+                            filter_size=3, act="relu")
+        nv = fluid.layers.data("nodes", [4, 6])
+        es = fluid.layers.data("edges", [2, 2], dtype="int32")
+        tc = cl.tree_conv(nv, es, output_size=5, num_filters=2)
+        return [vc, tc]
+
+    vc, tc = _run(build, {
+        "grid": grid, "row": np.array([5, 3], "int32"),
+        "col": np.array([5, 4], "int32"),
+        "nodes": nodes, "edges": edges})
+    assert vc.shape == (2, 4, 5, 5) and (vc >= 0).all()
+    # masked extents really zeroed
+    assert np.all(vc[1, :, 3:, :] == 0) and np.all(vc[1, :, :, 4:] == 0)
+    assert tc.shape == (2, 4, 5, 2) and np.all(np.isfinite(tc))
+
+
+def test_contrib_embedding_hash_shuffle_nms():
+    rng = np.random.RandomState(3)
+    ids = rng.randint(0, 20, (4, 6)).astype("int64")
+    toks = rng.randint(0, 50, (3, 6)).astype("int32")
+    bboxes = (rng.rand(1, 3, 4) * 10).astype("float32")
+    bboxes[..., 2:] += bboxes[..., :2]  # valid boxes
+    scores = rng.rand(1, 2, 3).astype("float32")
+
+    def build():
+        iv = fluid.layers.data("ids", [6], dtype="int64")
+        emb = cl.fused_embedding_seq_pool(iv, size=[20, 8])
+        tv = fluid.layers.data("toks", [6], dtype="int32")
+        ph = cl.search_pyramid_hash(
+            tv, num_emb=8, space_len=32, pyramid_layer=3, rand_len=16,
+            drop_out_percent=0.0, is_training=False, use_filter=False,
+            white_list_len=0, black_list_len=0, seed=1, lr=1.0)
+        bb = fluid.layers.data("bb", [3, 4])
+        sc = fluid.layers.data("sc", [2, 3])
+        out, idx = cl.multiclass_nms2(bb, sc, score_threshold=0.1,
+                                      nms_top_k=3, keep_top_k=3,
+                                      background_label=-1,
+                                      return_index=True)
+        xv = fluid.layers.data("xs", [6])
+        sh = cl.shuffle_batch(xv)
+        return [emb, ph, out, idx, sh]
+
+    xs = rng.randn(5, 6).astype("float32")
+    emb, ph, out, idx, sh = _run(build, {
+        "ids": ids, "toks": toks, "bb": bboxes, "sc": scores, "xs": xs})
+    assert emb.shape == (4, 8) and ph.shape == (3, 8)
+    assert out.shape == (1, 3, 6) and idx.shape == (1, 3)
+    # shuffle keeps exactly the same rows
+    assert sorted(map(tuple, sh.tolist())) == sorted(map(tuple, xs.tolist()))
+
+
+def test_basic_lstm_gru_stacks():
+    """basic_lstm/basic_gru (contrib rnn_impl): shapes, bidirectional
+    concat, and last_hidden == the T-th step of the output."""
+    rng = np.random.RandomState(4)
+    x = rng.randn(3, 7, 5).astype("float32")
+
+    def build():
+        xv = fluid.layers.data("x", [7, 5])
+        lout, lh, lc = cl.basic_lstm(xv, None, None, hidden_size=6,
+                                     num_layers=2, bidirectional=True)
+        gout, gh = cl.basic_gru(xv, None, hidden_size=6, num_layers=1)
+        return [lout, lh, lc, gout, gh]
+
+    lout, lh, lc, gout, gh = _run(build, {"x": x})
+    assert lout.shape == (3, 7, 12)      # bi: fwd|bwd concat
+    assert lh.shape == (4, 3, 6) and lc.shape == (4, 3, 6)  # 2 layers x 2 dir
+    assert gout.shape == (3, 7, 6) and gh.shape == (1, 3, 6)
+    # unidirectional GRU: last hidden is the final timestep of the output
+    np.testing.assert_allclose(gh[0], gout[:, -1], atol=1e-5, rtol=1e-5)
+    assert np.all(np.isfinite(lout))
+
+
+def test_basic_units_match_numpy():
+    """BasicLSTMUnit/BasicGRUUnit single-step cells (dygraph) against
+    a numpy reimplementation of the reference equations."""
+    import jax.numpy as jnp
+    from paddle_tpu.dygraph.base import to_variable
+
+    rng = np.random.RandomState(6)
+    x = rng.randn(2, 4).astype("float32")
+    h = rng.randn(2, 3).astype("float32")
+    c = rng.randn(2, 3).astype("float32")
+
+    lstm = cl.BasicLSTMUnit("lstm_u", 3, forget_bias=1.0)
+    nh, nc = lstm.forward(to_variable(x), to_variable(h), to_variable(c))
+    w = np.asarray(lstm._weight.value)
+    b = np.asarray(lstm._bias.value)
+    gates = np.concatenate([x, h], 1) @ w + b
+    i, j, f, o = np.split(gates, 4, 1)
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    ref_c = c * sig(f + 1.0) + sig(i) * np.tanh(j)
+    ref_h = np.tanh(ref_c) * sig(o)
+    np.testing.assert_allclose(np.asarray(nc.value), ref_c, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(nh.value), ref_h, atol=1e-5)
+
+    gru = cl.BasicGRUUnit("gru_u", 3)
+    gh = gru.forward(to_variable(x), to_variable(h))
+    gw, gb = np.asarray(gru._gate_w.value), np.asarray(gru._gate_b.value)
+    cw, cb = np.asarray(gru._cand_w.value), np.asarray(gru._cand_b.value)
+    rz = sig(np.concatenate([x, h], 1) @ gw + gb)
+    r, u = np.split(rz, 2, 1)
+    cand = np.tanh(np.concatenate([x, r * h], 1) @ cw + cb)
+    ref = u * h + (1 - u) * cand
+    np.testing.assert_allclose(np.asarray(gh.value), ref, atol=1e-5)
+
+
+def test_ctr_metric_bundle_accumulates():
+    rng = np.random.RandomState(7)
+    p1 = rng.rand(4, 1).astype("float32")
+    l1 = (rng.rand(4, 1) > 0.5).astype("float32")
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        pv = fluid.layers.data("p", [1])
+        lv = fluid.layers.data("l", [1])
+        outs = cl.ctr_metric_bundle(pv, lv)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        exe.run(main, feed={"p": p1, "l": l1}, fetch_list=list(outs))
+        res = exe.run(main, feed={"p": p1, "l": l1},
+                      fetch_list=list(outs))
+    sqr, ab, prob, q, pos, ins = [float(np.asarray(r)) for r in res]
+    # after TWO runs every accumulator holds twice the batch statistic
+    np.testing.assert_allclose(sqr, 2 * ((p1 - l1) ** 2).sum(), rtol=1e-5)
+    np.testing.assert_allclose(ab, 2 * np.abs(p1 - l1).sum(), rtol=1e-5)
+    np.testing.assert_allclose(prob, 2 * p1.sum(), rtol=1e-5)
+    np.testing.assert_allclose(pos, 2 * l1.sum(), rtol=1e-5)
+    np.testing.assert_allclose(ins, 8.0, rtol=1e-6)
+
+
+def test_extend_optimizer_with_weight_decay():
+    """AdamW = extend_with_decoupled_weight_decay(Adam): one step must
+    equal a plain-Adam step plus the decoupled p*coeff shrink."""
+    from paddle_tpu.contrib import extend_with_decoupled_weight_decay
+
+    rng = np.random.RandomState(8)
+    xb = rng.randn(8, 4).astype("float32")
+    yb = rng.randn(8, 1).astype("float32")
+
+    results = {}
+    for mode in ("adam", "adamw"):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 3
+        with fluid.program_guard(main, startup), fluid.unique_name.guard():
+            x = fluid.layers.data("x", [4])
+            y = fluid.layers.data("y", [1])
+            w_pred = fluid.layers.fc(x, 1, param_attr=fluid.ParamAttr(
+                name="w_dec"), bias_attr=False)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(w_pred, y))
+            if mode == "adam":
+                fluid.optimizer.Adam(1e-2).minimize(loss)
+            else:
+                AdamW = extend_with_decoupled_weight_decay(
+                    fluid.optimizer.Adam)
+                AdamW(weight_decay=0.1, learning_rate=1e-2).minimize(loss)
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            w0 = scope.get_numpy("w_dec").copy()
+            exe.run(main, feed={"x": xb, "y": yb}, fetch_list=[loss])
+            results[mode] = (w0, scope.get_numpy("w_dec").copy())
+    (w0a, wa), (w0w, ww) = results["adam"], results["adamw"]
+    np.testing.assert_allclose(w0a, w0w, atol=1e-7)  # same init
+    # decoupled decay: adamw result == adam result - coeff * w0
+    np.testing.assert_allclose(ww, wa - 0.1 * w0a, atol=1e-5, rtol=1e-5)
+
+
+def test_data_generator_roundtrips_into_dataset(tmp_path):
+    """MultiSlotDataGenerator emits the MultiSlot text format the
+    Dataset parser consumes (round-3 verdict missing #2)."""
+    from paddle_tpu.incubate.data_generator import MultiSlotDataGenerator
+
+    class Gen(MultiSlotDataGenerator):
+        def generate_sample(self, line):
+            def reader():
+                for i in range(6):
+                    yield [("show", [i % 2]),
+                           ("feat", [0.5 * i, 1.0 * i, 1.5 * i])]
+            return reader
+
+    g = Gen()
+    files = g.write_to_files(lines_per_file=3, prefix=str(tmp_path / "ds"))
+    assert len(files) == 2
+
+    from paddle_tpu.dataset import DatasetFactory
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        show = fluid.layers.data("show", [1], dtype="int64")
+        feat = fluid.layers.data("feat", [3])
+    dataset = DatasetFactory().create_dataset("InMemoryDataset")
+    dataset.set_batch_size(2)
+    dataset.set_use_var([show, feat])
+    dataset.set_filelist(files)
+    dataset.load_into_memory()
+    assert dataset.get_memory_data_size() == 6
+    batches = list(dataset._iter_batches())
+    feats = np.concatenate([np.asarray(b["feat"]).reshape(-1, 3)
+                            for b in batches])
+    assert feats.shape[0] == 6
+    assert np.isclose(feats.sum(), sum(3.0 * i for i in range(6)))
+
+
+def test_data_generator_validates_inconsistent_slots():
+    from paddle_tpu.incubate.data_generator import MultiSlotDataGenerator
+
+    g = MultiSlotDataGenerator()
+    g._gen_str([("a", [1]), ("b", [2.0])])
+    with pytest.raises(ValueError, match="not match"):
+        g._gen_str([("a", [1]), ("c", [2.0])])
+
+
+def test_basic_gru_matches_reference_unit_equations():
+    """Review finding r4: basic_gru must follow the reference contrib
+    BasicGRUUnit convention h = u*h_prev + (1-u)*c (origin_mode), NOT
+    the C++ gru ops' default h = u*c + (1-u)*h_prev."""
+    rng = np.random.RandomState(9)
+    x = rng.randn(2, 4, 3).astype("float32")
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 1
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        xv = fluid.layers.data("x", [4, 3])
+        gout, gh = cl.basic_gru(xv, None, hidden_size=5)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        out, _ = exe.run(main, feed={"x": x}, fetch_list=[gout, gh])
+        names = [n for n in scope.local_var_names() if ".w" in n or ".b" in n]
+        params = {n: scope.get_numpy(n) for n in names}
+    wx = params[[n for n in names if "w_0" in n or n.endswith(".w_0")][0]]
+    # identify by shape: wx [3, 15], wh [5, 15], bias [15]
+    by_shape = {v.shape: v for v in params.values()}
+    wx, wh, b = by_shape[(3, 15)], by_shape[(5, 15)], by_shape[(15,)]
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    h = np.zeros((2, 5), "float32")
+    for t in range(4):
+        xp = x[:, t] @ wx + b
+        rz = sig(xp[:, :10] + h @ wh[:, :10])
+        r, u = np.split(rz, 2, 1)
+        c = np.tanh(xp[:, 10:] + (r * h) @ wh[:, 10:])
+        h = u * h + (1 - u) * c          # reference BasicGRUUnit form
+    np.testing.assert_allclose(np.asarray(out)[:, -1], h, atol=1e-5,
+                               rtol=1e-5)
+
+
+def test_partial_ops_negative_start_index():
+    """Review finding r4: negative start_index counts from the end."""
+    rng = np.random.RandomState(10)
+    x = rng.randn(2, 6).astype("float32")
+    y = rng.randn(2, 6).astype("float32")
+
+    def build():
+        xv = fluid.layers.data("x", [6])
+        yv = fluid.layers.data("y", [6])
+        pc = cl.partial_concat([xv, yv], start_index=-2)
+        ps = cl.partial_sum([xv, yv], start_index=-3, length=2)
+        return [pc, ps]
+
+    pc, ps = _run(build, {"x": x, "y": y})
+    np.testing.assert_allclose(
+        pc, np.concatenate([x[:, -2:], y[:, -2:]], 1), atol=1e-6)
+    np.testing.assert_allclose(ps, x[:, 3:5] + y[:, 3:5], atol=1e-6)

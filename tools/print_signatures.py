@@ -35,6 +35,12 @@ MODULES = [
     "paddle_tpu.lod_tensor",
     "paddle_tpu.contrib.slim.nas",
     "paddle_tpu.contrib.decoder",
+    "paddle_tpu.contrib.layers",
+    "paddle_tpu.contrib.extend_optimizer",
+    "paddle_tpu.contrib.memory_usage_calc",
+    "paddle_tpu.contrib.model_stat",
+    "paddle_tpu.contrib.op_frequence",
+    "paddle_tpu.incubate.data_generator",
     "paddle_tpu.incubate.fleet.utils",
     "paddle_tpu.datasets.wmt14",
     "paddle_tpu.datasets.wmt16",
