@@ -1,0 +1,186 @@
+// Go inference binding for paddle_tpu, wrapping the embedded-CPython
+// C API (paddle_tpu/capi/paddle_capi.cpp -> libpaddle_capi.so).
+//
+// Reference analogue: go/paddle/predictor.go (cgo over
+// libpaddle_fluid_c). Same capability — load an exported inference
+// model, feed float32 tensors, run, fetch outputs — over this
+// framework's much smaller C surface: the predictor behind the C API
+// is the XLA-compiled clone-per-thread Predictor
+// (paddle_tpu/inference/predictor.py), so Go callers get the same
+// compiled execution path as Python ones.
+//
+// Build (requires a Go toolchain + the built C library; see
+// go/README.md — the CI image for this repo has no Go, so this
+// package is compile-gated there):
+//
+//	CGO_LDFLAGS="-L../../paddle_tpu/capi/build -lpaddle_capi" go build ./...
+package paddle
+
+/*
+#cgo LDFLAGS: -lpaddle_capi
+#include <stdint.h>
+#include <stdlib.h>
+
+extern int PD_Init();
+extern void PD_Finalize();
+extern const char *PD_GetLastError();
+extern void *PD_NewPredictor(const char *model_dir);
+extern void *PD_ClonePredictor(void *pred);
+extern void PD_DeletePredictor(void *pred);
+extern int PD_GetInputNum(void *pred);
+extern int PD_GetOutputNum(void *pred);
+extern int PD_GetInputName(void *pred, int i, char *out, int cap);
+extern int PD_GetOutputName(void *pred, int i, char *out, int cap);
+extern int PD_SetInputFloat(void *pred, const char *name, const float *data,
+                            const int64_t *shape, int ndim);
+extern int PD_PredictorRun(void *pred);
+extern int64_t PD_GetOutputFloat(void *pred, const char *name, float *out,
+                                 int64_t capacity, int64_t *shape_out,
+                                 int ndim_cap, int *ndim_out);
+*/
+import "C"
+
+import (
+	"fmt"
+	"runtime"
+	"unsafe"
+)
+
+// Init starts the embedded interpreter + jax runtime. Call once per
+// process before NewPredictor.
+func Init() error {
+	if C.PD_Init() != 0 {
+		return lastError("PD_Init")
+	}
+	return nil
+}
+
+// Finalize tears the runtime down (optional; process exit also works).
+func Finalize() { C.PD_Finalize() }
+
+func lastError(op string) error {
+	return fmt.Errorf("%s: %s", op, C.GoString(C.PD_GetLastError()))
+}
+
+// Predictor wraps one clone-per-thread inference session. A Predictor
+// is NOT safe for concurrent Run; Clone one per goroutine (cheap —
+// clones share the compiled executable and weights).
+type Predictor struct {
+	c unsafe.Pointer
+}
+
+// NewPredictor loads a save_inference_model directory.
+func NewPredictor(modelDir string) (*Predictor, error) {
+	cdir := C.CString(modelDir)
+	defer C.free(unsafe.Pointer(cdir))
+	p := C.PD_NewPredictor(cdir)
+	if p == nil {
+		return nil, lastError("PD_NewPredictor")
+	}
+	pred := &Predictor{c: p}
+	runtime.SetFinalizer(pred, (*Predictor).Delete)
+	return pred, nil
+}
+
+// Clone makes an independent session over the same compiled model.
+func (p *Predictor) Clone() (*Predictor, error) {
+	c := C.PD_ClonePredictor(p.c)
+	if c == nil {
+		return nil, lastError("PD_ClonePredictor")
+	}
+	cl := &Predictor{c: c}
+	runtime.SetFinalizer(cl, (*Predictor).Delete)
+	return cl, nil
+}
+
+// Delete releases the session; the finalizer calls it automatically.
+func (p *Predictor) Delete() {
+	if p.c != nil {
+		C.PD_DeletePredictor(p.c)
+		p.c = nil
+	}
+}
+
+func (p *Predictor) InputNum() int  { return int(C.PD_GetInputNum(p.c)) }
+func (p *Predictor) OutputNum() int { return int(C.PD_GetOutputNum(p.c)) }
+
+func (p *Predictor) name(get func(unsafe.Pointer, int, *C.char, C.int) C.int,
+	i int) (string, error) {
+	buf := make([]byte, 256)
+	if get(p.c, i, (*C.char)(unsafe.Pointer(&buf[0])), 256) != 0 {
+		return "", lastError("PD_Get*Name")
+	}
+	n := 0
+	for n < len(buf) && buf[n] != 0 {
+		n++
+	}
+	return string(buf[:n]), nil
+}
+
+func (p *Predictor) InputName(i int) (string, error) {
+	return p.name(func(c unsafe.Pointer, i int, out *C.char, cap C.int) C.int {
+		return C.int(C.PD_GetInputName(c, C.int(i), out, cap))
+	}, i)
+}
+
+func (p *Predictor) OutputName(i int) (string, error) {
+	return p.name(func(c unsafe.Pointer, i int, out *C.char, cap C.int) C.int {
+		return C.int(C.PD_GetOutputName(c, C.int(i), out, cap))
+	}, i)
+}
+
+// SetInput feeds a float32 tensor (row-major, shape dims) by name.
+func (p *Predictor) SetInput(name string, data []float32, shape []int64) error {
+	numel := int64(1)
+	for _, d := range shape {
+		numel *= d
+	}
+	if int64(len(data)) != numel {
+		return fmt.Errorf("SetInput %s: %d values for shape %v", name,
+			len(data), shape)
+	}
+	cname := C.CString(name)
+	defer C.free(unsafe.Pointer(cname))
+	rc := C.PD_SetInputFloat(p.c, cname,
+		(*C.float)(unsafe.Pointer(&data[0])),
+		(*C.int64_t)(unsafe.Pointer(&shape[0])), C.int(len(shape)))
+	if rc != 0 {
+		return lastError("PD_SetInputFloat")
+	}
+	return nil
+}
+
+// Run executes the compiled model on the current inputs.
+func (p *Predictor) Run() error {
+	if C.PD_PredictorRun(p.c) != 0 {
+		return lastError("PD_PredictorRun")
+	}
+	return nil
+}
+
+// GetOutput fetches a float32 output by name, returning the data and
+// its shape.
+func (p *Predictor) GetOutput(name string) ([]float32, []int64, error) {
+	cname := C.CString(name)
+	defer C.free(unsafe.Pointer(cname))
+	// probe pass for size: capacity 0 returns numel without copying
+	// (dummy dest: the C side memcpy's min(numel, capacity) elements)
+	var ndim C.int
+	var dummy C.float
+	shape := make([]int64, 8)
+	numel := C.PD_GetOutputFloat(p.c, cname, &dummy, 0,
+		(*C.int64_t)(unsafe.Pointer(&shape[0])), 8, &ndim)
+	if numel < 0 {
+		return nil, nil, lastError("PD_GetOutputFloat")
+	}
+	out := make([]float32, int(numel))
+	if numel > 0 {
+		rc := C.PD_GetOutputFloat(p.c, cname,
+			(*C.float)(unsafe.Pointer(&out[0])), numel,
+			(*C.int64_t)(unsafe.Pointer(&shape[0])), 8, &ndim)
+		if rc < 0 {
+			return nil, nil, lastError("PD_GetOutputFloat")
+		}
+	}
+	return out, shape[:int(ndim)], nil
+}

@@ -18,11 +18,13 @@ def ctr_metric_bundle(input, label):
     helper = LayerHelper("ctr_metric_bundle")
     block = helper.main_program.global_block()
 
+    from ...core.framework import unique_name
+
     def acc_var(tag):
-        name = f"ctr_metric_{tag}"
-        v = block.create_var(name=helper.main_program._unique(name)
-                             if hasattr(helper.main_program, "_unique")
-                             else name, shape=(1,), dtype="float32",
+        # unique per call site: two bundles in one program (e.g. two
+        # output heads) must not alias their running sums
+        v = block.create_var(name=unique_name.generate(f"ctr_metric_{tag}"),
+                             shape=(1,), dtype="float32",
                              persistable=True, stop_gradient=True)
         sb = helper.startup_program.global_block()
         sv = sb.create_var(name=v.name, shape=(1,), dtype="float32",

@@ -57,15 +57,24 @@ AnalysisConfig = Config
 class _Tensor:
     """Zero-copy-style IO handle (reference ZeroCopyTensor)."""
 
-    def __init__(self, name):
+    def __init__(self, name, static_shape=None):
         self.name = name
         self._value: Optional[np.ndarray] = None
+        self._static_shape = static_shape
 
     def copy_from_cpu(self, arr: np.ndarray):
         self._value = np.asarray(arr)
 
     def reshape(self, shape):
         pass  # shapes flow from the array itself
+
+    def shape(self):
+        """Reference ZeroCopyTensor::shape: the held value's shape, or
+        the program var's static shape before any data is set (-1 for
+        the batch dim, as in the reference)."""
+        if self._value is not None:
+            return list(self._value.shape)
+        return list(self._static_shape) if self._static_shape else []
 
     def copy_to_cpu(self) -> np.ndarray:
         return self._value
@@ -103,7 +112,10 @@ class Predictor:
             from ..contrib.mixed_precision.fp16_lists import AutoMixedPrecisionLists
 
             _insert_cast_ops(self._program.global_block(), AutoMixedPrecisionLists())
-        self._inputs = {n: _Tensor(n) for n in self._feed_names}
+        block = self._program.global_block()
+        self._inputs = {
+            n: _Tensor(n, block.var(n).shape if block.has_var(n) else None)
+            for n in self._feed_names}
         self._outputs = {v.name: _Tensor(v.name) for v in self._fetch_vars}
         self._lock = threading.Lock()
 
@@ -156,7 +168,8 @@ class Predictor:
         p._program = self._program
         p._feed_names = self._feed_names
         p._fetch_vars = self._fetch_vars
-        p._inputs = {n: _Tensor(n) for n in self._feed_names}
+        p._inputs = {n: _Tensor(n, t._static_shape)
+                     for n, t in self._inputs.items()}
         p._outputs = {v.name: _Tensor(v.name) for v in self._fetch_vars}
         p._lock = threading.Lock()
         return p

@@ -1,16 +1,22 @@
 """Blockwise (flash) attention for TPU via Pallas — forward AND backward,
 with key-padding mask and additive attention bias (BiasQK).
 
-Design: grid (batch, heads, seq_block); each program brings one Q (or
-K/V) block plus the full opposing sequence for its (b,h) into VMEM and
-works on the MXU. For the sequence lengths the flagship configs use
-(<= 2k) a full [S, D] K/V panel fits comfortably in VMEM (S*D*4B =
-512KB at S=2048, D=64), so no innermost loop is needed; the win over
-naive XLA attention is never materializing [B,H,S,S] in HBM. When the
-executor compiles over a mesh with an `sp` axis (sequence
+Design — two regimes, routed per call on the (padded) sequence length:
+  S <= 2048 (PADDLE_TPU_FLASH_PANEL_MAX): grid (batch, heads,
+    seq_block); each program brings one Q (or K/V) block plus the full
+    opposing [S, D] panel for its (b,h) into VMEM (512KB at S=2048,
+    D=64) and works on the MXU with a single softmax — no inner loop,
+    no online-softmax bookkeeping; the win over naive XLA attention is
+    never materializing [B,H,S,S] in HBM.
+  S > 2048: KV-block streaming (FA-2): grid (batch, heads, q_block,
+    kv_block) with the KV axis innermost, online-softmax accumulators
+    (acc, m, l) in VMEM scratch — VMEM use is O(blk_q*blk_k), so the
+    single-chip ceiling is HBM-bound (8k/16k+ work on one chip).
+When the executor compiles over a mesh with an `sp` axis (sequence
 parallelism), the flash_attention op routes to ring attention instead
 (parallel/ring_attention.py via _sequence_parallel_mesh below): each
-device keeps its local S/sp shard and K/V rotate over ICI.
+device keeps its local S/sp shard and K/V rotate over ICI; the local
+shard itself uses these kernels, so ring x streaming composes.
 
 Masking (reference operators/fused/multihead_matmul_op.cu:441 takes a
 BiasQK input for exactly this):
@@ -61,6 +67,16 @@ _logger = logging.getLogger("paddle_tpu.flash_attention")
 NEG_INF = -1e30
 LANES = 128  # TPU minor-dim tile; lse/delta are stored lane-replicated
 DEFAULT_BLK = 256
+
+
+def _panel_max() -> int:
+    """Above this sequence length the kernels switch from the
+    full-K/V-panel design (one [S, D] panel per (b, h) in VMEM — fastest
+    for the flagship <=2k configs) to KV-block streaming (FA-2 grid
+    iteration with online-softmax scratch accumulators — O(blk) VMEM,
+    lifts the single-chip ceiling to 8k+). Read per call so tests can
+    force the streaming path at tiny S."""
+    return int(os.environ.get("PADDLE_TPU_FLASH_PANEL_MAX", "2048"))
 
 
 def _reference_attention(q, k, v, sm_scale, causal, mask=None, bias=None):
@@ -184,6 +200,314 @@ def _flash_fwd_pallas(q, k, v, mask, bias, sm_scale, causal, interpret,
         interpret=interpret,
     )(*args)
     return res if with_lse else (res[0], None)
+
+
+# -- KV-block streaming (S > _panel_max()) ----------------------------------
+# FA-2 grid iteration: grid (B, H, nq, nk) with the KV axis innermost
+# ("arbitrary" semantics — same-output-block revisits are consecutive),
+# online-softmax state in VMEM scratch. Only O(blk_q x blk_k) tiles ever
+# live in VMEM, so sequence length is bounded by HBM, not VMEM. A dense
+# [S, S] bias at this length is O(S^2) HBM by definition (same problem
+# the ring-attention route warns about), so bias inputs stay on the
+# panel kernel — whose VMEM try/except falls back to XLA if S is too
+# big for the panel.
+
+
+def _make_fwd_stream_kernel(blk_q: int, blk_k: int, nk: int, causal: bool,
+                            sm_scale: float, with_lse: bool, has_mask: bool):
+    from jax.experimental import pallas as pl
+
+    def kernel(*refs):
+        it = iter(refs)
+        q_ref, k_ref, v_ref = next(it), next(it), next(it)
+        mask_ref = next(it) if has_mask else None
+        o_ref = next(it)
+        lse_ref = next(it) if with_lse else None
+        acc_ref, m_ref, l_ref = next(it), next(it), next(it)
+
+        qi, kj = pl.program_id(2), pl.program_id(3)
+
+        @pl.when(kj == 0)
+        def _init():
+            m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+            l_ref[...] = jnp.zeros_like(l_ref)
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+
+        # causal: skip blocks entirely above the diagonal
+        run = (qi * blk_q + blk_q - 1 >= kj * blk_k) if causal else True
+
+        @pl.when(run)
+        def _compute():
+            q = q_ref[0, 0].astype(jnp.float32)    # [blk_q, D]
+            k = k_ref[0, 0].astype(jnp.float32)    # [blk_k, D]
+            v = v_ref[0, 0].astype(jnp.float32)    # [blk_k, D]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * sm_scale
+            if has_mask:
+                s = s + mask_ref[0].astype(jnp.float32)[None, :]
+            if causal:
+                rows = qi * blk_q + jax.lax.broadcasted_iota(
+                    jnp.int32, s.shape, 0)
+                cols = kj * blk_k + jax.lax.broadcasted_iota(
+                    jnp.int32, s.shape, 1)
+                s = jnp.where(rows >= cols, s, NEG_INF)
+            m_prev = m_ref[:, :1]                  # [blk_q, 1]
+            l_prev = l_ref[:, :1]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+            corr = jnp.exp(m_prev - m_new)
+            p = jnp.exp(s - m_new)                 # [blk_q, blk_k]
+            l_new = l_prev * corr + jnp.sum(p, axis=1, keepdims=True)
+            acc_ref[...] = acc_ref[...] * corr + jax.lax.dot_general(
+                p, v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+            l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+        @pl.when(kj == nk - 1)
+        def _final():
+            o_ref[0, 0] = (acc_ref[...] / l_ref[:, :1]).astype(o_ref.dtype)
+            if with_lse:
+                lse_ref[0, 0] = m_ref[...] + jnp.log(l_ref[...])
+
+    return kernel
+
+
+def _flash_fwd_stream(q, k, v, mask, sm_scale, causal, interpret,
+                      blk_q=DEFAULT_BLK, blk_k=DEFAULT_BLK, with_lse=True):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, S, D = q.shape
+    blk_q, blk_k = min(blk_q, S), min(blk_k, S)
+    assert S % blk_q == 0 and S % blk_k == 0
+    nq, nk = S // blk_q, S // blk_k
+    has_mask = mask is not None
+    kernel = _make_fwd_stream_kernel(blk_q, blk_k, nk, causal, sm_scale,
+                                     with_lse, has_mask)
+    in_specs = [
+        pl.BlockSpec((1, 1, blk_q, D), lambda b, h, i, j: (b, h, i, 0)),
+        pl.BlockSpec((1, 1, blk_k, D), lambda b, h, i, j: (b, h, j, 0)),
+        pl.BlockSpec((1, 1, blk_k, D), lambda b, h, i, j: (b, h, j, 0)),
+    ]
+    args = [q, k, v]
+    if has_mask:
+        in_specs.append(pl.BlockSpec((1, blk_k), lambda b, h, i, j: (b, j)))
+        args.append(mask)
+    out_shape = [jax.ShapeDtypeStruct(q.shape, q.dtype)]
+    out_specs = [pl.BlockSpec((1, 1, blk_q, D),
+                              lambda b, h, i, j: (b, h, i, 0))]
+    if with_lse:
+        out_shape.append(jax.ShapeDtypeStruct((B, H, S, LANES), jnp.float32))
+        out_specs.append(pl.BlockSpec((1, 1, blk_q, LANES),
+                                      lambda b, h, i, j: (b, h, i, 0)))
+    res = pl.pallas_call(
+        kernel,
+        out_shape=tuple(out_shape),
+        grid=(B, H, nq, nk),
+        in_specs=in_specs,
+        out_specs=tuple(out_specs),
+        scratch_shapes=[
+            pltpu.VMEM((blk_q, D), jnp.float32),      # acc
+            pltpu.VMEM((blk_q, LANES), jnp.float32),  # m
+            pltpu.VMEM((blk_q, LANES), jnp.float32),  # l
+        ],
+        compiler_params=pltpu.CompilerParams(dimension_semantics=(
+            "parallel", "parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(*args)
+    return res if with_lse else (res[0], None)
+
+
+def _make_dq_stream_kernel(blk_q: int, blk_k: int, nk: int, causal: bool,
+                           sm_scale: float, has_mask: bool):
+    from jax.experimental import pallas as pl
+
+    def kernel(*refs):
+        it = iter(refs)
+        q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref = (
+            next(it), next(it), next(it), next(it), next(it), next(it))
+        mask_ref = next(it) if has_mask else None
+        dq_ref = next(it)
+        dq_acc = next(it)
+
+        qi, kj = pl.program_id(2), pl.program_id(3)
+
+        @pl.when(kj == 0)
+        def _init():
+            dq_acc[...] = jnp.zeros_like(dq_acc)
+
+        run = (qi * blk_q + blk_q - 1 >= kj * blk_k) if causal else True
+
+        @pl.when(run)
+        def _compute():
+            q = q_ref[0, 0].astype(jnp.float32)
+            k = k_ref[0, 0].astype(jnp.float32)
+            v = v_ref[0, 0].astype(jnp.float32)
+            do = do_ref[0, 0].astype(jnp.float32)
+            lse = lse_ref[0, 0][:, :1]
+            # delta = rowsum(dO * O): recomputed per block from the o/do
+            # tiles (cheap elementwise) instead of materializing a
+            # lane-replicated [B,H,S,128] HBM array — which would be a
+            # 128x blow-up at exactly the long-S regime this path serves
+            delta = jnp.sum(do * o_ref[0, 0].astype(jnp.float32),
+                            axis=1, keepdims=True)
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * sm_scale
+            if has_mask:
+                s = s + mask_ref[0].astype(jnp.float32)[None, :]
+            if causal:
+                rows = qi * blk_q + jax.lax.broadcasted_iota(
+                    jnp.int32, s.shape, 0)
+                cols = kj * blk_k + jax.lax.broadcasted_iota(
+                    jnp.int32, s.shape, 1)
+                s = jnp.where(rows >= cols, s, NEG_INF)
+            p = jnp.exp(s - lse)
+            dp = jax.lax.dot_general(
+                do, v, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            ds = p * (dp - delta) * sm_scale
+            dq_acc[...] += jax.lax.dot_general(
+                ds, k, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+        @pl.when(kj == nk - 1)
+        def _final():
+            dq_ref[0, 0] = dq_acc[...].astype(dq_ref.dtype)
+
+    return kernel
+
+
+def _make_dkv_stream_kernel(blk_q: int, blk_k: int, nq: int, causal: bool,
+                            sm_scale: float, has_mask: bool):
+    from jax.experimental import pallas as pl
+
+    def kernel(*refs):
+        it = iter(refs)
+        k_ref, v_ref, q_ref, do_ref, o_ref, lse_ref = (
+            next(it), next(it), next(it), next(it), next(it), next(it))
+        mask_ref = next(it) if has_mask else None
+        dk_ref, dv_ref = next(it), next(it)
+        dk_acc, dv_acc = next(it), next(it)
+
+        kj, qi = pl.program_id(2), pl.program_id(3)
+
+        @pl.when(qi == 0)
+        def _init():
+            dk_acc[...] = jnp.zeros_like(dk_acc)
+            dv_acc[...] = jnp.zeros_like(dv_acc)
+
+        run = (qi * blk_q + blk_q - 1 >= kj * blk_k) if causal else True
+
+        @pl.when(run)
+        def _compute():
+            k = k_ref[0, 0].astype(jnp.float32)    # [blk_k, D]
+            v = v_ref[0, 0].astype(jnp.float32)
+            q = q_ref[0, 0].astype(jnp.float32)    # [blk_q, D]
+            do = do_ref[0, 0].astype(jnp.float32)
+            lse = lse_ref[0, 0][:, 0]              # [blk_q]
+            delta = jnp.sum(do * o_ref[0, 0].astype(jnp.float32),
+                            axis=1)                # [blk_q] (see dq kernel)
+            st = jax.lax.dot_general(
+                k, q, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * sm_scale
+            if has_mask:
+                st = st + mask_ref[0].astype(jnp.float32)[:, None]
+            if causal:
+                rows = kj * blk_k + jax.lax.broadcasted_iota(
+                    jnp.int32, st.shape, 0)
+                cols = qi * blk_q + jax.lax.broadcasted_iota(
+                    jnp.int32, st.shape, 1)
+                st = jnp.where(cols >= rows, st, NEG_INF)  # keep q >= k
+            pt = jnp.exp(st - lse[None, :])        # [blk_k, blk_q]
+            dv_acc[...] += jax.lax.dot_general(
+                pt, do, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            dpt = jax.lax.dot_general(
+                v, do, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            dst = pt * (dpt - delta[None, :]) * sm_scale
+            dk_acc[...] += jax.lax.dot_general(
+                dst, q, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+        @pl.when(qi == nq - 1)
+        def _final():
+            dk_ref[0, 0] = dk_acc[...].astype(dk_ref.dtype)
+            dv_ref[0, 0] = dv_acc[...].astype(dv_ref.dtype)
+
+    return kernel
+
+
+def _flash_bwd_stream(q, k, v, mask, o, lse, g, sm_scale, causal, interpret,
+                      blk_q=DEFAULT_BLK, blk_k=DEFAULT_BLK):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, S, D = q.shape
+    blk_q, blk_k = min(blk_q, S), min(blk_k, S)
+    assert S % blk_q == 0 and S % blk_k == 0
+    nq, nk = S // blk_q, S // blk_k
+    has_mask = mask is not None
+
+    dq_in_specs = [
+        pl.BlockSpec((1, 1, blk_q, D), lambda b, h, i, j: (b, h, i, 0)),
+        pl.BlockSpec((1, 1, blk_k, D), lambda b, h, i, j: (b, h, j, 0)),
+        pl.BlockSpec((1, 1, blk_k, D), lambda b, h, i, j: (b, h, j, 0)),
+        pl.BlockSpec((1, 1, blk_q, D), lambda b, h, i, j: (b, h, i, 0)),
+        pl.BlockSpec((1, 1, blk_q, D), lambda b, h, i, j: (b, h, i, 0)),
+        pl.BlockSpec((1, 1, blk_q, LANES), lambda b, h, i, j: (b, h, i, 0)),
+    ]
+    dq_args = [q, k, v, g, o, lse]
+    if has_mask:
+        dq_in_specs.append(pl.BlockSpec((1, blk_k),
+                                        lambda b, h, i, j: (b, j)))
+        dq_args.append(mask)
+    dq = pl.pallas_call(
+        _make_dq_stream_kernel(blk_q, blk_k, nk, causal, sm_scale, has_mask),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        grid=(B, H, nq, nk),
+        in_specs=dq_in_specs,
+        out_specs=pl.BlockSpec((1, 1, blk_q, D),
+                               lambda b, h, i, j: (b, h, i, 0)),
+        scratch_shapes=[pltpu.VMEM((blk_q, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(dimension_semantics=(
+            "parallel", "parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(*dq_args)
+
+    dkv_in_specs = [
+        pl.BlockSpec((1, 1, blk_k, D), lambda b, h, j, i: (b, h, j, 0)),
+        pl.BlockSpec((1, 1, blk_k, D), lambda b, h, j, i: (b, h, j, 0)),
+        pl.BlockSpec((1, 1, blk_q, D), lambda b, h, j, i: (b, h, i, 0)),
+        pl.BlockSpec((1, 1, blk_q, D), lambda b, h, j, i: (b, h, i, 0)),
+        pl.BlockSpec((1, 1, blk_q, D), lambda b, h, j, i: (b, h, i, 0)),
+        pl.BlockSpec((1, 1, blk_q, LANES), lambda b, h, j, i: (b, h, i, 0)),
+    ]
+    dkv_args = [k, v, q, g, o, lse]
+    if has_mask:
+        dkv_in_specs.append(pl.BlockSpec((1, blk_k),
+                                         lambda b, h, j, i: (b, j)))
+        dkv_args.append(mask)
+    dk, dv = pl.pallas_call(
+        _make_dkv_stream_kernel(blk_q, blk_k, nq, causal, sm_scale,
+                                has_mask),
+        out_shape=(jax.ShapeDtypeStruct(k.shape, k.dtype),
+                   jax.ShapeDtypeStruct(v.shape, v.dtype)),
+        grid=(B, H, nk, nq),
+        in_specs=dkv_in_specs,
+        out_specs=(
+            pl.BlockSpec((1, 1, blk_k, D), lambda b, h, j, i: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, blk_k, D), lambda b, h, j, i: (b, h, j, 0)),
+        ),
+        scratch_shapes=[pltpu.VMEM((blk_k, D), jnp.float32),
+                        pltpu.VMEM((blk_k, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(dimension_semantics=(
+            "parallel", "parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(*dkv_args)
+    return dq, dk, dv, None
 
 
 # -- backward ---------------------------------------------------------------
@@ -515,6 +839,11 @@ def _run_fwd(q, k, v, mask, bias, causal, sm_scale, with_lse=True):
     mode = _pallas_mode()
     if mode is not None:
         try:
+            if q.shape[2] > _panel_max() and bias is None:
+                return _flash_fwd_stream(
+                    q, k, v, mask, sm_scale, causal,
+                    interpret=(mode == "interpret"), with_lse=with_lse,
+                )
             return _flash_fwd_pallas(
                 q, k, v, mask, bias, sm_scale, causal,
                 interpret=(mode == "interpret"), with_lse=with_lse,
@@ -536,6 +865,11 @@ def _run_bwd(q, k, v, mask, bias, o, lse, g, causal, sm_scale):
     mode = _pallas_mode() if lse is not None else None
     if mode is not None:
         try:
+            if q.shape[2] > _panel_max() and bias is None:
+                return _flash_bwd_stream(
+                    q, k, v, mask, o, lse, g, sm_scale, causal,
+                    interpret=(mode == "interpret"),
+                )
             return _flash_bwd_pallas(
                 q, k, v, mask, bias, o, lse, g, sm_scale, causal,
                 interpret=(mode == "interpret"),

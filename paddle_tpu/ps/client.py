@@ -8,6 +8,7 @@ param's slices across endpoints and scatter/gather them).
 from __future__ import annotations
 
 import concurrent.futures
+import itertools
 from typing import Dict, List, Sequence, Tuple
 
 import numpy as np
@@ -27,6 +28,18 @@ class PSClient:
         self._pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=max(4, len(self.endpoints))
         )
+        # unique per sync-sensitive REQUEST (not per step): the server
+        # replays its cached response when a lost-reply retry resends a
+        # (trainer_id, seq) it already completed — without this, a
+        # retry landing after its barrier/grad round released would
+        # register into the NEXT round and break the sync fence.
+        # Seeded with time_ns so a RESTARTED trainer's fresh requests
+        # can never collide with its previous incarnation's entries in
+        # the server's TTL-less cache. itertools.count: atomic under
+        # CPython, shared by pool threads
+        import time
+
+        self._seq = itertools.count(time.time_ns())
 
     # shard_map: var name -> list of (endpoint, row_begin, row_end)
     def send_grad(self, shard_map, name: str, grad: np.ndarray):
@@ -38,7 +51,8 @@ class PSClient:
                     P.request,
                     _addr(ep),
                     {"verb": P.SEND_GRAD, "name": f"{name}@{lo}",
-                     "grad": piece, "trainer_id": self.trainer_id},
+                     "grad": piece, "trainer_id": self.trainer_id,
+                     "seq": next(self._seq)},
                 )
             )
         for f in futs:
@@ -83,12 +97,13 @@ class PSClient:
                 _addr(ep),
                 {"verb": P.PUSH_SPARSE, "name": f"{name}@{lo}",
                  "rows": rows[mask] - lo, "grad": grad[mask],
-                 "trainer_id": self.trainer_id},
+                 "trainer_id": self.trainer_id, "seq": next(self._seq)},
             )
 
     def barrier(self):
         for ep in self.endpoints:
-            resp = P.request(_addr(ep), {"verb": P.BARRIER, "trainer_id": self.trainer_id})
+            resp = P.request(_addr(ep), {"verb": P.BARRIER, "trainer_id": self.trainer_id,
+                                        "seq": next(self._seq)})
             if not resp.get("ok"):
                 raise RuntimeError(f"barrier failed at {ep}: {resp.get('error')}")
 

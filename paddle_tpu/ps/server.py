@@ -18,7 +18,8 @@ from __future__ import annotations
 import threading
 import time
 import socketserver
-from typing import Any, Dict, List, Optional
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -129,10 +130,45 @@ class ParameterServer:
         self._shutdown = threading.Event()
         self._server: Optional[socketserver.ThreadingTCPServer] = None
         self._checkpoint_dir: Optional[str] = None
+        # idempotency table for the sync-sensitive verbs (barrier /
+        # send_grad / push_sparse): at-least-once retries mean a reply
+        # lost AFTER a round completed resends the request into the
+        # NEXT round — per-round tid-keying alone can't catch that
+        # (the retry would register in, and possibly release, a round
+        # the trainer never reached). Clients stamp each such request
+        # with a unique seq; completed ok-responses are cached per
+        # (trainer_id, seq) and replayed verbatim on a duplicate.
+        self._idem: Dict[Tuple[int, int], Dict[str, Any]] = {}
+        self._idem_order: deque = deque()
+        # own lock: _idem_put is called while holding _lock (the
+        # barrier releases under _barrier_cond, which wraps _lock)
+        self._idem_lock = threading.Lock()
+
+    def _idem_get(self, msg):
+        if "seq" not in msg:
+            return None, None
+        key = (int(msg.get("trainer_id", 0)), int(msg["seq"]))
+        with self._idem_lock:
+            return key, self._idem.get(key)
+
+    def _idem_put(self, key, resp):
+        # only successful responses are replayable; an error (e.g.
+        # barrier timeout) must stay retryable
+        if key is not None and resp.get("ok"):
+            with self._idem_lock:
+                if key not in self._idem:
+                    self._idem[key] = resp
+                    self._idem_order.append(key)
+                    while len(self._idem_order) > 4096:
+                        self._idem.pop(self._idem_order.popleft(), None)
+        return resp
 
     # -- request handling -----------------------------------------------------
     def _handle(self, msg: Dict[str, Any]) -> Dict[str, Any]:
         verb = msg["verb"]
+        idem_key, cached = self._idem_get(msg)
+        if cached is not None:
+            return cached
         if verb == P.GET_PARAM:
             with self._lock:
                 sh = self._shards[msg["name"]]
@@ -159,7 +195,7 @@ class ParameterServer:
                         sh.pending.clear()
                 else:
                     sh.apply(grad)
-            return {"ok": True}
+            return self._idem_put(idem_key, {"ok": True})
         if verb == P.PREFETCH:
             # sparse row lookup (reference parameter_prefetch.cc)
             with self._lock:
@@ -188,7 +224,7 @@ class ParameterServer:
                         sh.pending.clear()
                 else:
                     sh.apply_sparse(rows, grad)
-            return {"ok": True}
+            return self._idem_put(idem_key, {"ok": True})
         if verb == P.BARRIER:
             tid = int(msg.get("trainer_id", 0))
             deadline = time.time() + 300.0
@@ -202,7 +238,7 @@ class ParameterServer:
                     self._barrier_arrived.clear()
                     self._barrier_generation += 1
                     self._barrier_cond.notify_all()
-                    return {"ok": True}
+                    return self._idem_put(idem_key, {"ok": True})
                 # wait on a generation predicate: spurious wakeups and
                 # timeouts must not release the barrier early
                 while self._barrier_generation == my_gen:
@@ -210,7 +246,7 @@ class ParameterServer:
                     if remaining <= 0:
                         return {"ok": False, "error": "barrier timeout"}
                     self._barrier_cond.wait(timeout=remaining)
-            return {"ok": True}
+            return self._idem_put(idem_key, {"ok": True})
         if verb == P.CHECKPOINT:
             self.save(msg["dirname"])
             return {"ok": True}

@@ -1,0 +1,37 @@
+#!/usr/bin/env Rscript
+# R inference demo over paddle_tpu via reticulate — the same shape as
+# the reference's r/example/mobilenet.r (which drives
+# paddle.fluid.core.AnalysisConfig/create_paddle_predictor through
+# reticulate; there is no native R binding in the reference either,
+# r/README.md documents the reticulate route as THE R story).
+#
+# Usage:  Rscript predict.r <exported_model_dir>
+# The model dir comes from fluid.io.save_inference_model.
+
+library(reticulate)
+
+args <- commandArgs(trailingOnly = TRUE)
+model_dir <- ifelse(length(args) >= 1, args[1], "model")
+
+np <- import("numpy")
+inference <- import("paddle_tpu.inference")
+
+config <- inference$Config(model_dir)
+predictor <- inference$create_predictor(config)
+
+input_names <- predictor$get_input_names()
+cat("inputs:", unlist(input_names), "\n")
+
+# feed ones in the model's declared input shape
+handle <- predictor$get_input_handle(input_names[[1]])
+shape <- handle$shape()
+shape[[1]] <- 1L  # batch
+x <- np$ones(as.integer(unlist(shape)), dtype = "float32")
+handle$copy_from_cpu(x)
+
+predictor$zero_copy_run()
+
+output_names <- predictor$get_output_names()
+out <- predictor$get_output_handle(output_names[[1]])$copy_to_cpu()
+cat("output shape:", paste(dim(out), collapse = "x"), "\n")
+cat("first values:", head(as.numeric(out), 5), "\n")

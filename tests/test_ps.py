@@ -217,3 +217,77 @@ def test_ps_client_retry_exhaustion_raises():
     with pytest.raises(ConnectionError, match="failed after 3 attempts"):
         P.request(("127.0.0.1", 1), {"verb": P.GET_PARAM, "name": "x@0"},
                   retries=2, backoff=0.01, timeout=0.5)
+
+
+def test_stale_retry_does_not_break_next_round():
+    """At-least-once retries x sync rounds (code-review r4): a reply
+    lost AFTER a barrier/grad round completed makes the client resend
+    that request into the NEXT round. The server's (trainer_id, seq)
+    idempotency table must replay the cached response instead of
+    registering the duplicate — otherwise the next round's fence
+    releases before the trainer actually arrives."""
+    import threading
+
+    from paddle_tpu.ps import protocol as P
+    from paddle_tpu.ps.server import ParameterServer
+    from paddle_tpu.ps.client import PSClient
+
+    eps = _ports(1)
+    w = np.zeros((4, 2), "float32")
+    ps = ParameterServer(eps[0], {"w@0": w.copy()},
+                         {"w@0": {"type": "sgd", "lr": 1.0}}, trainers=2,
+                         sync_mode=True)
+    ps.start_background()
+    addr = (eps[0].rsplit(":", 1)[0], int(eps[0].rsplit(":", 1)[1]))
+
+    c0, c1 = PSClient(eps, 0), PSClient(eps, 1)
+
+    # round G: both trainers reach the barrier; capture trainer 0's msg
+    done = []
+    msg0 = {"verb": P.BARRIER, "trainer_id": 0, "seq": next(c0._seq)}
+    t = threading.Thread(target=lambda: done.append(
+        P.request(addr, dict(msg0))))
+    t.start()
+    c1.barrier()
+    t.join(timeout=30)
+    assert done and done[0]["ok"]
+
+    # the lost-reply retry: trainer 0 resends the SAME (tid, seq)
+    resp = P.request(addr, dict(msg0))
+    assert resp["ok"], "duplicate must be acked (cached response)"
+
+    # round G+1: trainer 1 arrives FIRST. If the duplicate leaked into
+    # this round's arrival set, the barrier would release immediately.
+    flag = []
+    t1 = threading.Thread(target=lambda: (c1.barrier(), flag.append(1)))
+    t1.start()
+    t1.join(timeout=1.0)
+    assert not flag, "stale retry released the next round's barrier early"
+
+    c0.barrier()  # trainer 0 genuinely arrives -> round releases
+    t1.join(timeout=30)
+    assert flag
+
+    # same property for sync grads: a duplicate send_grad of a
+    # COMPLETED round must not seed the next round's pending set
+    shard_map = {"w": [(eps[0], 0, 4)]}
+    g = np.ones((4, 2), "float32")
+    gmsg = {"verb": P.SEND_GRAD, "name": "w@0", "grad": g,
+            "trainer_id": 0, "seq": next(c0._seq)}
+    r1 = P.request(addr, dict(gmsg))
+    c1.send_grad(shard_map, "w", g)          # round applies (mean = 1)
+    assert r1["ok"]
+    got = c0.get_param(shard_map, "w")
+    np.testing.assert_allclose(got, w - 1.0)
+
+    P.request(addr, dict(gmsg))              # stale duplicate replayed
+    # a fresh full round must need BOTH trainers again
+    c0.send_grad(shard_map, "w", g)
+    got = c0.get_param(shard_map, "w")
+    np.testing.assert_allclose(got, w - 1.0,
+                               err_msg="duplicate completed a round")
+    c1.send_grad(shard_map, "w", g)
+    got = c0.get_param(shard_map, "w")
+    np.testing.assert_allclose(got, w - 2.0)
+
+    c0.shutdown_servers()
