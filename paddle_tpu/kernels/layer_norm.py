@@ -15,6 +15,12 @@ x_hat from the residuals (no [R, C] extra residual beyond x itself):
 dgamma/dbeta cross-row sums are per-block partials accumulated by XLA
 (a [n_blocks, C] sum — tiny).
 
+TPU layout notes (r4, first real-chip compile): every ref is >= 2D —
+gamma/beta ride as [1, C] panels and the per-row mean/rstd stats are
+lane-replicated [rows, 128] exactly like the flash kernels' LSE
+(Mosaic's compile helper crashed on the earlier rank-1 block specs;
+narrow (rows, 1) f32 layouts are the other classic trap).
+
 Set PADDLE_TPU_KERNEL_INTERPRET=1 to run the kernels in interpreter
 mode on any backend (CPU tests do this); on non-TPU backends without
 the flag, callers keep the plain-XLA path.
@@ -43,6 +49,7 @@ def kernels_enabled() -> bool:
 
 
 BLOCK_R = 256
+LANES = 128  # per-row stats are lane-replicated [*, LANES] (f32 tile)
 
 
 def _fwd_kernel(x_ref, g_ref, b_ref, y_ref, mean_ref, rstd_ref, *, eps):
@@ -53,17 +60,17 @@ def _fwd_kernel(x_ref, g_ref, b_ref, y_ref, mean_ref, rstd_ref, *, eps):
     xhat = (x - mean) * rstd
     y = xhat * g_ref[...].astype(jnp.float32) + b_ref[...].astype(jnp.float32)
     y_ref[...] = y.astype(y_ref.dtype)
-    mean_ref[...] = mean[:, 0].astype(jnp.float32)
-    rstd_ref[...] = rstd[:, 0].astype(jnp.float32)
+    mean_ref[...] = jnp.broadcast_to(mean, mean_ref.shape).astype(jnp.float32)
+    rstd_ref[...] = jnp.broadcast_to(rstd, rstd_ref.shape).astype(jnp.float32)
 
 
 def _bwd_kernel(x_ref, g_ref, dy_ref, mean_ref, rstd_ref,
                 dx_ref, dg_ref, db_ref):
     x = x_ref[...].astype(jnp.float32)
     dy = dy_ref[...].astype(jnp.float32)
-    g = g_ref[...].astype(jnp.float32)
-    mean = mean_ref[...][:, None]
-    rstd = rstd_ref[...][:, None]
+    g = g_ref[...].astype(jnp.float32)           # [1, C]
+    mean = mean_ref[...][:, :1]                  # [BR, 1] from [BR, LANES]
+    rstd = rstd_ref[...][:, :1]
     xhat = (x - mean) * rstd
     dyg = dy * g
     m1 = jnp.mean(dyg, axis=1, keepdims=True)
@@ -98,54 +105,61 @@ def fused_layer_norm(x2, gamma, beta, eps):
 
 
 def _fwd_impl(x2, gamma, beta, eps):
+    """Returns y [R, C] plus LANE-REPLICATED mean/rstd [R, LANES]."""
     R, C = x2.shape
     xp, true_r = _pad_rows(x2, BLOCK_R)
     n_blocks = xp.shape[0] // BLOCK_R
+    g2 = gamma.reshape(1, C)
+    b2 = beta.reshape(1, C)
     y, mean, rstd = pl.pallas_call(
         functools.partial(_fwd_kernel, eps=eps),
         grid=(n_blocks,),
         in_specs=[
             pl.BlockSpec((BLOCK_R, C), lambda i: (i, 0)),
-            pl.BlockSpec((C,), lambda i: (0,)),
-            pl.BlockSpec((C,), lambda i: (0,)),
+            pl.BlockSpec((1, C), lambda i: (0, 0)),
+            pl.BlockSpec((1, C), lambda i: (0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((BLOCK_R, C), lambda i: (i, 0)),
-            pl.BlockSpec((BLOCK_R,), lambda i: (i,)),
-            pl.BlockSpec((BLOCK_R,), lambda i: (i,)),
+            pl.BlockSpec((BLOCK_R, LANES), lambda i: (i, 0)),
+            pl.BlockSpec((BLOCK_R, LANES), lambda i: (i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(xp.shape, x2.dtype),
-            jax.ShapeDtypeStruct((xp.shape[0],), jnp.float32),
-            jax.ShapeDtypeStruct((xp.shape[0],), jnp.float32),
+            jax.ShapeDtypeStruct((xp.shape[0], LANES), jnp.float32),
+            jax.ShapeDtypeStruct((xp.shape[0], LANES), jnp.float32),
         ],
         interpret=_interpret(),
-    )(xp, gamma, beta)
+    )(xp, g2, b2)
     return y[:true_r], mean[:true_r], rstd[:true_r]
 
 
 def _vjp_fwd(x2, gamma, beta, eps):
     y, mean, rstd = _fwd_impl(x2, gamma, beta, eps)
-    return y, (x2, gamma, mean, rstd)
+    # residuals live from forward to backward: keep the [R] vectors,
+    # not the lane-replicated [R, 128] (128x the footprint); bwd
+    # re-broadcasts — XLA fuses that into the kernel's operand copy
+    return y, (x2, gamma, mean[:, 0], rstd[:, 0])
 
 
 def _vjp_bwd(eps, res, dy):
-    x2, gamma, mean, rstd = res
+    x2, gamma, mean, rstd = res                  # mean/rstd [R]
     R, C = x2.shape
     xp, true_r = _pad_rows(x2, BLOCK_R)
     dyp, _ = _pad_rows(dy, BLOCK_R)
-    meanp, _ = _pad_rows(mean.reshape(-1, 1), BLOCK_R)
-    rstdp, _ = _pad_rows(rstd.reshape(-1, 1), BLOCK_R)
+    rep = lambda v: jnp.broadcast_to(v[:, None], (R, LANES))  # noqa: E731
+    meanp, _ = _pad_rows(rep(mean), BLOCK_R)
+    rstdp, _ = _pad_rows(rep(rstd), BLOCK_R)
     n_blocks = xp.shape[0] // BLOCK_R
     dx, dg_part, db_part = pl.pallas_call(
         _bwd_kernel,
         grid=(n_blocks,),
         in_specs=[
             pl.BlockSpec((BLOCK_R, C), lambda i: (i, 0)),
-            pl.BlockSpec((C,), lambda i: (0,)),
+            pl.BlockSpec((1, C), lambda i: (0, 0)),
             pl.BlockSpec((BLOCK_R, C), lambda i: (i, 0)),
-            pl.BlockSpec((BLOCK_R,), lambda i: (i,)),
-            pl.BlockSpec((BLOCK_R,), lambda i: (i,)),
+            pl.BlockSpec((BLOCK_R, LANES), lambda i: (i, 0)),
+            pl.BlockSpec((BLOCK_R, LANES), lambda i: (i, 0)),
         ],
         out_specs=[
             pl.BlockSpec((BLOCK_R, C), lambda i: (i, 0)),
@@ -158,7 +172,7 @@ def _vjp_bwd(eps, res, dy):
             jax.ShapeDtypeStruct((n_blocks, C), jnp.float32),
         ],
         interpret=_interpret(),
-    )(xp, gamma, dyp, meanp[:, 0], rstdp[:, 0])
+    )(xp, gamma.reshape(1, C), dyp, meanp, rstdp)
     dgamma = jnp.sum(dg_part, axis=0).astype(gamma.dtype)
     dbeta = jnp.sum(db_part, axis=0).astype(gamma.dtype)
     return dx[:true_r], dgamma, dbeta

@@ -3,11 +3,74 @@ tests run anywhere (reference TestDistBase spawns localhost subprocesses
 instead — see SURVEY.md §4.4)."""
 
 import os
+import sys
+
+# The round-4 environment exports PALLAS_AXON_POOL_IPS +
+# JAX_PLATFORMS=axon ambiently, and the axon sitecustomize registers
+# the TPU-relay PJRT plugin at INTERPRETER STARTUP — before this file
+# runs. Scrubbing os.environ here is too late: the test process still
+# contends the single-slot relay claim (observed: pytest runs hung for
+# 10+ minutes in the claim queue). Re-exec the interpreter once with a
+# clean env so tests are CPU-only from the very first instruction.
+_AXON_VARS = ("PALLAS_AXON_POOL_IPS", "AXON_LOOPBACK_RELAY",
+              "PALLAS_AXON_REMOTE_COMPILE", "AXON_POOL_SVC_OVERRIDE")
+def _restore_captured_fds():
+    """pytest's global fd-capture is active while conftest imports: fds
+    1/2 point at capture tmpfiles, and the ORIGINAL stdout/stderr live
+    on as higher saved dups. Restore them so the re-exec'ed pytest's
+    output reaches the invoker, not a dead process's tmpfile. Saves
+    are allocated in (stdin, stdout, stderr) order, so the 2nd/3rd
+    plausible fds in ascending order are stdout/stderr."""
+    import fcntl
+
+    try:
+        fds = []
+        for name in sorted(os.listdir("/proc/self/fd"), key=int):
+            fd = int(name)
+            if fd <= 2:
+                continue
+            try:
+                tgt = os.readlink(f"/proc/self/fd/{fd}")
+                flags = fcntl.fcntl(fd, fcntl.F_GETFL)
+            except OSError:
+                continue
+            writable = (flags & os.O_ACCMODE) in (os.O_WRONLY, os.O_RDWR)
+            deleted_tmp = tgt.startswith("/tmp/#")
+            plausible = tgt.startswith(("pipe:", "socket:", "/dev/", "/"))
+            if writable and plausible and not deleted_tmp:
+                fds.append(fd)
+        if len(fds) >= 3:
+            # stdin's save is writable too (tty O_RDWR): saves allocate
+            # in (stdin, stdout, stderr) order, so skip the first
+            os.dup2(fds[1], 1)
+            os.dup2(fds[2], 2)
+        elif len(fds) == 2:
+            # read-only stdin save (pipe / /dev/null) was filtered out
+            os.dup2(fds[0], 1)
+            os.dup2(fds[1], 2)
+    except OSError:
+        pass  # output stays captured; tests still run, rc propagates
+
+
+if os.environ.get("PALLAS_AXON_POOL_IPS") and \
+        not os.environ.get("PT_TEST_REEXECED") and \
+        "pytest" in sys.argv[0]:
+    _env = dict(os.environ)
+    for _k in _AXON_VARS:
+        _env.pop(_k, None)
+    _env["PT_TEST_REEXECED"] = "1"
+    _env["JAX_PLATFORMS"] = "cpu"
+    _env["JAX_PLATFORM_NAME"] = "cpu"
+    _restore_captured_fds()
+    os.execve(sys.executable,
+              [sys.executable, "-m", "pytest"] + sys.argv[1:], _env)
 
 # NOTE: with the axon TPU plugin present, JAX_PLATFORMS alone is not
 # honored — set JAX_PLATFORM_NAME as well (verified experimentally).
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-os.environ.setdefault("JAX_PLATFORM_NAME", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["JAX_PLATFORM_NAME"] = "cpu"
+for _k in _AXON_VARS:
+    os.environ.pop(_k, None)
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

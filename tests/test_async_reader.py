@@ -22,20 +22,35 @@ def _slow_reader(n=6, delay=0.05):
 
 def test_double_buffer_overlaps_producer_and_consumer():
     """With prefetch, total time ~ max(produce, consume) per step, not
-    the sum: 6 steps of 50ms produce + 50ms consume must finish well
-    under the 600ms serial time."""
+    the sum. Compare against an in-situ serial (no prefetch) run of the
+    same workload so background CPU load inflates both measurements
+    equally (absolute wall-clock bounds flake on a loaded 1-core box)."""
     n, delay = 6, 0.05
-    loader = DataLoader.from_generator(capacity=4, use_double_buffer=True)
-    loader.set_batch_generator(_slow_reader(n, delay))
-    t0 = time.perf_counter()
-    seen = []
-    for batch in loader:
-        time.sleep(delay)  # consumer work
-        seen.append(float(np.asarray(batch["x"])[0, 0]))
-    elapsed = time.perf_counter() - t0
-    assert seen == list(range(n))
-    serial = 2 * n * delay
-    assert elapsed < serial * 0.8, (elapsed, serial)
+
+    # the double-buffer path device_puts each batch; pay the one-time
+    # jax backend init outside the timed region
+    import jax
+
+    jax.device_put(np.zeros(1, "float32")).block_until_ready()
+
+    def timed(use_double_buffer):
+        loader = DataLoader.from_generator(
+            capacity=4, use_double_buffer=use_double_buffer)
+        loader.set_batch_generator(_slow_reader(n, delay))
+        t0 = time.perf_counter()
+        seen = []
+        for batch in loader:
+            time.sleep(delay)  # consumer work
+            seen.append(float(np.asarray(batch["x"])[0, 0]))
+        assert seen == list(range(n))
+        return time.perf_counter() - t0
+
+    for attempt in range(3):
+        serial = timed(use_double_buffer=False)
+        overlapped = timed(use_double_buffer=True)
+        if overlapped < serial * 0.8:
+            return
+    assert overlapped < serial * 0.8, (overlapped, serial)
 
 
 def test_prefetch_yields_device_arrays_and_executor_accepts_them():

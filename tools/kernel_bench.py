@@ -59,7 +59,13 @@ def main():
     RESULTS["device"] = str(jax.devices()[0].device_kind)
     _save()
 
-    from paddle_tpu.kernels import flash_attention as fa
+    # NOTE: `from paddle_tpu.kernels import flash_attention` binds the
+    # FUNCTION re-exported by kernels/__init__.py, not the module —
+    # import the module explicitly (r4 TPU run: every fa._* lookup
+    # failed with AttributeError on the function object)
+    import importlib
+
+    fa = importlib.import_module("paddle_tpu.kernels.flash_attention")
     from paddle_tpu.kernels.layer_norm import fused_layer_norm
     from paddle_tpu.kernels.softmax_xent import fused_softmax_xent
 
@@ -172,8 +178,9 @@ def main():
             return (x - m) * jax.lax.rsqrt(v + 1e-5) * g + b
 
         for name, fn in (
+                # fused_layer_norm returns y only — no tuple to index
                 ("layer_norm_pallas",
-                 jax.jit(lambda x, g, b: fused_layer_norm(x, g, b, 1e-5)[0])),
+                 jax.jit(lambda x, g, b: fused_layer_norm(x, g, b, 1e-5))),
                 ("layer_norm_xla", jax.jit(ln_xla))):
             try:
                 ms, cs = bench(fn, (x, gmm, bta))
@@ -192,8 +199,10 @@ def main():
             return jnp.take_along_axis(lse - s, lbl, 1)
 
         for name, fn in (
+                # kernel takes labels [R] (not [R,1]) and returns the
+                # per-row loss vector
                 ("softmax_xent_pallas",
-                 jax.jit(lambda s, l: fused_softmax_xent(s, l)[0])),
+                 jax.jit(lambda s, l: fused_softmax_xent(s, l[:, 0]))),
                 ("softmax_xent_xla", jax.jit(sx_xla))):
             try:
                 ms, cs = bench(fn, (logits, labels))

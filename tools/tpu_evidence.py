@@ -84,6 +84,11 @@ def _once():
     except subprocess.TimeoutExpired:
         _log_probe("cycle=HARD_TIMEOUT (orchestrator overran)")
         return 2
+    # keep the last cycle's full stderr for diagnosis — stage errors
+    # only live there when the cycle still produced a capture
+    with open(os.path.join(HERE, ".bench_evidence",
+                           "last_cycle_stderr.log"), "w") as f:
+        f.write(proc.stderr[-20000:])
     rec = None
     for line in proc.stdout.splitlines():
         if line.startswith("{"):
