@@ -35,7 +35,9 @@ def _restore_captured_fds():
             except OSError:
                 continue
             writable = (flags & os.O_ACCMODE) in (os.O_WRONLY, os.O_RDWR)
-            deleted_tmp = tgt.startswith("/tmp/#")
+            # capture tmpfiles show as deleted (O_TMPFILE "/tmp/#..."
+            # or unlinked "/tmp/tmpXXX (deleted)") — exclude both forms
+            deleted_tmp = tgt.startswith("/tmp/#") or tgt.endswith("(deleted)")
             plausible = tgt.startswith(("pipe:", "socket:", "/dev/", "/"))
             if writable and plausible and not deleted_tmp:
                 fds.append(fd)

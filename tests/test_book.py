@@ -214,3 +214,101 @@ def test_book_small_transformer_lm():
                 first = float(l)
         final = float(l)
     assert final < 0.7 < first, (first, final)  # uniform = log(16)=2.77
+
+
+def test_book_understand_sentiment_lstm():
+    """Reference book test_understand_sentiment.py (stacked-LSTM net on
+    IMDB): embedding -> fc -> dynamic_lstm -> max-pool -> classifier.
+    Synthetic rule: a review is positive iff it contains more tokens
+    from the first half of the vocab — learnable through the embedding
+    and pooling, impossible for a bias-only model."""
+    vocab, T, emb_dim, H = 40, 12, 16, 24
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 5
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data("x", [T, 1], dtype="int64")
+        y = fluid.layers.data("y", [1], dtype="int64")
+        emb = fluid.layers.embedding(x, size=[vocab, emb_dim])   # [B,T,E]
+        fc = fluid.layers.fc(emb, H, num_flatten_dims=2)
+        hidden, _ = fluid.layers.dynamic_lstm(fc, H)
+        pooled = fluid.layers.sequence_pool(hidden, pool_type="max")
+        logits = fluid.layers.fc(pooled, 2)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, y))
+        acc = fluid.layers.accuracy(fluid.layers.softmax(logits), y)
+        fluid.optimizer.Adam(5e-3).minimize(loss)
+
+    rng = np.random.RandomState(3)
+
+    def batch(n=32):
+        t = rng.randint(0, vocab, (n, T, 1)).astype("int64")
+        lab = (np.sum(t[:, :, 0] < vocab // 2, axis=1) > T // 2)
+        return {"x": t, "y": lab.astype("int64")[:, None]}
+
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        first = None
+        for _ in range(120):
+            l, a = exe.run(main, feed=batch(), fetch_list=[loss, acc])
+            if first is None:
+                first = float(np.asarray(l))
+        final, final_acc = float(np.asarray(l)), float(np.asarray(a))
+    assert final < 0.45 < first, (first, final)
+    assert final_acc > 0.8, final_acc
+
+
+def test_book_label_semantic_roles_crf():
+    """Reference book test_label_semantic_roles.py: per-token tagging
+    trained with linear_chain_crf NLL, decoded with crf_decoding.
+    Synthetic rule: tag = (token + 1) % C — recoverable from emissions,
+    so the trained model must decode >=90% of tags correctly."""
+    vocab, T, C, emb_dim = 30, 10, 6, 16
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 11
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data("x", [T, 1], dtype="int64")
+        lbl = fluid.layers.data("lbl", [T], dtype="int64")
+        emb = fluid.layers.embedding(x, size=[vocab, emb_dim])
+        emission = fluid.layers.fc(emb, C, num_flatten_dims=2)
+        trans = fluid.layers.create_parameter([C + 2, C], "float32",
+                                              name="crfw")
+        *_, nll = fluid.layers.linear_chain_crf(emission, lbl, trans)
+        loss = fluid.layers.mean(nll)
+        fluid.optimizer.Adam(1e-2).minimize(loss)
+
+    infer = fluid.Program()
+    with fluid.program_guard(infer, fluid.Program()), \
+            fluid.unique_name.guard():
+        xi = fluid.layers.data("x", [T, 1], dtype="int64")
+        embi = fluid.layers.embedding(xi, size=[vocab, emb_dim])
+        emi = fluid.layers.fc(embi, C, num_flatten_dims=2)
+        transi = fluid.layers.create_parameter([C + 2, C], "float32",
+                                               name="crfw")
+        path = fluid.layers.crf_decoding(emi, transi)
+
+    rng = np.random.RandomState(4)
+
+    def batch(n=24):
+        t = rng.randint(0, vocab, (n, T, 1)).astype("int64")
+        tags = ((t[:, :, 0] + 1) % C).astype("int64")
+        return {"x": t, "lbl": tags}
+
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        first = None
+        for _ in range(150):
+            (l,) = exe.run(main, feed=batch(), fetch_list=[loss])
+            if first is None:
+                first = float(np.asarray(l))
+        final = float(np.asarray(l))
+        # decode with the TRAINED weights (infer program shares names
+        # through the scope, reference book pattern)
+        fd = batch(32)
+        (got,) = exe.run(infer, feed={"x": fd["x"]}, fetch_list=[path])
+    assert final < first * 0.3, (first, final)
+    accuracy = float(np.mean(np.asarray(got) == fd["lbl"]))
+    assert accuracy > 0.9, accuracy
