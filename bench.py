@@ -106,8 +106,15 @@ MULTI_STAGES = [
          flash=True, est=220, tag="gpt512"),
     dict(kind="resnet", model="resnet50", batch=64, seq=224, steps=10,
          warmup=2, flash=False, est=220, tag="resnet"),
+    # headline config at batch 32: bigger MXU tiles per dispatch; LAST
+    # so the distinct-model evidence stages never get starved under the
+    # driver's 850s budget (it fits in the 2400s evidence-loop cycles)
+    dict(kind="bert", model="base", batch=32, seq=512, steps=20, warmup=2,
+         flash=True, est=240, tag="headline32"),
 ]
-# headline pick order for the printed JSON line (others go in "extra")
+# headline pick order for the printed JSON line (others go in "extra");
+# "headline32" never appears here — the orchestrator merges it into
+# "headline" (keeping the faster row) before this scan
 HEADLINE_PRIORITY = ["headline", "bert128", "canary", "gpt512", "resnet"]
 IMPORT_BUDGET_S = 150  # jax import incl. relay dial; wedged = hung here
 
@@ -571,6 +578,21 @@ def _orchestrate():
         rows = []
     if rows:
         by_tag = {r.get("tag"): r for r in rows if "error" not in r}
+        # the two bert-512 batch variants measure the same config: keep
+        # whichever achieved more tokens/s as THE headline (mutate tags
+        # in place — `extra` selection below relies on row identity)
+        if "headline" in by_tag and "headline32" in by_tag:
+            best = max((by_tag["headline32"], by_tag["headline"]),
+                       key=lambda r: r.get("value", 0))
+            loser = (by_tag["headline"] if best is by_tag["headline32"]
+                     else by_tag["headline32"])
+            loser["tag"] = "headline_other_batch"
+            best["tag"] = "headline"
+            by_tag.pop("headline32")
+            by_tag["headline"] = best
+        elif "headline32" in by_tag:
+            by_tag["headline"] = by_tag.pop("headline32")
+            by_tag["headline"]["tag"] = "headline"
         headline = next(by_tag[t] for t in HEADLINE_PRIORITY if t in by_tag)
         extra = [r for r in rows if r is not headline]
         if extra:

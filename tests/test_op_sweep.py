@@ -532,6 +532,11 @@ NOOP_OPS = ["delete_var",  # scope-level free; nothing to lower (dist_compute.py
 # ops with dedicated tests elsewhere in the suite (regenerate with
 # paddle_tpu.core.registry.exercised_ops() after a full run)
 COVERED_ELSEWHERE = {
+    # round-4 loop-oracle tier (tests/test_detection_hard.py):
+    # deterministic sub-cases where the reference's random subsampling
+    # is the identity
+    'generate_proposals', 'rpn_target_assign',
+    'retinanet_detection_output', 'yolov3_loss',
     # round-4 dedicated tier (test_random_ops_statistics,
     # test_nce_recomputed_from_its_own_samples below)
     'gaussian_random_batch_size_like', 'uniform_random_batch_size_like',
@@ -2369,11 +2374,11 @@ def test_verified_tier_is_at_least_80_percent():
     verified = (COVERED_ELSEWHERE | (set(ORACLES) & set(SPECS))
                 | set(NOOP_OPS)) & fwd
     frac = len(verified) / len(fwd)
-    # round-4 ratchet (verdict next-step #5): 80% -> 95%. The remaining
-    # tail is the sampling-heavy detection redesigns (generate_proposals,
-    # rpn_target_assign, retinanet_detection_output, yolov3_loss).
-    assert frac >= 0.95, (
-        f"verified tier {len(verified)}/{len(fwd)} = {frac:.1%} < 95% — "
+    # round-4 ratchet (verdict next-step #5): 80% -> 95% -> 100% once
+    # the detection loop-oracles (tests/test_detection_hard.py) closed
+    # the sampling-heavy tail.
+    assert frac >= 1.0, (
+        f"verified tier {len(verified)}/{len(fwd)} = {frac:.1%} < 100% — "
         "add numpy oracles to ORACLES or dedicated tests")
     # hygiene: every oracle key must be a real spec (else it's dead)
     dead = sorted(set(ORACLES) - set(SPECS))
