@@ -106,11 +106,14 @@ MULTI_STAGES = [
          flash=True, est=220, tag="gpt512"),
     dict(kind="resnet", model="resnet50", batch=64, seq=224, steps=10,
          warmup=2, flash=False, est=220, tag="resnet"),
-    # headline config at batch 32: bigger MXU tiles per dispatch; LAST
-    # so the distinct-model evidence stages never get starved under the
-    # driver's 850s budget (it fits in the 2400s evidence-loop cycles)
+    # extra-budget stages (2400s evidence-loop cycles only; the
+    # driver's 850s run exhausts its budget above, by design):
+    # headline at batch 32 — bigger MXU tiles per dispatch — and
+    # ResNet-50 in NHWC, the TPU-native conv layout
     dict(kind="bert", model="base", batch=32, seq=512, steps=20, warmup=2,
          flash=True, est=240, tag="headline32"),
+    dict(kind="resnet", model="resnet50_nhwc", batch=64, seq=224, steps=10,
+         warmup=2, flash=False, est=220, tag="resnet_nhwc"),
 ]
 # headline pick order for the printed JSON line (others go in "extra");
 # "headline32" never appears here — the orchestrator merges it into
@@ -153,8 +156,11 @@ def _build_gpt(fluid, cfg_name, seq, opt):
 def _build_resnet(fluid, cfg_name, image_size, opt):
     from paddle_tpu.models.resnet import build_resnet50
 
+    # "resnet50_nhwc" runs every conv/bn/pool in the TPU-native layout
+    fmt = "NHWC" if cfg_name.endswith("_nhwc") else "NCHW"
     main_prog, startup, feeds, fetches = build_resnet50(
-        num_classes=1000, image_size=image_size, optimizer=opt)
+        num_classes=1000, image_size=image_size, optimizer=opt,
+        data_format=fmt)
     return main_prog, startup, fetches["loss"], None
 
 
@@ -593,7 +599,12 @@ def _orchestrate():
         elif "headline32" in by_tag:
             by_tag["headline"] = by_tag.pop("headline32")
             by_tag["headline"]["tag"] = "headline"
-        headline = next(by_tag[t] for t in HEADLINE_PRIORITY if t in by_tag)
+        headline = next((by_tag[t] for t in HEADLINE_PRIORITY if t in by_tag),
+                        None)
+        if headline is None:
+            # a stage outside the priority list (e.g. resnet_nhwc) was
+            # the only survivor — still a real TPU row, still evidence
+            headline = max(by_tag.values(), key=lambda r: r.get("value", 0))
         extra = [r for r in rows if r is not headline]
         if extra:
             headline = dict(headline, extra=extra)

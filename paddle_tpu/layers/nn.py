@@ -247,7 +247,13 @@ def conv2d(
     helper = LayerHelper(
         "conv2d", param_attr=param_attr, bias_attr=bias_attr, act=act, name=name
     )
-    n, c, h, w_ = input.shape
+    if data_format not in ("NCHW", "NHWC"):
+        raise ValueError(f"conv2d: data_format must be NCHW/NHWC, "
+                         f"got {data_format!r}")
+    if data_format == "NCHW":
+        n, c, h, w_ = input.shape
+    else:
+        n, h, w_, c = input.shape
     fs = filter_size if isinstance(filter_size, (list, tuple)) else [filter_size] * 2
     st = stride if isinstance(stride, (list, tuple)) else [stride] * 2
     pd = padding if isinstance(padding, (list, tuple)) else [padding] * 2
@@ -260,12 +266,10 @@ def conv2d(
         input.dtype,
         default_initializer=NormalInitializer(0.0, std),
     )
-    out_shape = (
-        n,
-        num_filters,
-        _conv_out_size(h, fs[0], pd[0], st[0], dl[0]),
-        _conv_out_size(w_, fs[1], pd[1], st[1], dl[1]),
-    )
+    oh = _conv_out_size(h, fs[0], pd[0], st[0], dl[0])
+    ow = _conv_out_size(w_, fs[1], pd[1], st[1], dl[1])
+    out_shape = ((n, num_filters, oh, ow) if data_format == "NCHW"
+                 else (n, oh, ow, num_filters))
     out = _out(helper, input, shape=out_shape)
     helper.append_op(
         type="conv2d",
@@ -276,6 +280,7 @@ def conv2d(
             "paddings": list(pd),
             "dilations": list(dl),
             "groups": groups,
+            "data_format": data_format,
         },
     )
     if helper.bias_attr is not False:
@@ -287,7 +292,7 @@ def conv2d(
             type="elementwise_add",
             inputs={"X": [out], "Y": [b]},
             outputs={"Out": [out2]},
-            attrs={"axis": 1},
+            attrs={"axis": 1 if data_format == "NCHW" else 3},
         )
         out = out2
     return helper.append_activation(out)
@@ -354,21 +359,26 @@ def pool2d(
     ceil_mode=False,
     name=None,
     exclusive=True,
+    data_format="NCHW",
 ):
     helper = LayerHelper("pool2d", name=name)
-    n, c, h, w_ = input.shape
+    if data_format not in ("NCHW", "NHWC"):
+        raise ValueError(f"pool2d: data_format must be NCHW/NHWC, "
+                         f"got {data_format!r}")
+    if data_format == "NCHW":
+        n, c, h, w_ = input.shape
+    else:
+        n, h, w_, c = input.shape
     ks = pool_size if isinstance(pool_size, (list, tuple)) else [pool_size] * 2
     st = pool_stride if isinstance(pool_stride, (list, tuple)) else [pool_stride] * 2
     pd = pool_padding if isinstance(pool_padding, (list, tuple)) else [pool_padding] * 2
     if global_pooling:
-        out_shape = (n, c, 1, 1)
+        out_shape = (n, c, 1, 1) if data_format == "NCHW" else (n, 1, 1, c)
     else:
-        out_shape = (
-            n,
-            c,
-            _conv_out_size(h, ks[0], pd[0], st[0]),
-            _conv_out_size(w_, ks[1], pd[1], st[1]),
-        )
+        oh = _conv_out_size(h, ks[0], pd[0], st[0])
+        ow = _conv_out_size(w_, ks[1], pd[1], st[1])
+        out_shape = ((n, c, oh, ow) if data_format == "NCHW"
+                     else (n, oh, ow, c))
     out = _out(helper, input, shape=out_shape)
     helper.append_op(
         type="pool2d",
@@ -382,6 +392,7 @@ def pool2d(
             "global_pooling": global_pooling,
             "ceil_mode": ceil_mode,
             "exclusive": exclusive,
+            "data_format": data_format,
         },
     )
     return out

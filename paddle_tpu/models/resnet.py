@@ -1,5 +1,9 @@
 """ResNet-50 (BASELINE config 2). Reference model shape:
-tests/unittests/dist_se_resnext.py + book image-classification tests."""
+tests/unittests/dist_se_resnext.py + book image-classification tests.
+
+data_format="NHWC" runs every conv/bn/pool in the TPU-native layout
+(trailing channels tile onto vector lanes without relayouts); the feed
+contract stays NCHW — the one transpose happens on the input image."""
 
 from __future__ import annotations
 
@@ -8,11 +12,13 @@ from ..core.framework import Program, program_guard
 from ..param_attr import ParamAttr
 
 
-def _conv_bn(x, num_filters, filter_size, stride=1, act="relu", name=""):
+def _conv_bn(x, num_filters, filter_size, stride=1, act="relu", name="",
+             fmt="NCHW"):
     conv = layers.conv2d(
         x, num_filters, filter_size, stride=stride,
         padding=(filter_size - 1) // 2, bias_attr=False,
         param_attr=ParamAttr(name=f"{name}.conv.w"),
+        data_format=fmt,
     )
     return layers.batch_norm(
         conv, act=act,
@@ -20,34 +26,48 @@ def _conv_bn(x, num_filters, filter_size, stride=1, act="relu", name=""):
         bias_attr=ParamAttr(name=f"{name}.bn.bias"),
         moving_mean_name=f"{name}.bn.mean",
         moving_variance_name=f"{name}.bn.var",
+        data_layout=fmt,
     )
 
 
-def _bottleneck(x, num_filters, stride, name):
-    conv0 = _conv_bn(x, num_filters, 1, act="relu", name=f"{name}.b0")
-    conv1 = _conv_bn(conv0, num_filters, 3, stride=stride, act="relu", name=f"{name}.b1")
-    conv2 = _conv_bn(conv1, num_filters * 4, 1, act=None, name=f"{name}.b2")
-    if stride != 1 or x.shape[1] != num_filters * 4:
-        short = _conv_bn(x, num_filters * 4, 1, stride=stride, act=None, name=f"{name}.sc")
+def _bottleneck(x, num_filters, stride, name, fmt="NCHW"):
+    ch_axis = 1 if fmt == "NCHW" else 3
+    conv0 = _conv_bn(x, num_filters, 1, act="relu", name=f"{name}.b0",
+                     fmt=fmt)
+    conv1 = _conv_bn(conv0, num_filters, 3, stride=stride, act="relu",
+                     name=f"{name}.b1", fmt=fmt)
+    conv2 = _conv_bn(conv1, num_filters * 4, 1, act=None, name=f"{name}.b2",
+                     fmt=fmt)
+    if stride != 1 or x.shape[ch_axis] != num_filters * 4:
+        short = _conv_bn(x, num_filters * 4, 1, stride=stride, act=None,
+                         name=f"{name}.sc", fmt=fmt)
     else:
         short = x
     return layers.relu(layers.elementwise_add(short, conv2))
 
 
-def build_resnet50(num_classes=1000, image_size=224, optimizer=None):
+def build_resnet50(num_classes=1000, image_size=224, optimizer=None,
+                   data_format="NCHW"):
+    fmt = data_format
     main, startup = Program(), Program()
     with program_guard(main, startup):
         img = layers.data("image", [3, image_size, image_size])
         label = layers.data("label", [1], dtype="int64")
-        x = _conv_bn(img, 64, 7, stride=2, name="stem")
-        x = layers.pool2d(x, 3, "max", pool_stride=2, pool_padding=1)
+        x = img
+        if fmt == "NHWC":
+            x = layers.transpose(x, [0, 2, 3, 1])
+        x = _conv_bn(x, 64, 7, stride=2, name="stem", fmt=fmt)
+        x = layers.pool2d(x, 3, "max", pool_stride=2, pool_padding=1,
+                          data_format=fmt)
         depth = [3, 4, 6, 3]
         filters = [64, 128, 256, 512]
         for stage, (d, f) in enumerate(zip(depth, filters)):
             for blk in range(d):
                 stride = 2 if blk == 0 and stage > 0 else 1
-                x = _bottleneck(x, f, stride, name=f"s{stage}b{blk}")
-        pool = layers.pool2d(x, 7, "avg", global_pooling=True)
+                x = _bottleneck(x, f, stride, name=f"s{stage}b{blk}",
+                                fmt=fmt)
+        pool = layers.pool2d(x, 7, "avg", global_pooling=True,
+                             data_format=fmt)
         logits = layers.fc(pool, num_classes, param_attr=ParamAttr(name="head.w"))
         loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
         acc = layers.accuracy(layers.softmax(logits), label)

@@ -27,11 +27,16 @@ def _pair(v):
 
 @register_op("conv2d", inputs=("Input", "Filter", "Bias"), outputs=("Output",))
 def _conv2d(ctx, op, ins):
+    """Reference conv_op.cc (+ conv_cudnn): NCHW and NHWC data_format
+    (filters stay OIHW in both — the reference's layout). NHWC is the
+    TPU-native layout: XLA tiles the trailing C dim onto lanes without
+    the relayout transposes NCHW convs need."""
     x, w = ins["Input"][0], ins["Filter"][0]
     strides = _pair(op.attrs.get("strides", [1, 1]))
     paddings = _pair(op.attrs.get("paddings", [0, 0]))
     dilations = _pair(op.attrs.get("dilations", [1, 1]))
     groups = int(op.attrs.get("groups", 1))
+    fmt = op.attrs.get("data_format", "NCHW")
     algo = op.attrs.get("padding_algorithm", "EXPLICIT")
     if algo == "SAME":
         pad = "SAME"
@@ -49,10 +54,11 @@ def _conv2d(ctx, op, ins):
         padding=pad,
         rhs_dilation=dilations,
         feature_group_count=groups,
-        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        dimension_numbers=(fmt, "OIHW", fmt),
     )
     if ins.get("Bias"):
-        out = out + ins["Bias"][0].reshape((1, -1, 1, 1))
+        bshape = (1, -1, 1, 1) if fmt == "NCHW" else (1, 1, 1, -1)
+        out = out + ins["Bias"][0].reshape(bshape)
     return {"Output": [out]}
 
 
@@ -128,24 +134,42 @@ def _pool2d(ctx, op, ins):
     ksize = _pair(op.attrs.get("ksize", [2, 2]))
     strides = _pair(op.attrs.get("strides", [2, 2]))
     paddings = _pair(op.attrs.get("paddings", [0, 0]))
+    fmt = op.attrs.get("data_format", "NCHW")
+    hw = (2, 3) if fmt == "NCHW" else (1, 2)
     if op.attrs.get("global_pooling", False) or op.attrs.get("adaptive", False) and all(
         k == 1 for k in _pair(op.attrs.get("ksize", [1, 1]))
     ):
         if op.attrs.get("global_pooling", False):
-            ksize = [x.shape[2], x.shape[3]]
+            ksize = [x.shape[hw[0]], x.shape[hw[1]]]
             strides = ksize
             paddings = [0, 0]
     if op.attrs.get("adaptive", False):
         # adaptive pooling: output size = ksize; use exact reshape-mean
         oh, ow = ksize
-        n, c, h, w = x.shape
-        assert h % oh == 0 and w % ow == 0, "adaptive pool needs divisible sizes"
-        xr = x.reshape(n, c, oh, h // oh, ow, w // ow)
-        out = jnp.max(xr, axis=(3, 5)) if ptype == "max" else jnp.mean(xr, axis=(3, 5))
+        if fmt == "NCHW":
+            n, c, h, w = x.shape
+            assert h % oh == 0 and w % ow == 0, \
+                "adaptive pool needs divisible sizes"
+            xr = x.reshape(n, c, oh, h // oh, ow, w // ow)
+            red = (3, 5)
+        else:
+            n, h, w, c = x.shape
+            assert h % oh == 0 and w % ow == 0, \
+                "adaptive pool needs divisible sizes"
+            xr = x.reshape(n, oh, h // oh, ow, w // ow, c)
+            red = (2, 4)
+        out = jnp.max(xr, axis=red) if ptype == "max" else jnp.mean(xr, axis=red)
         return {"Out": [out]}
-    window = (1, 1, ksize[0], ksize[1])
-    strd = (1, 1, strides[0], strides[1])
-    pads = ((0, 0), (0, 0), (paddings[0], paddings[0]), (paddings[1], paddings[1]))
+    if fmt == "NCHW":
+        window = (1, 1, ksize[0], ksize[1])
+        strd = (1, 1, strides[0], strides[1])
+        pads = ((0, 0), (0, 0), (paddings[0], paddings[0]),
+                (paddings[1], paddings[1]))
+    else:
+        window = (1, ksize[0], ksize[1], 1)
+        strd = (1, strides[0], strides[1], 1)
+        pads = ((0, 0), (paddings[0], paddings[0]),
+                (paddings[1], paddings[1]), (0, 0))
     if ptype == "max":
         init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
         out = jax.lax.reduce_window(x, init, jax.lax.max, window, strd, pads)
