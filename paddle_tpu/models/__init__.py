@@ -17,4 +17,5 @@ from .gpt import (
 )
 from .seq2seq import build_seq2seq, beam_search_infer
 from .ctr import build_deepfm, build_wide_deep, synthetic_ctr_batch
+from .vision import build_vgg, build_se_resnext
 from .ssd import build_ssd, multi_box_head, ssd_loss, detection_output

@@ -13,10 +13,12 @@ from ..param_attr import ParamAttr
 
 
 def _conv_bn(x, num_filters, filter_size, stride=1, act="relu", name="",
-             fmt="NCHW"):
+             fmt="NCHW", groups=1):
+    """conv(no bias) + batch_norm, layout-aware. Shared by the resnet /
+    vgg / se_resnext builders (models/vision.py imports it)."""
     conv = layers.conv2d(
         x, num_filters, filter_size, stride=stride,
-        padding=(filter_size - 1) // 2, bias_attr=False,
+        padding=(filter_size - 1) // 2, bias_attr=False, groups=groups,
         param_attr=ParamAttr(name=f"{name}.conv.w"),
         data_format=fmt,
     )

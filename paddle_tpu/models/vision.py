@@ -1,0 +1,131 @@
+"""VGG + SE-ResNeXt — the other two conv families the reference's
+book/dist tests train (book/test_image_classification.py vgg16;
+tests/unittests/dist_se_resnext.py SE-ResNeXt-50).
+
+Both support data_format="NHWC" (TPU-native layout) like resnet.py;
+the feed contract stays NCHW with one input transpose.
+"""
+
+from __future__ import annotations
+
+from .. import layers
+from ..core.framework import Program, program_guard
+from ..param_attr import ParamAttr
+from .resnet import _conv_bn as _resnet_conv_bn
+
+
+def _ch(x, fmt):
+    return x.shape[1] if fmt == "NCHW" else x.shape[3]
+
+
+def build_vgg(num_classes=10, image_size=32, optimizer=None, depth=11,
+              data_format="NCHW"):
+    """VGG-{11,13,16,19} with batch norm (reference book
+    test_image_classification.py `vgg16_bn_drop`)."""
+    cfgs = {
+        11: [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+        13: [64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M",
+             512, 512, "M"],
+        16: [64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+             512, 512, 512, "M", 512, 512, 512, "M"],
+        19: [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+             512, 512, 512, 512, "M", 512, 512, 512, 512, "M"],
+    }
+    fmt = data_format
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        img = layers.data("image", [3, image_size, image_size])
+        label = layers.data("label", [1], dtype="int64")
+        x = img
+        if fmt == "NHWC":
+            x = layers.transpose(x, [0, 2, 3, 1])
+        i = 0
+        for v in cfgs[depth]:
+            if v == "M":
+                x = layers.pool2d(x, 2, "max", pool_stride=2,
+                                  data_format=fmt)
+                continue
+            x = layers.conv2d(
+                x, v, 3, padding=1, bias_attr=False,
+                param_attr=ParamAttr(name=f"vgg.c{i}.w"), data_format=fmt)
+            x = layers.batch_norm(
+                x, act="relu", data_layout=fmt,
+                param_attr=ParamAttr(name=f"vgg.bn{i}.s"),
+                bias_attr=ParamAttr(name=f"vgg.bn{i}.b"),
+                moving_mean_name=f"vgg.bn{i}.m",
+                moving_variance_name=f"vgg.bn{i}.v")
+            i += 1
+        x = layers.dropout(x, 0.5)
+        h = layers.fc(x, 512, act="relu", param_attr=ParamAttr(name="fc1.w"))
+        h = layers.dropout(h, 0.5)
+        logits = layers.fc(h, num_classes, param_attr=ParamAttr(name="fc2.w"))
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+        acc = layers.accuracy(layers.softmax(logits), label)
+        if optimizer is not None:
+            optimizer.minimize(loss)
+    return main, startup, {"image": img, "label": label}, {"loss": loss,
+                                                           "acc": acc}
+
+
+def _squeeze_excite(x, reduction, name, fmt):
+    c = _ch(x, fmt)
+    pool = layers.pool2d(x, 1, "avg", global_pooling=True, data_format=fmt)
+    sq = layers.fc(pool, max(c // reduction, 4), act="relu",
+                   param_attr=ParamAttr(name=f"{name}.sq.w"))
+    ex = layers.fc(sq, c, act="sigmoid",
+                   param_attr=ParamAttr(name=f"{name}.ex.w"))
+    # [B, C] gate reshaped to rank 4 at the layout's channel position
+    ex4 = layers.reshape(ex, [-1, c, 1, 1] if fmt == "NCHW"
+                         else [-1, 1, 1, c])
+    return layers.elementwise_mul(x, ex4, axis=0)
+
+
+def _conv_bn(x, nf, fs, stride, act, name, fmt, groups=1):
+    return _resnet_conv_bn(x, nf, fs, stride=stride, act=act, name=name,
+                           fmt=fmt, groups=groups)
+
+
+def _sex_block(x, nf, stride, cardinality, reduction, name, fmt):
+    """SE-ResNeXt bottleneck: grouped 3x3 + squeeze-excite + shortcut
+    (reference dist_se_resnext.py bottleneck_block)."""
+    conv0 = _conv_bn(x, nf, 1, 1, "relu", f"{name}.c0", fmt)
+    conv1 = _conv_bn(conv0, nf, 3, stride, "relu", f"{name}.c1", fmt,
+                     groups=cardinality)
+    conv2 = _conv_bn(conv1, nf * 2, 1, 1, None, f"{name}.c2", fmt)
+    scaled = _squeeze_excite(conv2, reduction, f"{name}.se", fmt)
+    if stride != 1 or _ch(x, fmt) != nf * 2:
+        short = _conv_bn(x, nf * 2, 1, stride, None, f"{name}.sc", fmt)
+    else:
+        short = x
+    return layers.relu(layers.elementwise_add(short, scaled))
+
+
+def build_se_resnext(num_classes=10, image_size=32, optimizer=None,
+                     depth=(1, 1, 1), filters=(64, 128, 256),
+                     cardinality=8, reduction=16, data_format="NCHW"):
+    """SE-ResNeXt; default depth is the CI-sized variant (the reference
+    dist test also shrinks it — full 50-layer = depth (3,4,6,3))."""
+    fmt = data_format
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        img = layers.data("image", [3, image_size, image_size])
+        label = layers.data("label", [1], dtype="int64")
+        x = img
+        if fmt == "NHWC":
+            x = layers.transpose(x, [0, 2, 3, 1])
+        x = _conv_bn(x, 64, 3, 1, "relu", "stem", fmt)
+        for stage, (d, f) in enumerate(zip(depth, filters)):
+            for blk in range(d):
+                stride = 2 if blk == 0 and stage > 0 else 1
+                x = _sex_block(x, f, stride, cardinality, reduction,
+                               f"s{stage}b{blk}", fmt)
+        pool = layers.pool2d(x, 1, "avg", global_pooling=True,
+                             data_format=fmt)
+        logits = layers.fc(pool, num_classes,
+                           param_attr=ParamAttr(name="head.w"))
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+        acc = layers.accuracy(layers.softmax(logits), label)
+        if optimizer is not None:
+            optimizer.minimize(loss)
+    return main, startup, {"image": img, "label": label}, {"loss": loss,
+                                                           "acc": acc}

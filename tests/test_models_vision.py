@@ -1,0 +1,66 @@
+"""VGG + SE-ResNeXt (reference book/test_image_classification.py and
+tests/unittests/dist_se_resnext.py): train on a separable synthetic
+image rule, loss falls; NHWC variant matches NCHW."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.models.vision import build_se_resnext, build_vgg
+
+
+def _batches(rng, n=16, size=16, classes=4):
+    """class k = bright blob in quadrant k: linearly separable from
+    pooled features, so a few steps must cut the loss."""
+    imgs = rng.randn(n, 3, size, size).astype("float32") * 0.1
+    labels = rng.randint(0, classes, (n, 1)).astype("int64")
+    h = size // 2
+    for i, k in enumerate(labels[:, 0]):
+        r, c = divmod(int(k), 2)
+        imgs[i, :, r * h:(r + 1) * h, c * h:(c + 1) * h] += 1.0
+    return {"image": imgs, "label": labels}
+
+
+def _train(build, steps=25, size=16, **kw):
+    main, startup, feeds, fetches = build(
+        num_classes=4, image_size=size,
+        optimizer=fluid.optimizer.Adam(2e-3), **kw)
+    rng = np.random.RandomState(0)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        first = None
+        for _ in range(steps):
+            (l,) = exe.run(main, feed=_batches(rng, size=size),
+                           fetch_list=[fetches["loss"]])
+            if first is None:
+                first = float(np.asarray(l))
+    return first, float(np.asarray(l))
+
+
+def test_vgg11_trains():
+    # 32px: VGG's five 2x pools need 2^5 of spatial extent
+    first, final = _train(build_vgg, depth=11, size=32)
+    assert final < first * 0.7, (first, final)
+
+
+def test_se_resnext_trains():
+    first, final = _train(build_se_resnext)
+    assert final < first * 0.7, (first, final)
+
+
+def test_se_resnext_nhwc_matches_nchw_first_loss():
+    rng = np.random.RandomState(1)
+    feed = _batches(rng)
+    losses = {}
+    for fmt in ("NCHW", "NHWC"):
+        main, startup, feeds, fetches = build_se_resnext(
+            num_classes=4, image_size=16, data_format=fmt)
+        main.random_seed = startup.random_seed = 9
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            (l,) = exe.run(main, feed=feed, fetch_list=[fetches["loss"]])
+            losses[fmt] = float(np.asarray(l))
+    np.testing.assert_allclose(losses["NCHW"], losses["NHWC"], rtol=2e-5)
