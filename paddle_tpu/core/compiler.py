@@ -94,6 +94,23 @@ class CompiledProgram:
         self._in_shardings = shardings
         return self
 
+    def _axis_mesh(self, axis: str, n: int, dp: int, places):
+        """(dp, <axis>) mesh over the first dp*n devices — the shared
+        construction for the sp / ep variants."""
+        import jax
+        from jax.sharding import Mesh
+        import numpy as np
+
+        devs = np.array(places_to_devices(places) if places else jax.devices())
+        need = n * dp
+        if devs.size < need:
+            raise ValueError(
+                f"{axis} parallel needs dp*{axis}={need} devices, "
+                f"have {devs.size}")
+        if dp > 1:
+            return Mesh(devs[:need].reshape(dp, n), ("dp", axis))
+        return Mesh(devs[:n], (axis,))
+
     def with_sequence_parallel(self, sp: int, dp: int = 1,
                                places=None) -> "CompiledProgram":
         """Sequence (context) parallelism: shard dim 1 — the sequence
@@ -105,20 +122,9 @@ class CompiledProgram:
         sequences far longer than one chip's HBM could hold. Beyond
         the reference (SURVEY §5: it has no long-context parallelism).
         """
-        import jax
-        from jax.sharding import Mesh, PartitionSpec as P
-        import numpy as np
+        from jax.sharding import PartitionSpec as P
 
-        devs = np.array(places_to_devices(places) if places else jax.devices())
-        need = sp * dp
-        if devs.size < need:
-            raise ValueError(
-                f"sequence parallel needs dp*sp={need} devices, "
-                f"have {devs.size}")
-        if dp > 1:
-            self._mesh = Mesh(devs[:need].reshape(dp, sp), ("dp", "sp"))
-        else:
-            self._mesh = Mesh(devs[:sp], ("sp",))
+        self._mesh = self._axis_mesh("sp", sp, dp, places)
         shardings = {}
         for v in self._program.global_block().vars.values():
             if not (getattr(v, "is_data", False) and v.shape):
@@ -133,6 +139,34 @@ class CompiledProgram:
             elif lead:
                 shardings[v.name] = P(
                     *((lead,) + (None,) * (len(v.shape) - 1)))
+        self._in_shardings = shardings
+        return self
+
+    def with_expert_parallel(self, ep: int, dp: int = 1,
+                             places=None) -> "CompiledProgram":
+        """Expert parallelism: shard every switch_moe layer's expert
+        weights (vars tagged _moe_expert_param) over an `ep` mesh axis,
+        optionally combined with batch sharding over `dp`. The
+        switch_moe op detects the ep axis at lowering time (ops/moe.py)
+        and runs each device's local experts inside shard_map, with a
+        psum over `ep` combining token outputs. Beyond the reference
+        (SURVEY §2f: the snapshot has no MoE/EP)."""
+        from jax.sharding import PartitionSpec as P
+
+        self._mesh = self._axis_mesh("ep", ep, dp, places)
+        shardings = {}
+        tagged = 0
+        for v in self._program.global_block().vars.values():
+            if getattr(v, "_moe_expert_param", False):
+                v.sharding = ("ep",) + (None,) * (len(v.shape) - 1)
+                tagged += 1
+            elif getattr(v, "is_data", False) and v.shape and dp > 1:
+                shardings[v.name] = P(
+                    *(("dp",) + (None,) * (len(v.shape) - 1)))
+        if not tagged:
+            raise ValueError(
+                "with_expert_parallel: program has no switch_moe expert "
+                "parameters (layers.switch_moe tags them)")
         self._in_shardings = shardings
         return self
 

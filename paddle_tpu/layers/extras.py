@@ -14,6 +14,7 @@ from ..layer_helper import LayerHelper
 from ..core.framework import unique_name, default_main_program
 
 __all__ = [
+    "switch_moe",
     "Print", "autoincreased_step_counter", "case", "switch_case",
     "while_loop", "IfElse", "ctc_greedy_decoder", "dice_loss", "eye",
     "image_resize_short", "load", "lod_append", "scatter_nd",
@@ -472,3 +473,63 @@ def detection_output(loc, scores, prior_box, prior_box_var=None,
 
 
 __all__ += ["multi_box_head", "ssd_loss", "detection_output"]
+
+
+def switch_moe(input, num_experts, expert_hidden, capacity_factor=1.25,
+               act="gelu", param_attr=None, bias_attr=None, name=None):
+    """Switch-transformer MoE FFN layer (top-1 routing, capacity-bound
+    dispatch). Returns (out, aux_loss): add `aux_coeff * aux_loss` to
+    the training loss for load balancing. Expert weights are tagged so
+    CompiledProgram.with_expert_parallel can shard them over the `ep`
+    mesh axis (ops/moe.py). Beyond the reference (no MoE in the
+    snapshot); API mirrors the layers.fc conventions."""
+    from ..layer_helper import LayerHelper
+    from ..initializer import XavierInitializer, ConstantInitializer
+    from ..param_attr import ParamAttr
+    from .nn import _out
+
+    helper = LayerHelper("switch_moe", param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+
+    def _slot(base, suffix):
+        """Per-slot copy of a user attr: this layer owns FIVE params, a
+        single shared ParamAttr (whose name the first create_parameter
+        fills in) would alias them all through weight sharing."""
+        # bias_attr=False means "no bias" elsewhere; the moe op's
+        # biases are structural, so fall back to the default attr
+        a = ParamAttr._to_attr(base if base is not False else None)
+        a = ParamAttr(**a.__dict__.copy())
+        if a.name is not None:
+            a.name = f"{a.name}.{suffix}"
+        return a
+
+    d = int(input.shape[-1])
+    e, f = int(num_experts), int(expert_hidden)
+    wg = helper.create_parameter(
+        _slot(helper.param_attr, "gate"), [d, e], input.dtype,
+        default_initializer=XavierInitializer())
+    w1 = helper.create_parameter(
+        _slot(helper.param_attr, "w1"), [e, d, f], input.dtype,
+        default_initializer=XavierInitializer())
+    b1 = helper.create_parameter(
+        _slot(helper.bias_attr, "b1"), [e, f], input.dtype, is_bias=True,
+        default_initializer=ConstantInitializer(0.0))
+    w2 = helper.create_parameter(
+        _slot(helper.param_attr, "w2"), [e, f, d], input.dtype,
+        default_initializer=XavierInitializer())
+    b2 = helper.create_parameter(
+        _slot(helper.bias_attr, "b2"), [e, d], input.dtype, is_bias=True,
+        default_initializer=ConstantInitializer(0.0))
+    # with_expert_parallel shards every tagged var's dim 0 over `ep`
+    for v in (w1, b1, w2, b2):
+        v._moe_expert_param = True
+    out = _out(helper, input, shape=input.shape)
+    aux = _out(helper, input, shape=(1,))
+    helper.append_op(
+        type="switch_moe",
+        inputs={"X": [input], "GateW": [wg], "ExpertW1": [w1],
+                "ExpertB1": [b1], "ExpertW2": [w2], "ExpertB2": [b2]},
+        outputs={"Out": [out], "AuxLoss": [aux]},
+        attrs={"capacity_factor": float(capacity_factor), "act": act},
+    )
+    return out, aux

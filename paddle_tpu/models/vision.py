@@ -11,7 +11,7 @@ from __future__ import annotations
 from .. import layers
 from ..core.framework import Program, program_guard
 from ..param_attr import ParamAttr
-from .resnet import _conv_bn as _resnet_conv_bn
+from .resnet import _conv_bn
 
 
 def _ch(x, fmt):
@@ -78,11 +78,6 @@ def _squeeze_excite(x, reduction, name, fmt):
     ex4 = layers.reshape(ex, [-1, c, 1, 1] if fmt == "NCHW"
                          else [-1, 1, 1, c])
     return layers.elementwise_mul(x, ex4, axis=0)
-
-
-def _conv_bn(x, nf, fs, stride, act, name, fmt, groups=1):
-    return _resnet_conv_bn(x, nf, fs, stride=stride, act=act, name=name,
-                           fmt=fmt, groups=groups)
 
 
 def _sex_block(x, nf, stride, cardinality, reduction, name, fmt):

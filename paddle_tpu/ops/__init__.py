@@ -26,3 +26,4 @@ from . import dist_compute  # noqa: F401
 from . import misc  # noqa: F401
 from . import detection2  # noqa: F401
 from . import persist  # noqa: F401
+from . import moe  # noqa: F401

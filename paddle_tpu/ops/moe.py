@@ -1,0 +1,141 @@
+"""Switch-style Mixture-of-Experts with expert parallelism.
+
+Beyond the reference (SURVEY §2f last row names EP as a north-star
+axis; the reference snapshot has no MoE). Design follows the Switch
+Transformer recipe: top-1 routing, capacity-bounded dispatch, and the
+load-balancing auxiliary loss aux = E * sum_e(frac_e * mean_prob_e).
+
+Two lowerings behind ONE op type, selected by the compile mesh (the
+same routing contract as the fused attention op's `sp` axis):
+  - dense: every expert computed on-device; einsum over the expert dim
+    (XLA batches the [E, C, D] x [E, D, F] as one MXU-friendly matmul).
+  - expert-parallel (`ep` mesh axis, CompiledProgram.
+    with_expert_parallel): shard_map shards the expert WEIGHTS and the
+    expert compute over `ep`; each device routes its (optionally
+    dp-sharded) tokens, computes only its local experts, and a psum
+    over `ep` combines contributions. Router stats psum over `dp` so
+    the aux loss matches the unsharded value exactly.
+
+Tokens over capacity C = ceil(T/E * capacity_factor) are dropped
+(pass through with zero expert output), the Switch convention.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+def _moe_math(x2, wg, w1, b1, w2, b2, cap, act, e_first, e_local,
+              dp_axis=None, ep_axis=None):
+    """Core switch-MoE on [T, D] tokens against experts
+    [e_first : e_first + e_local) of the global E.
+
+    Returns (out [T, D] — LOCAL experts' contribution only, aux []).
+    """
+    T, D = x2.shape
+    E = wg.shape[1]
+    logits = x2 @ wg                               # [T, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert = jnp.argmax(probs, axis=-1)            # [T]
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=1)[:, 0]
+    onehot = jax.nn.one_hot(expert, E, dtype=x2.dtype)   # [T, E]
+    # rank of each token within its expert's queue (0-based)
+    pos = (jnp.cumsum(onehot, axis=0) - onehot)
+    pos = jnp.sum(pos * onehot, axis=1).astype(jnp.int32)  # [T]
+    keep = pos < cap
+
+    eloc = expert.astype(jnp.int32) - e_first
+    mine = keep & (eloc >= 0) & (eloc < e_local)
+    ec = jnp.clip(eloc, 0, e_local - 1)
+    pc = jnp.clip(pos, 0, cap - 1)
+    disp = jnp.zeros((e_local, cap, D), x2.dtype)
+    disp = disp.at[ec, pc].add(x2 * mine[:, None].astype(x2.dtype))
+    h = jnp.einsum("ecd,edf->ecf", disp, w1) + b1[:, None, :]
+    h = jax.nn.gelu(h) if act == "gelu" else jax.nn.relu(h)
+    y = jnp.einsum("ecf,efd->ecd", h, w2) + b2[:, None, :]
+    out = y[ec, pc] * (gate * mine.astype(gate.dtype))[:, None]
+
+    # load-balance stats — global over the dp token shards
+    count_e = jnp.sum(onehot, axis=0)              # [E]
+    prob_e = jnp.sum(probs, axis=0)                # [E]
+    t_total = jnp.asarray(T, x2.dtype)
+    if dp_axis is not None:
+        count_e = jax.lax.psum(count_e, dp_axis)
+        prob_e = jax.lax.psum(prob_e, dp_axis)
+        t_total = jax.lax.psum(t_total, dp_axis)
+    aux = E * jnp.sum((count_e / t_total) * (prob_e / t_total))
+    if ep_axis is not None:
+        out = jax.lax.psum(out, ep_axis)
+    return out, aux
+
+
+def _ep_mesh(ctx):
+    mesh = getattr(ctx, "mesh", None)
+    if mesh is None:
+        return None
+    try:
+        if dict(mesh.shape).get("ep", 1) > 1:
+            return mesh
+    except (TypeError, AttributeError):
+        return None
+    return None
+
+
+@register_op(
+    "switch_moe",
+    inputs=("X", "GateW", "ExpertW1", "ExpertB1", "ExpertW2", "ExpertB2"),
+    outputs=("Out", "AuxLoss"),
+)
+def _switch_moe(ctx, op, ins):
+    x = ins["X"][0]
+    wg = ins["GateW"][0]
+    w1, b1 = ins["ExpertW1"][0], ins["ExpertB1"][0]
+    w2, b2 = ins["ExpertW2"][0], ins["ExpertB2"][0]
+    cap_factor = float(op.attrs.get("capacity_factor", 1.25))
+    act = op.attrs.get("act", "gelu")
+    E = int(w1.shape[0])
+    D = x.shape[-1]
+
+    mesh = _ep_mesh(ctx)
+    if mesh is None:
+        x2 = x.reshape(-1, D)
+        T = x2.shape[0]
+        cap = max(int(-(-T * cap_factor // E)), 1)
+        out, aux = _moe_math(x2, wg, w1, b1, w2, b2, cap, act, 0, E)
+        return {"Out": [out.reshape(x.shape)], "AuxLoss": [aux.reshape(1)]}
+
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    axes = dict(mesh.shape)
+    ep = axes["ep"]
+    dp = axes.get("dp", 1)
+    if E % ep:
+        raise ValueError(f"switch_moe: num_experts {E} must divide the "
+                         f"ep axis {ep}")
+    e_local = E // ep
+    dp_axis = "dp" if dp > 1 else None
+    xspec = P(*((("dp",) if dp > 1 else (None,))
+                + (None,) * (len(x.shape) - 1)))
+    espec = P("ep", None, None)
+    bspec = P("ep", None)
+
+    def local_fn(xl, wgl, w1l, b1l, w2l, b2l):
+        x2 = xl.reshape(-1, D)
+        T_local = x2.shape[0]
+        cap = max(int(-(-T_local * cap_factor // E)), 1)
+        e_first = jax.lax.axis_index("ep") * e_local
+        out, aux = _moe_math(x2, wgl, w1l, b1l, w2l, b2l, cap, act,
+                             e_first, e_local, dp_axis=dp_axis,
+                             ep_axis="ep")
+        return out.reshape(xl.shape), aux.reshape(1)
+
+    out, aux = shard_map(
+        local_fn, mesh=mesh,
+        in_specs=(xspec, P(None, None), espec, bspec, espec, bspec),
+        out_specs=(xspec, P()),
+    )(x, wg, w1, b1, w2, b2)
+    return {"Out": [out], "AuxLoss": [aux]}
