@@ -65,6 +65,10 @@ class CompiledProgram:
         self._build_strategy = build_strategy or BuildStrategy()
         self._mesh = None
         self._in_shardings = None
+        # per-STATE-var (parameter) specs for this compile only — kept
+        # here, not on the Program's vars, so one with_* choice can't
+        # poison a later compile of the same program on another mesh
+        self._state_shardings = None
 
     def with_data_parallel(
         self,
@@ -155,19 +159,20 @@ class CompiledProgram:
 
         self._mesh = self._axis_mesh("ep", ep, dp, places)
         shardings = {}
-        tagged = 0
+        state_shardings = {}
         for v in self._program.global_block().vars.values():
             if getattr(v, "_moe_expert_param", False):
-                v.sharding = ("ep",) + (None,) * (len(v.shape) - 1)
-                tagged += 1
+                state_shardings[v.name] = (
+                    ("ep",) + (None,) * (len(v.shape) - 1))
             elif getattr(v, "is_data", False) and v.shape and dp > 1:
                 shardings[v.name] = P(
                     *(("dp",) + (None,) * (len(v.shape) - 1)))
-        if not tagged:
+        if not state_shardings:
             raise ValueError(
                 "with_expert_parallel: program has no switch_moe expert "
                 "parameters (layers.switch_moe tags them)")
         self._in_shardings = shardings
+        self._state_shardings = state_shardings
         return self
 
     def with_pipeline(self, places=None) -> "CompiledProgram":

@@ -385,9 +385,11 @@ class Executor:
 
         mesh = None
         in_shardings = None
+        state_shardings = None
         if isinstance(program, CompiledProgram):
             mesh = program._mesh
             in_shardings = program._in_shardings
+            state_shardings = getattr(program, "_state_shardings", None)
             program = program._program
         if program is None:
             program = framework.default_main_program()
@@ -412,14 +414,21 @@ class Executor:
             program.version,
             feed_sig,
             tuple(fetch_names),
-            mesh is not None,
+            # the mesh SHAPE and sharding choices, not just presence:
+            # the same program compiled dp-then-sp (or with different
+            # expert placements) must not hit the stale executable
+            tuple(sorted(dict(mesh.shape).items())) if mesh is not None
+            else None,
+            tuple(sorted((k, tuple(v)) for k, v in state_shardings.items()))
+            if state_shardings else None,
             flag("check_nan_inf"),
             self.disable_donation,
         )
         compiled = self._cache.get(key) if use_program_cache else None
         if compiled is None:
             compiled = self._compile(
-                program, block, sorted(feed), fetch_names, scope, mesh, in_shardings
+                program, block, sorted(feed), fetch_names, scope, mesh,
+                in_shardings, state_shardings
             )
             if use_program_cache:
                 self._cache[key] = compiled
@@ -553,6 +562,7 @@ class Executor:
         scope: Scope,
         mesh=None,
         in_shardings=None,
+        state_shardings=None,
     ) -> _CompiledBlock:
         state_names, written_names = self._analyze_block(program, block, feed_names)
 
@@ -600,10 +610,14 @@ class Executor:
             in_shardings = in_shardings or {}
 
             def _state_sharding(n):
-                # Variables may carry a PartitionSpec-like annotation
-                # (tuple of axis-name-or-None per dim) — the GSPMD
-                # equivalent of the reference's per-device param
-                # placement (multi_devices_graph_pass var scattering).
+                # Per-compile specs (CompiledProgram._state_shardings,
+                # e.g. with_expert_parallel) take precedence; Variables
+                # may also carry a PartitionSpec-like annotation (tuple
+                # of axis-name-or-None per dim) — the GSPMD equivalent
+                # of the reference's per-device param placement
+                # (multi_devices_graph_pass var scattering).
+                if state_shardings and n in state_shardings:
+                    return NamedSharding(mesh, P(*state_shardings[n]))
                 if block.has_var(n):
                     spec = block.var(n).sharding
                     if spec is not None:

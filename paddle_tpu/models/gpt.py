@@ -33,6 +33,13 @@ class GPTConfig:
     attention_dropout: float = 0.1
     initializer_range: float = 0.02
     use_flash_attention: bool = False
+    # MoE (beyond-reference, SURVEY §2f EP axis): every `moe_every`-th
+    # decoder swaps its dense FFN for a switch-MoE layer (0 = dense).
+    # Train with CompiledProgram.with_expert_parallel to shard experts.
+    moe_every: int = 0
+    moe_experts: int = 8
+    moe_capacity: float = 1.25
+    moe_aux_coeff: float = 0.01
 
     @staticmethod
     def small():
@@ -56,7 +63,8 @@ def _attr(name, std):
     return ParamAttr(name=name, initializer=NormalInitializer(0.0, std))
 
 
-def _decoder_layer(x, cfg: GPTConfig, idx: int, is_test=False):
+def _decoder_layer(x, cfg: GPTConfig, idx: int, is_test=False,
+                   aux_losses=None):
     h = cfg.hidden_size
     std = cfg.initializer_range
     pre = f"dec{idx}"
@@ -94,16 +102,25 @@ def _decoder_layer(x, cfg: GPTConfig, idx: int, is_test=False):
         param_attr=ParamAttr(name=f"{pre}_ln2.scale"),
         bias_attr=ParamAttr(name=f"{pre}_ln2.bias"),
     )
-    ffn1 = layers.fc(
-        ln2, cfg.ffn_size, num_flatten_dims=2, act="gelu",
-        param_attr=_attr(f"{pre}_ffn1.w", std),
-        bias_attr=ParamAttr(name=f"{pre}_ffn1.b"),
-    )
-    ffn2 = layers.fc(
-        ffn1, h, num_flatten_dims=2,
-        param_attr=_attr(f"{pre}_ffn2.w", std),
-        bias_attr=ParamAttr(name=f"{pre}_ffn2.b"),
-    )
+    if cfg.moe_every and (idx + 1) % cfg.moe_every == 0:
+        ffn2, aux = layers.switch_moe(
+            ln2, cfg.moe_experts, cfg.ffn_size,
+            capacity_factor=cfg.moe_capacity,
+            param_attr=ParamAttr(name=f"{pre}_moe"),
+            bias_attr=ParamAttr(name=f"{pre}_moe_b"))
+        if aux_losses is not None:
+            aux_losses.append(aux)
+    else:
+        ffn1 = layers.fc(
+            ln2, cfg.ffn_size, num_flatten_dims=2, act="gelu",
+            param_attr=_attr(f"{pre}_ffn1.w", std),
+            bias_attr=ParamAttr(name=f"{pre}_ffn1.b"),
+        )
+        ffn2 = layers.fc(
+            ffn1, h, num_flatten_dims=2,
+            param_attr=_attr(f"{pre}_ffn2.w", std),
+            bias_attr=ParamAttr(name=f"{pre}_ffn2.b"),
+        )
     if not is_test and cfg.hidden_dropout:
         ffn2 = layers.dropout(ffn2, cfg.hidden_dropout,
                               dropout_implementation="upscale_in_train")
@@ -127,8 +144,10 @@ def build_gpt_lm(cfg: GPTConfig, seq_len: int, optimizer=None, is_test=False):
             param_attr=_attr("gpt_pos_emb", cfg.initializer_range),
         )
         x = layers.elementwise_add(emb, pos)
+        aux_losses = []
         for i in range(cfg.num_layers):
-            x = _decoder_layer(x, cfg, i, is_test=is_test)
+            x = _decoder_layer(x, cfg, i, is_test=is_test,
+                               aux_losses=aux_losses)
         x = layers.layer_norm(
             x, begin_norm_axis=2,
             param_attr=ParamAttr(name="gpt_lnf.scale"),
@@ -144,6 +163,18 @@ def build_gpt_lm(cfg: GPTConfig, seq_len: int, optimizer=None, is_test=False):
                 logits, layers.unsqueeze(labels, [2])
             )
         )
+        if aux_losses and not is_test:
+            # switch-MoE load-balance term (mean over MoE layers) —
+            # train-only: eval loss/perplexity stays the pure LM
+            # objective
+            total_aux = aux_losses[0]
+            for a in aux_losses[1:]:
+                total_aux = layers.elementwise_add(total_aux, a)
+            loss = layers.elementwise_add(
+                layers.reshape(loss, [1]),
+                layers.scale(total_aux,
+                             scale=cfg.moe_aux_coeff / len(aux_losses)))
+            loss = layers.mean(loss)
         if optimizer is not None:
             optimizer.minimize(loss)
     return main, startup, {"tokens": tokens, "labels": labels}, {

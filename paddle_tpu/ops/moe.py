@@ -114,8 +114,8 @@ def _switch_moe(ctx, op, ins):
     ep = axes["ep"]
     dp = axes.get("dp", 1)
     if E % ep:
-        raise ValueError(f"switch_moe: num_experts {E} must divide the "
-                         f"ep axis {ep}")
+        raise ValueError(f"switch_moe: the ep mesh axis ({ep}) must "
+                         f"divide num_experts ({E})")
     e_local = E // ep
     dp_axis = "dp" if dp > 1 else None
     xspec = P(*((("dp",) if dp > 1 else (None,))

@@ -112,3 +112,35 @@ def test_switch_moe_user_param_attr_names():
                                 bias_attr=fluid.ParamAttr(name="moeb"))
     names = sorted(p.name for p in main.all_parameters())
     assert names == ["moe.gate", "moe.w1", "moe.w2", "moeb.b1", "moeb.b2"], names
+
+
+def test_gpt_moe_trains_and_ep_parity():
+    """GPT with every-layer switch-MoE FFNs: trains dense, and the
+    ep4-sharded loss equals the dense loss (drop-free capacity)."""
+    from paddle_tpu.models.gpt import GPTConfig, build_gpt_lm, \
+        synthetic_lm_batch
+
+    cfg = GPTConfig.tiny()
+    cfg.moe_every, cfg.moe_experts, cfg.moe_capacity = 1, 4, 8.0
+    batch = synthetic_lm_batch(np.random.RandomState(0), 2, 32,
+                               cfg.vocab_size)
+    losses = {}
+    for mode in ("dense", "ep"):
+        main, startup, feeds, fetches = build_gpt_lm(
+            cfg, 32, optimizer=fluid.optimizer.Adam(1e-3))
+        main.random_seed = startup.random_seed = 7
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.TPUPlace())
+            exe.run(startup)
+            prog = main
+            if mode == "ep":
+                prog = fluid.CompiledProgram(main).with_expert_parallel(
+                    ep=4, places=[fluid.TPUPlace(i) for i in range(4)])
+            ls = [float(np.asarray(exe.run(prog, feed=batch,
+                                           fetch_list=[fetches["loss"]])[0]))
+                  for _ in range(3)]
+        losses[mode] = ls
+    assert losses["dense"][-1] < losses["dense"][0], losses
+    np.testing.assert_allclose(losses["dense"], losses["ep"],
+                               rtol=2e-5, atol=1e-5)
