@@ -75,6 +75,7 @@ def _once():
     env["PT_BENCH_DEADLINE"] = str(CYCLE_DEADLINE)
     env["PT_BENCH_KERNELS"] = "1"       # kernel bench inside the claim
     env["PT_BENCH_CPU_FALLBACK"] = "0"  # relay-down cycles just log
+    env["PT_BENCH_IMPORT_BUDGET"] = "420"  # patient: see bench.py note
     try:
         proc = subprocess.run(
             [sys.executable, os.path.join(HERE, "bench.py")],
