@@ -532,6 +532,9 @@ NOOP_OPS = ["delete_var",  # scope-level free; nothing to lower (dist_compute.py
 # ops with dedicated tests elsewhere in the suite (regenerate with
 # paddle_tpu.core.registry.exercised_ops() after a full run)
 COVERED_ELSEWHERE = {
+    # round-4 MoE (tests/test_moe.py: dense training, ep parity,
+    # capacity drops, gpt integration)
+    'switch_moe',
     # round-4 loop-oracle tier (tests/test_detection_hard.py):
     # deterministic sub-cases where the reference's random subsampling
     # is the identity
