@@ -532,22 +532,45 @@ def _best_cached_tpu_row():
     loop's captures): headline-priority tag first, then value."""
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "BENCH_TPU_EVIDENCE.json")
+    import datetime
+
     try:
         with open(path) as f:
             hist = json.load(f)
-    except (OSError, json.JSONDecodeError):
+        now = datetime.datetime.now(datetime.timezone.utc)
+        rows = []
+        for rec in hist if isinstance(hist, list) else []:
+            if not isinstance(rec, dict):
+                continue
+            ts = rec.get("captured_at")
+            # extra rows inherit the capture cycle's timestamp
+            for r in [rec] + [x for x in rec.get("extra", [])
+                              if isinstance(x, dict)]:
+                if (r.get("backend") == "tpu"
+                        and isinstance(r.get("value"), (int, float))):
+                    rows.append((r, r.get("captured_at") or ts))
+        # this ROUND's captures only (the file persists across rounds):
+        # drop rows older than 18h or with no usable timestamp
+        fresh = []
+        for r, ts in rows:
+            try:
+                age = (now - datetime.datetime.strptime(
+                    ts, "%Y-%m-%dT%H:%M:%SZ").replace(
+                        tzinfo=datetime.timezone.utc)).total_seconds()
+            except (TypeError, ValueError):
+                continue
+            if age < 18 * 3600:
+                fresh.append((r, ts))
+        if not fresh:
+            return None
+        rank = {t: i for i, t in enumerate(HEADLINE_PRIORITY)}
+        fresh.sort(key=lambda rt: (rank.get(rt[0].get("tag"), len(rank)),
+                                   -rt[0]["value"]))
+        best, ts = fresh[0]
+        return dict(best, captured_at=ts)
+    except Exception as e:  # noqa: BLE001 — degraded env must not crash
+        sys.stderr.write(f"[bench] cached-row lookup failed: {e}\n")
         return None
-    rows = []
-    for rec in hist if isinstance(hist, list) else []:
-        for r in [rec] + list(rec.get("extra", [])):
-            if r.get("backend") == "tpu" and "value" in r:
-                rows.append(r)
-    if not rows:
-        return None
-    rank = {t: i for i, t in enumerate(HEADLINE_PRIORITY)}
-    rows.sort(key=lambda r: (rank.get(r.get("tag"), len(rank)),
-                             -r.get("value", 0)))
-    return rows[0]
 
 
 def _orchestrate():

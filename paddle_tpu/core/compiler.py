@@ -69,6 +69,8 @@ class CompiledProgram:
         # here, not on the Program's vars, so one with_* choice can't
         # poison a later compile of the same program on another mesh
         self._state_shardings = None
+        # extra lowering-context entries (e.g. sp_mode) for this compile
+        self._axis_env = None
 
     def with_data_parallel(
         self,
@@ -116,18 +118,29 @@ class CompiledProgram:
         return Mesh(devs[:n], (axis,))
 
     def with_sequence_parallel(self, sp: int, dp: int = 1,
-                               places=None) -> "CompiledProgram":
+                               places=None,
+                               mode: str = "ring") -> "CompiledProgram":
         """Sequence (context) parallelism: shard dim 1 — the sequence
         axis of [B, S, ...] data vars — over an `sp` mesh axis,
         optionally combined with batch sharding over `dp`. The fused
         flash_attention op detects the sp axis at lowering time and
-        runs as ring attention (parallel/ring_attention.py): K/V
-        shards rotate over ICI via ppermute, so the attention works on
-        sequences far longer than one chip's HBM could hold. Beyond
-        the reference (SURVEY §5: it has no long-context parallelism).
+        runs one of two strategies (beyond the reference, SURVEY §5:
+        it has no long-context parallelism):
+
+          mode="ring"    — K/V shards rotate over ICI via ppermute
+                           (parallel/ring_attention.py); works for any
+                           head count, comm = sp-1 K/V rotations.
+          mode="ulysses" — all-to-all head<->sequence re-sharding
+                           (parallel/ulysses.py, the DeepSpeed-Ulysses
+                           recipe); needs heads % sp == 0, comm = 2
+                           activation all-to-alls.
         """
         from jax.sharding import PartitionSpec as P
 
+        if mode not in ("ring", "ulysses"):
+            raise ValueError(f"with_sequence_parallel: mode must be "
+                             f"'ring' or 'ulysses', got {mode!r}")
+        self._axis_env = {"sp_mode": mode}
         self._mesh = self._axis_mesh("sp", sp, dp, places)
         shardings = {}
         for v in self._program.global_block().vars.values():

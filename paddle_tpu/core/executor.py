@@ -211,6 +211,7 @@ def build_block_fn(
         return _build_gradient_merge_fn(
             block, feed_names, state_names, fetch_names, written_names, mesh, k,
             bool(getattr(block.program, "_gradient_merge_avg", True)),
+            axis_env=axis_env,
         )
 
     def fn(step_key, *args):
@@ -236,7 +237,8 @@ def build_block_fn(
 
 
 def _build_gradient_merge_fn(
-    block, feed_names, state_names, fetch_names, written_names, mesh, k, avg
+    block, feed_names, state_names, fetch_names, written_names, mesh, k, avg,
+    axis_env=None,
 ):
     """Gradient accumulation (reference ir/multi_batch_merge_pass.cc:
     repeat fwd/bwd k times, apply the optimizer once).
@@ -293,7 +295,8 @@ def _build_gradient_merge_fn(
             for n in feed_names:
                 env[n] = feeds[n][i]
             ctx = LoweringContext(
-                step_key=jax.random.fold_in(step_key, i), mesh=mesh
+                step_key=jax.random.fold_in(step_key, i), mesh=mesh,
+                axis_env=axis_env,
             )
             ctx.check_nan_inf = check
             _lower_block(block, env, ctx, ops=body_ops)
@@ -316,7 +319,8 @@ def _build_gradient_merge_fn(
         env = dict(base_env)
         env.update(wk)
         env.update(acc)
-        ctx = LoweringContext(step_key=jax.random.fold_in(step_key, k), mesh=mesh)
+        ctx = LoweringContext(step_key=jax.random.fold_in(step_key, k),
+                              mesh=mesh, axis_env=axis_env)
         ctx.check_nan_inf = check
         _lower_block(block, env, ctx, ops=opt_ops)
 
@@ -386,10 +390,12 @@ class Executor:
         mesh = None
         in_shardings = None
         state_shardings = None
+        axis_env = None
         if isinstance(program, CompiledProgram):
             mesh = program._mesh
             in_shardings = program._in_shardings
             state_shardings = getattr(program, "_state_shardings", None)
+            axis_env = getattr(program, "_axis_env", None)
             program = program._program
         if program is None:
             program = framework.default_main_program()
@@ -421,6 +427,7 @@ class Executor:
             else None,
             tuple(sorted((k, tuple(v)) for k, v in state_shardings.items()))
             if state_shardings else None,
+            tuple(sorted(axis_env.items())) if axis_env else None,
             flag("check_nan_inf"),
             self.disable_donation,
         )
@@ -428,7 +435,7 @@ class Executor:
         if compiled is None:
             compiled = self._compile(
                 program, block, sorted(feed), fetch_names, scope, mesh,
-                in_shardings, state_shardings
+                in_shardings, state_shardings, axis_env
             )
             if use_program_cache:
                 self._cache[key] = compiled
@@ -563,6 +570,7 @@ class Executor:
         mesh=None,
         in_shardings=None,
         state_shardings=None,
+        axis_env=None,
     ) -> _CompiledBlock:
         state_names, written_names = self._analyze_block(program, block, feed_names)
 
@@ -592,7 +600,8 @@ class Executor:
                     "launch (jax.distributed) or compile with "
                     "with_data_parallel()"
                 )
-        fn = build_block_fn(block, feed_names, state_names, fetch_names, written_names, mesh)
+        fn = build_block_fn(block, feed_names, state_names, fetch_names,
+                            written_names, mesh, axis_env=axis_env)
 
         # donate the state args that are rewritten (buffer aliasing for
         # in-place param update, reference ParamOut=Param convention)

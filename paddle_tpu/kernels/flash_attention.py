@@ -986,17 +986,38 @@ def _flash_attention_op(ctx, op, ins):
                 "the ring; falling back to the flash kernel (GSPMD will "
                 "all-gather K/V across the sp axis)")
         else:
-            from ..parallel.ring_attention import make_ring_attention_fn
+            # mode comes from with_sequence_parallel(mode=...): "ring"
+            # rotates K/V shards (parallel/ring_attention.py); "ulysses"
+            # re-shards head<->sequence with 2 all-to-alls
+            # (parallel/ulysses.py) and needs H % sp == 0
+            mode = (ctx.axis_env or {}).get("sp_mode", "ring")
+            n_heads_ok = h % dict(sp_mesh.shape)["sp"] == 0
+            if mode == "ulysses" and not n_heads_ok:
+                _logger.warning(
+                    "flash_attention: ulysses needs heads %% sp == 0 "
+                    "(H=%s, sp=%s); using ring", h,
+                    dict(sp_mesh.shape)["sp"])
+                mode = "ring"
+            if mode == "ulysses":
+                from ..parallel.ulysses import make_ulysses_attention_fn
 
-            ring = make_ring_attention_fn(
+                make_fn = make_ulysses_attention_fn
+            else:
+                from ..parallel.ring_attention import make_ring_attention_fn
+
+                make_fn = make_ring_attention_fn
+            sp_fn = make_fn(
                 sp_mesh, "sp", causal=causal, with_mask=mask is not None)
             qs, ks, vs = split(q), split(k), split(v)
             if mask is not None:
                 # bool or [B,1,1,S]-shaped masks must become additive
-                # [B, S] before the shard_map in_spec P(None, 'sp')
-                o = ring(qs, ks, vs, _normalize_mask(mask, B, S))
+                # [B, S] first; its shard_map in_spec is per-mode —
+                # ring: P(None, 'sp') (mask rotates with its keys),
+                # ulysses: P(None, None) (replicated — local attention
+                # spans the full sequence)
+                o = sp_fn(qs, ks, vs, _normalize_mask(mask, B, S))
             else:
-                o = ring(qs, ks, vs)
+                o = sp_fn(qs, ks, vs)
     if o is None:
         o = flash_attention(split(q), split(k), split(v), causal, None,
                             mask=mask, bias=bias)
