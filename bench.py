@@ -675,7 +675,8 @@ def _orchestrate():
     # round by the evidence loop — honestly marked as cached, with its
     # capture timestamp. A wedged relay at the one moment the driver
     # runs bench.py must not erase a whole round of real-chip numbers.
-    cached = _best_cached_tpu_row()
+    cached = (None if os.environ.get("PT_BENCH_NO_CACHED") == "1"
+              else _best_cached_tpu_row())
     if cached is not None:
         cached = dict(cached, cached=True,
                       cached_reason="relay down at bench time; row was "

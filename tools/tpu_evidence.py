@@ -76,6 +76,7 @@ def _once():
     env["PT_BENCH_KERNELS"] = "1"       # kernel bench inside the claim
     env["PT_BENCH_CPU_FALLBACK"] = "0"  # relay-down cycles just log
     env["PT_BENCH_IMPORT_BUDGET"] = "420"  # patient: see bench.py note
+    env["PT_BENCH_NO_CACHED"] = "1"  # never re-report our own captures
     try:
         proc = subprocess.run(
             [sys.executable, os.path.join(HERE, "bench.py")],
@@ -101,6 +102,12 @@ def _once():
         tail = proc.stderr.strip().splitlines()
         _log_probe(f"cycle=NO_CAPTURE rc={proc.returncode} "
                    f"tail={tail[-1][-200:] if tail else ''!r}")
+        return 2
+    if rec.get("cached"):
+        # bench re-surfaced an EARLIER capture (belt for the
+        # PT_BENCH_NO_CACHED suspender): not a new datapoint —
+        # appending it would re-stamp an old row as fresh
+        _log_probe("cycle=CACHED_ONLY (no live capture)")
         return 2
     _append_evidence(rec)
     n_extra = len(rec.get("extra", []))
