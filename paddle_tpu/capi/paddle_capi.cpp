@@ -55,6 +55,32 @@ struct Gil {
   ~Gil() { PyGILState_Release(st); }
 };
 
+// numpy array from a host buffer: frombuffer(mv, dtype).reshape.copy()
+PyObject *np_array_from(const void *data, const int64_t *shape, int ndim,
+                        const char *dtype, size_t elem_size) {
+  int64_t numel = 1;
+  for (int i = 0; i < ndim; ++i) numel *= shape[i];
+  PyObject *shape_t = PyTuple_New(ndim);
+  for (int i = 0; i < ndim; ++i)
+    PyTuple_SetItem(shape_t, i, PyLong_FromLongLong(shape[i]));
+  PyObject *mv = PyMemoryView_FromMemory(
+      (char *)data, numel * (int64_t)elem_size, PyBUF_READ);
+  PyObject *arr = PyObject_CallMethod(g_np_mod, "frombuffer", "Os", mv, dtype);
+  Py_DECREF(mv);
+  if (!arr) {
+    Py_DECREF(shape_t);
+    return nullptr;
+  }
+  PyObject *reshaped = PyObject_CallMethod(arr, "reshape", "O", shape_t);
+  Py_DECREF(arr);
+  Py_DECREF(shape_t);
+  if (!reshaped) return nullptr;
+  PyObject *copied = PyObject_CallMethod(reshaped, "copy", nullptr);
+  Py_DECREF(reshaped);
+  return copied;
+}
+
+
 }  // namespace
 
 // -- lifecycle ---------------------------------------------------------------
@@ -180,32 +206,8 @@ PD_CAPI int PD_GetOutputName(void *pred, int i, char *out, int cap) {
 PD_CAPI int PD_SetInputFloat(void *pred, const char *name, const float *data,
                              const int64_t *shape, int ndim) {
   Gil gil;
-  int64_t numel = 1;
-  for (int i = 0; i < ndim; ++i) numel *= shape[i];
-  PyObject *shape_t = PyTuple_New(ndim);
-  for (int i = 0; i < ndim; ++i)
-    PyTuple_SetItem(shape_t, i, PyLong_FromLongLong(shape[i]));
-
-  // np.frombuffer(memoryview, dtype=float32).reshape(shape).copy()
-  PyObject *mv = PyMemoryView_FromMemory((char *)data,
-                                         numel * (int64_t)sizeof(float),
-                                         PyBUF_READ);
-  PyObject *arr = PyObject_CallMethod(g_np_mod, "frombuffer", "Os", mv, "float32");
-  Py_DECREF(mv);
-  if (!arr) {
-    Py_DECREF(shape_t);
-    capture_error();
-    return -1;
-  }
-  PyObject *reshaped = PyObject_CallMethod(arr, "reshape", "O", shape_t);
-  Py_DECREF(arr);
-  Py_DECREF(shape_t);
-  if (!reshaped) {
-    capture_error();
-    return -1;
-  }
-  PyObject *copied = PyObject_CallMethod(reshaped, "copy", nullptr);
-  Py_DECREF(reshaped);
+  PyObject *copied = np_array_from(data, shape, ndim, "float32",
+                                   sizeof(float));
   if (!copied) {
     capture_error();
     return -1;
@@ -306,4 +308,104 @@ PD_CAPI int64_t PD_GetOutputFloat(void *pred, const char *name, float *out,
 PD_CAPI void PD_Finalize() {
   // embedding hosts usually skip finalization (jax atexit handlers);
   // provided for completeness.
+}
+
+// -- native trainer ----------------------------------------------------------
+// Reference: paddle/fluid/train/demo/demo_trainer.cc — load a
+// serialized program pair (saved by a python authoring script) and run
+// train steps from native code with no Python driver in the loop. The
+// programs travel as the Program JSON serialization; the python side
+// is paddle_tpu/capi/trainer.py (CTrainer).
+
+namespace {
+
+PyObject *g_trainer_mod = nullptr;
+
+int trainer_set_input(void *t, const char *name, const void *data,
+                      const int64_t *shape, int ndim, const char *dtype,
+                      size_t elem) {
+  Gil gil;
+  PyObject *arr = np_array_from(data, shape, ndim, dtype, elem);
+  if (!arr) {
+    capture_error();
+    return -1;
+  }
+  PyObject *r =
+      PyObject_CallMethod((PyObject *)t, "set_input", "sO", name, arr);
+  Py_DECREF(arr);
+  if (!r) {
+    capture_error();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+}  // namespace
+
+PD_CAPI void *PD_TrainerNew(const char *main_json_path,
+                            const char *startup_json_path) {
+  Gil gil;
+  if (!g_trainer_mod) {
+    g_trainer_mod = PyImport_ImportModule("paddle_tpu.capi.trainer");
+    if (!g_trainer_mod) {
+      fprintf(stderr, "PD_TrainerNew(import): %s\n", capture_error());
+      return nullptr;
+    }
+  }
+  PyObject *t = PyObject_CallMethod(g_trainer_mod, "new_trainer", "ss",
+                                    main_json_path, startup_json_path);
+  if (!t) fprintf(stderr, "PD_TrainerNew: %s\n", capture_error());
+  return t;
+}
+
+PD_CAPI void PD_TrainerDelete(void *t) {
+  Gil gil;
+  Py_XDECREF((PyObject *)t);
+}
+
+PD_CAPI int PD_TrainerSetInputFloat(void *t, const char *name,
+                                    const float *data, const int64_t *shape,
+                                    int ndim) {
+  return trainer_set_input(t, name, data, shape, ndim, "float32",
+                           sizeof(float));
+}
+
+PD_CAPI int PD_TrainerSetInputInt64(void *t, const char *name,
+                                    const int64_t *data, const int64_t *shape,
+                                    int ndim) {
+  return trainer_set_input(t, name, data, shape, ndim, "int64",
+                           sizeof(int64_t));
+}
+
+// one train step; *loss_out receives the scalar fetch (e.g. the loss)
+PD_CAPI int PD_TrainerRunStep(void *t, const char *fetch_name,
+                              double *loss_out) {
+  Gil gil;
+  PyObject *r =
+      PyObject_CallMethod((PyObject *)t, "run_step", "s", fetch_name);
+  if (!r) {
+    fprintf(stderr, "PD_TrainerRunStep: %s\n", capture_error());
+    return -1;
+  }
+  double v = PyFloat_AsDouble(r);
+  Py_DECREF(r);
+  if (PyErr_Occurred()) {
+    capture_error();
+    return -1;
+  }
+  if (loss_out) *loss_out = v;
+  return 0;
+}
+
+PD_CAPI int PD_TrainerSavePersistables(void *t, const char *dirname) {
+  Gil gil;
+  PyObject *r = PyObject_CallMethod((PyObject *)t, "save_persistables", "s",
+                                    dirname);
+  if (!r) {
+    fprintf(stderr, "PD_TrainerSavePersistables: %s\n", capture_error());
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
 }

@@ -1,0 +1,47 @@
+"""Python-side object the native trainer C API drives.
+
+Reference: paddle/fluid/train/demo/demo_trainer.cc — a C++ binary
+loads a SERIALIZED program (saved by a python model-authoring script),
+runs the startup program once, then loops train steps with no Python
+driver in the loop. Here the C layer (paddle_capi.cpp PD_Trainer*)
+embeds CPython and drives this class; the programs travel as the
+Program JSON serialization (core/framework.py to_json/from_json — the
+ProgramDesc-protobuf equivalent)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.executor import Executor, Scope, scope_guard
+from ..core.framework import Program
+from ..core.places import TPUPlace
+
+
+class CTrainer:
+    def __init__(self, main_path: str, startup_path: str):
+        with open(main_path) as f:
+            self.main = Program.from_json(f.read())
+        with open(startup_path) as f:
+            self.startup = Program.from_json(f.read())
+        self.scope = Scope()
+        self.exe = Executor(TPUPlace())
+        self.exe.run(self.startup, scope=self.scope)
+        self.feed = {}
+
+    def set_input(self, name: str, arr) -> None:
+        self.feed[name] = np.asarray(arr)
+
+    def run_step(self, fetch_name: str) -> float:
+        (out,) = self.exe.run(self.main, feed=self.feed,
+                              fetch_list=[fetch_name], scope=self.scope)
+        return float(np.asarray(out).reshape(-1)[0])
+
+    def save_persistables(self, dirname: str) -> None:
+        from .. import io
+
+        with scope_guard(self.scope):
+            io.save_persistables(self.exe, dirname, self.main)
+
+
+def new_trainer(main_path: str, startup_path: str) -> CTrainer:
+    return CTrainer(main_path, startup_path)
