@@ -144,3 +144,32 @@ def test_gpt_moe_trains_and_ep_parity():
     assert losses["dense"][-1] < losses["dense"][0], losses
     np.testing.assert_allclose(losses["dense"], losses["ep"],
                                rtol=2e-5, atol=1e-5)
+
+
+def test_moe_inference_roundtrip(tmp_path):
+    """save_inference_model prunes the MoE net to the Out path and the
+    predictor serves it (dense lowering, single chip)."""
+    d = str(tmp_path / "moe_model")
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 31
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data("x", [4, 8])
+        out, aux = fluid.layers.switch_moe(x, 4, 16, capacity_factor=8.0)
+        y = fluid.layers.fc(out, 3)
+    scope = fluid.Scope()
+    xv = np.random.RandomState(6).randn(2, 4, 8).astype("float32")
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        fluid.io.save_inference_model(d, ["x"], [y], exe, main_program=main)
+        (want,) = exe.run(main, feed={"x": xv}, fetch_list=[y])
+
+    from paddle_tpu.inference import Config, create_predictor
+
+    pred = create_predictor(Config(d))
+    h = pred.get_input_handle(pred.get_input_names()[0])
+    h.copy_from_cpu(xv)
+    pred.zero_copy_run()
+    got = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=1e-6)
