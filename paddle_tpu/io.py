@@ -245,9 +245,13 @@ def _async_checkpointer():
 
 
 def load_checkpoint(dirname, main_program=None, scope=None, step=None):
-    """Restore persistables saved by save_checkpoint; arrays come back
-    with their saved shardings restored lazily on first use."""
-    import jax.numpy as jnp
+    """Restore persistables saved by save_checkpoint. Arrays land as
+    UNCOMMITTED host values: a checkpoint written on one device
+    topology (say dp4) must resume on another (dp2, single chip) — the
+    next compile re-places them per ITS mesh, so sharding is a property
+    of the compile, not of the checkpoint (elastic resume; the
+    reference only restarts on the same topology)."""
+    import numpy as np
     import orbax.checkpoint as ocp
 
     main_program = main_program or framework.default_main_program()
@@ -258,7 +262,7 @@ def load_checkpoint(dirname, main_program=None, scope=None, step=None):
     ckptr = ocp.Checkpointer(ocp.StandardCheckpointHandler())
     state = ckptr.restore(path)
     for name, val in state.items():
-        scope.set_var(name, jnp.asarray(val))
+        scope.set_var(name, np.asarray(val))
     return sorted(state)
 
 

@@ -1,0 +1,83 @@
+"""Elastic resume: a checkpoint written while training on one device
+topology restores onto a DIFFERENT topology and the loss trajectory
+continues exactly.
+
+The reference's only recovery story is checkpoint-restart on the SAME
+topology (SURVEY §5: "No elastic re-scaling ... recovery = checkpoint
+restart"). Here persistables checkpoint through orbax (io.py) and
+data-parallel sharding is a property of the COMPILE, not the saved
+state, so dp4 -> dp2 -> single-device resume works with bitwise-stable
+parameter state."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def _build(seed=41):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = seed
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data("x", [12])
+        y = fluid.layers.data("y", [1], dtype="int64")
+        h = fluid.layers.fc(x, 32, act="relu")
+        loss = fluid.layers.mean(fluid.layers.softmax_with_cross_entropy(
+            fluid.layers.fc(h, 4), y))
+        fluid.optimizer.Adam(5e-3).minimize(loss)
+    return main, startup, loss
+
+
+def _feeds(n):
+    rng = np.random.RandomState(2)
+    out = []
+    for _ in range(n):
+        x = rng.randn(8, 12).astype("float32")
+        out.append({"x": x, "y": (np.abs(x).sum(1, keepdims=True) > 9.5)
+                    .astype("int64") + (x[:, :1] > 0).astype("int64")})
+    return out
+
+
+def test_checkpoint_resumes_across_topologies(tmp_path):
+    feeds = _feeds(8)
+    ck = str(tmp_path / "ck")
+
+    def dp_prog(main, loss, n):
+        if n == 1:
+            return main
+        return fluid.CompiledProgram(main).with_data_parallel(
+            loss_name=loss.name,
+            places=[fluid.TPUPlace(i) for i in range(n)])
+
+    # -- phase 1: train 4 steps on dp4, checkpoint -----------------------
+    main, startup, loss = _build()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        prog = dp_prog(main, loss, 4)
+        first_losses = [float(np.asarray(
+            exe.run(prog, feed=f, fetch_list=[loss])[0]))
+            for f in feeds[:4]]
+        fluid.io.save_checkpoint(ck, main_program=main, scope=scope)
+
+    # -- reference continuation: same scope keeps training on dp4 --------
+    with fluid.scope_guard(scope):
+        want = [float(np.asarray(
+            exe.run(prog, feed=f, fetch_list=[loss])[0]))
+            for f in feeds[4:]]
+
+    # -- phase 2: restore into FRESH scopes on dp2 and single device -----
+    for n in (2, 1):
+        main2, startup2, loss2 = _build()
+        scope2 = fluid.Scope()
+        with fluid.scope_guard(scope2):
+            exe2 = fluid.Executor(fluid.TPUPlace())
+            exe2.run(startup2)  # creates vars; checkpoint overwrites
+            fluid.io.load_checkpoint(ck, main_program=main2, scope=scope2)
+            got = [float(np.asarray(
+                exe2.run(dp_prog(main2, loss2, n), feed=f,
+                         fetch_list=[loss2])[0]))
+                for f in feeds[4:]]
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-6,
+                                   err_msg=f"resume on {n} device(s)")
+    assert want[-1] < first_losses[0], (first_losses, want)
