@@ -160,26 +160,46 @@ class CompiledProgram:
         return self
 
     def with_expert_parallel(self, ep: int, dp: int = 1,
-                             places=None) -> "CompiledProgram":
+                             places=None,
+                             dispatch: str = "psum") -> "CompiledProgram":
         """Expert parallelism: shard every switch_moe layer's expert
         weights (vars tagged _moe_expert_param) over an `ep` mesh axis,
         optionally combined with batch sharding over `dp`. The
         switch_moe op detects the ep axis at lowering time (ops/moe.py)
-        and runs each device's local experts inside shard_map, with a
-        psum over `ep` combining token outputs. Beyond the reference
-        (SURVEY §2f: the snapshot has no MoE/EP)."""
+        and runs each device's local experts inside shard_map. Beyond
+        the reference (SURVEY §2f: the snapshot has no MoE/EP).
+
+          dispatch="psum"     — tokens replicated over ep; each rank
+                                computes its experts for all tokens, a
+                                psum combines. Simple; comm = one
+                                activation psum.
+          dispatch="alltoall" — the DeepSpeed/GShard form: tokens shard
+                                over ep too; one all_to_all delivers
+                                each rank exactly its experts' tokens,
+                                a second returns outputs. Comm = 2x the
+                                ROUTED tokens; dp*ep must divide the
+                                batch size.
+        """
         from jax.sharding import PartitionSpec as P
 
+        if dispatch not in ("psum", "alltoall"):
+            raise ValueError(f"with_expert_parallel: dispatch must be "
+                             f"'psum' or 'alltoall', got {dispatch!r}")
+        self._axis_env = {"ep_dispatch": dispatch}
         self._mesh = self._axis_mesh("ep", ep, dp, places)
         shardings = {}
         state_shardings = {}
+        # alltoall shards the batch over BOTH axes; psum over dp only
+        batch_axes = ((("dp", "ep") if dp > 1 else ("ep",))
+                      if dispatch == "alltoall"
+                      else (("dp",) if dp > 1 else None))
         for v in self._program.global_block().vars.values():
             if getattr(v, "_moe_expert_param", False):
                 state_shardings[v.name] = (
                     ("ep",) + (None,) * (len(v.shape) - 1))
-            elif getattr(v, "is_data", False) and v.shape and dp > 1:
+            elif getattr(v, "is_data", False) and v.shape and batch_axes:
                 shardings[v.name] = P(
-                    *(("dp",) + (None,) * (len(v.shape) - 1)))
+                    *((batch_axes,) + (None,) * (len(v.shape) - 1)))
         if not state_shardings:
             raise ValueError(
                 "with_expert_parallel: program has no switch_moe expert "

@@ -72,6 +72,54 @@ def _moe_math(x2, wg, w1, b1, w2, b2, cap, act, e_first, e_local,
     return out, aux
 
 
+def _moe_math_a2a(x2, wg, w1l, b1l, w2l, b2l, cap, act, ep, e_local,
+                  token_axes):
+    """All-to-all dispatch (the DeepSpeed/GShard EP form): tokens are
+    sharded over `ep` too; each rank routes its T_local tokens into
+    per-destination buffers [ep, E_local, cap, D], ONE all_to_all
+    delivers every rank exactly the tokens its local experts own, and a
+    second all_to_all returns the outputs — comm volume is the routed
+    tokens (2x), not the full activation psum.
+
+    Capacity is per (source rank, expert): cap = ceil(T_local/E * f).
+    """
+    T, D = x2.shape
+    E = wg.shape[1]
+    logits = x2 @ wg
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert = jnp.argmax(probs, axis=-1)
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=1)[:, 0]
+    onehot = jax.nn.one_hot(expert, E, dtype=x2.dtype)
+    pos = (jnp.cumsum(onehot, axis=0) - onehot)
+    pos = jnp.sum(pos * onehot, axis=1).astype(jnp.int32)
+    keep = pos < cap
+
+    dest = (expert.astype(jnp.int32) // e_local)
+    eloc = (expert.astype(jnp.int32) % e_local)
+    dc = jnp.clip(dest, 0, ep - 1)
+    ec = jnp.clip(eloc, 0, e_local - 1)
+    pc = jnp.clip(pos, 0, cap - 1)
+    disp = jnp.zeros((ep, e_local, cap, D), x2.dtype)
+    disp = disp.at[dc, ec, pc].add(x2 * keep[:, None].astype(x2.dtype))
+    # send slice [d] to rank d; receive [s] = slice from source s
+    recv = jax.lax.all_to_all(disp, "ep", split_axis=0, concat_axis=0,
+                              tiled=True)
+    h = jnp.einsum("secd,edf->secf", recv, w1l) + b1l[None, :, None, :]
+    h = jax.nn.gelu(h) if act == "gelu" else jax.nn.relu(h)
+    y = jnp.einsum("secf,efd->secd", h, w2l) + b2l[None, :, None, :]
+    back = jax.lax.all_to_all(y, "ep", split_axis=0, concat_axis=0,
+                              tiled=True)
+    # back[d, e, c] = output rank d computed for MY slot (d, e, c)
+    out = back[dc, ec, pc] * (gate * keep.astype(gate.dtype))[:, None]
+
+    count_e, prob_e, t_total = jax.lax.psum(
+        (jnp.sum(onehot, axis=0), jnp.sum(probs, axis=0),
+         jnp.asarray(T, x2.dtype)),
+        tuple(token_axes))
+    aux = E * jnp.sum((count_e / t_total) * (prob_e / t_total))
+    return out, aux
+
+
 def _ep_mesh(ctx):
     mesh = getattr(ctx, "mesh", None)
     if mesh is None:
@@ -117,21 +165,42 @@ def _switch_moe(ctx, op, ins):
         raise ValueError(f"switch_moe: the ep mesh axis ({ep}) must "
                          f"divide num_experts ({E})")
     e_local = E // ep
-    dp_axis = "dp" if dp > 1 else None
-    xspec = P(*((("dp",) if dp > 1 else (None,))
-                + (None,) * (len(x.shape) - 1)))
+    dispatch = (ctx.axis_env or {}).get("ep_dispatch", "psum")
     espec = P("ep", None, None)
     bspec = P("ep", None)
 
-    def local_fn(xl, wgl, w1l, b1l, w2l, b2l):
-        x2 = xl.reshape(-1, D)
-        T_local = x2.shape[0]
-        cap = max(int(-(-T_local * cap_factor // E)), 1)
-        e_first = jax.lax.axis_index("ep") * e_local
-        out, aux = _moe_math(x2, wgl, w1l, b1l, w2l, b2l, cap, act,
-                             e_first, e_local, dp_axis=dp_axis,
-                             ep_axis="ep")
-        return out.reshape(xl.shape), aux.reshape(1)
+    if dispatch == "alltoall":
+        # tokens sharded over ep (and dp): batch dim splits over both
+        n_shards = dp * ep
+        if int(x.shape[0]) % n_shards:
+            raise ValueError(
+                f"switch_moe alltoall dispatch: batch size {x.shape[0]} "
+                f"must be divisible by dp*ep = {n_shards} (tokens shard "
+                "over both axes); use dispatch='psum' otherwise")
+        tok_axes = ("dp", "ep") if dp > 1 else ("ep",)
+        xspec = P(*((tok_axes,) + (None,) * (len(x.shape) - 1)))
+
+        def local_fn(xl, wgl, w1l, b1l, w2l, b2l):
+            x2 = xl.reshape(-1, D)
+            cap = max(int(-(-x2.shape[0] * cap_factor // E)), 1)
+            out, aux = _moe_math_a2a(x2, wgl, w1l, b1l, w2l, b2l, cap,
+                                     act, ep, e_local, tok_axes)
+            return out.reshape(xl.shape), aux.reshape(1)
+    else:
+        # tokens replicated over ep; each rank computes its local
+        # experts for ALL tokens and a psum combines contributions
+        dp_axis = "dp" if dp > 1 else None
+        xspec = P(*((("dp",) if dp > 1 else (None,))
+                    + (None,) * (len(x.shape) - 1)))
+
+        def local_fn(xl, wgl, w1l, b1l, w2l, b2l):
+            x2 = xl.reshape(-1, D)
+            cap = max(int(-(-x2.shape[0] * cap_factor // E)), 1)
+            e_first = jax.lax.axis_index("ep") * e_local
+            out, aux = _moe_math(x2, wgl, w1l, b1l, w2l, b2l, cap, act,
+                                 e_first, e_local, dp_axis=dp_axis,
+                                 ep_axis="ep")
+            return out.reshape(xl.shape), aux.reshape(1)
 
     out, aux = shard_map(
         local_fn, mesh=mesh,

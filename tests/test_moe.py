@@ -42,10 +42,13 @@ def test_switch_moe_trains_dense():
     assert ls[-1] < ls[0] * 0.6, (ls[0], ls[-1])
 
 
-@pytest.mark.parametrize("dp,ep", [(1, 4), (2, 2)])
-def test_expert_parallel_matches_dense(dp, ep):
+@pytest.mark.parametrize("dp,ep,dispatch", [
+    (1, 4, "psum"), (2, 2, "psum"), (1, 4, "alltoall"), (2, 2, "alltoall"),
+])
+def test_expert_parallel_matches_dense(dp, ep, dispatch):
     """Same weights (shared names + per-program seed), same feed: the
-    ep-sharded loss trajectory must equal the dense one."""
+    ep-sharded loss trajectory must equal the dense one — for BOTH
+    dispatch strategies (psum-combine and all_to_all token routing)."""
     rng = np.random.RandomState(1)
     feeds = [_feed(rng) for _ in range(3)]
     losses = {}
@@ -58,7 +61,7 @@ def test_expert_parallel_matches_dense(dp, ep):
             prog = main
             if mode == "ep":
                 prog = fluid.CompiledProgram(main).with_expert_parallel(
-                    ep=ep, dp=dp,
+                    ep=ep, dp=dp, dispatch=dispatch,
                     places=[fluid.TPUPlace(i) for i in range(dp * ep)])
             ls = [float(np.asarray(exe.run(prog, feed=f,
                                            fetch_list=[loss])[0]))
