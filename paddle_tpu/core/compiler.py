@@ -193,13 +193,27 @@ class CompiledProgram:
         batch_axes = ((("dp", "ep") if dp > 1 else ("ep",))
                       if dispatch == "alltoall"
                       else (("dp",) if dp > 1 else None))
+        expert_names = set()
         for v in self._program.global_block().vars.values():
             if getattr(v, "_moe_expert_param", False):
                 state_shardings[v.name] = (
                     ("ep",) + (None,) * (len(v.shape) - 1))
+                expert_names.add(v.name)
             elif getattr(v, "is_data", False) and v.shape and batch_axes:
                 shardings[v.name] = P(
                     *((batch_axes,) + (None,) * (len(v.shape) - 1)))
+        # expert params' optimizer accumulators (Adam moments etc.)
+        # shard over ep too — the structural accumulator_owner tag, the
+        # same mechanism ZeRO uses (parallel/sharding.py)
+        for v in self._program.global_block().vars.values():
+            if (getattr(v, "accumulator_owner", None) in expert_names
+                    and v.shape and len(v.shape) >= 1 and v.shape
+                    and max(v.shape) > 1):
+                owner = self._program.global_block().var(
+                    v.accumulator_owner)
+                if tuple(v.shape) == tuple(owner.shape):
+                    state_shardings[v.name] = (
+                        ("ep",) + (None,) * (len(v.shape) - 1))
         if not state_shardings:
             raise ValueError(
                 "with_expert_parallel: program has no switch_moe expert "

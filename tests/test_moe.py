@@ -176,3 +176,23 @@ def test_moe_inference_roundtrip(tmp_path):
     got = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-5, atol=1e-6)
+
+
+def test_expert_accumulators_shard_over_ep():
+    """Adam moments of expert params ride the ep axis too (the same
+    structural accumulator_owner tag ZeRO uses) — expert optimizer
+    state memory scales 1/ep."""
+    main, startup, loss = _build()
+    cp = fluid.CompiledProgram(main).with_expert_parallel(
+        ep=4, places=[fluid.TPUPlace(i) for i in range(4)])
+    specs = cp._state_shardings
+    moe_params = [v.name for v in main.global_block().vars.values()
+                  if getattr(v, "_moe_expert_param", False)]
+    assert len(moe_params) == 4
+    accums = [n for n, v in main.global_block().vars.items()
+              if getattr(v, "accumulator_owner", None) in moe_params
+              and tuple(v.shape) == tuple(
+                  main.global_block().var(v.accumulator_owner).shape)]
+    assert len(accums) >= 8, accums  # moment1+moment2 per expert param
+    for n in moe_params + accums:
+        assert specs[n][0] == "ep", (n, specs.get(n))
