@@ -252,8 +252,15 @@ class Variable:
         return layers._getitem(self, item)
 
     # -- serialization --------------------------------------------------------
+    # structural tags that must survive serialization: sharding specs
+    # and accumulator/MoE ownership drive re-sharding of a LOADED
+    # program (with_expert_parallel, shard_optimizer_states) — losing
+    # them would make a deserialized program silently unshardable
+    _SERIALIZED_TAGS = ("sharding", "is_accumulator", "accumulator_owner",
+                        "_moe_expert_param")
+
     def to_dict(self) -> Dict[str, Any]:
-        return {
+        d = {
             "name": self.name,
             "shape": list(self.shape) if self.shape is not None else None,
             "dtype": self.dtype,
@@ -264,6 +271,14 @@ class Variable:
             "trainable": self.trainable,
             "type": self.type,
         }
+        tags = {}
+        for t in self._SERIALIZED_TAGS:
+            v = getattr(self, t, None)
+            if v is not None and v is not False:
+                tags[t] = list(v) if isinstance(v, tuple) else v
+        if tags:
+            d["tags"] = tags
+        return d
 
 
 class Parameter(Variable):
@@ -577,14 +592,21 @@ class Program:
                 vd = dict(vd)
                 name = vd.pop("name")
                 trainable = vd.pop("trainable", True)
+                tags = vd.pop("tags", None)
                 if trainable and vd.get("persistable"):
                     shape = vd.pop("shape")
                     dtype = vd.pop("dtype")
                     vd.pop("is_data", None)
                     vd.pop("type", None)
-                    blk.create_parameter(name, shape, dtype, **vd)
+                    nv = blk.create_parameter(name, shape, dtype, **vd)
                 else:
-                    blk.create_var(name, **vd)
+                    nv = blk.create_var(name, **vd)
+                for t, val in (tags or {}).items():
+                    if t == "sharding":
+                        # entries may themselves be joint-axis tuples
+                        val = tuple(tuple(e) if isinstance(e, list) else e
+                                    for e in val)
+                    setattr(nv, t, val)
             for od in bd["ops"]:
                 attrs = {}
                 for k, v in od["attrs"].items():

@@ -196,3 +196,27 @@ def test_expert_accumulators_shard_over_ep():
     assert len(accums) >= 8, accums  # moment1+moment2 per expert param
     for n in moe_params + accums:
         assert specs[n][0] == "ep", (n, specs.get(n))
+
+
+def test_moe_program_roundtrips_with_tags(tmp_path):
+    """Program JSON round-trip preserves the structural tags that
+    drive re-sharding (_moe_expert_param, is_accumulator,
+    accumulator_owner, sharding) — a deserialized MoE program can be
+    expert-parallelized and a ZeRO'd one keeps its specs."""
+    main, startup, loss = _build()
+    from paddle_tpu.parallel.sharding import shard_optimizer_states
+
+    shard_optimizer_states(main, 4)
+    r = fluid.Program.from_json(main.to_json())
+    gb, ob = r.global_block(), main.global_block()
+    for name, v in ob.vars.items():
+        rv = gb.var(name)
+        for t in ("_moe_expert_param", "is_accumulator",
+                  "accumulator_owner"):
+            assert getattr(rv, t, None) == getattr(v, t, None), (name, t)
+        assert getattr(rv, "sharding", None) == getattr(v, "sharding",
+                                                        None), name
+    # the loaded program expert-parallelizes (the tag made it through)
+    cp = fluid.CompiledProgram(r).with_expert_parallel(
+        ep=4, places=[fluid.TPUPlace(i) for i in range(4)])
+    assert any(s[0] == "ep" for s in cp._state_shardings.values())
