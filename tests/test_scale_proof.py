@@ -62,3 +62,11 @@ def test_gpt3_1p3b_zero_compiles_and_fits():
     assert c["reduce-scatter"] > 0 or c["dynamic-slice"] > 0, c
     # (b) XLA memory analysis fits v5p HBM per device
     assert r["fits_v5p_hbm"] and r["hbm_fraction"] < 0.5, r
+
+
+def test_gpt_moe_ep_compiles_and_fits():
+    r = _run("gpt_moe_ep")
+    assert r["n_params"] > 2.5e9, r["n_params"]
+    # the a2a dispatch must appear in the SPMD HLO
+    assert r["collectives"]["all-to-all"] >= 2, r["collectives"]
+    assert r["fits_v5p_hbm"], r["per_device_bytes"]

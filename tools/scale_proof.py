@@ -64,6 +64,26 @@ def _build(config):
             "labels": ((per_dev_batch * N_DEV, seq), "int64"),
         }
         zero = True
+    elif config == "gpt_moe_ep":
+        # beyond-reference: GPT-MoE over a dp8 x ep8 mesh with
+        # all-to-all token dispatch (ops/moe.py); 64 experts, every
+        # other decoder is an MoE layer -> ~3.2B total params with
+        # per-device expert memory 1/8
+        from paddle_tpu.models.gpt import GPTConfig, build_gpt_lm
+
+        cfg = GPTConfig(vocab_size=32000, hidden_size=1024, num_layers=16,
+                        num_heads=16, ffn_size=4096, max_position=1024,
+                        hidden_dropout=0.0, attention_dropout=0.0,
+                        moe_every=2, moe_experts=64, moe_capacity=1.25)
+        seq, per_dev_batch = 1024, 1
+        opt = fluid.optimizer.Adam(1e-4)
+        main, startup, feeds, fetches = build_gpt_lm(
+            cfg, seq, optimizer=opt)
+        feed_shapes = {
+            "tokens": ((per_dev_batch * N_DEV, seq), "int64"),
+            "labels": ((per_dev_batch * N_DEV, seq), "int64"),
+        }
+        zero = False
     else:
         raise SystemExit(f"unknown config {config}")
     return main, fetches["loss"], feed_shapes, zero
@@ -96,12 +116,31 @@ def main():
         int(np.prod(v.shape)) for v in block.vars.values()
         if isinstance(v, Parameter))
 
-    mesh = Mesh(np.array(jax.devices()[:N_DEV]).reshape(N_DEV), ("dp",))
+    moe_ep = config == "gpt_moe_ep"
+    axis_env = None
+    if moe_ep:
+        # dp8 x ep8: expert weights/accumulators shard over ep (same
+        # annotation with_expert_parallel applies), tokens over both
+        mesh = Mesh(np.array(jax.devices()[:N_DEV]).reshape(8, 8),
+                    ("dp", "ep"))
+        axis_env = {"ep_dispatch": "alltoall"}
+        experts = set()
+        for name, v in block.vars.items():
+            if getattr(v, "_moe_expert_param", False):
+                v.sharding = ("ep",) + (None,) * (len(v.shape) - 1)
+                experts.add(name)
+        for name, v in block.vars.items():
+            if (getattr(v, "accumulator_owner", None) in experts
+                    and tuple(v.shape)
+                    == tuple(block.var(v.accumulator_owner).shape)):
+                v.sharding = ("ep",) + (None,) * (len(v.shape) - 1)
+    else:
+        mesh = Mesh(np.array(jax.devices()[:N_DEV]).reshape(N_DEV), ("dp",))
     exe = fluid.Executor(fluid.CPUPlace())
     feed_names = sorted(feed_shapes)
     state_names, written = exe._analyze_block(prog, block, feed_names)
     fn = build_block_fn(block, feed_names, state_names, [loss_var.name],
-                        written, mesh)
+                        written, mesh, axis_env=axis_env)
 
     def sharding_of(name):
         v = block.var(name) if block.has_var(name) else None
@@ -116,8 +155,9 @@ def main():
         v = block.var(n)
         abstract.append(jax.ShapeDtypeStruct(tuple(v.shape), v.dtype))
         state_sh.append(sharding_of(n))
+    feed_spec = P(("dp", "ep")) if moe_ep else P("dp")
     in_sh = ([NamedSharding(mesh, P())]
-             + [NamedSharding(mesh, P("dp")) for _ in feed_names]
+             + [NamedSharding(mesh, feed_spec) for _ in feed_names]
              + state_sh)
     # pin outputs: fetches replicated, new state keeps each var's
     # sharding — ZeRO-1 must therefore ALL-GATHER the updated params
@@ -130,7 +170,7 @@ def main():
     txt = compiled.as_text()
     counts = {c: txt.count(c) for c in
               ("all-reduce", "reduce-scatter", "all-gather",
-               "dynamic-slice", "dynamic-update-slice")}
+               "all-to-all", "dynamic-slice", "dynamic-update-slice")}
     ma = compiled.memory_analysis()
     per_dev = (ma.argument_size_in_bytes + ma.output_size_in_bytes
                + ma.temp_size_in_bytes)
