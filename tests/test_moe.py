@@ -220,3 +220,43 @@ def test_moe_program_roundtrips_with_tags(tmp_path):
     cp = fluid.CompiledProgram(r).with_expert_parallel(
         ep=4, places=[fluid.TPUPlace(i) for i in range(4)])
     assert any(s[0] == "ep" for s in cp._state_shardings.values())
+
+
+def test_expert_parallel_composes_with_gradient_merge():
+    """EP + gradient accumulation: k=2 microbatch scan inside the
+    ep-sharded compile matches the dense gradient-merge run (the
+    gradient-merge sub-builder must carry the ep axis_env)."""
+    def build(k):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 21
+        with fluid.program_guard(main, startup), fluid.unique_name.guard():
+            x = fluid.layers.data("x", [6, 8])
+            y = fluid.layers.data("y", [6, 8])
+            out, aux = fluid.layers.switch_moe(x, 4, 16,
+                                               capacity_factor=8.0)
+            loss = fluid.layers.mean(fluid.layers.elementwise_add(
+                fluid.layers.mean(fluid.layers.square_error_cost(out, y)),
+                fluid.layers.scale(aux, scale=0.01)))
+            fluid.optimizer.GradientMergeOptimizer(
+                fluid.optimizer.Adam(5e-3), k_steps=k).minimize(loss)
+        return main, startup, loss
+
+    rng = np.random.RandomState(4)
+    feed = _feed(rng, B=8, S=6)
+    losses = {}
+    for mode in ("dense", "ep"):
+        main, startup, loss = build(2)
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.TPUPlace())
+            exe.run(startup)
+            prog = main
+            if mode == "ep":
+                prog = fluid.CompiledProgram(main).with_expert_parallel(
+                    ep=4, places=[fluid.TPUPlace(i) for i in range(4)])
+            ls = [float(np.asarray(exe.run(prog, feed=feed,
+                                           fetch_list=[loss])[0]))
+                  for _ in range(2)]
+        losses[mode] = ls
+    np.testing.assert_allclose(losses["dense"], losses["ep"],
+                               rtol=2e-5, atol=1e-6)
