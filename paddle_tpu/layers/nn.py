@@ -311,11 +311,18 @@ def conv2d_transpose(
     bias_attr=None,
     act=None,
     name=None,
+    data_format="NCHW",
 ):
     helper = LayerHelper(
         "conv2d_transpose", param_attr=param_attr, bias_attr=bias_attr, act=act, name=name
     )
-    n, c, h, w_ = input.shape
+    if data_format not in ("NCHW", "NHWC"):
+        raise ValueError(f"conv2d_transpose: data_format must be "
+                         f"NCHW/NHWC, got {data_format!r}")
+    if data_format == "NCHW":
+        n, c, h, w_ = input.shape
+    else:
+        n, h, w_, c = input.shape
     fs = filter_size if isinstance(filter_size, (list, tuple)) else [filter_size] * 2
     st = stride if isinstance(stride, (list, tuple)) else [stride] * 2
     pd = padding if isinstance(padding, (list, tuple)) else [padding] * 2
@@ -325,13 +332,16 @@ def conv2d_transpose(
     def _o(i, k, p, s):
         return -1 if (i is None or i < 0) else (i - 1) * s - 2 * p + k
 
-    out_shape = (n, num_filters, _o(h, fs[0], pd[0], st[0]), _o(w_, fs[1], pd[1], st[1]))
+    oh, ow = _o(h, fs[0], pd[0], st[0]), _o(w_, fs[1], pd[1], st[1])
+    out_shape = ((n, num_filters, oh, ow) if data_format == "NCHW"
+                 else (n, oh, ow, num_filters))
     out = _out(helper, input, shape=out_shape)
     helper.append_op(
         type="conv2d_transpose",
         inputs={"Input": [input], "Filter": [filt]},
         outputs={"Output": [out]},
-        attrs={"strides": list(st), "paddings": list(pd), "groups": groups},
+        attrs={"strides": list(st), "paddings": list(pd), "groups": groups,
+               "data_format": data_format},
     )
     if helper.bias_attr is not False:
         b = helper.create_parameter(
@@ -342,7 +352,7 @@ def conv2d_transpose(
             type="elementwise_add",
             inputs={"X": [out], "Y": [b]},
             outputs={"Out": [out2]},
-            attrs={"axis": 1},
+            attrs={"axis": 1 if data_format == "NCHW" else 3},
         )
         out = out2
     return helper.append_activation(out)

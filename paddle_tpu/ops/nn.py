@@ -89,6 +89,8 @@ def _conv2d_transpose(ctx, op, ins):
     # out = (in-1)*stride - 2*pad + k_eff needs jax pad (k_eff-1-pad)
     # per side (k_eff = (k-1)*dilation + 1). (0,0) explicit would mean
     # a forward-VALID shape — wrong for every kernel > 1.
+    fmt = op.attrs.get("data_format", "NCHW")
+    ch_axis = 1 if fmt == "NCHW" else 3
     ke = [(w.shape[2] - 1) * dilations[0] + 1,
           (w.shape[3] - 1) * dilations[1] + 1]
     pad = [(ke[0] - 1 - paddings[0], ke[0] - 1 - paddings[0]),
@@ -101,7 +103,7 @@ def _conv2d_transpose(ctx, op, ins):
             strides=strides,
             padding=pad,
             rhs_dilation=dilations,
-            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            dimension_numbers=(fmt, "OIHW", fmt),
             transpose_kernel=True,
         )
 
@@ -114,16 +116,19 @@ def _conv2d_transpose(ctx, op, ins):
         # [g*in_c/g:(g+1)*in_c/g] producing out_c/g channels each,
         # concatenated along channels. Static group count: XLA fuses
         # the per-group convs.
-        if x.shape[1] % groups or w.shape[0] != x.shape[1]:
+        in_c = x.shape[ch_axis]
+        if in_c % groups or w.shape[0] != in_c:
             raise ValueError(
-                f"conv2d_transpose: in_c {x.shape[1]} and filter dim0 "
+                f"conv2d_transpose: in_c {in_c} and filter dim0 "
                 f"{w.shape[0]} must be divisible/equal for groups={groups}")
         out = jnp.concatenate(
             [one(xi, wi) for xi, wi in
-             zip(jnp.split(x, groups, axis=1), jnp.split(w, groups, axis=0))],
-            axis=1)
+             zip(jnp.split(x, groups, axis=ch_axis),
+                 jnp.split(w, groups, axis=0))],
+            axis=ch_axis)
     if ins.get("Bias"):
-        out = out + ins["Bias"][0].reshape((1, -1, 1, 1))
+        bshape = (1, -1, 1, 1) if fmt == "NCHW" else (1, 1, 1, -1)
+        out = out + ins["Bias"][0].reshape(bshape)
     return {"Output": [out]}
 
 

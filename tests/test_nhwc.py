@@ -77,3 +77,33 @@ def test_nhwc_resnet50_builds_and_steps():
             "label": rng.randint(0, 10, (2, 1)).astype("int64")},
             fetch_list=[fetches["loss"]])
     assert np.isfinite(float(np.asarray(l)))
+
+
+def test_conv2d_transpose_nhwc_matches_nchw():
+    """Transposed conv (incl. groups) produces the same math in either
+    layout, shared weights."""
+    rng = np.random.RandomState(5)
+    feed = {"image": rng.randn(2, 4, 8, 8).astype("float32")}
+    outs = {}
+    for fmt in ("NCHW", "NHWC"):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 7
+        with fluid.program_guard(main, startup), fluid.unique_name.guard():
+            img = fluid.layers.data("image", [4, 8, 8])
+            x = img
+            if fmt == "NHWC":
+                x = fluid.layers.transpose(x, [0, 2, 3, 1])
+            y = fluid.layers.conv2d_transpose(
+                x, 6, filter_size=3, stride=2, padding=1, groups=2,
+                param_attr=fluid.ParamAttr(name="dc.w"),
+                bias_attr=fluid.ParamAttr(name="dc.b"), data_format=fmt)
+            if fmt == "NHWC":
+                y = fluid.layers.transpose(y, [0, 3, 1, 2])
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            (o,) = exe.run(main, feed=feed, fetch_list=[y])
+            outs[fmt] = np.asarray(o)
+    np.testing.assert_allclose(outs["NCHW"], outs["NHWC"], rtol=2e-5,
+                               atol=2e-6)
