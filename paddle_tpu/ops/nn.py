@@ -421,16 +421,29 @@ def _layer_norm(ctx, op, ins):
     "group_norm", inputs=("X", "Scale", "Bias"), outputs=("Y", "Mean", "Variance")
 )
 def _group_norm(ctx, op, ins):
-    x = ins["X"][0]  # NCHW
+    x = ins["X"][0]
     g = int(op.attrs.get("groups", 1))
     eps = float(op.attrs.get("epsilon", 1e-5))
-    n, c = x.shape[0], x.shape[1]
-    xg = x.reshape((n, g, c // g) + x.shape[2:])
-    axes = tuple(range(2, xg.ndim))
-    mean = jnp.mean(xg, axis=axes, keepdims=True)
-    var = jnp.var(xg, axis=axes, keepdims=True)
-    y = ((xg - mean) / jnp.sqrt(var + eps)).reshape(x.shape)
-    bshape = [1, c] + [1] * (x.ndim - 2)
+    layout = op.attrs.get("data_layout", "NCHW")
+    n = x.shape[0]
+    if layout == "NHWC":
+        # channels last (reference group_norm_op.cc data_layout): group
+        # the trailing C, normalize per (n, g) over spatial + c/g
+        c = x.shape[-1]
+        xg = x.reshape(x.shape[:-1] + (g, c // g))
+        axes = tuple(range(1, xg.ndim - 2)) + (xg.ndim - 1,)
+        mean = jnp.mean(xg, axis=axes, keepdims=True)
+        var = jnp.var(xg, axis=axes, keepdims=True)
+        y = ((xg - mean) / jnp.sqrt(var + eps)).reshape(x.shape)
+        bshape = [1] * (x.ndim - 1) + [c]
+    else:
+        c = x.shape[1]
+        xg = x.reshape((n, g, c // g) + x.shape[2:])
+        axes = tuple(range(2, xg.ndim))
+        mean = jnp.mean(xg, axis=axes, keepdims=True)
+        var = jnp.var(xg, axis=axes, keepdims=True)
+        y = ((xg - mean) / jnp.sqrt(var + eps)).reshape(x.shape)
+        bshape = [1, c] + [1] * (x.ndim - 2)
     if ins.get("Scale"):
         y = y * ins["Scale"][0].reshape(bshape)
     if ins.get("Bias"):

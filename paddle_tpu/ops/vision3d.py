@@ -31,18 +31,20 @@ def _tup(v, n):
 
 @register_op("conv3d", inputs=("Input", "Filter", "Bias"), outputs=("Output",))
 def _conv3d(ctx, op, ins):
-    x, w = ins["Input"][0], ins["Filter"][0]  # NCDHW, OIDHW
+    x, w = ins["Input"][0], ins["Filter"][0]  # filters OIDHW always
     s = _tup(op.attrs.get("strides", [1, 1, 1]), 3)
     p = _tup(op.attrs.get("paddings", [0, 0, 0]), 3)
     d = _tup(op.attrs.get("dilations", [1, 1, 1]), 3)
     groups = int(op.attrs.get("groups", 1))
+    fmt = op.attrs.get("data_format", "NCDHW")
     out = jax.lax.conv_general_dilated(
         x, w, window_strides=s, padding=[(pi, pi) for pi in p],
         rhs_dilation=d, feature_group_count=groups,
-        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        dimension_numbers=(fmt, "OIDHW", fmt),
     )
     if ins.get("Bias"):
-        out = out + ins["Bias"][0].reshape((1, -1, 1, 1, 1))
+        bshape = (1, -1, 1, 1, 1) if fmt == "NCDHW" else (1, 1, 1, 1, -1)
+        out = out + ins["Bias"][0].reshape(bshape)
     return {"Output": [out]}
 
 
@@ -102,12 +104,18 @@ def _pool3d(ctx, op, ins):
     k = _tup(op.attrs.get("ksize", [2, 2, 2]), 3)
     s = _tup(op.attrs.get("strides", [2, 2, 2]), 3)
     p = _tup(op.attrs.get("paddings", [0, 0, 0]), 3)
+    fmt = op.attrs.get("data_format", "NCDHW")
     if op.attrs.get("global_pooling", False):
-        k = x.shape[2:5]
+        k = x.shape[2:5] if fmt == "NCDHW" else x.shape[1:4]
         s, p = k, (0, 0, 0)
-    window = (1, 1) + k
-    strd = (1, 1) + s
-    pads = ((0, 0), (0, 0)) + tuple((pi, pi) for pi in p)
+    if fmt == "NCDHW":
+        window = (1, 1) + k
+        strd = (1, 1) + s
+        pads = ((0, 0), (0, 0)) + tuple((pi, pi) for pi in p)
+    else:
+        window = (1,) + k + (1,)
+        strd = (1,) + s + (1,)
+        pads = ((0, 0),) + tuple((pi, pi) for pi in p) + ((0, 0),)
     if ptype == "max":
         out = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, window, strd, pads)
     else:

@@ -107,3 +107,55 @@ def test_conv2d_transpose_nhwc_matches_nchw():
             outs[fmt] = np.asarray(o)
     np.testing.assert_allclose(outs["NCHW"], outs["NHWC"], rtol=2e-5,
                                atol=2e-6)
+
+
+def test_conv3d_pool3d_groupnorm_channels_last():
+    """3D conv/pool (NDHWC) and group_norm (NHWC data_layout) match
+    their channels-first forms via transposes."""
+    import jax.numpy as jnp
+    from paddle_tpu.core.registry import get_op_def
+    from paddle_tpu.core.registry import LoweringContext
+
+    class _Op:
+        def __init__(self, type_, attrs):
+            self.type, self.attrs = type_, attrs
+
+    ctx = LoweringContext()
+    rng = np.random.RandomState(8)
+    x = jnp.asarray(rng.randn(2, 3, 6, 6, 6), jnp.float32)   # NCDHW
+    w = jnp.asarray(rng.randn(5, 3, 3, 3, 3), jnp.float32)
+
+    ref = get_op_def("conv3d").lower(
+        ctx, _Op("conv3d", {"strides": [1] * 3, "paddings": [1] * 3}),
+        {"Input": [x], "Filter": [w]})["Output"][0]
+    got = get_op_def("conv3d").lower(
+        ctx, _Op("conv3d", {"strides": [1] * 3, "paddings": [1] * 3,
+                            "data_format": "NDHWC"}),
+        {"Input": [jnp.transpose(x, (0, 2, 3, 4, 1))], "Filter": [w]})[
+            "Output"][0]
+    np.testing.assert_allclose(np.asarray(jnp.transpose(got, (0, 4, 1, 2, 3))),
+                               np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+    refp = get_op_def("pool3d").lower(
+        ctx, _Op("pool3d", {"ksize": [2] * 3, "strides": [2] * 3,
+                            "paddings": [0] * 3}), {"X": [x]})["Out"][0]
+    gotp = get_op_def("pool3d").lower(
+        ctx, _Op("pool3d", {"ksize": [2] * 3, "strides": [2] * 3,
+                            "paddings": [0] * 3, "data_format": "NDHWC"}),
+        {"X": [jnp.transpose(x, (0, 2, 3, 4, 1))]})["Out"][0]
+    np.testing.assert_allclose(
+        np.asarray(jnp.transpose(gotp, (0, 4, 1, 2, 3))), np.asarray(refp),
+        rtol=1e-6)
+
+    x4 = jnp.asarray(rng.randn(2, 8, 5, 5), jnp.float32)      # NCHW
+    sc = jnp.asarray(rng.randn(8), jnp.float32)
+    b = jnp.asarray(rng.randn(8), jnp.float32)
+    refg = get_op_def("group_norm").lower(
+        ctx, _Op("group_norm", {"groups": 4}),
+        {"X": [x4], "Scale": [sc], "Bias": [b]})["Y"][0]
+    gotg = get_op_def("group_norm").lower(
+        ctx, _Op("group_norm", {"groups": 4, "data_layout": "NHWC"}),
+        {"X": [jnp.transpose(x4, (0, 2, 3, 1))], "Scale": [sc],
+         "Bias": [b]})["Y"][0]
+    np.testing.assert_allclose(np.asarray(jnp.transpose(gotg, (0, 3, 1, 2))),
+                               np.asarray(refg), rtol=2e-5, atol=2e-5)
