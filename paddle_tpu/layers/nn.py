@@ -323,16 +323,31 @@ def conv2d_transpose(
         n, c, h, w_ = input.shape
     else:
         n, h, w_, c = input.shape
-    fs = filter_size if isinstance(filter_size, (list, tuple)) else [filter_size] * 2
     st = stride if isinstance(stride, (list, tuple)) else [stride] * 2
     pd = padding if isinstance(padding, (list, tuple)) else [padding] * 2
+    dl = dilation if isinstance(dilation, (list, tuple)) else [dilation] * 2
+    if filter_size is None:
+        # reference conv2d_transpose derives the kernel from
+        # output_size: k_eff = out - (in-1)*stride + 2*pad
+        if output_size is None:
+            raise ValueError("conv2d_transpose: provide filter_size or "
+                             "output_size")
+        os_ = (output_size if isinstance(output_size, (list, tuple))
+               else [output_size] * 2)
+        fs = [(os_[0] - (h - 1) * st[0] + 2 * pd[0] - 1) // dl[0] + 1,
+              (os_[1] - (w_ - 1) * st[1] + 2 * pd[1] - 1) // dl[1] + 1]
+    else:
+        fs = (filter_size if isinstance(filter_size, (list, tuple))
+              else [filter_size] * 2)
     filter_shape = [c, num_filters // groups, fs[0], fs[1]]
     filt = helper.create_parameter(helper.param_attr, filter_shape, input.dtype)
 
-    def _o(i, k, p, s):
-        return -1 if (i is None or i < 0) else (i - 1) * s - 2 * p + k
+    def _o(i, k, p, s, d):
+        ke = d * (k - 1) + 1
+        return -1 if (i is None or i < 0) else (i - 1) * s - 2 * p + ke
 
-    oh, ow = _o(h, fs[0], pd[0], st[0]), _o(w_, fs[1], pd[1], st[1])
+    oh = _o(h, fs[0], pd[0], st[0], dl[0])
+    ow = _o(w_, fs[1], pd[1], st[1], dl[1])
     out_shape = ((n, num_filters, oh, ow) if data_format == "NCHW"
                  else (n, oh, ow, num_filters))
     out = _out(helper, input, shape=out_shape)
@@ -340,7 +355,8 @@ def conv2d_transpose(
         type="conv2d_transpose",
         inputs={"Input": [input], "Filter": [filt]},
         outputs={"Output": [out]},
-        attrs={"strides": list(st), "paddings": list(pd), "groups": groups,
+        attrs={"strides": list(st), "paddings": list(pd),
+               "dilations": list(dl), "groups": groups,
                "data_format": data_format},
     )
     if helper.bias_attr is not False:

@@ -432,18 +432,15 @@ def _group_norm(ctx, op, ins):
         c = x.shape[-1]
         xg = x.reshape(x.shape[:-1] + (g, c // g))
         axes = tuple(range(1, xg.ndim - 2)) + (xg.ndim - 1,)
-        mean = jnp.mean(xg, axis=axes, keepdims=True)
-        var = jnp.var(xg, axis=axes, keepdims=True)
-        y = ((xg - mean) / jnp.sqrt(var + eps)).reshape(x.shape)
         bshape = [1] * (x.ndim - 1) + [c]
     else:
         c = x.shape[1]
         xg = x.reshape((n, g, c // g) + x.shape[2:])
         axes = tuple(range(2, xg.ndim))
-        mean = jnp.mean(xg, axis=axes, keepdims=True)
-        var = jnp.var(xg, axis=axes, keepdims=True)
-        y = ((xg - mean) / jnp.sqrt(var + eps)).reshape(x.shape)
         bshape = [1, c] + [1] * (x.ndim - 2)
+    mean = jnp.mean(xg, axis=axes, keepdims=True)
+    var = jnp.var(xg, axis=axes, keepdims=True)
+    y = ((xg - mean) / jnp.sqrt(var + eps)).reshape(x.shape)
     if ins.get("Scale"):
         y = y * ins["Scale"][0].reshape(bshape)
     if ins.get("Bias"):

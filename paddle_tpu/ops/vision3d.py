@@ -55,6 +55,7 @@ def _conv3d_transpose(ctx, op, ins):
     s = _tup(op.attrs.get("strides", [1, 1, 1]), 3)
     p = _tup(op.attrs.get("paddings", [0, 0, 0]), 3)
     d = _tup(op.attrs.get("dilations", [1, 1, 1]), 3)
+    fmt = op.attrs.get("data_format", "NCDHW")
     # jax explicit padding is output-space: paddle pad -> (k_eff-1-pad)
     # per side (see conv2d_transpose in ops/nn.py)
     ke = [(w.shape[2 + i] - 1) * d[i] + 1 for i in range(3)]
@@ -62,10 +63,11 @@ def _conv3d_transpose(ctx, op, ins):
         x, w, strides=s,
         padding=[(ke[i] - 1 - p[i], ke[i] - 1 - p[i]) for i in range(3)],
         rhs_dilation=d,
-        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"), transpose_kernel=True,
+        dimension_numbers=(fmt, "OIDHW", fmt), transpose_kernel=True,
     )
     if ins.get("Bias"):
-        out = out + ins["Bias"][0].reshape((1, -1, 1, 1, 1))
+        bshape = (1, -1, 1, 1, 1) if fmt == "NCDHW" else (1, 1, 1, 1, -1)
+        out = out + ins["Bias"][0].reshape(bshape)
     return {"Output": [out]}
 
 
@@ -76,6 +78,11 @@ def _depthwise_conv2d_transpose(ctx, op, ins):
     # has no grouped conv_transpose, so run channels batched via vmap
     # over the channel axis (one fused program, still static).
     x, w = ins["Input"][0], ins["Filter"][0]  # [N,C,H,W], [C,1,kh,kw]
+    if op.attrs.get("data_format", "NCHW") != "NCHW":
+        raise NotImplementedError(
+            "depthwise_conv2d_transpose: only NCHW is lowered (the "
+            "vmap-over-channels path is channel-first); transpose the "
+            "input or use conv2d_transpose with groups")
     s = _tup(op.attrs.get("strides", [1, 1]), 2)
     p = _tup(op.attrs.get("paddings", [0, 0]), 2)
     ke = [w.shape[2] , w.shape[3]]  # dilation 1 path
