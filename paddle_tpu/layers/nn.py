@@ -326,16 +326,27 @@ def conv2d_transpose(
     st = stride if isinstance(stride, (list, tuple)) else [stride] * 2
     pd = padding if isinstance(padding, (list, tuple)) else [padding] * 2
     dl = dilation if isinstance(dilation, (list, tuple)) else [dilation] * 2
+    os_ = None
+    if output_size is not None:
+        os_ = (list(output_size) if isinstance(output_size, (list, tuple))
+               else [output_size] * 2)
     if filter_size is None:
         # reference conv2d_transpose derives the kernel from
         # output_size: k_eff = out - (in-1)*stride + 2*pad
-        if output_size is None:
+        if os_ is None:
             raise ValueError("conv2d_transpose: provide filter_size or "
                              "output_size")
-        os_ = (output_size if isinstance(output_size, (list, tuple))
-               else [output_size] * 2)
+        if h is None or h < 0 or w_ is None or w_ < 0:
+            raise ValueError(
+                "conv2d_transpose: deriving filter_size from output_size "
+                "needs static input spatial dims")
         fs = [(os_[0] - (h - 1) * st[0] + 2 * pd[0] - 1) // dl[0] + 1,
               (os_[1] - (w_ - 1) * st[1] + 2 * pd[1] - 1) // dl[1] + 1]
+        if fs[0] <= 0 or fs[1] <= 0:
+            raise ValueError(
+                f"conv2d_transpose: output_size {os_} too small for "
+                f"input ({h}, {w_}) with stride {st} / padding {pd} "
+                f"(derived kernel {fs})")
     else:
         fs = (filter_size if isinstance(filter_size, (list, tuple))
               else [filter_size] * 2)
@@ -348,6 +359,17 @@ def conv2d_transpose(
 
     oh = _o(h, fs[0], pd[0], st[0], dl[0])
     ow = _o(w_, fs[1], pd[1], st[1], dl[1])
+    if os_ is not None and filter_size is not None:
+        # output_size disambiguates the stride>1 output within
+        # [formula, formula + stride - 1] (reference conv_transpose
+        # semantics); the op lowering pads the extra rows/cols
+        for i, (o_want, o_have, s_i) in enumerate(
+                zip(os_, (oh, ow), st)):
+            if o_have >= 0 and not (0 <= o_want - o_have < s_i):
+                raise ValueError(
+                    f"conv2d_transpose: output_size[{i}]={o_want} not in "
+                    f"[{o_have}, {o_have + s_i - 1}]")
+        oh, ow = os_
     out_shape = ((n, num_filters, oh, ow) if data_format == "NCHW"
                  else (n, oh, ow, num_filters))
     out = _out(helper, input, shape=out_shape)
@@ -357,7 +379,8 @@ def conv2d_transpose(
         outputs={"Output": [out]},
         attrs={"strides": list(st), "paddings": list(pd),
                "dilations": list(dl), "groups": groups,
-               "data_format": data_format},
+               "data_format": data_format,
+               **({"output_size": list(os_)} if os_ is not None else {})},
     )
     if helper.bias_attr is not False:
         b = helper.create_parameter(

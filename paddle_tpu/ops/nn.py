@@ -91,10 +91,25 @@ def _conv2d_transpose(ctx, op, ins):
     # a forward-VALID shape — wrong for every kernel > 1.
     fmt = op.attrs.get("data_format", "NCHW")
     ch_axis = 1 if fmt == "NCHW" else 3
+    hw_axes = (2, 3) if fmt == "NCHW" else (1, 2)
     ke = [(w.shape[2] - 1) * dilations[0] + 1,
           (w.shape[3] - 1) * dilations[1] + 1]
-    pad = [(ke[0] - 1 - paddings[0], ke[0] - 1 - paddings[0]),
-           (ke[1] - 1 - paddings[1], ke[1] - 1 - paddings[1])]
+    # output_size attr (reference conv_transpose output_size) selects
+    # within [formula, formula + stride - 1]: pad the extra rows/cols
+    # on the high side of the output-space conv
+    extra = [0, 0]
+    out_size = op.attrs.get("output_size")
+    if out_size:
+        for i in range(2):
+            formula = ((x.shape[hw_axes[i]] - 1) * strides[i]
+                       - 2 * paddings[i] + ke[i])
+            extra[i] = int(out_size[i]) - formula
+            if not 0 <= extra[i] < strides[i]:
+                raise ValueError(
+                    f"conv2d_transpose: output_size[{i}]={out_size[i]} "
+                    f"not in [{formula}, {formula + strides[i] - 1}]")
+    pad = [(ke[0] - 1 - paddings[0], ke[0] - 1 - paddings[0] + extra[0]),
+           (ke[1] - 1 - paddings[1], ke[1] - 1 - paddings[1] + extra[1])]
 
     def one(xi, wi):
         return jax.lax.conv_transpose(

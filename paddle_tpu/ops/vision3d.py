@@ -83,6 +83,11 @@ def _depthwise_conv2d_transpose(ctx, op, ins):
             "depthwise_conv2d_transpose: only NCHW is lowered (the "
             "vmap-over-channels path is channel-first); transpose the "
             "input or use conv2d_transpose with groups")
+    if any(int(d) != 1 for d in op.attrs.get("dilations", [1, 1])):
+        raise NotImplementedError(
+            "depthwise_conv2d_transpose: dilation > 1 is not lowered "
+            "(the ke/padding math below assumes dilation 1); use "
+            "conv2d_transpose with groups")
     s = _tup(op.attrs.get("strides", [1, 1]), 2)
     p = _tup(op.attrs.get("paddings", [0, 0]), 2)
     ke = [w.shape[2] , w.shape[3]]  # dilation 1 path

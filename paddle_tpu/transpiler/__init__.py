@@ -27,3 +27,5 @@ __all__ = [
     "SingleProcessMultiThread",
     "GeoSgdTranspiler",
 ]
+
+from .layout import auto_nhwc  # noqa: F401,E402

@@ -1,0 +1,109 @@
+"""Automatic NCHW->NHWC layout pass (transpiler/layout.py — the
+reference's layout-transform-pass idea, TPU-native target): flip conv
+regions to channels-last with transposes only at region boundaries,
+training trajectory identical."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.transpiler import auto_nhwc
+
+
+def test_resnet50_auto_nhwc_training_parity():
+    from paddle_tpu.models.resnet import build_resnet50
+
+    rng = np.random.RandomState(0)
+    feed = {"image": rng.randn(2, 3, 32, 32).astype("f"),
+            "label": rng.randint(0, 10, (2, 1)).astype("int64")}
+    losses = {}
+    stats = {}
+    for flip in (False, True):
+        main, startup, feeds, fetches = build_resnet50(
+            num_classes=10, image_size=32)
+        main.random_seed = startup.random_seed = 3
+        with fluid.program_guard(main, startup), fluid.unique_name.guard():
+            if flip:
+                stats["flipped"] = auto_nhwc(main)
+                stats["transposes"] = sum(
+                    1 for op in main.global_block().ops
+                    if op.type == "transpose2")
+            fluid.optimizer.SGD(1e-2).minimize(fetches["loss"])
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.TPUPlace())
+            exe.run(startup)
+            ls = [float(np.asarray(exe.run(main, feed=feed,
+                                           fetch_list=[fetches["loss"]])[0]))
+                  for _ in range(3)]
+        losses[flip] = ls
+    # step 1 must match exactly (same math); later steps only loosely —
+    # NHWC convs reduce in a different order, and batch-norm + SGD on a
+    # 2-sample batch amplifies float32 rounding chaotically
+    np.testing.assert_allclose(losses[False][0], losses[True][0],
+                               rtol=2e-5)
+    np.testing.assert_allclose(losses[False][1], losses[True][1],
+                               rtol=1e-3)
+    # every conv/pool/bn flipped (53 conv + 53 bn + 2 pool = 108)...
+    assert stats["flipped"] >= 108, stats
+    # ...with only BOUNDARY transposes (image in, pre-fc out), not
+    # per-op relayouts
+    assert stats["transposes"] <= 4, stats
+
+
+def test_auto_nhwc_refuses_backward_programs():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data("x", [3, 8, 8])
+        y = fluid.layers.data("y", [1], dtype="int64")
+        h = fluid.layers.conv2d(x, 4, 3, padding=1)
+        loss = fluid.layers.mean(fluid.layers.softmax_with_cross_entropy(
+            fluid.layers.fc(h, 2), y))
+        fluid.optimizer.SGD(1e-2).minimize(loss)
+    with pytest.raises(ValueError, match="forward"):
+        auto_nhwc(main)
+
+
+def test_auto_nhwc_mixed_anchors_and_fetch_shapes():
+    """A region var consumed by a non-flippable op (reshape anchor)
+    gets transposed back; the 4D conv output fetched directly comes
+    back channels-last with matching var metadata."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 5
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data("x", [3, 8, 8])
+        c = fluid.layers.conv2d(x, 4, 3, padding=1,
+                                param_attr=fluid.ParamAttr(name="w"))
+        r = fluid.layers.reshape(c, [-1, 4 * 8 * 8])   # anchor
+        s = fluid.layers.reduce_sum(r)
+    want_c = None
+    rng = np.random.RandomState(1)
+    feed = {"x": rng.randn(2, 3, 8, 8).astype("f")}
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        want_c, want_s = exe.run(main, feed=feed, fetch_list=[c, s])
+
+    main2, startup2 = fluid.Program(), fluid.Program()
+    main2.random_seed = startup2.random_seed = 5
+    with fluid.program_guard(main2, startup2), fluid.unique_name.guard():
+        x2 = fluid.layers.data("x", [3, 8, 8])
+        c2 = fluid.layers.conv2d(x2, 4, 3, padding=1,
+                                 param_attr=fluid.ParamAttr(name="w"))
+        r2 = fluid.layers.reshape(c2, [-1, 4 * 8 * 8])
+        s2 = fluid.layers.reduce_sum(r2)
+        auto_nhwc(main2)
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        exe2 = fluid.Executor(fluid.CPUPlace())
+        exe2.run(startup2)
+        got_c, got_s = exe2.run(main2, feed=feed, fetch_list=[c2, s2])
+    # reshape consumed the NCHW-restored tensor: scalar matches exactly
+    np.testing.assert_allclose(np.asarray(got_s), np.asarray(want_s),
+                               rtol=2e-5)
+    # the fetched conv output itself is now channels-last
+    assert np.asarray(got_c).shape == (2, 8, 8, 4)
+    np.testing.assert_allclose(np.asarray(got_c),
+                               np.asarray(want_c).transpose(0, 2, 3, 1),
+                               rtol=2e-5, atol=2e-6)

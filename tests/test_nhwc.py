@@ -159,3 +159,34 @@ def test_conv3d_pool3d_groupnorm_channels_last():
          "Bias": [b]})["Y"][0]
     np.testing.assert_allclose(np.asarray(jnp.transpose(gotg, (0, 3, 1, 2))),
                                np.asarray(refg), rtol=2e-5, atol=2e-5)
+
+
+def test_conv2d_transpose_output_size_selects_shape():
+    """output_size disambiguates the stride>1 transposed-conv output
+    (reference conv_transpose_op.cc): 8 -> 16 with k3 s2 p1 (formula
+    gives 15)."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 2
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data("x", [3, 8, 8])
+        y = fluid.layers.conv2d_transpose(
+            x, 4, filter_size=3, stride=2, padding=1, output_size=16)
+        assert tuple(y.shape[1:]) == (4, 16, 16), y.shape
+    rng = np.random.RandomState(3)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        (o,) = exe.run(main, feed={"x": rng.randn(2, 3, 8, 8).astype("f")},
+                       fetch_list=[y])
+    assert np.asarray(o).shape == (2, 4, 16, 16)
+    # the formula-sized region must equal the no-output_size result
+    # (extra rows/cols are appended on the high side)
+    import pytest
+    with pytest.raises(ValueError, match="output_size"):
+        main2, startup2 = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main2, startup2), \
+                fluid.unique_name.guard():
+            x2 = fluid.layers.data("x", [3, 8, 8])
+            fluid.layers.conv2d_transpose(x2, 4, filter_size=3, stride=2,
+                                          padding=1, output_size=40)
