@@ -107,3 +107,30 @@ def test_auto_nhwc_mixed_anchors_and_fetch_shapes():
     np.testing.assert_allclose(np.asarray(got_c),
                                np.asarray(want_c).transpose(0, 2, 3, 1),
                                rtol=2e-5, atol=2e-6)
+
+
+def test_se_resnext_auto_nhwc_first_loss_parity():
+    """The pass handles squeeze-excite blocks: fc anchors inside the
+    region (global-pool -> fc -> fc -> reshape -> elementwise_mul gate)
+    restore NCHW where needed and the first loss matches exactly."""
+    from paddle_tpu.models.vision import build_se_resnext
+
+    rng = np.random.RandomState(2)
+    feed = {"image": rng.randn(2, 3, 16, 16).astype("f"),
+            "label": rng.randint(0, 4, (2, 1)).astype("int64")}
+    losses = {}
+    for flip in (False, True):
+        main, startup, feeds, fetches = build_se_resnext(
+            num_classes=4, image_size=16)
+        main.random_seed = startup.random_seed = 11
+        with fluid.program_guard(main, startup), fluid.unique_name.guard():
+            if flip:
+                assert auto_nhwc(main) >= 10
+            fluid.optimizer.SGD(1e-2).minimize(fetches["loss"])
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.TPUPlace())
+            exe.run(startup)
+            (l,) = exe.run(main, feed=feed, fetch_list=[fetches["loss"]])
+            losses[flip] = float(np.asarray(l))
+    np.testing.assert_allclose(losses[False], losses[True], rtol=2e-5)
