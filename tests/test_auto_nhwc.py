@@ -134,3 +134,39 @@ def test_se_resnext_auto_nhwc_first_loss_parity():
             (l,) = exe.run(main, feed=feed, fetch_list=[fetches["loss"]])
             losses[flip] = float(np.asarray(l))
     np.testing.assert_allclose(losses[False], losses[True], rtol=2e-5)
+
+
+def test_auto_nhwc_inference_roundtrip(tmp_path):
+    """save_inference_model on a flipped program serves identically to
+    the NCHW original through the predictor."""
+    d_nchw, d_nhwc = str(tmp_path / "nchw"), str(tmp_path / "nhwc")
+    rng = np.random.RandomState(7)
+    xv = rng.randn(2, 3, 16, 16).astype("f")
+    outs = {}
+    for flip, d in ((False, d_nchw), (True, d_nhwc)):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 13
+        with fluid.program_guard(main, startup), fluid.unique_name.guard():
+            x = fluid.layers.data("x", [3, 16, 16])
+            h = fluid.layers.conv2d(x, 8, 3, padding=1,
+                                    param_attr=fluid.ParamAttr(name="cw"))
+            h = fluid.layers.pool2d(h, 2, "avg", global_pooling=True)
+            y = fluid.layers.fc(h, 5, param_attr=fluid.ParamAttr(name="fw"))
+            if flip:
+                auto_nhwc(main)
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            fluid.io.save_inference_model(d, ["x"], [y], exe,
+                                          main_program=main)
+        from paddle_tpu.inference import Config, create_predictor
+
+        pred = create_predictor(Config(d))
+        hdl = pred.get_input_handle(pred.get_input_names()[0])
+        hdl.copy_from_cpu(xv)
+        pred.zero_copy_run()
+        outs[flip] = np.asarray(
+            pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu())
+    np.testing.assert_allclose(outs[False], outs[True], rtol=2e-5,
+                               atol=2e-6)
