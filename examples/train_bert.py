@@ -1,0 +1,43 @@
+"""BERT pretraining with flash attention + bf16 AMP (BASELINE config 3;
+reference ERNIE/BERT fleet scripts)."""
+
+import argparse
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.contrib.mixed_precision import decorate
+from paddle_tpu.models import BertConfig, build_bert_pretrain
+from paddle_tpu.models.bert import synthetic_batch
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=128)
+    ap.add_argument("--config", default="tiny",
+                    choices=["tiny", "base", "large"])
+    ap.add_argument("--flash", action="store_true",
+                    help="fused Pallas flash attention (TPU)")
+    args = ap.parse_args()
+
+    cfg = getattr(BertConfig, args.config)()
+    cfg.use_flash_attention = args.flash
+    opt = decorate(fluid.optimizer.Adam(1e-4), init_loss_scaling=1.0,
+                   use_dynamic_loss_scaling=False, dest_dtype="bfloat16")
+    main_prog, startup, feeds, fetches = build_bert_pretrain(
+        cfg, args.seq, optimizer=opt)
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(startup)
+
+    rng = np.random.RandomState(0)
+    for step in range(args.steps):
+        batch = synthetic_batch(rng, args.batch, args.seq, cfg.vocab_size)
+        (loss,) = exe.run(main_prog, feed=batch,
+                          fetch_list=[fetches["loss"]])
+        print(f"step {step}: loss={float(np.asarray(loss)):.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,39 @@
+"""LeNet on (synthetic) MNIST — the classic fluid train loop
+(BASELINE config 1; reference book/test_recognize_digits.py)."""
+
+import argparse
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.models import build_lenet
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=100)
+    ap.add_argument("--batch", type=int, default=64)
+    args = ap.parse_args()
+
+    main_prog, startup, feeds, fetches = build_lenet(
+        optimizer=fluid.optimizer.Adam(1e-3))
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(startup)
+
+    rng = np.random.RandomState(0)
+    for step in range(args.steps):
+        # synthetic digits: class = brightest quadrant (learnable)
+        imgs = rng.randn(args.batch, 1, 28, 28).astype("f") * 0.1
+        labels = rng.randint(0, 10, (args.batch, 1)).astype("int64")
+        for i, k in enumerate(labels[:, 0]):
+            imgs[i, 0, (k % 4) * 7:(k % 4) * 7 + 7] += 0.5 + 0.1 * (k // 4)
+        loss, acc = exe.run(main_prog,
+                            feed={"img": imgs, "label": labels},
+                            fetch_list=[fetches["loss"], fetches["acc"]])
+        if step % 10 == 0 or step == args.steps - 1:
+            print(f"step {step}: loss={float(np.asarray(loss)):.4f} "
+                  f"acc={float(np.asarray(acc)):.3f}")
+
+
+if __name__ == "__main__":
+    main()

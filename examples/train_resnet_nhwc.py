@@ -1,0 +1,42 @@
+"""ResNet-50 flipped to the TPU-native channels-last layout by the
+auto_nhwc program pass — model code stays NCHW (reference layout);
+the pass rewrites the program (transpiler/layout.py)."""
+
+import argparse
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.models import build_resnet50
+from paddle_tpu.transpiler import auto_nhwc
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--image-size", type=int, default=64)
+    args = ap.parse_args()
+
+    main_prog, startup, feeds, fetches = build_resnet50(
+        num_classes=10, image_size=args.image_size)
+    with fluid.program_guard(main_prog, startup), \
+            fluid.unique_name.guard():
+        n = auto_nhwc(main_prog)
+        fluid.optimizer.Momentum(1e-2, 0.9).minimize(fetches["loss"])
+    print(f"auto_nhwc flipped {n} ops to channels-last")
+
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    for step in range(args.steps):
+        feed = {"image": rng.randn(args.batch, 3, args.image_size,
+                                   args.image_size).astype("f"),
+                "label": rng.randint(0, 10, (args.batch, 1)).astype("int64")}
+        (loss,) = exe.run(main_prog, feed=feed,
+                          fetch_list=[fetches["loss"]])
+        print(f"step {step}: loss={float(np.asarray(loss)):.4f}")
+
+
+if __name__ == "__main__":
+    main()
