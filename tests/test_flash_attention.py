@@ -390,3 +390,37 @@ def test_stream_residuals_are_linear_in_seq(stream_mode):
     max_leaf = max(int(np.prod(a.shape))
                    for a in jax.tree_util.tree_leaves(res))
     assert max_leaf <= B * H * S * max(D, fa.LANES), max_leaf
+
+
+def test_flash_d128_heads_fwd_bwd():
+    """Head dim 128 — the GPT-3 1.3B flagship shape (16 heads x 128);
+    the suite otherwise exercises D in {32, 64}."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.kernels.flash_attention import (_reference_attention,
+                                                    flash_attention)
+
+    B, H, S, D = 1, 2, 256, 128
+    q, k, v = (_rand((B, H, S, D), i) for i in range(3))
+
+    got = np.asarray(flash_attention(q, k, v, causal=True),
+                     np.float32)
+    want = np.asarray(_reference_attention(
+        jnp.asarray(q, jnp.float32), jnp.asarray(k, jnp.float32),
+        jnp.asarray(v, jnp.float32), 1.0 / np.sqrt(D), True), np.float32)
+    np.testing.assert_allclose(got, want, atol=2e-2, rtol=2e-2)
+
+    def loss_f(q, k, v):
+        return flash_attention(q, k, v, causal=True).astype(
+            jnp.float32).sum()
+
+    def loss_r(q, k, v):
+        return _reference_attention(q, k, v, 1.0 / np.sqrt(D),
+                                    True).astype(jnp.float32).sum()
+
+    g = jax.grad(loss_f, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_r, argnums=(0, 1, 2))(
+        *(jnp.asarray(a, jnp.float32) for a in (q, k, v)))
+    for a, b in zip(g, gr):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b), atol=5e-2, rtol=5e-2)
