@@ -170,3 +170,71 @@ def test_auto_nhwc_inference_roundtrip(tmp_path):
             pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu())
     np.testing.assert_allclose(outs[False], outs[True], rtol=2e-5,
                                atol=2e-6)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4, 5, 6, 7])
+def test_auto_nhwc_random_graphs_match(seed):
+    """Property test: random conv/pool/bn/relu/add/anchor DAGs produce
+    identical scalar outputs after the pass (multi-consumer vars,
+    diamonds, anchors at arbitrary depths)."""
+    rng = np.random.RandomState(100 + seed)
+
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 77
+        with fluid.program_guard(main, startup), fluid.unique_name.guard():
+            x = fluid.layers.data("x", [4, 8, 8])
+            pool = [x]
+            for i in range(6):
+                kind = rng.randint(0, 5)
+                src = pool[rng.randint(0, len(pool))]
+                if kind == 0:
+                    v = fluid.layers.conv2d(
+                        src, 4, 3, padding=1,
+                        param_attr=fluid.ParamAttr(name=f"w{i}"),
+                        bias_attr=fluid.ParamAttr(name=f"bb{i}"))
+                elif kind == 1:
+                    v = fluid.layers.batch_norm(
+                        src, act="relu",
+                        param_attr=fluid.ParamAttr(name=f"s{i}"),
+                        bias_attr=fluid.ParamAttr(name=f"b{i}"),
+                        moving_mean_name=f"m{i}",
+                        moving_variance_name=f"v{i}")
+                elif kind == 2:
+                    v = fluid.layers.pool2d(src, 2, "max", pool_stride=1,
+                                            pool_padding=1)
+                    # keep 8x8 via stride1+pad: shape -> 9x9; crop back
+                    v = fluid.layers.slice(v, axes=[2, 3], starts=[0, 0],
+                                           ends=[8, 8])
+                elif kind == 3:
+                    other = pool[rng.randint(0, len(pool))]
+                    v = fluid.layers.relu(
+                        fluid.layers.elementwise_add(src, other))
+                else:
+                    # anchor in the middle: reshape + back
+                    v = fluid.layers.reshape(src, [-1, 4, 64])
+                    v = fluid.layers.reshape(v, [-1, 4, 8, 8])
+                pool.append(v)
+            total = fluid.layers.reduce_sum(pool[-1])
+            for v in pool[1:-1]:
+                total = fluid.layers.elementwise_add(
+                    total, fluid.layers.reduce_sum(v))
+        return main, startup, total
+
+    rng_state = rng.get_state()
+    feed = {"x": np.random.RandomState(9).randn(2, 4, 8, 8).astype("f")}
+    outs = {}
+    for flip in (False, True):
+        rng.set_state(rng_state)   # identical graph both times
+        main, startup, total = build()
+        if flip:
+            with fluid.program_guard(main, startup), \
+                    fluid.unique_name.guard():
+                auto_nhwc(main)
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            (o,) = exe.run(main, feed=feed, fetch_list=[total])
+            outs[flip] = float(np.asarray(o))
+    np.testing.assert_allclose(outs[False], outs[True], rtol=3e-5)
