@@ -458,23 +458,40 @@ def _multi_child():
             # a diagnostic row: the evidence file itself records WHY
             _emit({"tag": stage["tag"], "error": last_err[:300]})
         gc.collect()  # free the previous stage's device buffers
+        if stage["tag"] == "headline":
+            # kernel timings outrank the remaining evidence stages
+            # (r3 verdict next-step #2): run them right after the
+            # headline so a SHORT relay window still captures them
+            _run_kernel_bench(budget - (time.monotonic() - t0))
 
-    left = budget - (time.monotonic() - t0)
-    if os.environ.get("PT_BENCH_KERNELS") == "1" and left > 240:
-        # the last stage may have flipped the Pallas kill switches off
-        os.environ["PADDLE_TPU_FUSED_KERNELS"] = "1"
-        os.environ["PT_BENCH_FLASH"] = "1"
-        os.environ["PT_KERNEL_BENCH_DEADLINE"] = str(left - 30)
-        sys.path.insert(0, os.path.join(
-            os.path.dirname(os.path.abspath(__file__)), "tools"))
-        try:
-            import kernel_bench  # computes its deadline at import
-
-            kernel_bench.main()
-        except Exception as e:  # noqa: BLE001
-            sys.stderr.write(f"[bench] kernel_bench: "
-                             f"{type(e).__name__}: {e}\n")
+    _run_kernel_bench(budget - (time.monotonic() - t0))
     sys.exit(0)
+
+
+_KERNEL_BENCH_DONE = False
+
+
+def _run_kernel_bench(left):
+    """In-claim Pallas kernel bench (tools/kernel_bench.py); at most
+    once per capture."""
+    global _KERNEL_BENCH_DONE
+    if (_KERNEL_BENCH_DONE or os.environ.get("PT_BENCH_KERNELS") != "1"
+            or left < 240):
+        return
+    _KERNEL_BENCH_DONE = True
+    # the previous stage may have flipped the Pallas kill switches off
+    os.environ["PADDLE_TPU_FUSED_KERNELS"] = "1"
+    os.environ["PT_BENCH_FLASH"] = "1"
+    os.environ["PT_KERNEL_BENCH_DEADLINE"] = str(min(left - 30, 780))
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    try:
+        import kernel_bench  # computes its deadline at import
+
+        kernel_bench.main()
+    except Exception as e:  # noqa: BLE001
+        sys.stderr.write(f"[bench] kernel_bench: "
+                         f"{type(e).__name__}: {e}\n")
 
 
 def _stage_env(stage, pypath, axon_ips):
