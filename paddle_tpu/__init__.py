@@ -72,7 +72,8 @@ from . import flags
 #   import paddle_tpu as fluid
 #   fluid.layers.fc(...)
 
-__version__ = "0.1.0"
+from .version import full_version as __version__  # noqa: E402
+from .version import commit as __git_commit__  # noqa: E402
 
 __all__ = [
     "Program",

@@ -1,0 +1,28 @@
+"""Version metadata module (reference: the version.py that
+python/setup.py.in:67 write_version_py generates — full_version /
+major / minor / patch / rc / istaged / commit / show()). The build
+flag accessor reports the TPU substrate instead of MKL."""
+
+full_version = "0.1.0"
+major = "0"
+minor = "1"
+patch = "0"
+rc = "0"
+istaged = True
+commit = "unknown"
+with_tpu = "ON"
+
+
+def show():
+    if istaged:
+        print("full_version:", full_version)
+        print("major:", major)
+        print("minor:", minor)
+        print("patch:", patch)
+        print("rc:", rc)
+    else:
+        print("commit:", commit)
+
+
+def tpu():
+    return with_tpu
