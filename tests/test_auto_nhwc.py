@@ -238,3 +238,44 @@ def test_auto_nhwc_random_graphs_match(seed):
             (o,) = exe.run(main, feed=feed, fetch_list=[total])
             outs[flip] = float(np.asarray(o))
     np.testing.assert_allclose(outs[False], outs[True], rtol=3e-5)
+
+
+def test_auto_nhwc_composes_with_data_parallel():
+    """Flipped program under a dp4 mesh: loss equals the single-device
+    flipped run (batch-preserving transposes shard cleanly)."""
+    rng = np.random.RandomState(21)
+    feed = {"image": rng.randn(8, 3, 16, 16).astype("f"),
+            "label": rng.randint(0, 4, (8, 1)).astype("int64")}
+    losses = {}
+    for dp in (1, 4):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 31
+        with fluid.program_guard(main, startup), fluid.unique_name.guard():
+            img = fluid.layers.data("image", [3, 16, 16])
+            y = fluid.layers.data("label", [1], dtype="int64")
+            h = fluid.layers.conv2d(img, 8, 3, padding=1,
+                                    param_attr=fluid.ParamAttr(name="c.w"))
+            h = fluid.layers.batch_norm(
+                h, act="relu", param_attr=fluid.ParamAttr(name="n.s"),
+                bias_attr=fluid.ParamAttr(name="n.b"),
+                moving_mean_name="n.m", moving_variance_name="n.v")
+            h = fluid.layers.pool2d(h, 2, "avg", global_pooling=True)
+            loss = fluid.layers.mean(fluid.layers.softmax_with_cross_entropy(
+                fluid.layers.fc(h, 4, param_attr=fluid.ParamAttr(name="f.w")),
+                y))
+            auto_nhwc(main)
+            fluid.optimizer.SGD(1e-2).minimize(loss)
+        prog = main
+        if dp > 1:
+            prog = fluid.CompiledProgram(main).with_data_parallel(
+                loss_name=loss.name,
+                places=[fluid.TPUPlace(i) for i in range(dp)])
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.TPUPlace())
+            exe.run(startup)
+            ls = [float(np.asarray(exe.run(prog, feed=feed,
+                                           fetch_list=[loss])[0]))
+                  for _ in range(3)]
+        losses[dp] = ls
+    np.testing.assert_allclose(losses[1], losses[4], rtol=2e-5, atol=2e-6)
