@@ -1,0 +1,40 @@
+"""Authoring half of the native-trainer demo (reference
+train/demo/demo_network.py): build a regression program pair in python
+and serialize it; examples/native_trainer.c then trains it with no
+Python driver in the loop.
+
+  python examples/author_trainer_program.py /tmp/model
+  gcc examples/native_trainer.c -o ctrainer \
+      -Lpaddle_tpu/capi/build -lpaddle_capi \
+      -Wl,-rpath,paddle_tpu/capi/build $(python3-config --ldflags --embed)
+  ./ctrainer /tmp/model/main.json /tmp/model/startup.json <loss> /tmp/ck
+(the authoring script prints the loss var name)."""
+
+import os
+import sys
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def main():
+    out_dir = sys.argv[1] if len(sys.argv) > 1 else "/tmp/paddle_tpu_demo"
+    os.makedirs(out_dir, exist_ok=True)
+    main_prog, startup = fluid.Program(), fluid.Program()
+    main_prog.random_seed = startup.random_seed = 13
+    with fluid.program_guard(main_prog, startup), fluid.unique_name.guard():
+        x = layers.data("x", [4])
+        y = layers.data("y", [1])
+        pred = layers.fc(x, 1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(0.5).minimize(loss)
+    with open(os.path.join(out_dir, "main.json"), "w") as f:
+        f.write(main_prog.to_json())
+    with open(os.path.join(out_dir, "startup.json"), "w") as f:
+        f.write(startup.to_json())
+    print(out_dir)
+    print(loss.name)
+
+
+if __name__ == "__main__":
+    main()

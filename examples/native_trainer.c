@@ -1,0 +1,52 @@
+/* Native trainer over the PD_Trainer* C ABI: loads the serialized
+ * program pair written by examples/author_trainer_program.py and runs
+ * the whole training loop from C — no Python driver in the loop
+ * (reference paddle/fluid/train/demo/demo_trainer.cc).
+ *
+ * argv: main.json startup.json loss_var_name save_dir */
+#include <stdio.h>
+#include <stdint.h>
+
+extern int PD_Init();
+extern void *PD_TrainerNew(const char *, const char *);
+extern void PD_TrainerDelete(void *);
+extern int PD_TrainerSetInputFloat(void *, const char *, const float *,
+                                   const int64_t *, int);
+extern int PD_TrainerRunStep(void *, const char *, double *);
+extern int PD_TrainerSavePersistables(void *, const char *);
+
+int main(int argc, char **argv) {
+  if (argc < 5) {
+    fprintf(stderr, "usage: %s main.json startup.json loss save_dir\n",
+            argv[0]);
+    return 64;
+  }
+  if (PD_Init() != 0) return 1;
+  void *t = PD_TrainerNew(argv[1], argv[2]);
+  if (!t) return 2;
+
+  /* deterministic y = 2*sum(x) - 1 regression data */
+  float x[16 * 4], y[16 * 1];
+  for (int i = 0; i < 16; ++i) {
+    float s = 0.f;
+    for (int j = 0; j < 4; ++j) {
+      x[i * 4 + j] = (float)((i * 7 + j * 3) % 11) / 11.0f - 0.5f;
+      s += x[i * 4 + j];
+    }
+    y[i] = 2.0f * s - 1.0f;
+  }
+  int64_t xs[2] = {16, 4}, ys[2] = {16, 1};
+  if (PD_TrainerSetInputFloat(t, "x", x, xs, 2) != 0) return 3;
+  if (PD_TrainerSetInputFloat(t, "y", y, ys, 2) != 0) return 4;
+
+  double first = 0, loss = 0;
+  for (int step = 0; step < 60; ++step) {
+    if (PD_TrainerRunStep(t, argv[3], &loss) != 0) return 5;
+    if (step == 0) first = loss;
+  }
+  printf("first=%.6f last=%.6f\n", first, loss);
+  if (!(loss < first * 0.2)) return 6;
+  if (PD_TrainerSavePersistables(t, argv[4]) != 0) return 7;
+  PD_TrainerDelete(t);
+  return 0;
+}

@@ -12,90 +12,37 @@ import numpy as np
 import pytest
 
 import paddle_tpu as fluid
-from paddle_tpu import layers
 
-C_MAIN = r"""
-#include <stdio.h>
-#include <stdint.h>
-
-extern int PD_Init();
-extern void *PD_TrainerNew(const char *, const char *);
-extern void PD_TrainerDelete(void *);
-extern int PD_TrainerSetInputFloat(void *, const char *, const float *,
-                                   const int64_t *, int);
-extern int PD_TrainerRunStep(void *, const char *, double *);
-extern int PD_TrainerSavePersistables(void *, const char *);
-
-int main(int argc, char **argv) {
-  /* argv: main.json startup.json loss_name save_dir */
-  if (PD_Init() != 0) return 1;
-  void *t = PD_TrainerNew(argv[1], argv[2]);
-  if (!t) return 2;
-
-  /* deterministic y = 2x - 1 regression data */
-  float x[16 * 4], y[16 * 1];
-  for (int i = 0; i < 16; ++i) {
-    float s = 0.f;
-    for (int j = 0; j < 4; ++j) {
-      x[i * 4 + j] = (float)((i * 7 + j * 3) % 11) / 11.0f - 0.5f;
-      s += x[i * 4 + j];
-    }
-    y[i] = 2.0f * s - 1.0f;
-  }
-  int64_t xs[2] = {16, 4}, ys[2] = {16, 1};
-  if (PD_TrainerSetInputFloat(t, "x", x, xs, 2) != 0) return 3;
-  if (PD_TrainerSetInputFloat(t, "y", y, ys, 2) != 0) return 4;
-
-  double first = 0, loss = 0;
-  for (int step = 0; step < 60; ++step) {
-    if (PD_TrainerRunStep(t, argv[3], &loss) != 0) return 5;
-    if (step == 0) first = loss;
-  }
-  printf("first=%.6f last=%.6f\n", first, loss);
-  if (!(loss < first * 0.2)) return 6;
-  if (PD_TrainerSavePersistables(t, argv[4]) != 0) return 7;
-  PD_TrainerDelete(t);
-  return 0;
-}
-"""
 
 
 def test_c_trainer_trains_saved_program(tmp_path):
-    # -- python authoring side (reference demo_network.py) -------------
-    main, startup = fluid.Program(), fluid.Program()
-    main.random_seed = startup.random_seed = 13
-    with fluid.program_guard(main, startup), fluid.unique_name.guard():
-        x = layers.data("x", [4])
-        y = layers.data("y", [1])
-        pred = layers.fc(x, 1)
-        loss = layers.mean(layers.square_error_cost(pred, y))
-        fluid.optimizer.SGD(0.5).minimize(loss)
-    main_p = str(tmp_path / "main.json")
-    startup_p = str(tmp_path / "startup.json")
-    with open(main_p, "w") as f:
-        f.write(main.to_json())
-    with open(startup_p, "w") as f:
-        f.write(startup.to_json())
+    # -- python authoring side: the EXAMPLE script (so it can't rot) ---
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": here}
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    author = subprocess.run(
+        [sys.executable, os.path.join(here, "examples",
+                                      "author_trainer_program.py"),
+         str(tmp_path)],
+        capture_output=True, text=True, env=env, timeout=300, check=True)
+    out_dir, loss_name = author.stdout.split()
+    main_p = os.path.join(out_dir, "main.json")
+    startup_p = os.path.join(out_dir, "startup.json")
 
-    # -- native side ---------------------------------------------------
+    # -- native side: the EXAMPLE C driver -----------------------------
     from paddle_tpu.capi.build import build, embed_flags
 
     so = build()
-    csrc = tmp_path / "trainer_main.c"
-    csrc.write_text(C_MAIN)
+    csrc = os.path.join(here, "examples", "native_trainer.c")
     exe_path = str(tmp_path / "ctrainer")
     cflags, ldflags = embed_flags()
     subprocess.run(
-        ["gcc", str(csrc), "-o", exe_path, f"-L{os.path.dirname(so)}",
+        ["gcc", csrc, "-o", exe_path, f"-L{os.path.dirname(so)}",
          "-lpaddle_capi", f"-Wl,-rpath,{os.path.dirname(so)}"] + ldflags,
         check=True, capture_output=True)
 
     save_dir = str(tmp_path / "persist")
-    env = {**os.environ, "JAX_PLATFORMS": "cpu",
-           "PYTHONPATH": os.path.dirname(os.path.dirname(
-               os.path.abspath(__file__)))}
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    proc = subprocess.run([exe_path, main_p, startup_p, loss.name, save_dir],
+    proc = subprocess.run([exe_path, main_p, startup_p, loss_name, save_dir],
                           capture_output=True, text=True, env=env,
                           timeout=420)
     assert proc.returncode == 0, (proc.returncode, proc.stdout, proc.stderr)
