@@ -81,3 +81,61 @@ def test_checkpoint_resumes_across_topologies(tmp_path):
         np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-6,
                                    err_msg=f"resume on {n} device(s)")
     assert want[-1] < first_losses[0], (first_losses, want)
+
+
+def test_expert_parallel_checkpoint_resumes_elsewhere(tmp_path):
+    """A checkpoint written mid-training under ep4 expert parallelism
+    (expert weights AND Adam moments sharded over ep) resumes dense
+    and under ep2 with identical loss trajectories."""
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 51
+        with fluid.program_guard(main, startup), fluid.unique_name.guard():
+            x = fluid.layers.data("x", [4, 8])
+            y = fluid.layers.data("y", [4, 8])
+            out, aux = fluid.layers.switch_moe(x, 4, 16,
+                                               capacity_factor=8.0)
+            loss = fluid.layers.mean(fluid.layers.elementwise_add(
+                fluid.layers.mean(fluid.layers.square_error_cost(out, y)),
+                fluid.layers.scale(aux, scale=0.01)))
+            fluid.optimizer.Adam(5e-3).minimize(loss)
+        return main, startup, loss
+
+    def ep_prog(main, n, dispatch="psum"):
+        if n == 1:
+            return main
+        return fluid.CompiledProgram(main).with_expert_parallel(
+            ep=n, dispatch=dispatch,
+            places=[fluid.TPUPlace(i) for i in range(n)])
+
+    rng = np.random.RandomState(3)
+    feeds = [{"x": rng.randn(8, 4, 8).astype("f"),
+              "y": rng.randn(8, 4, 8).astype("f")} for _ in range(6)]
+    ck = str(tmp_path / "ck")
+
+    main, startup, loss = build()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        prog = ep_prog(main, 4)
+        for f in feeds[:3]:
+            exe.run(prog, feed=f, fetch_list=[loss])
+        fluid.io.save_checkpoint(ck, main_program=main, scope=scope)
+        want = [float(np.asarray(exe.run(prog, feed=f,
+                                         fetch_list=[loss])[0]))
+                for f in feeds[3:]]
+
+    for n in (1, 2):
+        main2, startup2, loss2 = build()
+        scope2 = fluid.Scope()
+        with fluid.scope_guard(scope2):
+            exe2 = fluid.Executor(fluid.TPUPlace())
+            exe2.run(startup2)
+            fluid.io.load_checkpoint(ck, main_program=main2, scope=scope2)
+            got = [float(np.asarray(
+                exe2.run(ep_prog(main2, n, "alltoall" if n > 1 else "psum"),
+                         feed=f, fetch_list=[loss2])[0]))
+                for f in feeds[3:]]
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-6,
+                                   err_msg=f"resume ep={n}")
