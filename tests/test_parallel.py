@@ -400,3 +400,35 @@ def test_gpt_trains_with_ulysses_sequence_parallel():
             (l,) = exe.run(prog, feed=batch, fetch_list=[fetches["loss"]])
             losses[mode] = float(np.asarray(l))
     assert abs(losses["single"] - losses["ulysses"]) < 2e-4, losses
+
+
+@pytest.mark.parametrize("mode", ["ring", "ulysses"])
+def test_gpt_trains_with_combined_dp_sp(mode):
+    """dp2 x sp4 combined mesh (8 devices): batch shards over dp,
+    sequence over sp, loss parity vs single device — the combined-axis
+    path of with_sequence_parallel (dp>1) for both strategies."""
+    import paddle_tpu as fluid
+    from paddle_tpu.models.gpt import (GPTConfig, build_gpt_lm,
+                                       synthetic_lm_batch)
+
+    cfg = GPTConfig.tiny()            # 4 heads: ulysses needs H % sp == 0
+    cfg.use_flash_attention = True
+    batch = synthetic_lm_batch(np.random.RandomState(0), 4, 64,
+                               cfg.vocab_size)
+    losses = {}
+    for run in ("single", "dpsp"):
+        main, startup, _, fetches = build_gpt_lm(
+            cfg, 64, optimizer=fluid.optimizer.Adam(1e-3))
+        main.random_seed = startup.random_seed = 29
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.TPUPlace())
+            exe.run(startup)
+            prog = main
+            if run == "dpsp":
+                prog = fluid.CompiledProgram(main).with_sequence_parallel(
+                    sp=4, dp=2, mode=mode,
+                    places=[fluid.TPUPlace(i) for i in range(8)])
+            (l,) = exe.run(prog, feed=batch, fetch_list=[fetches["loss"]])
+            losses[run] = float(np.asarray(l))
+    assert abs(losses["single"] - losses["dpsp"]) < 2e-4, (mode, losses)
