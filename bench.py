@@ -75,6 +75,14 @@ BASELINES = {
     ("resnet", 224): 2_500.0,
 }
 
+# The effective A100 rate the BASELINES table encodes: 190k tok/s x
+# 674e6 FLOPs/tok = 128 TFLOP/s (~41% of A100 bf16 peak). Non-headline
+# configs (bert-tiny canary, bert-large, MoE variants) get their
+# baseline by dividing this rate by THEIR OWN FLOPs/token — round-4
+# verdict weak #3: the canary (a ~50x smaller model) was divided by
+# the bert-base baseline and reported "2.46x A100" at mfu 0.003.
+A100_EFF_FLOPS = 128e12
+
 # bf16 peak FLOP/s per chip by device kind substring
 TPU_PEAKS = [
     ("v6e", 918e12), ("v6", 918e12),
@@ -194,8 +202,12 @@ def _batch_for(kind, np, batch, seq, cfg):
 def _use_flash():
     import jax
 
-    return jax.default_backend() == "tpu" and os.environ.get(
-        "PT_BENCH_FLASH", "1") == "1"
+    if os.environ.get("PT_BENCH_FLASH", "1") != "1":
+        return False
+    # interpreter-mode kernels run anywhere — lets the CI smoke test
+    # (tests/test_bench_smoke.py) walk the flash stages on CPU
+    return (jax.default_backend() == "tpu"
+            or os.environ.get("PADDLE_TPU_KERNEL_INTERPRET") == "1")
 
 
 def main():
@@ -355,15 +367,29 @@ def run_stage_inproc(kind, model, batch, seq, steps, warmup, flash):
         # ResNet-50 fwd ~4.1 GFLOPs @224; train ~3x fwd
         flops_per_sample = 3 * 4.1e9  # 12.3 GFLOPs
         mfu = value * flops_per_sample / peak if on_tpu else None
-        baseline = BASELINES.get(("resnet", seq))
+        # both layouts are the same model — the 2500 img/s applies
+        baseline = (BASELINES.get(("resnet", seq))
+                    if model.startswith("resnet50") else None)
+        baseline_kind = "table" if baseline else None
     else:
         value = batch * seq * steps / dt
         unit = "tokens/s"
         metric = "tokens_per_sec_per_chip"
         flops_per_tok = 6.0 * n_params
         mfu = value * flops_per_tok / peak if on_tpu else None
-        baseline = (BASELINES.get((f"{kind}_{model}", seq))
-                    or BASELINES.get((kind, seq)))
+        # the table rows name specific models (bert=base, gpt=small,
+        # bert_large); anything else gets a FLOPs-scaled baseline so
+        # vs_baseline always means "vs an A100 running THIS model"
+        canonical = {"bert": "base", "gpt": "small"}.get(kind)
+        baseline = BASELINES.get((f"{kind}_{model}", seq)) or (
+            BASELINES.get((kind, seq)) if model == canonical else None)
+        baseline_kind = "table" if baseline else None
+        if baseline is None and cfg is not None:
+            # fwd+bwd attention term, same arithmetic as the module
+            # docstring: 12 * L * d * S
+            attn = 12.0 * cfg.num_layers * cfg.hidden_size * seq
+            baseline = A100_EFF_FLOPS / (flops_per_tok + attn)
+            baseline_kind = "flops_scaled"
 
     return {
         "metric": metric,
@@ -371,9 +397,13 @@ def run_stage_inproc(kind, model, batch, seq, steps, warmup, flash):
         "unit": unit,
         "vs_baseline": (round(value / baseline, 4)
                         if baseline else None),
+        "baseline_kind": baseline_kind,
         "config": {"kind": kind, "model": model, "batch": batch,
                    "seq": seq, "steps": steps, "amp": "bfloat16",
-                   "flash": _use_flash()},
+                   "flash": _use_flash(),
+                   **({"data_format":
+                       "NHWC" if model.endswith("_nhwc") else "NCHW"}
+                      if kind == "resnet" else {})},
         "backend": jax.default_backend(),
         "device_kind": device_kind,
         "mfu": round(mfu, 4) if mfu is not None else None,
@@ -418,6 +448,12 @@ def _multi_child():
 
     if jax.default_backend() != "tpu":
         sys.exit(3)
+    # waiter mode (round-5): with a very large PT_BENCH_IMPORT_BUDGET
+    # this child sits in the relay claim queue for hours and starts
+    # capturing the moment the grant lands — so the stage/kernel budget
+    # clock must start at GRANT time, not process start, or a grant
+    # arriving after `budget` seconds would trip the watchdog instantly
+    t0 = time.monotonic()
     phase["code"] = 17
     phase["deadline"] = t0 + budget
 

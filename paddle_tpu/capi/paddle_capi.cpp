@@ -60,11 +60,22 @@ PyObject *np_array_from(const void *data, const int64_t *shape, int ndim,
                         const char *dtype, size_t elem_size) {
   int64_t numel = 1;
   for (int i = 0; i < ndim; ++i) numel *= shape[i];
+  // allocation failures must surface as a capturable error, not a
+  // nullptr deref in the embedding host (round-4 advisor finding)
   PyObject *shape_t = PyTuple_New(ndim);
+  if (!shape_t) {
+    capture_error();
+    return nullptr;
+  }
   for (int i = 0; i < ndim; ++i)
     PyTuple_SetItem(shape_t, i, PyLong_FromLongLong(shape[i]));
   PyObject *mv = PyMemoryView_FromMemory(
       (char *)data, numel * (int64_t)elem_size, PyBUF_READ);
+  if (!mv) {
+    capture_error();
+    Py_DECREF(shape_t);
+    return nullptr;
+  }
   PyObject *arr = PyObject_CallMethod(g_np_mod, "frombuffer", "Os", mv, dtype);
   Py_DECREF(mv);
   if (!arr) {

@@ -420,11 +420,15 @@ class Executor:
             program.version,
             feed_sig,
             tuple(fetch_names),
-            # the mesh SHAPE and sharding choices, not just presence:
-            # the same program compiled dp-then-sp (or with different
-            # expert placements) must not hit the stale executable
-            tuple(sorted(dict(mesh.shape).items())) if mesh is not None
-            else None,
+            # the mesh SHAPE, DEVICE SET and sharding choices, not just
+            # presence: the same program compiled dp-then-sp (or with
+            # different expert placements) must not hit the stale
+            # executable, and two same-shape meshes over different
+            # devices (e.g. [0,1] vs [2,3]) compile to different
+            # device assignments
+            (tuple(sorted(dict(mesh.shape).items())),
+             tuple(d.id for d in mesh.devices.flat))
+            if mesh is not None else None,
             tuple(sorted((k, tuple(v)) for k, v in state_shardings.items()))
             if state_shardings else None,
             tuple(sorted(axis_env.items())) if axis_env else None,

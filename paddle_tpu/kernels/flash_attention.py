@@ -95,7 +95,13 @@ def _reference_attention(q, k, v, sm_scale, causal, mask=None, bias=None):
 
 
 def _pallas_mode() -> Optional[str]:
-    if os.environ.get("PADDLE_TPU_FLASH_INTERPRET", ""):
+    # PADDLE_TPU_KERNEL_INTERPRET is the shared interpret switch the
+    # other fused kernels (layer_norm, softmax_xent) use — honoring it
+    # here keeps CI smoke coverage real: with only the flash-specific
+    # var, tests/test_bench_smoke.py's flash stages silently took the
+    # XLA fallback on CPU (round-5 review finding)
+    if (os.environ.get("PADDLE_TPU_FLASH_INTERPRET", "")
+            or os.environ.get("PADDLE_TPU_KERNEL_INTERPRET", "")):
         return "interpret"
     if jax.default_backend() == "tpu":
         return "tpu"

@@ -359,7 +359,14 @@ def conv2d_transpose(
 
     oh = _o(h, fs[0], pd[0], st[0], dl[0])
     ow = _o(w_, fs[1], pd[1], st[1], dl[1])
-    if os_ is not None and filter_size is not None:
+    if os_ is not None and filter_size is None:
+        # derived-kernel path: the floor division in the fs derivation
+        # can make the formula output smaller than the requested
+        # output_size when dilation > 1; the op's `extra` padding
+        # guarantees the runtime shape IS output_size, so the static
+        # metadata must match it (round-4 advisor finding)
+        oh, ow = os_
+    elif os_ is not None:
         # output_size disambiguates the stride>1 output within
         # [formula, formula + stride - 1] (reference conv_transpose
         # semantics); the op lowering pads the extra rows/cols

@@ -125,11 +125,15 @@ def auto_nhwc(program: Program) -> int:
         elif t in _UNARY_PASS:
             xname = op.inputs.get("X", [None])[0]
             if xname in nhwc:
+                # unary ops preserve shape, so a channels-last input
+                # makes EVERY output channels-last at runtime — mark
+                # them even when shape metadata is missing (shape None
+                # left an unmarked-NHWC var that downstream anchors
+                # consumed as NCHW; round-4 advisor finding)
                 for names in op.outputs.values():
                     for oname in names:
-                        if _is4d(block, oname):
-                            nhwc.add(oname)
-                            _permute_meta(oname)
+                        nhwc.add(oname)
+                        _permute_meta(oname)
             new_ops.append(op)
         elif t in _EW_PASS:
             xs = op.inputs.get("X", [])

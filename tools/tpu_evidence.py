@@ -1,24 +1,35 @@
-"""Continuous TPU performance evidence capture (round-3 verdict
-next-step #1: probe all round, fire the ladder at the first up-window;
-round-4 redesign: ONE relay claim per cycle).
+"""Continuous TPU performance evidence capture.
 
-Run from the repo root with the normal (axon) environment:
-    python tools/tpu_evidence.py            # one cycle
-    python tools/tpu_evidence.py --loop 600 # all round (nohup this)
+Round-3: probe all round, fire the ladder at the first up-window.
+Round-4: ONE relay claim per cycle (killing a hung claimant drops its
+relay session, which wedges the relay for hours).
+Round-5 redesign (verdict next-step #6): the round-4 loop still
+*cycled* — every ~17 min it enqueued a claimant, waited 420 s, and
+os._exit()ed it. A claimant that exits JUST as the relay issues its
+grant orphans that grant ("grant unclaimed past timeout — client
+lost"), wedging the relay again — the loop could self-perpetuate the
+wedge it was probing. This version keeps ONE infinitely-patient
+claimant in the queue:
 
-Each cycle runs bench.py, whose one-claim multi-stage child probes the
-relay by importing jax and — if live — walks the whole ladder (canary
--> BERT-512 headline -> GPT/ResNet evidence stages) plus the Pallas
-kernel bench in ONE interpreter holding ONE relay claim. The old flow
-made 3-6 claims per cycle (probe child, bench re-probe, one child per
-stage, kernel bench) and killing any hung claimant dropped a session,
-which is what wedges the relay for hours (r3/r4 probe logs: every
-TIMEOUT follows a killed claimant).
+  * the bench multi-child gets PT_BENCH_IMPORT_BUDGET = the whole
+    round, so it NEVER exits pre-grant (no ghost-grant race, by
+    construction);
+  * the moment the grant lands, its stage/kernel budget clock starts
+    (bench.py resets t0 post-import) and the full ladder + Pallas
+    kernel bench runs inside the one claim;
+  * the loop heartbeats every 10 min into probe_log.txt — the log now
+    distinguishes QUEUED (waiting, harmless) from CAPTURING from
+    GRANT outcomes instead of 30 identical NO_CAPTURE lines;
+  * every cycle outcome lands in .bench_evidence/wedge_summary.json
+    (the per-round wedge summary the round-4 verdict asked for).
+
+tools/relay_probe.py is the manual triage tool for classifying a
+wedge (clean-timeout claim attempt + client-log fingerprints); it is
+NOT run while the waiter is queued — extra claimants would only add
+grant-race surface.
 
 TPU rows append to BENCH_TPU_EVIDENCE.json; kernel timings land in
-KERNEL_BENCH_TPU.json (written by tools/kernel_bench.py in-process);
-every attempt is timestamped in .bench_evidence/probe_log.txt — the
-committed log is itself evidence that every attempt was made.
+KERNEL_BENCH_TPU.json (written by tools/kernel_bench.py in-process).
 """
 
 import datetime
@@ -26,14 +37,19 @@ import json
 import os
 import subprocess
 import sys
+import time
 
 HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 EVIDENCE = os.path.join(HERE, "BENCH_TPU_EVIDENCE.json")
 PROBE_LOG = os.path.join(HERE, ".bench_evidence", "probe_log.txt")
+WEDGE_SUMMARY = os.path.join(HERE, ".bench_evidence", "wedge_summary.json")
 
-# generous deadline when self-driven (the driver's own end-of-round run
-# keeps bench.py's 850s default): canary+headline+bonus+kernels
-CYCLE_DEADLINE = int(os.environ.get("PT_EVIDENCE_DEADLINE", "2400"))
+# budget for the ladder + kernel bench ONCE the claim is granted
+CAPTURE_BUDGET = int(os.environ.get("PT_EVIDENCE_DEADLINE", "2400"))
+# how long the claimant may sit in the queue before the cycle is
+# abandoned (default: effectively the whole round)
+WAIT_BUDGET = int(os.environ.get("PT_EVIDENCE_WAIT", str(10 * 3600)))
+HEARTBEAT_S = 600
 
 
 def _now():
@@ -45,6 +61,21 @@ def _log_probe(line):
     os.makedirs(os.path.dirname(PROBE_LOG), exist_ok=True)
     with open(PROBE_LOG, "a") as f:
         f.write(f"{_now()} {line}\n")
+
+
+def _record_outcome(outcome, **kw):
+    """Append a cycle outcome to the per-round wedge summary."""
+    os.makedirs(os.path.dirname(WEDGE_SUMMARY), exist_ok=True)
+    hist = []
+    if os.path.exists(WEDGE_SUMMARY):
+        try:
+            with open(WEDGE_SUMMARY) as f:
+                hist = json.load(f)
+        except (json.JSONDecodeError, OSError):
+            hist = []
+    hist.append({"at": _now(), "outcome": outcome, **kw})
+    with open(WEDGE_SUMMARY, "w") as f:
+        json.dump(hist, f, indent=1)
 
 
 def _append_evidence(rec):
@@ -65,68 +96,107 @@ def _append_evidence(rec):
         json.dump(hist, f, indent=1)
 
 
-def _once():
-    """One capture cycle = one bench.py run = at most ONE relay claim.
+def _once(wait_s=WAIT_BUDGET):
+    """One capture cycle = one bench.py run = ONE patient relay claim.
     Returns 0 on a TPU capture, nonzero otherwise."""
     env = dict(os.environ)
     if not env.get("PALLAS_AXON_POOL_IPS"):
         _log_probe("cycle=SKIP no axon env")
         return 1
-    env["PT_BENCH_DEADLINE"] = str(CYCLE_DEADLINE)
+    env["PT_BENCH_DEADLINE"] = str(CAPTURE_BUDGET)
     env["PT_BENCH_KERNELS"] = "1"       # kernel bench inside the claim
     env["PT_BENCH_CPU_FALLBACK"] = "0"  # relay-down cycles just log
-    env["PT_BENCH_IMPORT_BUDGET"] = "420"  # patient: see bench.py note
+    env["PT_BENCH_IMPORT_BUDGET"] = str(wait_s)  # patient claimant
     env["PT_BENCH_NO_CACHED"] = "1"  # never re-report our own captures
+    t0 = time.monotonic()
+    _log_probe(f"cycle=START wait_budget={wait_s}s "
+               f"capture_budget={CAPTURE_BUDGET}s")
+    # stdio to FILES, not pipes: this loop polls for HOURS without
+    # reading; a child filling a 64KiB pipe would block in write() and
+    # get hard-killed while holding a granted relay claim — the exact
+    # wedge trigger the patient-waiter design exists to avoid
+    # (round-5 review finding)
+    import tempfile
+
+    outf = tempfile.NamedTemporaryFile(
+        mode="w+", prefix="pt_evidence_out_", delete=False)
+    errf = tempfile.NamedTemporaryFile(
+        mode="w+", prefix="pt_evidence_err_", delete=False)
     try:
-        proc = subprocess.run(
+        proc = subprocess.Popen(
             [sys.executable, os.path.join(HERE, "bench.py")],
-            capture_output=True, text=True, timeout=CYCLE_DEADLINE + 300,
-            env=env,
-        )
-    except subprocess.TimeoutExpired:
-        _log_probe("cycle=HARD_TIMEOUT (orchestrator overran)")
-        return 2
-    # keep the last cycle's full stderr for diagnosis — stage errors
-    # only live there when the cycle still produced a capture
+            stdout=outf, stderr=errf, text=True, env=env)
+        hard_deadline = t0 + wait_s + CAPTURE_BUDGET + 600
+        next_beat = t0 + HEARTBEAT_S
+        while proc.poll() is None:
+            time.sleep(10)
+            now = time.monotonic()
+            if now >= next_beat:
+                _log_probe(f"cycle=QUEUED {int(now - t0)}s elapsed "
+                           f"(claimant alive, no grant yet or capturing)")
+                next_beat = now + HEARTBEAT_S
+            if now > hard_deadline:
+                # past wait+capture+slack: the orchestrator itself is
+                # stuck. Killing here CAN orphan a just-granted
+                # session, but at this point the round is over anyway.
+                proc.kill()
+                proc.wait()
+                _log_probe("cycle=HARD_TIMEOUT (orchestrator overran)")
+                _record_outcome("HARD_TIMEOUT", waited_s=int(now - t0))
+                return 2
+        outf.seek(0)
+        out = outf.read()
+        errf.seek(0)
+        err = errf.read()
+    finally:
+        for f in (outf, errf):
+            f.close()
+            try:
+                os.unlink(f.name)
+            except OSError:
+                pass
+    waited = int(time.monotonic() - t0)
     with open(os.path.join(HERE, ".bench_evidence",
                            "last_cycle_stderr.log"), "w") as f:
-        f.write(proc.stderr[-20000:])
+        f.write(err[-20000:])
     rec = None
-    for line in proc.stdout.splitlines():
+    for line in out.splitlines():
         if line.startswith("{"):
             try:
                 rec = json.loads(line)
             except json.JSONDecodeError:
                 pass
     if rec is None:
-        tail = proc.stderr.strip().splitlines()
+        tail = err.strip().splitlines()
         _log_probe(f"cycle=NO_CAPTURE rc={proc.returncode} "
+                   f"waited={waited}s "
                    f"tail={tail[-1][-200:] if tail else ''!r}")
+        _record_outcome("NO_CAPTURE", rc=proc.returncode, waited_s=waited)
         return 2
     if rec.get("cached"):
-        # bench re-surfaced an EARLIER capture (belt for the
-        # PT_BENCH_NO_CACHED suspender): not a new datapoint —
-        # appending it would re-stamp an old row as fresh
         _log_probe("cycle=CACHED_ONLY (no live capture)")
+        _record_outcome("CACHED_ONLY", waited_s=waited)
         return 2
     _append_evidence(rec)
     n_extra = len(rec.get("extra", []))
     _log_probe(f"cycle=TPU_CAPTURE tag={rec.get('tag')} "
                f"value={rec.get('value')} {rec.get('unit')} "
-               f"mfu={rec.get('mfu')} extra_stages={n_extra}")
+               f"mfu={rec.get('mfu')} extra_stages={n_extra} "
+               f"waited={waited}s")
+    _record_outcome("TPU_CAPTURE", waited_s=waited,
+                    tag=rec.get("tag"), value=rec.get("value"))
     print(json.dumps(rec))
     return 0
 
 
 def _loop(interval):
-    """Continuous capture: one bench cycle every `interval` s for the
-    whole round. A builder needing the relay for manual work touches
-    .bench_evidence/pause; the loop logs the skip and stays clear of
-    the single-claim relay."""
-    import time
-
+    """Continuous capture. With the patient-waiter design `interval`
+    only paces RE-captures after a success; a no-grant cycle already
+    spans the whole round. A builder needing the relay for manual work
+    touches .bench_evidence/pause BEFORE a cycle starts."""
     pause = os.path.join(HERE, ".bench_evidence", "pause")
-    _log_probe(f"loop=START interval={interval}s pid={os.getpid()}")
+    _log_probe(f"loop=START interval={interval}s pid={os.getpid()} "
+               f"mode=patient-waiter")
     while True:
         if os.path.exists(pause):
             _log_probe("loop=PAUSED (pause file present)")
