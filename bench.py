@@ -446,7 +446,16 @@ def _multi_child():
 
     import jax  # dials + claims the relay (sitecustomize)
 
-    if jax.default_backend() != "tpu":
+    try:
+        backend = jax.default_backend()
+    except RuntimeError as e:
+        # the claim RESOLVED with an error instead of hanging — seen
+        # ~25 min into a wedge: "UNAVAILABLE: TPU backend setup/compile
+        # error". A definitive relay-side answer, not a harness bug;
+        # exit 3 (relay down) so the loop classifies it as such.
+        sys.stderr.write(f"[bench] backend init failed: {e}\n")
+        sys.exit(3)
+    if backend != "tpu":
         sys.exit(3)
     # waiter mode (round-5): with a very large PT_BENCH_IMPORT_BUDGET
     # this child sits in the relay claim queue for hours and starts

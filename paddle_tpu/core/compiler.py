@@ -71,6 +71,22 @@ class CompiledProgram:
         self._state_shardings = None
         # extra lowering-context entries (e.g. sp_mode) for this compile
         self._axis_env = None
+        # which with_* strategy built _mesh (chaining guard)
+        self._strategy = None
+
+    def _claim_strategy(self, name: str) -> None:
+        """Each compile takes exactly ONE with_* strategy. Chaining
+        with_sequence_parallel().with_expert_parallel() used to
+        silently keep only the last mesh/shardings (round-4 advisor
+        finding); combined meshes are built by the single strategy's
+        own dp=... argument instead."""
+        if self._strategy is not None:
+            raise ValueError(
+                f"CompiledProgram: {name} after {self._strategy} — "
+                f"strategies are mutually exclusive per compile; use "
+                f"the dp= argument of {self._strategy} (or a fresh "
+                f"CompiledProgram) for combined meshes")
+        self._strategy = name
 
     def with_data_parallel(
         self,
@@ -89,6 +105,7 @@ class CompiledProgram:
         from jax.sharding import Mesh, PartitionSpec as P
         import numpy as np
 
+        self._claim_strategy("with_data_parallel")
         if build_strategy is not None:
             self._build_strategy = build_strategy
         devs = np.array(places_to_devices(places) if places else jax.devices())
@@ -140,6 +157,7 @@ class CompiledProgram:
         if mode not in ("ring", "ulysses"):
             raise ValueError(f"with_sequence_parallel: mode must be "
                              f"'ring' or 'ulysses', got {mode!r}")
+        self._claim_strategy("with_sequence_parallel")
         self._axis_env = {"sp_mode": mode}
         self._mesh = self._axis_mesh("sp", sp, dp, places)
         shardings = {}
@@ -185,6 +203,7 @@ class CompiledProgram:
         if dispatch not in ("psum", "alltoall"):
             raise ValueError(f"with_expert_parallel: dispatch must be "
                              f"'psum' or 'alltoall', got {dispatch!r}")
+        self._claim_strategy("with_expert_parallel")
         self._axis_env = {"ep_dispatch": dispatch}
         self._mesh = self._axis_mesh("ep", ep, dp, places)
         shardings = {}
@@ -222,26 +241,66 @@ class CompiledProgram:
         self._state_shardings = state_shardings
         return self
 
-    def with_pipeline(self, places=None) -> "CompiledProgram":
-        """Attach a `pp` mesh sized to the program's pipeline stages
-        (PipelineOptimizer cut_list). The executor then compiles the
-        step as the SPMD GPipe schedule (core/pipeline_program.py)."""
+    def with_pipeline(self, places=None, dp: int = 1,
+                      mp: int = 1) -> "CompiledProgram":
+        """Attach a mesh whose `pp` axis is sized to the program's
+        pipeline stages (PipelineOptimizer cut_list). The executor then
+        compiles the step as the SPMD GPipe/1F1B schedule
+        (core/pipeline_program.py).
+
+        dp adds a data-parallel axis AROUND the pipeline: the schedule
+        shard_maps manually over pp only, so dp stays GSPMD-auto
+        inside each stage — batch sharding composes with the pipeline
+        with zero manual collectives (forward data parallelism needs
+        none; the dp gradient all-reduce happens in the outer jit,
+        outside the stage dispatch). The reference composes these as
+        separate systems (PipelineTrainer sections x NCCL rings,
+        framework/trainer.h:118); here one mesh + one compiled
+        executable carries both axes.
+
+        mp (megatron tensor parallelism INSIDE a pipelined stage) is
+        rejected here: auto-GSPMD collectives would land inside the
+        schedule's device-varying lax.switch branches, whose
+        full-mesh rendezvous deadlocks when other pp ranks are in
+        other branches (observed on the dp2 x mp2 x pp2 CPU mesh).
+        Tensor parallelism inside pipeline stages needs the manual
+        path — parallel.pipeline.pipeline_train_step_3d, which takes
+        explicit per-stage psums."""
         import jax
-        from jax.sharding import Mesh
+        from jax.sharding import Mesh, PartitionSpec as P
         import numpy as np
 
+        if mp > 1:
+            raise NotImplementedError(
+                "with_pipeline(mp=...): tensor parallelism inside "
+                "pipelined stages requires manual collectives — use "
+                "parallel.pipeline.pipeline_train_step_3d, or compose "
+                "with_pipeline(dp=...) with megatron sharding OUTSIDE "
+                "a pipeline (plain pjit path)")
         cuts = getattr(self._program, "_pipeline_cuts", None)
         if not cuts:
             raise ValueError(
                 "program has no pipeline cuts — minimize with "
                 "PipelineOptimizer(cut_list=...) first"
             )
+        self._claim_strategy("with_pipeline")
         n = len(cuts) + 1
+        need = n * dp
         devs = places_to_devices(places) if places else jax.devices()
-        if len(devs) < n:
-            raise ValueError(f"pipeline needs {n} devices, have {len(devs)}")
-        self._mesh = Mesh(np.array(devs[:n]), ("pp",))
+        if len(devs) < need:
+            raise ValueError(
+                f"pipeline needs pp*dp={need} devices, have {len(devs)}")
+        if dp > 1:
+            self._mesh = Mesh(
+                np.array(devs[:need]).reshape(dp, n), ("dp", "pp"))
+        else:
+            self._mesh = Mesh(np.array(devs[:n]), ("pp",))
         self._in_shardings = {}
+        if dp > 1:
+            for v in self._program.global_block().vars.values():
+                if getattr(v, "is_data", False) and v.shape:
+                    self._in_shardings[v.name] = P(
+                        *(("dp",) + (None,) * (len(v.shape) - 1)))
         return self
 
     # graph passthroughs used by reference code

@@ -175,6 +175,73 @@ def build_pipeline_fn(
             "metric in mean/reduce_mean or reduce_sum so the pipelined "
             "microbatch aggregation is well-defined"
         )
+
+    def _sum_chain(name: str):
+        """Reduction type ('sum'/'mean') at the end of a transparent
+        chain, or None when undecidable — non-raising helper for the
+        ratio detector."""
+        n, hops = name, 0
+        while hops < 32:
+            op = _producer(n)
+            if op is None:
+                return None
+            if op.type in _SUM_OPS:
+                return "sum"
+            if op.type in _MEAN_OPS:
+                return "mean"
+            if op.type in _TRANSPARENT:
+                n = op.inputs.get("X", [None])[0]
+                hops += 1
+                continue
+            return None
+        return None
+
+    def _aux_kind(name: str):
+        """('ratio', num_var, den_var) for sum/sum divisions — the
+        masked-mean shape every LoD-style loss takes (BERT:
+        reduce_sum(ce * mask) / reduce_sum(mask)). Aggregating num and
+        den SEPARATELY over microbatches and dividing once at the end
+        reproduces the dense loss (and, through autodiff, its exact
+        gradient) — per-microbatch ratios would weight microbatches by
+        their own mask counts. Otherwise ('mean',) / ('sum',)."""
+        op = _producer(name)
+        if (op is not None and op.type == "elementwise_div"
+                and _sum_chain(op.inputs["X"][0]) == "sum"
+                and _sum_chain(op.inputs["Y"][0]) == "sum"):
+            return ("ratio", op.inputs["X"][0], op.inputs["Y"][0])
+        return ("mean",) if _aux_is_mean(name) else ("sum",)
+
+    aux_kinds = {n: _aux_kind(n) for n in aux_names}
+    # the names stages actually fetch: ratio members replace their div
+    aux_fetch = list(dict.fromkeys(
+        x for n in aux_names
+        for x in (aux_kinds[n][1:] if aux_kinds[n][0] == "ratio" else (n,))
+    ))
+
+    def _recombine(vals):
+        """Per-public-aux value from the raw microbatch sums."""
+        out = {}
+        for n in aux_names:
+            k = aux_kinds[n]
+            if k[0] == "ratio":
+                out[n] = vals[k[1]] / vals[k[2]]
+            elif k[0] == "mean":
+                out[n] = vals[n] / M
+            else:
+                out[n] = vals[n]
+        return out
+
+    def _loss_index_1f1b():
+        if aux_kinds[loss_name][0] == "ratio":
+            raise NotImplementedError(
+                "ratio-of-sums (masked-mean) losses pipeline exactly "
+                "under schedule='gpipe' (numerator and denominator "
+                "aggregate separately through autodiff); the "
+                "hand-scheduled 1F1B backward seeds a single scalar — "
+                "use gpipe, or end the loss in mean/reduce_sum"
+            )
+        return aux_fetch.index(loss_name)
+
     not_last = [n for n in aux_names if n not in last_produced]
     if not_last:
         raise NotImplementedError(
@@ -264,10 +331,10 @@ def build_pipeline_fn(
                 if s == S - 1:
                     aux = tuple(
                         jnp.reshape(jnp.asarray(local[n], jnp.float32), ())
-                        for n in aux_names
+                        for n in aux_fetch
                     )
                 else:
-                    aux = tuple(jnp.zeros((), jnp.float32) for _ in aux_names)
+                    aux = tuple(jnp.zeros((), jnp.float32) for _ in aux_fetch)
                 return b_out, aux
 
             return f
@@ -286,7 +353,7 @@ def build_pipeline_fn(
                 _lower_block(block, local, ctx, ops=seg)
                 if i < S - 1:
                     bvals.append([local[n] for n in boundaries[i]])
-            return bvals, [local[n] for n in aux_names]
+            return bvals, [local[n] for n in aux_fetch]
 
         shapes, aux_shapes = jax.eval_shape(chain, diff_vals)
         sig = [tuple((a.shape, str(a.dtype)) for a in sh) for sh in shapes]
@@ -297,7 +364,7 @@ def build_pipeline_fn(
                 "(equal widths at every cut)"
             )
         boundary_structs = list(shapes[0])
-        for n, a in zip(aux_names, aux_shapes):
+        for n, a in zip(aux_fetch, aux_shapes):
             if int(np.prod(a.shape)) != 1:
                 raise NotImplementedError(
                     f"fetch var {n!r} has shape {a.shape}; only scalar "
@@ -312,7 +379,7 @@ def build_pipeline_fn(
         stage_fns = [make_stage(s) for s in range(S)]
 
         aux0 = tuple(
-            jax.ShapeDtypeStruct((), jnp.float32) for _ in aux_names
+            jax.ShapeDtypeStruct((), jnp.float32) for _ in aux_fetch
         )
         schedule = getattr(program, "_pipeline_schedule", "gpipe")
         if schedule == "1f1b":
@@ -327,13 +394,11 @@ def build_pipeline_fn(
                 aux0,
                 mesh,
                 axis_name=axis_name,
-                loss_index=aux_names.index(loss_name),
-                grad_scale=(1.0 / M if _aux_is_mean(loss_name) else 1.0),
+                loss_index=_loss_index_1f1b(),
+                grad_scale=(1.0 / M
+                            if aux_kinds[loss_name][0] == "mean" else 1.0),
             )
-            aux = {
-                n: (v / M if _aux_is_mean(n) else v)
-                for n, v in zip(aux_names, aux_sum)
-            }
+            aux = _recombine(dict(zip(aux_fetch, aux_sum)))
         else:
             def run(dv):
                 aux_sum = pipeline_schedule(
@@ -345,10 +410,7 @@ def build_pipeline_fn(
                     mesh,
                     axis_name=axis_name,
                 )
-                aux = {
-                    n: (v / M if _aux_is_mean(n) else v)
-                    for n, v in zip(aux_names, aux_sum)
-                }
+                aux = _recombine(dict(zip(aux_fetch, aux_sum)))
                 loss = jnp.reshape(aux[loss_name], ())
                 return loss, aux
 

@@ -25,6 +25,10 @@ class Config:
         self._bf16 = False
         self._aot = True
         self._memory_optimize = True  # XLA always; knob for parity
+        self._bucketing = False
+        self._seq_buckets = ()
+        self._batch_buckets = ()
+        self._pad_batch = True
 
     def set_model(self, prog_file_or_dir, params_file=None):
         if params_file is None:
@@ -43,6 +47,32 @@ class Config:
         """Cast white-list ops to bfloat16 (the TPU analog of the
         reference's TensorRT fp16 / mkldnn bf16 switches)."""
         self._bf16 = True
+
+    def enable_shape_bucketing(self, seq_buckets=None, batch_buckets=None,
+                               pad_batch=True):
+        """Serve variable-length requests without per-shape recompiles
+        — the TPU-native answer to the reference's ragged LoD
+        inference (framework/lod_tensor.h:104: LoD batches flow
+        through CUDA ops at their true lengths; XLA needs static
+        shapes, so each new shape is a fresh compile).
+
+        Every feed is padded UP to a bucket: dim 0 (batch, when
+        pad_batch) to the next batch bucket, dim 1 (sequence, rank>=2
+        feeds) to the next seq bucket. The executor's program cache
+        then holds one executable per touched bucket pair instead of
+        one per distinct request shape. Outputs are sliced back to the
+        request's true batch (and true seq, where an output dim still
+        equals the padded seq). Padding is zeros — models that take a
+        padding mask (the BERT input_mask convention) are exact;
+        bucket_stats() reports the padding-waste fraction so capacity
+        planning can see the pad/recompile trade."""
+        self._bucketing = True
+        self._seq_buckets = sorted(seq_buckets or
+                                   (16, 32, 64, 96, 128, 192, 256,
+                                    384, 512, 768, 1024, 1536, 2048))
+        self._batch_buckets = sorted(batch_buckets or
+                                     (1, 2, 4, 8, 16, 32, 64, 128))
+        self._pad_batch = pad_batch
 
     def switch_ir_optim(self, flag=True):
         self._aot = flag
@@ -118,6 +148,10 @@ class Predictor:
             for n in self._feed_names}
         self._outputs = {v.name: _Tensor(v.name) for v in self._fetch_vars}
         self._lock = threading.Lock()
+        self._bucket_stats = {"runs": 0, "padded_elements": 0,
+                              "real_elements": 0, "shapes_seen": set(),
+                              "buckets_used": set()}
+        self._trueshape_cache = {}
 
     # -- reference API --------------------------------------------------------
     def get_input_names(self) -> List[str]:
@@ -136,6 +170,97 @@ class Predictor:
     get_input_tensor = get_input_handle
     get_output_tensor = get_output_handle
 
+    def _bucket_of(self, x, ladder):
+        for b in ladder:
+            if x <= b:
+                return b
+        # beyond the ladder: round up to a multiple of the last step
+        step = ladder[-1] if ladder else 128
+        return -(-x // step) * step
+
+    def _pad_feed(self, feed):
+        """Pad every feed up to its (batch, seq) bucket; returns the
+        padded dict + (real_elements, padded_elements) for stats."""
+        cfg = self._config
+        padded = {}
+        n_real = n_pad = 0
+        for n, a in feed.items():
+            a = np.asarray(a)
+            pads = [(0, 0)] * a.ndim
+            if a.ndim >= 1 and cfg._pad_batch:
+                pads[0] = (0, self._bucket_of(a.shape[0], cfg._batch_buckets)
+                           - a.shape[0])
+            if a.ndim >= 2:
+                pads[1] = (0, self._bucket_of(a.shape[1], cfg._seq_buckets)
+                           - a.shape[1])
+            padded[n] = (np.pad(a, pads) if any(p != (0, 0) for p in pads)
+                         else a)
+            n_real += int(a.size)
+            n_pad += int(padded[n].size)
+        return padded, (n_real, n_pad)
+
+    def _true_fetch_shapes(self, feed):
+        """Abstract-eval (jax.eval_shape — no compile, no execute) the
+        program at the TRUE request shapes: the exact per-fetch output
+        shapes to slice the padded run back to. Shape-coincidence
+        heuristics are not safe here — a 16-class logits dim is
+        indistinguishable from a 16-bucket seq dim by size alone.
+        Cached per request-shape signature."""
+        import jax
+
+        import paddle_tpu as fluid
+        from ..core.executor import build_block_fn
+
+        sig = tuple(
+            (n, tuple(np.asarray(a).shape), str(np.asarray(a).dtype))
+            for n, a in sorted(feed.items()))
+        hit = self._trueshape_cache.get(sig)
+        if hit is not None:
+            return hit
+        block = self._program.global_block()
+        with fluid.scope_guard(self._scope):
+            feed_vals, _ = self._exe._prepare_feed(block, dict(feed))
+            feed_names = sorted(feed_vals)
+            state_names, written = self._exe._analyze_block(
+                self._program, block, feed_names)
+            fn = build_block_fn(
+                block, feed_names, state_names,
+                [v.name for v in self._fetch_vars], written, None)
+            args = (
+                [jax.random.PRNGKey(0)]
+                + [jax.ShapeDtypeStruct(np.asarray(feed_vals[n]).shape,
+                                        np.asarray(feed_vals[n]).dtype)
+                   for n in feed_names]
+                + [jax.ShapeDtypeStruct(
+                       np.asarray(self._scope.find_var(n)).shape,
+                       np.asarray(self._scope.find_var(n)).dtype)
+                   for n in state_names]
+            )
+            outs = jax.eval_shape(fn, *args)
+        shapes = [tuple(int(d) for d in o.shape)
+                  for o in outs[:len(self._fetch_vars)]]
+        self._trueshape_cache[sig] = shapes
+        return shapes
+
+    @staticmethod
+    def _slice_to(out, shape):
+        out = np.asarray(out)
+        if out.shape == tuple(shape):
+            return out
+        return out[tuple(slice(0, s) for s in shape)]
+
+    def bucket_stats(self):
+        """Serving-efficiency report for enable_shape_bucketing:
+        compiled-shape count vs request-shape count, and the fraction
+        of device FLOPs spent on padding."""
+        st = dict(self._bucket_stats)
+        st["request_shapes"] = len(st.pop("shapes_seen"))
+        st["compiled_shapes"] = len(st.pop("buckets_used"))
+        tot = st.pop("padded_elements"), st.pop("real_elements")
+        st["padding_waste"] = (round(1.0 - tot[1] / tot[0], 4)
+                               if tot[0] else 0.0)
+        return st
+
     def run(self, inputs: Optional[Sequence[np.ndarray]] = None):
         import paddle_tpu as fluid
 
@@ -143,10 +268,28 @@ class Predictor:
             for n, a in zip(self._feed_names, inputs):
                 self._inputs[n].copy_from_cpu(a)
         feed = {n: t._value for n, t in self._inputs.items()}
+        true_shapes = None
+        if self._config._bucketing:
+            req_sig = tuple(np.asarray(a).shape for a in feed.values())
+            true_shapes = self._true_fetch_shapes(feed)
+            feed, counts = self._pad_feed(feed)
         with self._lock, fluid.scope_guard(self._scope):
+            if true_shapes is not None:
+                # stats under the run lock: concurrent run() on a
+                # shared Predictor is supported, counters must not race
+                st = self._bucket_stats
+                st["runs"] += 1
+                st["shapes_seen"].add(req_sig)
+                st["buckets_used"].add(
+                    tuple(a.shape for a in feed.values()))
+                st["real_elements"] += counts[0]
+                st["padded_elements"] += counts[1]
             outs = self._exe.run(
                 self._program, feed=feed, fetch_list=self._fetch_vars
             )
+        if true_shapes is not None:
+            outs = [self._slice_to(o, s)
+                    for o, s in zip(outs, true_shapes)]
         for t, o in zip(self._outputs.values(), outs):
             t._value = o
         return outs
@@ -172,6 +315,10 @@ class Predictor:
                      for n, t in self._inputs.items()}
         p._outputs = {v.name: _Tensor(v.name) for v in self._fetch_vars}
         p._lock = threading.Lock()
+        p._bucket_stats = {"runs": 0, "padded_elements": 0,
+                           "real_elements": 0, "shapes_seen": set(),
+                           "buckets_used": set()}
+        p._trueshape_cache = self._trueshape_cache  # same program
         return p
 
 

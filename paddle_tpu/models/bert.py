@@ -157,8 +157,14 @@ def build_bert_pretrain(
         if not is_test and cfg.hidden_dropout:
             x = layers.dropout(x, cfg.hidden_dropout,
                                dropout_implementation="upscale_in_train")
+        # per-layer outputs double as PipelineOptimizer cut points
+        # (reference PipelineOptimizer cuts its program at user-chosen
+        # vars, optimizer.py:3414); every boundary is the same
+        # [B, S, H] activation, which the SPMD pipeline requires
+        encoder_outputs = []
         for i in range(cfg.num_layers):
             x = _encoder_layer(x, cfg, i, is_test, input_mask=input_mask)
+            encoder_outputs.append(x)
         logits = layers.fc(
             x, cfg.vocab_size, num_flatten_dims=2,
             param_attr=_attr("lm_head.w", std), bias_attr=ParamAttr(name="lm_head.b"),
@@ -174,6 +180,7 @@ def build_bert_pretrain(
     return main, startup, {"src_ids": src, "pos_ids": pos,
                            "labels": labels, "input_mask": input_mask}, {
         "loss": loss, "logits": logits,
+        "encoder_outputs": encoder_outputs,
     }
 
 

@@ -32,6 +32,39 @@ def _shard_map():
     return smap
 
 
+def _auto_axes_of(mesh, axis_name):
+    return tuple(a for a in mesh.axis_names if a != axis_name)
+
+
+def _pin_auto_replicated(tree, auto_axes):
+    """Partial-manual hazard guard. When the pipeline axis is manual
+    but other mesh axes (dp) stay GSPMD-auto, an auto-axis collective
+    must complete INSIDE the branch that contains it with a
+    branch-output layout identical across branches — otherwise the
+    branch-output reshard lands inside a device-varying lax.switch and
+    its full-mesh rendezvous deadlocks (observed: CollectivePermute
+    stuck on a dp2 x mp2 x pp2 CPU mesh). Pin every branch output to
+    auto-replicated. A bare PartitionSpec resolves against the CONTEXT
+    mesh (auto+manual axis types); a NamedSharding(mesh, ...) would
+    carry all-Auto types and fail the consistency check."""
+    if not auto_axes:
+        return tree
+    from jax.sharding import PartitionSpec as _P
+
+    return jax.tree_util.tree_map(
+        lambda a: jax.lax.with_sharding_constraint(a, _P()), tree)
+
+
+def _manual_axis_kwargs(mesh, axis_name, kwargs):
+    """Restrict shard_map's manual axes to the pipeline axis so every
+    other mesh axis (dp) stays GSPMD-auto inside the stages — batch
+    sharding composes with the pipeline with zero manual collectives
+    (round-5: the user-stack dp x pp path)."""
+    if set(mesh.axis_names) != {axis_name}:
+        kwargs["axis_names"] = {axis_name}
+    return kwargs
+
+
 def pipeline_apply(
     stage_fn: Callable,
     stage_params,
@@ -172,6 +205,9 @@ def pipeline_schedule(
     M = jax.tree_util.tree_leaves(feeds_mb)[0].shape[0]
     tmap = jax.tree_util.tree_map
 
+    auto_axes = _auto_axes_of(mesh, axis_name)
+    _pin_replicated = lambda tree: _pin_auto_replicated(tree, auto_axes)
+
     def per_device(prms, feeds):
         idx = lax.axis_index(axis_name)
         total = M + n_stages - 1
@@ -192,7 +228,8 @@ def pipeline_schedule(
             # type, but e.g. the last stage returns constant zeros for
             # its boundary — mark all outputs varying
             branches = [
-                (lambda f: lambda p, b, m, i: tmap(vary, f(p, b, m, i)))(f)
+                (lambda f: lambda p, b, m, i: tmap(
+                    vary, _pin_replicated(f(p, b, m, i))))(f)
                 for f in stage_fns
             ]
             b_out, aux = lax.switch(idx, branches, prms, inflight, mb, mb_idx)
@@ -217,7 +254,8 @@ def pipeline_schedule(
     # grad (4,0) instead of (2,5)). The schedule's replication proofs
     # are handled by the explicit psum above, so the check is safely
     # dropped.
-    kwargs = {"mesh": mesh, "in_specs": (P(), P()), "out_specs": P()}
+    kwargs = _manual_axis_kwargs(mesh, axis_name, {
+        "mesh": mesh, "in_specs": (P(), P()), "out_specs": P()})
     try:
         wrapped = smap(per_device, check_vma=False, **kwargs)
     except TypeError:
@@ -271,6 +309,8 @@ def pipeline_schedule_1f1b(
     fwd_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
     bwd_perm = [(i, (i - 1) % n_stages) for i in range(n_stages)]
     n_aux = len(aux0)
+    auto_axes = _auto_axes_of(mesh, axis_name)
+    _pin_replicated = lambda tree: _pin_auto_replicated(tree, auto_axes)
 
     def per_device(dv, rest, feeds):
         idx = lax.axis_index(axis_name)
@@ -288,8 +328,8 @@ def pipeline_schedule_1f1b(
                 feeds)
 
         fwd_branches = [
-            (lambda f: lambda d, b, m, i: tmap(vary, f((d,) + tuple(rest),
-                                                       b, m, i)))(f)
+            (lambda f: lambda d, b, m, i: tmap(
+                vary, _pin_replicated(f((d,) + tuple(rest), b, m, i))))(f)
             for f in stage_fns
         ]
 
@@ -309,7 +349,8 @@ def pipeline_schedule_1f1b(
                 # the last stage's boundary output is constant zeros, so
                 # its (garbage) incoming dy contributes nothing
                 dd, db = vjp((dy, aux_seed))
-                return tmap(vary, dd), tmap(vary, db)
+                return (tmap(vary, _pin_replicated(dd)),
+                        tmap(vary, _pin_replicated(db)))
 
             return branch
 
@@ -369,8 +410,9 @@ def pipeline_schedule_1f1b(
         return aux_out, grads
 
     smap = _shard_map()
-    kwargs = {"mesh": mesh, "in_specs": (P(), P(), P()),
-              "out_specs": (P(), P())}
+    kwargs = _manual_axis_kwargs(mesh, axis_name, {
+        "mesh": mesh, "in_specs": (P(), P(), P()),
+        "out_specs": (P(), P())})
     try:
         wrapped = smap(per_device, check_vma=False, **kwargs)
     except TypeError:

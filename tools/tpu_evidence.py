@@ -168,10 +168,19 @@ def _once(wait_s=WAIT_BUDGET):
                 pass
     if rec is None:
         tail = err.strip().splitlines()
-        _log_probe(f"cycle=NO_CAPTURE rc={proc.returncode} "
+        # classify (round-5): the relay can RESOLVE a queued claim
+        # with UNAVAILABLE after ~25 min — that is "no terminal behind
+        # the relay", a different beast from an unanswered claim
+        cause = "UNKNOWN"
+        if "UNAVAILABLE" in err or "backend init failed" in err:
+            cause = "RELAY_ANSWERED_UNAVAILABLE"
+        elif "rc=19" in err:
+            cause = "CLAIM_UNANSWERED"
+        _log_probe(f"cycle=NO_CAPTURE rc={proc.returncode} cause={cause} "
                    f"waited={waited}s "
                    f"tail={tail[-1][-200:] if tail else ''!r}")
-        _record_outcome("NO_CAPTURE", rc=proc.returncode, waited_s=waited)
+        _record_outcome("NO_CAPTURE", rc=proc.returncode, waited_s=waited,
+                        cause=cause)
         return 2
     if rec.get("cached"):
         _log_probe("cycle=CACHED_ONLY (no live capture)")
