@@ -108,6 +108,7 @@ def _once(wait_s=WAIT_BUDGET):
     env["PT_BENCH_CPU_FALLBACK"] = "0"  # relay-down cycles just log
     env["PT_BENCH_IMPORT_BUDGET"] = str(wait_s)  # patient claimant
     env["PT_BENCH_NO_CACHED"] = "1"  # never re-report our own captures
+    env["PT_BENCH_PROFILE"] = "1"    # jax-profiler trace on key stages
     t0 = time.monotonic()
     _log_probe(f"cycle=START wait_budget={wait_s}s "
                f"capture_budget={CAPTURE_BUDGET}s")
