@@ -655,8 +655,13 @@ def _best_cached_tpu_row():
                 if (r.get("backend") == "tpu"
                         and isinstance(r.get("value"), (int, float))):
                     rows.append((r, r.get("captured_at") or ts))
-        # this ROUND's captures only (the file persists across rounds):
-        # drop rows older than 18h or with no usable timestamp
+        # recent captures only (the file persists across rounds). 36h:
+        # wide enough that a round whose relay stayed terminal-less
+        # end-to-end (round 5: every claim resolved UNAVAILABLE) can
+        # still surface the adjacent round's real-chip rows — honestly
+        # marked cached with their original capture timestamp — instead
+        # of degrading to a CPU row; stale history beyond that is
+        # dropped.
         fresh = []
         for r, ts in rows:
             try:
@@ -665,7 +670,7 @@ def _best_cached_tpu_row():
                         tzinfo=datetime.timezone.utc)).total_seconds()
             except (TypeError, ValueError):
                 continue
-            if age < 18 * 3600:
+            if age < 36 * 3600:
                 fresh.append((r, ts))
         if not fresh:
             return None
