@@ -89,8 +89,103 @@ def _build(config):
     return main, fetches["loss"], feed_shapes, zero
 
 
+def run_pp3d_stacked():
+    """Pipeline memory-partition proof: a ~1B-param GPT-class stack
+    pipelined dp8 x pp8 in the stacked-weights SPMD form
+    (parallel/pipeline.py pipeline_train_step_3d with pp-only param
+    specs). The program-level pipeline (lax.switch over heterogeneous
+    segments) REPLICATES weights across pp by design — schedule
+    parallelism, not memory partitioning (PARITY.md); this is the form
+    that actually divides per-device weight bytes by the pp degree,
+    and the memory analysis proves it: per-device argument bytes
+    ~= total params/8 + microbatches."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from paddle_tpu.parallel.pipeline import pipeline_train_step_3d
+
+    S_STAGES, D, FFN, HEADS, SEQ = 8, 3072, 12288, 16, 1024
+    M, MB = 8, 1  # 8 microbatches of per-device batch 1
+    mesh = Mesh(np.array(jax.devices()[:N_DEV]).reshape(8, 1, 8),
+                ("dp", "mp", "pp"))
+
+    def stage(p, x):
+        # one transformer block per stage: MHA + MLP, pre-LN
+        def ln(h):
+            m = h.mean(-1, keepdims=True)
+            v = ((h - m) ** 2).mean(-1, keepdims=True)
+            return (h - m) * lax.rsqrt(v + 1e-5)
+
+        B, S, _ = x.shape
+        h = ln(x)
+        qkv = h @ p["wqkv"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, S, HEADS, D // HEADS).transpose(0, 2, 1, 3)
+        k = k.reshape(B, S, HEADS, D // HEADS).transpose(0, 2, 1, 3)
+        v = v.reshape(B, S, HEADS, D // HEADS).transpose(0, 2, 1, 3)
+        s = (q @ k.transpose(0, 1, 3, 2)) / (D // HEADS) ** 0.5
+        cm = jnp.tril(jnp.ones((S, S), bool))
+        s = jnp.where(cm[None, None], s, -1e9)
+        o = (jax.nn.softmax(s, -1) @ v).transpose(0, 2, 1, 3).reshape(
+            B, S, D)
+        x = x + o @ p["wo"]
+        h = ln(x)
+        return x + jax.nn.gelu(h @ p["w1"]) @ p["w2"]
+
+    r = np.random.RandomState(0)
+
+    def w(*shape):
+        return jnp.asarray(r.randn(S_STAGES, *shape) * 0.02, jnp.float32)
+
+    params = {"wqkv": w(D, 3 * D), "wo": w(D, D),
+              "w1": w(D, FFN), "w2": w(FFN, D)}
+    specs = {k: P(*( ("pp",) + (None,) * (v.ndim - 1)))
+             for k, v in params.items()}
+    n_params = sum(int(np.prod(v.shape)) for v in params.values())
+
+    step = pipeline_train_step_3d(stage, mesh, specs)
+    x_abs = jax.ShapeDtypeStruct((M, MB * 8, SEQ, D), jnp.float32)
+    p_abs = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), params)
+    compiled = jax.jit(step).lower(p_abs, x_abs, x_abs).compile()
+    txt = compiled.as_text()
+    counts = {c: txt.count(c) for c in
+              ("all-reduce", "collective-permute", "all-gather",
+               "dynamic-slice")}
+    ma = compiled.memory_analysis()
+    per_dev = (ma.argument_size_in_bytes + ma.output_size_in_bytes
+               + ma.temp_size_in_bytes)
+    param_bytes_total = n_params * 4
+    result = {
+        "config": "gpt_pp3d_stacked",
+        "n_devices": N_DEV,
+        "mesh": "dp8 x pp8",
+        "n_params": n_params,
+        "collectives": counts,
+        "per_device_bytes": {
+            "arguments": ma.argument_size_in_bytes,
+            "outputs": ma.output_size_in_bytes,
+            "temp": ma.temp_size_in_bytes,
+            "total": per_dev,
+        },
+        # the pipeline-memory claim: each device holds ~1/8 of the
+        # weights (plus its dp-shard of microbatch activations)
+        "param_bytes_total": param_bytes_total,
+        "weight_partition_ratio": round(
+            ma.argument_size_in_bytes / param_bytes_total, 4),
+        "fits_v5p_hbm": per_dev < V5P_HBM_BYTES,
+        "hbm_fraction": round(per_dev / V5P_HBM_BYTES, 4),
+    }
+    print(json.dumps(result))
+
+
 def main():
     config = sys.argv[1]
+    if config == "gpt_pp3d_stacked":
+        return run_pp3d_stacked()
     import numpy as np
     import jax
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
