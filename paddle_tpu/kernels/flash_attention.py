@@ -103,7 +103,10 @@ def _pallas_mode() -> Optional[str]:
     if (os.environ.get("PADDLE_TPU_FLASH_INTERPRET", "")
             or os.environ.get("PADDLE_TPU_KERNEL_INTERPRET", "")):
         return "interpret"
-    if jax.default_backend() == "tpu":
+    if (jax.default_backend() == "tpu"
+            or os.environ.get("PADDLE_TPU_FORCE_PALLAS") == "1"):
+        # FORCE_PALLAS: local AOT validation lowers the real Mosaic
+        # kernels for a v5e topology from a CPU host (tools/aot_check.py)
         return "tpu"
     return None
 
@@ -140,7 +143,7 @@ def _make_fwd_kernel(blk_q: int, causal: bool, sm_scale: float,
         if has_bias:
             s = s + bias_ref[0, 0].astype(jnp.float32)
         if has_mask:
-            s = s + mask_ref[0].astype(jnp.float32)[None, :]
+            s = s + mask_ref[0, 0].astype(jnp.float32)[None, :]
         if causal:
             rows = qi * blk_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
@@ -183,8 +186,9 @@ def _flash_fwd_pallas(q, k, v, mask, bias, sm_scale, causal, interpret,
     ]
     args = [q, k, v]
     if has_mask:
-        in_specs.append(pl.BlockSpec((1, S), lambda b, h, i: (b, 0)))
-        args.append(mask)
+        in_specs.append(
+            pl.BlockSpec((1, 1, S), lambda b, h, i: (b, 0, 0)))
+        args.append(mask[:, None, :])
     if has_bias:
         Bb, Hb = bias.shape[0], bias.shape[1]
         in_specs.append(
@@ -251,7 +255,7 @@ def _make_fwd_stream_kernel(blk_q: int, blk_k: int, nk: int, causal: bool,
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32) * sm_scale
             if has_mask:
-                s = s + mask_ref[0].astype(jnp.float32)[None, :]
+                s = s + mask_ref[0, 0].astype(jnp.float32)[None, :]
             if causal:
                 rows = qi * blk_q + jax.lax.broadcasted_iota(
                     jnp.int32, s.shape, 0)
@@ -298,8 +302,9 @@ def _flash_fwd_stream(q, k, v, mask, sm_scale, causal, interpret,
     ]
     args = [q, k, v]
     if has_mask:
-        in_specs.append(pl.BlockSpec((1, blk_k), lambda b, h, i, j: (b, j)))
-        args.append(mask)
+        in_specs.append(
+            pl.BlockSpec((1, 1, blk_k), lambda b, h, i, j: (b, 0, j)))
+        args.append(mask[:, None, :])
     out_shape = [jax.ShapeDtypeStruct(q.shape, q.dtype)]
     out_specs = [pl.BlockSpec((1, 1, blk_q, D),
                               lambda b, h, i, j: (b, h, i, 0))]
@@ -362,7 +367,7 @@ def _make_dq_stream_kernel(blk_q: int, blk_k: int, nk: int, causal: bool,
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32) * sm_scale
             if has_mask:
-                s = s + mask_ref[0].astype(jnp.float32)[None, :]
+                s = s + mask_ref[0, 0].astype(jnp.float32)[None, :]
             if causal:
                 rows = qi * blk_q + jax.lax.broadcasted_iota(
                     jnp.int32, s.shape, 0)
@@ -419,7 +424,7 @@ def _make_dkv_stream_kernel(blk_q: int, blk_k: int, nq: int, causal: bool,
                 k, q, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32) * sm_scale
             if has_mask:
-                st = st + mask_ref[0].astype(jnp.float32)[:, None]
+                st = st + mask_ref[0, 0].astype(jnp.float32)[:, None]
             if causal:
                 rows = kj * blk_k + jax.lax.broadcasted_iota(
                     jnp.int32, st.shape, 0)
@@ -467,9 +472,9 @@ def _flash_bwd_stream(q, k, v, mask, o, lse, g, sm_scale, causal, interpret,
     ]
     dq_args = [q, k, v, g, o, lse]
     if has_mask:
-        dq_in_specs.append(pl.BlockSpec((1, blk_k),
-                                        lambda b, h, i, j: (b, j)))
-        dq_args.append(mask)
+        dq_in_specs.append(pl.BlockSpec((1, 1, blk_k),
+                                        lambda b, h, i, j: (b, 0, j)))
+        dq_args.append(mask[:, None, :])
     dq = pl.pallas_call(
         _make_dq_stream_kernel(blk_q, blk_k, nk, causal, sm_scale, has_mask),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
@@ -493,9 +498,9 @@ def _flash_bwd_stream(q, k, v, mask, o, lse, g, sm_scale, causal, interpret,
     ]
     dkv_args = [k, v, q, g, o, lse]
     if has_mask:
-        dkv_in_specs.append(pl.BlockSpec((1, blk_k),
-                                         lambda b, h, j, i: (b, j)))
-        dkv_args.append(mask)
+        dkv_in_specs.append(pl.BlockSpec((1, 1, blk_k),
+                                         lambda b, h, j, i: (b, 0, j)))
+        dkv_args.append(mask[:, None, :])
     dk, dv = pl.pallas_call(
         _make_dkv_stream_kernel(blk_q, blk_k, nq, causal, sm_scale,
                                 has_mask),
@@ -553,7 +558,7 @@ def _make_dq_kernel(blk_q: int, causal: bool, sm_scale: float,
         if has_bias:
             s = s + bias_ref[0, 0].astype(jnp.float32)
         if has_mask:
-            s = s + mask_ref[0].astype(jnp.float32)[None, :]
+            s = s + mask_ref[0, 0].astype(jnp.float32)[None, :]
         if causal:
             rows = qi * blk_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
@@ -611,7 +616,7 @@ def _make_dkv_kernel(blk_k: int, causal: bool, sm_scale: float,
             # bias block is [S_q, blk_k] — transpose to the st layout
             st = st + bias_ref[0, 0].astype(jnp.float32).T
         if has_mask:
-            st = st + mask_ref[0].astype(jnp.float32)[:, None]
+            st = st + mask_ref[0, 0].astype(jnp.float32)[:, None]
         if causal:
             rows = ki * blk_k + jax.lax.broadcasted_iota(jnp.int32, st.shape, 0)
             cols = jax.lax.broadcasted_iota(jnp.int32, st.shape, 1)
@@ -659,8 +664,9 @@ def _flash_bwd_pallas(q, k, v, mask, bias, o, lse, g, sm_scale, causal,
         ]
         dq_args = [q, k, v, g, lse, delta]
         if has_mask:
-            dq_in_specs.append(pl.BlockSpec((1, S), lambda b, h, i: (b, 0)))
-            dq_args.append(mask)
+            dq_in_specs.append(
+                pl.BlockSpec((1, 1, S), lambda b, h, i: (b, 0, 0)))
+            dq_args.append(mask[:, None, :])
         dq = pl.pallas_call(
             _make_dq_kernel(blk_q, causal, sm_scale, has_mask, False),
             out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
@@ -690,7 +696,7 @@ def _flash_bwd_pallas(q, k, v, mask, bias, o, lse, g, sm_scale, causal,
             def idx(i, a, c):
                 b_, h_ = to_bh(i, a, c)
                 return {"q": (b_, h_, i, 0), "kv": (b_, h_, 0, 0),
-                        "mask": (b_, 0),
+                        "mask": (b_, 0, 0),
                         "bias": (b_ if Bb > 1 else 0,
                                  h_ if Hb > 1 else 0, i, 0)}[which]
             return pl.BlockSpec(shape_blk, idx)
@@ -705,8 +711,8 @@ def _flash_bwd_pallas(q, k, v, mask, bias, o, lse, g, sm_scale, causal,
         ]
         dq_args = [q, k, v, g, lse, delta]
         if has_mask:
-            dq_in_specs.append(spec((1, S), "mask"))
-            dq_args.append(mask)
+            dq_in_specs.append(spec((1, 1, S), "mask"))
+            dq_args.append(mask[:, None, :])
         dq_in_specs.append(spec((1, 1, blk_q, S), "bias"))
         dq_args.append(bias)
 
@@ -750,8 +756,9 @@ def _flash_bwd_pallas(q, k, v, mask, bias, o, lse, g, sm_scale, causal,
     ]
     dkv_args = [k, v, q, g, lse, delta]
     if has_mask:
-        dkv_in_specs.append(pl.BlockSpec((1, blk_k), lambda b, h, j: (b, j)))
-        dkv_args.append(mask)
+        dkv_in_specs.append(
+            pl.BlockSpec((1, 1, blk_k), lambda b, h, j: (b, 0, j)))
+        dkv_args.append(mask[:, None, :])
     if has_bias:
         Bb, Hb = bias.shape[0], bias.shape[1]
         dkv_in_specs.append(pl.BlockSpec(

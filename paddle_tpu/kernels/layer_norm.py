@@ -45,6 +45,12 @@ def kernels_enabled() -> bool:
     # stages use it to minimize compile surface on a flaky relay)
     if os.environ.get("PADDLE_TPU_FUSED_KERNELS", "1") == "0":
         return False
+    # FORCE_PALLAS: compile the real (non-interpret) Mosaic kernels
+    # regardless of the default backend — the local AOT validation
+    # path (tools/aot_check.py) lowers for a v5e topology from a CPU
+    # host, where default_backend() still says "cpu"
+    if os.environ.get("PADDLE_TPU_FORCE_PALLAS") == "1":
+        return True
     return _interpret() or jax.default_backend() == "tpu"
 
 
@@ -77,8 +83,12 @@ def _bwd_kernel(x_ref, g_ref, dy_ref, mean_ref, rstd_ref,
     m2 = jnp.mean(dyg * xhat, axis=1, keepdims=True)
     dx = rstd * (dyg - m1 - xhat * m2)
     dx_ref[...] = dx.astype(dx_ref.dtype)
-    dg_ref[...] = jnp.sum(dy * xhat, axis=0)[None]  # per-block partial [1, C]
-    db_ref[...] = jnp.sum(dy, axis=0)[None]
+    # per-block partials, written as [1, 1, C] blocks of a rank-3
+    # [n_blocks, 1, C] output: Mosaic requires the last TWO block dims
+    # to be (8-divisible | equal-to-array); a rank-2 (1, C) block over
+    # [n_blocks, C] violates that (round-5 local AOT check)
+    dg_ref[...] = jnp.sum(dy * xhat, axis=0)[None, None]
+    db_ref[...] = jnp.sum(dy, axis=0)[None, None]
 
 
 def _pad_rows(a, br):
@@ -163,18 +173,18 @@ def _vjp_bwd(eps, res, dy):
         ],
         out_specs=[
             pl.BlockSpec((BLOCK_R, C), lambda i: (i, 0)),
-            pl.BlockSpec((1, C), lambda i: (i, 0)),
-            pl.BlockSpec((1, C), lambda i: (i, 0)),
+            pl.BlockSpec((1, 1, C), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, 1, C), lambda i: (i, 0, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(xp.shape, x2.dtype),
-            jax.ShapeDtypeStruct((n_blocks, C), jnp.float32),
-            jax.ShapeDtypeStruct((n_blocks, C), jnp.float32),
+            jax.ShapeDtypeStruct((n_blocks, 1, C), jnp.float32),
+            jax.ShapeDtypeStruct((n_blocks, 1, C), jnp.float32),
         ],
         interpret=_interpret(),
     )(xp, gamma.reshape(1, C), dyp, meanp, rstdp)
-    dgamma = jnp.sum(dg_part, axis=0).astype(gamma.dtype)
-    dbeta = jnp.sum(db_part, axis=0).astype(gamma.dtype)
+    dgamma = jnp.sum(dg_part, axis=(0, 1)).astype(gamma.dtype)
+    dbeta = jnp.sum(db_part, axis=(0, 1)).astype(gamma.dtype)
     return dx[:true_r], dgamma, dbeta
 
 

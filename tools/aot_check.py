@@ -1,0 +1,201 @@
+"""Local AOT validation of the Pallas kernels + headline step against
+the REAL TPU compiler (round-5: the relay answered UNAVAILABLE all
+round, but libtpu ships in the image, so the Mosaic compiler can run
+locally against a v5e topology — no chip needed to prove the kernels
+COMPILE; only execution/numerics still need the relay).
+
+This kills the round-4 failure mode where "TPU-first kernels" had
+never been seen by the real Mosaic compiler: the r4 live window found
+rank-1 block-spec crashes the CPU interpreter never could
+(ROUND4_NOTES #2). Everything here runs through
+jax.experimental.topologies.get_topology_desc("v5e:2x2") +
+jit(...).lower(...).compile() with PADDLE_TPU_FORCE_PALLAS=1, i.e. the
+exact kernels the live capture will run.
+
+Run:  python tools/aot_check.py            # writes AOT_TPU_CHECK.json
+Gated test: PT_AOT_CHECK=1 pytest tests/test_aot_check.py
+
+Reference capability mirrored: the reference's fused GPU kernels are
+compiled by nvcc for their target arch at build time
+(/root/reference/paddle/fluid/operators/fused/multihead_matmul_op.cu:1);
+this is the TPU analogue — target-arch compilation as a local,
+driver-checkable step.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(HERE, "AOT_TPU_CHECK.json")
+
+_CHILD_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "TPU_ACCELERATOR_TYPE": "v5litepod-4",
+    "TPU_WORKER_HOSTNAMES": "localhost",
+    "TPU_SKIP_MDS_QUERY": "1",
+    "PADDLE_TPU_FORCE_PALLAS": "1",
+}
+
+
+def _child():
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import topologies
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    sys.path.insert(0, HERE)
+    topo = topologies.get_topology_desc(platform="tpu",
+                                        topology_name="v5e:2x2")
+    dev = topo.devices[0]
+    mesh1 = Mesh(np.array([dev]), ("d",))
+    R = NamedSharding(mesh1, P())  # replicated on the single device
+
+    results = {"target": str(dev.device_kind), "rows": []}
+
+    def row(name, **kw):
+        kw["name"] = name
+        results["rows"].append(kw)
+        print(json.dumps(kw), flush=True)
+
+    def aot(name, fn, abstract_args, **meta):
+        """Compile fn for the v5e target; record ok/compile_s/memory
+        or the compiler's rejection."""
+        t0 = time.time()
+        try:
+            n = len(jax.tree_util.tree_leaves(abstract_args))
+            jitted = jax.jit(fn, in_shardings=(R,) * n)
+            compiled = jitted.lower(*abstract_args).compile()
+            ma = compiled.memory_analysis()
+            row(name, ok=True, compile_s=round(time.time() - t0, 1),
+                temp_bytes=int(ma.temp_size_in_bytes),
+                arg_bytes=int(ma.argument_size_in_bytes), **meta)
+            return True
+        except Exception as e:  # noqa: BLE001 — record the rejection
+            row(name, ok=False, compile_s=round(time.time() - t0, 1),
+                error=f"{type(e).__name__}: {e}"[:400], **meta)
+            return False
+
+    import importlib
+
+    fa = importlib.import_module("paddle_tpu.kernels.flash_attention")
+    from paddle_tpu.kernels.layer_norm import fused_layer_norm
+    from paddle_tpu.kernels.softmax_xent import fused_softmax_xent
+
+    bf = jnp.bfloat16
+    H, D = 12, 64
+    # -- flash forward: blk sweep x seq, the r3/r4 unvalidated matrix --
+    for S, B in ((512, 8), (2048, 2)):
+        q = jax.ShapeDtypeStruct((B, H, S, D), bf)
+        sm = 1.0 / D ** 0.5
+        for blk in (128, 256, 512):
+            if blk > S:
+                continue
+            aot(f"flash_fwd_S{S}_blk{blk}",
+                lambda q, k, v, blk=blk, sm=sm: fa._flash_fwd_pallas(
+                    q, k, v, None, None, sm, True, interpret=False,
+                    blk_q=blk, with_lse=False)[0],
+                (q, q, q), S=S, blk_q=blk)
+        # fwd+bwd through the public API (mask path + custom vjp)
+        aot(f"flash_train_S{S}",
+            jax.grad(lambda q, k, v: fa.flash_attention(
+                q, k, v, causal=True).astype(jnp.float32).sum(),
+                argnums=(0, 1, 2)),
+            (q, q, q), S=S)
+    # masked + bias variant at head dim 128 (the GPT-1.3B shape)
+    q128 = jax.ShapeDtypeStruct((2, 8, 512, 128), bf)
+    m = jax.ShapeDtypeStruct((2, 512), jnp.float32)
+    aot("flash_fwd_hd128_mask",
+        lambda q, k, v, m: fa.flash_attention(q, k, v, causal=False,
+                                              mask=m),
+        (q128, q128, q128, m), S=512, head_dim=128)
+    # mask AND bias through fwd+bwd — the configuration whose bias-path
+    # dq kernel held the one rank-2 mask spec the r5 migration missed
+    bshape = jax.ShapeDtypeStruct((1, 8, 512, 512), jnp.float32)
+    aot("flash_train_mask_bias",
+        jax.grad(lambda q, k, v, m, b: fa.flash_attention(
+            q, k, v, causal=False, mask=m, bias=b).astype(
+                jnp.float32).sum(), argnums=(0, 1, 2, 4)),
+        (q128, q128, q128, m, bshape), S=512, head_dim=128)
+    # masked train at the plain shape too (the stream-kernel bwd path)
+    qm = jax.ShapeDtypeStruct((2, H, 512, D), bf)
+    mm2 = jax.ShapeDtypeStruct((2, 512), jnp.float32)
+    aot("flash_train_mask",
+        jax.grad(lambda q, k, v, m: fa.flash_attention(
+            q, k, v, causal=True, mask=m).astype(jnp.float32).sum(),
+            argnums=(0, 1, 2)),
+        (qm, qm, qm, mm2), S=512)
+
+    # -- fused layer_norm fwd + bwd ------------------------------------
+    x = jax.ShapeDtypeStruct((4096, 768), jnp.float32)
+    g = jax.ShapeDtypeStruct((768,), jnp.float32)
+    aot("layer_norm_fwd",
+        lambda x, g, b: fused_layer_norm(x, g, b, 1e-5), (x, g, g))
+    aot("layer_norm_train",
+        jax.grad(lambda x, g, b: fused_layer_norm(
+            x, g, b, 1e-5).sum(), argnums=(0, 1, 2)), (x, g, g))
+
+    # -- fused softmax_xent fwd + bwd ----------------------------------
+    s = jax.ShapeDtypeStruct((4096, 30522), jnp.float32)
+    lbl = jax.ShapeDtypeStruct((4096,), jnp.int32)
+    aot("softmax_xent_fwd", fused_softmax_xent, (s, lbl))
+    aot("softmax_xent_train",
+        jax.grad(lambda s, lbl: fused_softmax_xent(s, lbl).sum()),
+        (s, lbl))
+
+    # -- the HEADLINE step: BERT-base seq-512 flash train step ---------
+    # the exact (kind, model, batch, seq) of bench.py's headline stage,
+    # params + adam state as abstract args, full fwd+bwd+update
+    if os.environ.get("PT_AOT_HEADLINE", "1") == "1":
+        import bench
+
+        os.environ["PT_BENCH_FLASH"] = "1"
+        os.environ["PADDLE_TPU_FUSED_KERNELS"] = "1"
+        import paddle_tpu as fluid
+        from paddle_tpu.contrib.mixed_precision import decorate
+
+        opt = decorate(fluid.optimizer.Adam(1e-4), init_loss_scaling=1.0,
+                       use_dynamic_loss_scaling=False,
+                       dest_dtype="bfloat16")
+        main_prog, startup, loss_var, cfg = bench._build_bert(
+            fluid, "base", 512, opt)
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.TPUPlace())
+            exe.run(startup)
+            batch_data = bench._batch_for("bert", np, 16, 512, cfg)
+            fn, args, meta = exe.export_fn(
+                main_prog, batch_data, [loss_var], scope=scope)
+        abstract = tuple(
+            jax.ShapeDtypeStruct(np.asarray(a).shape,
+                                 np.asarray(a).dtype) for a in args)
+        aot("headline_bert_base_s512_flash_train_step", fn, abstract,
+            batch=16, seq=512, flash=True)
+
+    with open(OUT, "w") as f:
+        json.dump(results, f, indent=1)
+    bad = [r for r in results["rows"] if not r.get("ok")]
+    print(f"AOT check: {len(results['rows']) - len(bad)}/"
+          f"{len(results['rows'])} compiled for {results['target']}")
+    return 1 if bad else 0
+
+
+def main():
+    if os.environ.get("PT_AOT_CHILD") == "1":
+        return _child()
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)   # never touch the relay
+    env.pop("PALLAS_AXON_REMOTE_COMPILE", None)
+    env.pop("AXON_LOOPBACK_RELAY", None)
+    env.update(_CHILD_ENV)
+    env["PT_AOT_CHILD"] = "1"
+    proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                          env=env, timeout=5400)
+    return proc.returncode
+
+
+if __name__ == "__main__":
+    sys.exit(main())
