@@ -30,6 +30,31 @@ V5P_HBM_BYTES = 95e9
 N_DEV = 64
 
 
+def _target_devices():
+    """64 compile targets: virtual CPU devices (default — fast, but
+    the CPU partitioner spells some collectives differently), or the
+    REAL v5p toolchain via a local AOT topology when
+    PT_SCALE_PROOF_TARGET=v5p (round-5: libtpu ships in the image, so
+    the actual TPU partitioner + its HBM analysis run with no chip).
+    """
+    import jax
+
+    if os.environ.get("PT_SCALE_PROOF_TARGET") == "v5p":
+        os.environ.setdefault("TPU_ACCELERATOR_TYPE", "v5p-128")
+        os.environ.setdefault("TPU_WORKER_HOSTNAMES", "localhost")
+        os.environ.setdefault("TPU_SKIP_MDS_QUERY", "1")
+        from jax.experimental import topologies
+
+        topo = topologies.get_topology_desc(
+            platform="tpu", topology_name="v5p:4x4x4")
+        return list(topo.devices), "v5p"
+    assert len(jax.devices()) >= N_DEV, (
+        f"need {N_DEV} virtual devices (XLA_FLAGS="
+        f"--xla_force_host_platform_device_count={N_DEV}), "
+        f"have {len(jax.devices())}")
+    return jax.devices(), "cpu-virtual"
+
+
 def _build(config):
     import numpy as np
     import paddle_tpu as fluid
@@ -109,7 +134,8 @@ def run_pp3d_stacked():
 
     S_STAGES, D, FFN, HEADS, SEQ = 8, 3072, 12288, 16, 1024
     M, MB = 8, 1  # 8 microbatches of per-device batch 1
-    mesh = Mesh(np.array(jax.devices()[:N_DEV]).reshape(8, 1, 8),
+    devs, target = _target_devices()
+    mesh = Mesh(np.array(devs[:N_DEV]).reshape(8, 1, 8),
                 ("dp", "mp", "pp"))
 
     def stage(p, x):
@@ -162,6 +188,7 @@ def run_pp3d_stacked():
     result = {
         "config": "gpt_pp3d_stacked",
         "n_devices": N_DEV,
+        "target": target,
         "mesh": "dp8 x pp8",
         "n_params": n_params,
         "collectives": counts,
@@ -195,10 +222,7 @@ def main():
     from paddle_tpu.core.framework import Parameter
     from paddle_tpu.parallel.sharding import shard_optimizer_states
 
-    assert len(jax.devices()) >= N_DEV, (
-        f"need {N_DEV} virtual devices (XLA_FLAGS="
-        f"--xla_force_host_platform_device_count={N_DEV}), "
-        f"have {len(jax.devices())}")
+    devs, target = _target_devices()
 
     prog, loss_var, feed_shapes, zero = _build(config)
     n_zero = 0
@@ -216,7 +240,7 @@ def main():
     if moe_ep:
         # dp8 x ep8: expert weights/accumulators shard over ep (same
         # annotation with_expert_parallel applies), tokens over both
-        mesh = Mesh(np.array(jax.devices()[:N_DEV]).reshape(8, 8),
+        mesh = Mesh(np.array(devs[:N_DEV]).reshape(8, 8),
                     ("dp", "ep"))
         axis_env = {"ep_dispatch": "alltoall"}
         experts = set()
@@ -230,7 +254,7 @@ def main():
                     == tuple(block.var(v.accumulator_owner).shape)):
                 v.sharding = ("ep",) + (None,) * (len(v.shape) - 1)
     else:
-        mesh = Mesh(np.array(jax.devices()[:N_DEV]).reshape(N_DEV), ("dp",))
+        mesh = Mesh(np.array(devs[:N_DEV]).reshape(N_DEV), ("dp",))
     exe = fluid.Executor(fluid.CPUPlace())
     feed_names = sorted(feed_shapes)
     state_names, written = exe._analyze_block(prog, block, feed_names)
@@ -272,6 +296,7 @@ def main():
     result = {
         "config": config,
         "n_devices": N_DEV,
+        "target": target,
         "n_params": n_params,
         "zero_sharded_accumulators": n_zero,
         "collectives": counts,
