@@ -374,6 +374,74 @@ class Executor:
         self.disable_donation = False
 
     # -- public API -----------------------------------------------------------
+    def aot_compile(self, program, feed, fetch_list, scope=None,
+                    devices=None):
+        """Compile the train/eval step WITHOUT executing it — for an
+        arbitrary device set, e.g. a jax.experimental.topologies AOT
+        topology of real TPU devices (round-5: libtpu compiles for
+        v5e/v5p locally with no chip attached). Accepts a Program or a
+        CompiledProgram (whose mesh, if any, is re-laid over `devices`
+        with the same axis names/shape). Returns the jax compiled
+        object — .memory_analysis() / .as_text() give the target's own
+        HBM accounting and SPMD HLO.
+
+        The scope must hold initialized persistables (run the startup
+        program first); `feed` supplies example arrays or
+        ShapeDtypeStructs. Compilation caching is NOT used: an AOT
+        target must never collide with the live-device cache."""
+        from jax.sharding import Mesh
+
+        from .compiler import CompiledProgram
+
+        mesh = in_shardings = state_shardings = axis_env = None
+        if isinstance(program, CompiledProgram):
+            mesh = program._mesh
+            in_shardings = program._in_shardings
+            state_shardings = getattr(program, "_state_shardings", None)
+            axis_env = getattr(program, "_axis_env", None)
+            program = program._program
+        if mesh is not None and devices is not None:
+            need = mesh.devices.size
+            if len(devices) < need:
+                raise ValueError(
+                    f"aot_compile: mesh needs {need} devices, "
+                    f"got {len(devices)}")
+            mesh = Mesh(
+                np.array(devices[:need]).reshape(mesh.devices.shape),
+                mesh.axis_names)
+        elif mesh is None and devices is not None:
+            # plain Program on an AOT target: a 1-device mesh pins the
+            # compile to that device kind (vars carrying multi-axis
+            # sharding annotations need the CompiledProgram form)
+            mesh = Mesh(np.array(devices[:1]), ("aot",))
+        scope = scope or global_scope()
+        block = program.global_block()
+        # the docstring promises ShapeDtypeStruct feeds; _prepare_feed
+        # np.asarray()s its values, so materialize structs as zeros
+        feed = {
+            n: (np.zeros(v.shape, v.dtype)
+                if isinstance(v, jax.ShapeDtypeStruct) else v)
+            for n, v in dict(feed).items()
+        }
+        feed_vals, _ = self._prepare_feed(block, feed)
+        feed_names = sorted(feed_vals)
+        fetch_names = [
+            v.name if isinstance(v, Variable) else str(v)
+            for v in fetch_list
+        ]
+        compiled_blk = self._compile(
+            program, block, feed_names, fetch_names, scope, mesh,
+            in_shardings, state_shardings, axis_env)
+        abstract = [jax.ShapeDtypeStruct((2,), jnp.uint32)]
+        for n in compiled_blk.feed_names:
+            a = np.asarray(feed_vals[n])
+            abstract.append(jax.ShapeDtypeStruct(a.shape, a.dtype))
+        for n in compiled_blk.state_names:
+            v = scope.find_var(n)
+            a = np.asarray(v)
+            abstract.append(jax.ShapeDtypeStruct(a.shape, a.dtype))
+        return compiled_blk.fn.lower(*abstract).compile()
+
     def run(
         self,
         program=None,

@@ -320,6 +320,10 @@ def build_pipeline_fn(
                         jax.random.fold_in(key, s), mb_idx
                     ),
                     mesh=mesh,
+                    # inside the schedule's manual-pp shard_map —
+                    # Pallas calls must not try to wrap themselves
+                    # (kernels/mesh_wrap.py mode())
+                    manual_axes=("pp",),
                 )
                 _lower_block(block, local, ctx, ops=segments[s])
                 if s < S - 1:

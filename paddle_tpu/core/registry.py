@@ -46,11 +46,17 @@ class LoweringContext:
     collective ops (reference ring_id -> mesh axis name).
     """
 
-    def __init__(self, step_key=None, mesh=None, axis_env=None, scope=None):
+    def __init__(self, step_key=None, mesh=None, axis_env=None, scope=None,
+                 manual_axes=()):
         self.step_key = step_key
         self.mesh = mesh
         self.axis_env = axis_env or {}
         self.scope = scope
+        # mesh axes already inside a manual shard_map region (the
+        # pipeline schedule sets ("pp",)) — kernels/mesh_wrap.py uses
+        # this to decide whether a Pallas call may wrap itself in a
+        # shard_map (real TPU: Mosaic cannot be GSPMD-auto-partitioned)
+        self.manual_axes = tuple(manual_axes or ())
 
     def op_key(self, op) -> jax.Array:
         """Deterministic per-op PRNG key: fold the op's stable ident into

@@ -1032,8 +1032,52 @@ def _flash_attention_op(ctx, op, ins):
             else:
                 o = sp_fn(qs, ks, vs)
     if o is None:
-        o = flash_attention(split(q), split(k), split(v), causal, None,
-                            mask=mask, bias=bias)
+        from . import mesh_wrap as _mw
+
+        wmode, wmesh, waxes = _mw.mode(ctx)
+        qs, ks, vs = split(q), split(k), split(v)
+        if _pallas_mode() is None or wmode == "direct":
+            # XLA fallback / single device: no partitioning hazard
+            # (interpret mode under a mesh DOES take the wrap branch
+            # below, so CI covers the spec threading the real-TPU
+            # compile depends on — round-5 review finding)
+            o = flash_attention(qs, ks, vs, causal, None,
+                                mask=mask, bias=bias)
+        elif wmode == "xla":
+            # inside a manual region with auto axes left (pipeline
+            # stages under dp): nesting a partial-manual shard_map is
+            # not attempted — use the XLA attention, which GSPMD
+            # partitions fine
+            o = _reference_attention(qs, ks, vs, 1.0 / math.sqrt(D),
+                                     causal, mask=mask, bias=bias)
+        else:
+            # multi-device mesh: shard_map the kernel over every auto
+            # axis (real TPU cannot GSPMD-auto-partition Mosaic) —
+            # batch rides dp, heads ride mp, anything else replicates
+            dim_axes = {0: "dp", 1: "mp"}
+            qspec = _mw.dim_spec(qs.shape, dim_axes, wmesh, waxes)
+            args = [qs, ks, vs]
+            specs = [qspec, qspec, qspec]
+            if mask is not None:
+                args.append(mask)
+                specs.append(_mw.dim_spec(mask.shape, {0: "dp"},
+                                          wmesh, waxes))
+            if bias is not None:
+                args.append(bias)
+                specs.append(_mw.dim_spec(bias.shape, {0: "dp", 1: "mp"},
+                                          wmesh, waxes))
+            has_m, has_b = mask is not None, bias is not None
+
+            def _local(*a):
+                it = iter(a)
+                ql, kl, vl = next(it), next(it), next(it)
+                ml = next(it) if has_m else None
+                bl = next(it) if has_b else None
+                return flash_attention(ql, kl, vl, causal, None,
+                                       mask=ml, bias=bl)
+
+            o = _mw.wrap_call(wmesh, waxes, _local, tuple(specs),
+                              qspec)(*args)
     return {"Out": [o.transpose(0, 2, 1, 3).reshape(B, S, HD)]}
 
 

@@ -213,3 +213,40 @@ def layer_norm_pallas(x, gamma, beta, eps, begin_norm_axis):
     mean = jnp.mean(x2, axis=1)
     var = jnp.var(x2, axis=1)
     return y.reshape(shape), mean, var
+
+
+def layer_norm_pallas_meshed(x, gamma, beta, eps, begin_norm_axis,
+                             mesh, axes):
+    """Mosaic-safe meshed form: the kernel runs inside a shard_map over
+    every auto mesh axis (real TPU cannot GSPMD-auto-partition Pallas —
+    kernels/mesh_wrap.py). Rows are independent, so batch/sequence
+    dims shard (dp/sp) and the kernel sees its local rows; gamma/beta
+    replicate. Mean/Variance aux come from XLA outside the wrap.
+    Returns None past the VMEM bound (caller keeps XLA)."""
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from .mesh_wrap import dim_spec, wrap_call
+
+    shape = x.shape
+    C = int(np.prod(shape[begin_norm_axis:]))
+    if C > MAX_C:
+        return None
+    if gamma is None:
+        gamma = jnp.ones((C,), x.dtype)
+    if beta is None:
+        beta = jnp.zeros((C,), x.dtype)
+    dim_axes = {0: "dp"}
+    if begin_norm_axis >= 2:
+        dim_axes[1] = "sp"
+    xspec = dim_spec(shape, dim_axes, mesh, axes)
+
+    def local_fn(xl, g, b):
+        return fused_layer_norm(
+            xl.reshape(-1, C), g.reshape(C), b.reshape(C),
+            float(eps)).reshape(xl.shape)
+
+    y = wrap_call(mesh, axes, local_fn, (xspec, P(), P()), xspec)(
+        x, gamma, beta)
+    x2 = x.reshape(-1, C)
+    return y, jnp.mean(x2, axis=1), jnp.var(x2, axis=1)

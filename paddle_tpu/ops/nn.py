@@ -227,28 +227,51 @@ def _softmax_with_cross_entropy(ctx, op, ins):
 
     from ..kernels.softmax_xent import MAX_C as _XENT_MAX_C
 
+    from ..kernels import mesh_wrap
+
+    wmode, wmesh, waxes = mesh_wrap.mode(ctx)
     last = axis in (-1, logits.ndim - 1)
-    if (kernels_enabled() and not soft_label
+    if (kernels_enabled() and wmode != "xla" and not soft_label
             and 2 <= logits.shape[-1] <= _XENT_MAX_C and last):
         # fused Pallas kernel (north-star fused set) owns the LOSS
         # path; the Softmax slot comes from XLA's softmax so grads
         # through it are exact (the kernel's lse has no pullback) —
-        # XLA CSEs the shared exp work when both are consumed.
+        # XLA CSEs the shared exp work when both are consumed. Under a
+        # multi-device mesh the kernel shard_maps itself over the
+        # leading (batch/sequence) dims — rows are independent (real
+        # TPU: Mosaic cannot be GSPMD-auto-partitioned).
         C = logits.shape[-1]
         lead = logits.shape[:-1]
-        l2 = logits.reshape(-1, C)
         lbl = label
         if lbl.ndim == logits.ndim and lbl.shape[-1] == 1:
             lbl = jnp.squeeze(lbl, -1)
-        flat_lbl = lbl.reshape(-1)
-        safe = jnp.where(flat_lbl == ignore_index, 0,
-                         flat_lbl).astype(jnp.int32)
-        loss_flat = fused_softmax_xent(l2, safe)
-        keep = (flat_lbl != ignore_index)
-        loss_flat = jnp.where(keep, loss_flat, 0.0)
+        safe_nd = jnp.where(lbl == ignore_index, 0, lbl).astype(jnp.int32)
+        if wmode == "wrap":
+            from jax.sharding import PartitionSpec as _P
+
+            dim_axes = {0: "dp"}
+            if len(lead) >= 2:
+                dim_axes[1] = "sp"
+            lspec = mesh_wrap.dim_spec(logits.shape, dim_axes, wmesh,
+                                       waxes)
+            yspec = mesh_wrap.dim_spec(tuple(lead), dim_axes, wmesh,
+                                       waxes)
+
+            def _local(lg, lb):
+                return fused_softmax_xent(
+                    lg.reshape(-1, C), lb.reshape(-1)).reshape(lb.shape)
+
+            loss_nd = mesh_wrap.wrap_call(
+                wmesh, waxes, _local, (lspec, yspec), yspec)(
+                    logits, safe_nd)
+        else:
+            loss_nd = fused_softmax_xent(
+                logits.reshape(-1, C),
+                safe_nd.reshape(-1)).reshape(safe_nd.shape)
+        loss_nd = jnp.where(lbl != ignore_index, loss_nd, 0.0)
         softmax = jax.nn.softmax(logits, axis=-1)
         return {"Softmax": [softmax],
-                "Loss": [loss_flat.reshape(tuple(lead) + (1,))]}
+                "Loss": [loss_nd.reshape(tuple(lead) + (1,))]}
 
     logp = jax.nn.log_softmax(logits, axis=axis)
     softmax = jnp.exp(logp)
@@ -401,16 +424,25 @@ def _layer_norm(ctx, op, ins):
     x = ins["X"][0]
     eps = float(op.attrs.get("epsilon", 1e-5))
     bna = int(op.attrs.get("begin_norm_axis", 1))
-    from ..kernels.layer_norm import kernels_enabled, layer_norm_pallas
+    from ..kernels import mesh_wrap
+    from ..kernels.layer_norm import (kernels_enabled, layer_norm_pallas,
+                                      layer_norm_pallas_meshed)
 
-    if kernels_enabled() and x.ndim >= 2 and jnp.issubdtype(
-            x.dtype, jnp.floating):
+    wmode, wmesh, waxes = mesh_wrap.mode(ctx)
+    if (kernels_enabled() and wmode != "xla" and x.ndim >= 2
+            and jnp.issubdtype(x.dtype, jnp.floating)):
         # fused Pallas row kernel (north-star fused set); identical
         # numerics, no separate mean/var passes in HBM. Returns None
-        # past the VMEM bound -> fall through to XLA.
+        # past the VMEM bound -> fall through to XLA. Under a
+        # multi-device mesh the kernel shard_maps itself (real TPU:
+        # Mosaic cannot be GSPMD-auto-partitioned).
         scale = ins["Scale"][0] if ins.get("Scale") else None
         bias = ins["Bias"][0] if ins.get("Bias") else None
-        res = layer_norm_pallas(x, scale, bias, eps, bna)
+        if wmode == "wrap":
+            res = layer_norm_pallas_meshed(x, scale, bias, eps, bna,
+                                           wmesh, waxes)
+        else:
+            res = layer_norm_pallas(x, scale, bias, eps, bna)
         if res is not None:
             y, mean, var = res
             return {"Y": [y], "Mean": [mean], "Variance": [var]}

@@ -33,4 +33,4 @@ def test_all_kernels_and_headline_compile_for_v5e():
     bad = [r for r in results["rows"] if not r.get("ok")]
     assert not bad, bad
     names = {r["name"] for r in results["rows"]}
-    assert "headline_bert_base_s512_flash_train_step" in names
+    assert "stage_headline_bert_base_s512_flash" in names

@@ -171,3 +171,38 @@ def test_compile_cache_shared_across_scopes():
             exe.run(startup)
             exe.run(main, feed=feeds, fetch_list=[out])
     assert len(exe._cache) == 2  # startup + main, NOT x2 per scope
+
+
+def test_aot_compile_for_explicit_devices():
+    """Executor.aot_compile: compile-without-execute for an explicit
+    device set (the local-AOT entry tools/aot_check.py uses with real
+    TPU topologies; here: CPU devices, so it runs in CI)."""
+    import jax
+
+    import paddle_tpu as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data("x", [8])
+        y = fluid.layers.data("y", [1], dtype="int64")
+        loss = fluid.layers.mean(fluid.layers.softmax_with_cross_entropy(
+            fluid.layers.fc(x, 4), y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        feed = {"x": np.zeros((4, 8), "float32"),
+                "y": np.zeros((4, 1), "int64")}
+        # plain Program + single explicit device
+        compiled = exe.aot_compile(main, feed, [loss], scope=scope,
+                                   devices=jax.devices()[:1])
+        assert compiled.memory_analysis() is not None
+        assert "fusion" in compiled.as_text() or compiled.as_text()
+        # CompiledProgram mesh re-laid over explicit devices (dp4)
+        cp = fluid.CompiledProgram(main).with_data_parallel(
+            loss_name=loss.name,
+            places=[fluid.TPUPlace(i) for i in range(4)])
+        compiled4 = exe.aot_compile(cp, feed, [loss], scope=scope,
+                                    devices=jax.devices()[:4])
+        assert "all-reduce" in compiled4.as_text()
