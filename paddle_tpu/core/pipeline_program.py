@@ -179,7 +179,11 @@ def build_pipeline_fn(
     def _sum_chain(name: str):
         """Reduction type ('sum'/'mean') at the end of a transparent
         chain, or None when undecidable — non-raising helper for the
-        ratio detector."""
+        ratio detector. The ratio path needs SUM-ADDITIVITY across
+        microbatches (sum_mb f(x_mb) == f(x_full)), so a bias-carrying
+        `scale` op breaks the chain: scale(s, bias=eps) summed over M
+        microbatches adds eps M times while the full-batch value adds
+        it once (round-5 review finding)."""
         n, hops = name, 0
         while hops < 32:
             op = _producer(n)
@@ -190,6 +194,9 @@ def build_pipeline_fn(
             if op.type in _MEAN_OPS:
                 return "mean"
             if op.type in _TRANSPARENT:
+                if (op.type == "scale"
+                        and float(op.attrs.get("bias", 0.0)) != 0.0):
+                    return None
                 n = op.inputs.get("X", [None])[0]
                 hops += 1
                 continue
@@ -231,16 +238,67 @@ def build_pipeline_fn(
                 out[n] = vals[n]
         return out
 
-    def _loss_index_1f1b():
-        if aux_kinds[loss_name][0] == "ratio":
+    def _ratio_den_ops():
+        """Validated op list producing the ratio denominator from
+        feeds alone; raises the actionable error otherwise. Shared by
+        _loss_index_1f1b and _grad_scale_1f1b so neither depends on
+        the other having run first (round-5 review finding)."""
+        k = aux_kinds[loss_name]
+        chosen, external = _den_subgraph_ops(k[2])
+        if external - set(feed_names):
             raise NotImplementedError(
-                "ratio-of-sums (masked-mean) losses pipeline exactly "
-                "under schedule='gpipe' (numerator and denominator "
-                "aggregate separately through autodiff); the "
-                "hand-scheduled 1F1B backward seeds a single scalar — "
-                "use gpipe, or end the loss in mean/reduce_sum"
+                "ratio-of-sums loss whose denominator depends on "
+                f"non-feed vars {sorted(external - set(feed_names))} "
+                "cannot seed the hand-scheduled 1F1B backward — use "
+                "schedule='gpipe' (exact for any ratio), or make the "
+                "denominator feed-only"
             )
-        return aux_fetch.index(loss_name)
+        return chosen
+
+    def _grad_scale_1f1b(feeds_full):
+        k = aux_kinds[loss_name]
+        if k[0] == "mean":
+            return 1.0 / M
+        if k[0] != "ratio":
+            return 1.0
+        denv = dict(feeds_full)
+        ctx = LoweringContext(mesh=None)
+        _lower_block(block, denv, ctx, ops=_ratio_den_ops())
+        return 1.0 / jnp.reshape(
+            jnp.asarray(denv[k[2]], jnp.float32), ())
+
+    def _den_subgraph_ops(name):
+        """The ops producing `name`, plus the external inputs they
+        need — for evaluating a FEED-ONLY denominator outside the
+        schedule (reduce_sum(mask) et al.)."""
+        needed = {name}
+        chosen = []
+        for op in reversed(fwd_ops):
+            outs = {n for ns in op.outputs.values() for n in ns}
+            if outs & needed:
+                chosen.append(op)
+                needed |= {n for ns in op.inputs.values() for n in ns}
+        chosen.reverse()
+        produced = {n for op2 in chosen
+                    for ns in op2.outputs.values() for n in ns}
+        external = {n for op2 in chosen
+                    for ns in op2.inputs.values()
+                    for n in ns} - produced
+        return chosen, external
+
+    def _loss_index_1f1b():
+        """aux index whose backward seed carries the loss gradient.
+        For a ratio loss the seed rides the NUMERATOR: when the
+        denominator is feed-only (the masked-mean case — den =
+        reduce_sum(mask) has no parameter dependence), d(num/den) =
+        (1/den) * d num exactly, and den is computable outside the
+        schedule from the full batch. A parameter-dependent
+        denominator has no single-scalar 1F1B seed — use gpipe."""
+        k = aux_kinds[loss_name]
+        if k[0] != "ratio":
+            return aux_fetch.index(loss_name)
+        _ratio_den_ops()  # validate feed-only (raises otherwise)
+        return aux_fetch.index(k[1])
 
     not_last = [n for n in aux_names if n not in last_produced]
     if not_last:
@@ -291,8 +349,10 @@ def build_pipeline_fn(
     def fn(step_key, *args):
         env: Dict[str, jnp.ndarray] = {}
         feeds_mb: Dict[str, jnp.ndarray] = {}
+        feeds_full: Dict[str, jnp.ndarray] = {}
         for i, n in enumerate(feed_names):
             v = args[i]
+            feeds_full[n] = v
             if v.shape[0] % M:
                 raise ValueError(
                     f"pipeline microbatches M={M} does not divide batch "
@@ -399,8 +459,7 @@ def build_pipeline_fn(
                 mesh,
                 axis_name=axis_name,
                 loss_index=_loss_index_1f1b(),
-                grad_scale=(1.0 / M
-                            if aux_kinds[loss_name][0] == "mean" else 1.0),
+                grad_scale=_grad_scale_1f1b(feeds_full),
             )
             aux = _recombine(dict(zip(aux_fetch, aux_sum)))
         else:

@@ -273,7 +273,7 @@ def pipeline_schedule_1f1b(
     mesh,
     axis_name: str = "pp",
     loss_index: int = 0,
-    grad_scale: float = 1.0,
+    grad_scale=1.0,
 ):
     """1F1B schedule for S heterogeneous Program stages — the
     hand-scheduled analogue of autodiff-through-`pipeline_schedule`
@@ -312,7 +312,7 @@ def pipeline_schedule_1f1b(
     auto_axes = _auto_axes_of(mesh, axis_name)
     _pin_replicated = lambda tree: _pin_auto_replicated(tree, auto_axes)
 
-    def per_device(dv, rest, feeds):
+    def per_device(dv, rest, feeds, gscale):
         idx = lax.axis_index(axis_name)
         vary = lambda a: a + (idx * 0).astype(a.dtype)
         stash0 = tuple(
@@ -341,10 +341,12 @@ def pipeline_schedule_1f1b(
                     return stage_fns[s]((d_,) + tuple(rest), b_, m, i)
 
                 _, vjp = jax.vjp(primal, d, b_saved)
+                # gscale is a per-device ARG (not a closure): ratio
+                # losses seed a TRACED 1/denominator, and traced
+                # closures must not leak into the shard_map body
                 aux_seed = tuple(
-                    jnp.asarray(
-                        grad_scale if (is_last and j == loss_index) else 0.0,
-                        jnp.float32)
+                    (gscale if (is_last and j == loss_index)
+                     else jnp.zeros((), jnp.float32))
                     for j in range(n_aux))
                 # the last stage's boundary output is constant zeros, so
                 # its (garbage) incoming dy contributes nothing
@@ -411,13 +413,14 @@ def pipeline_schedule_1f1b(
 
     smap = _shard_map()
     kwargs = _manual_axis_kwargs(mesh, axis_name, {
-        "mesh": mesh, "in_specs": (P(), P(), P()),
+        "mesh": mesh, "in_specs": (P(), P(), P(), P()),
         "out_specs": (P(), P())})
     try:
         wrapped = smap(per_device, check_vma=False, **kwargs)
     except TypeError:
         wrapped = smap(per_device, check_rep=False, **kwargs)
-    return wrapped(diff_params, tuple(rest_params), feeds_mb)
+    return wrapped(diff_params, tuple(rest_params), feeds_mb,
+                   jnp.asarray(grad_scale, jnp.float32))
 
 
 def pipeline_train_step(

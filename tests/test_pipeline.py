@@ -438,7 +438,7 @@ def test_program_pipeline_masked_mean_ratio_loss_parity():
 
     _need_devices(2)
 
-    def build(pipelined):
+    def build(pipelined, schedule="gpipe"):
         main, startup = fluid.Program(), fluid.Program()
         main.random_seed = startup.random_seed = 11
         with fluid.program_guard(main, startup), fluid.unique_name.guard():
@@ -453,7 +453,7 @@ def test_program_pipeline_masked_mean_ratio_loss_parity():
             if pipelined:
                 fluid.optimizer.PipelineOptimizer(
                     fluid.optimizer.SGD(0.1), cut_list=[h],
-                    num_microbatches=4).minimize(loss)
+                    num_microbatches=4, schedule=schedule).minimize(loss)
             else:
                 fluid.optimizer.SGD(0.1).minimize(loss)
         target = (fluid.CompiledProgram(main).with_pipeline()
@@ -482,4 +482,12 @@ def test_program_pipeline_masked_mean_ratio_loss_parity():
     np.testing.assert_allclose(pp_l, base_l, rtol=1e-5, atol=1e-6)
     for n in base_p:
         np.testing.assert_allclose(pp_p[n], base_p[n], rtol=1e-4,
+                                   atol=1e-6, err_msg=n)
+    # 1F1B: the backward seed rides the numerator scaled by the
+    # feed-only denominator (1/den), computed outside the schedule —
+    # exact for the same non-uniform masks
+    fb_l, fb_p = build(True, schedule="1f1b")
+    np.testing.assert_allclose(fb_l, base_l, rtol=1e-5, atol=1e-6)
+    for n in base_p:
+        np.testing.assert_allclose(fb_p[n], base_p[n], rtol=1e-4,
                                    atol=1e-6, err_msg=n)
