@@ -294,6 +294,23 @@ def _child():
                places=[fluid.TPUPlace(i) for i in range(4)]),
            (dmain, dstart, df["loss"]), dfeed, mesh="dp4")
 
+        # (d) dp2 x ep2 switch-MoE GPT (expert parallelism; alltoall
+        # dispatch) — completes the axis coverage: dp/sp/pp above
+        ecfg = GPTConfig.tiny()
+        ecfg.moe_every = 2
+        ecfg.moe_experts = 4
+        emain, estart, _, ef = build_gpt_lm(
+            ecfg, 128, optimizer=fluid.optimizer.Adam(1e-3))
+        efeed = {"tokens": rng.randint(0, ecfg.vocab_size,
+                                       (8, 128)).astype("int64"),
+                 "labels": rng.randint(0, ecfg.vocab_size,
+                                       (8, 128)).astype("int64")}
+        mc("multichip_dp2xep2_moe_gpt",
+           lambda m: fluid.CompiledProgram(m).with_expert_parallel(
+               ep=2, dp=2, dispatch="alltoall",
+               places=[fluid.TPUPlace(i) for i in range(4)]),
+           (emain, estart, ef["loss"]), efeed, mesh="dp2 x ep2")
+
     # merge-by-name into the existing archive: different env
     # selections (kernels-only / stages / multichip) must accumulate,
     # not erase each other's evidence (round-5 review finding)
