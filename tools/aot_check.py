@@ -311,6 +311,24 @@ def _child():
                places=[fluid.TPUPlace(i) for i in range(4)]),
            (emain, estart, ef["loss"]), efeed, mesh="dp2 x ep2")
 
+        # (e) LONG CONTEXT: sp4 ring attention at S=8192 — each local
+        # S/sp=2048 shard sits at the panel/streaming boundary, so the
+        # ring rotation composes with the FA-2 KV-streaming kernels;
+        # this is the long-context flagship compiling for real silicon
+        lcfg = GPTConfig.tiny()
+        lcfg.use_flash_attention = True
+        lcfg.max_position = 8192
+        lmain, lstart, _, lf = build_gpt_lm(
+            lcfg, 8192, optimizer=fluid.optimizer.Adam(1e-3))
+        lfeed = {"tokens": rng.randint(0, lcfg.vocab_size,
+                                       (1, 8192)).astype("int64"),
+                 "labels": rng.randint(0, lcfg.vocab_size,
+                                       (1, 8192)).astype("int64")}
+        mc("multichip_sp4_ring_longctx_gpt_s8192",
+           lambda m: fluid.CompiledProgram(m).with_sequence_parallel(
+               sp=4, places=[fluid.TPUPlace(i) for i in range(4)]),
+           (lmain, lstart, lf["loss"]), lfeed, mesh="sp4", seq=8192)
+
     # merge-by-name into the existing archive: different env
     # selections (kernels-only / stages / multichip) must accumulate,
     # not erase each other's evidence (round-5 review finding)
