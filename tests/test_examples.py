@@ -42,3 +42,13 @@ def _run(script, *args):
 def test_example_runs(script, args):
     out = _run(script, *args)
     assert "loss=" in out or "acc=" in out, out[-400:]
+
+
+def test_train_pipeline_dp():
+    out = _run("train_pipeline_dp.py")
+    assert "pipeline x dp training OK" in out
+
+
+def test_serve_bucketed():
+    out = _run("serve_bucketed.py")
+    assert "bucketed serving OK" in out
