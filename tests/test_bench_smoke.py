@@ -160,3 +160,28 @@ def test_profile_trace_path_runs_on_cpu(tmp_path, _interpret_kernels):
     # makedirs before the profiler starts, so directories don't count)
     files = [p for p in tmp_path.rglob("*") if p.is_file()]
     assert files, "profiler produced no trace files"
+
+
+def test_rebaseline_cached_row_kills_stale_vs_baseline():
+    """A cached pre-fix row (bert-tiny divided by the bert-base table
+    baseline: '2.46x A100' at mfu 0.003) must be re-derived from its
+    own mfu when resurfaced — the round-4 verdict's done-criterion is
+    'no row with vs_baseline > 1 while mfu < 0.01', including cached
+    ones."""
+    row = {"config": {"kind": "bert", "model": "tiny", "seq": 128},
+           "value": 467191.0, "vs_baseline": 2.4589, "mfu": 0.003,
+           "device_kind": "tpu v5 lite"}
+    out = bench._rebaseline(dict(row))
+    assert out["vs_baseline"] < 0.01, out
+    assert out["baseline_kind"] == "flops_scaled_from_mfu"
+    # a named (table) config keeps its table baseline untouched
+    row2 = {"config": {"kind": "bert", "model": "base", "seq": 512},
+            "value": 100000.0, "vs_baseline": 0.5587, "mfu": 0.35,
+            "device_kind": "tpu v5 lite"}
+    out2 = bench._rebaseline(dict(row2))
+    assert out2["vs_baseline"] == 0.5587 and out2["baseline_kind"] == "table"
+    # cpu rows (no mfu) surface with vs_baseline null, never stale
+    row3 = {"config": {"kind": "bert", "model": "tiny", "seq": 128},
+            "value": 5300.0, "vs_baseline": 0.0279, "mfu": None,
+            "device_kind": "cpu"}
+    assert bench._rebaseline(dict(row3))["vs_baseline"] is None
