@@ -67,6 +67,7 @@ from . import dygraph
 from .flags import get_flags, set_flags
 from . import debugger
 from . import flags
+from . import analysis  # static Program-IR verifier / lint (proglint)
 
 # ``fluid``-style alias so reference user code reads naturally:
 #   import paddle_tpu as fluid
@@ -108,6 +109,7 @@ __all__ = [
     "ParamAttr",
     "DataFeeder",
     "DataLoader",
+    "analysis",
 ]
 
 

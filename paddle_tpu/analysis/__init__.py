@@ -1,0 +1,54 @@
+"""paddle_tpu.analysis — static Program-IR verifier & lint framework.
+
+Multi-pass static analyzer over the Program/Block/Operator/Variable IR
+(core/framework.py) that runs BEFORE any JAX lowering: every error
+caught here is an error that never burns a TPU window. See README
+section "Static analysis (proglint)" for the pass list and diagnostic
+codes, tools/proglint.py for the CLI, and the ``validate_program``
+flag (flags.py) for the executor integration.
+
+    from paddle_tpu import analysis
+    report = analysis.analyze_program(prog, fetch_names=[loss.name])
+    assert report.ok, report.format_human()
+"""
+
+from .diagnostics import (
+    AnalysisReport,
+    CODES,
+    Diagnostic,
+    ERROR,
+    INFO,
+    Location,
+    ProgramVerificationError,
+    SUPPRESS_ATTR,
+    WARN,
+    emit_eager,
+    is_suppressed,
+)
+from .analyzer import (
+    PassContext,
+    analyze_program,
+    register_pass,
+    registered_passes,
+    validate_for_run,
+)
+from . import passes  # noqa: F401  — registers the built-in passes
+
+__all__ = [
+    "AnalysisReport",
+    "CODES",
+    "Diagnostic",
+    "ERROR",
+    "INFO",
+    "Location",
+    "ProgramVerificationError",
+    "SUPPRESS_ATTR",
+    "WARN",
+    "PassContext",
+    "analyze_program",
+    "emit_eager",
+    "is_suppressed",
+    "register_pass",
+    "registered_passes",
+    "validate_for_run",
+]

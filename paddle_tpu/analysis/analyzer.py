@@ -1,0 +1,194 @@
+"""Pass registry + runner for the static Program-IR analyzer.
+
+A pass is a function ``fn(ctx)`` registered under a stable name; it
+inspects ``ctx.program`` and emits diagnostics via ``ctx.emit``. The
+runner (``analyze_program``) executes passes in registration order and
+returns an ``AnalysisReport``.
+
+Two execution profiles:
+
+  * ``analyze_program(...)`` — everything (the CLI / CI profile);
+  * ``validate_for_run(...)`` — the executor's pre-lowering hook
+    behind the ``validate_program`` flag: in ``warn`` mode only the
+    cheap structural passes run and findings are logged; in ``strict``
+    mode all passes run and error-severity findings raise
+    ``ProgramVerificationError`` before any op is lowered.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+from typing import Callable, Dict, List, Optional, Sequence
+
+from .diagnostics import (
+    AnalysisReport,
+    Diagnostic,
+    Location,
+    ProgramVerificationError,
+    is_suppressed,
+)
+
+_logger = logging.getLogger("paddle_tpu.analysis")
+
+# name -> (fn, expensive). Ordered: registration order is run order.
+_PASS_REGISTRY: "collections.OrderedDict[str, tuple]" = collections.OrderedDict()
+
+
+def register_pass(name: str, expensive: bool = False):
+    """Decorator registering an analysis pass. ``expensive`` passes
+    (abstract re-inference, whole-graph reachability) are skipped by
+    the executor's default warn-mode hook and run under strict mode /
+    the CLI."""
+
+    def deco(fn: Callable):
+        _PASS_REGISTRY[name] = (fn, expensive)
+        fn._pass_name = name
+        return fn
+
+    return deco
+
+
+def registered_passes() -> List[str]:
+    return list(_PASS_REGISTRY)
+
+
+class PassContext:
+    """What a pass sees: the program, the run's feed/fetch interface,
+    and the emit sink (which applies per-op suppression)."""
+
+    def __init__(self, program, report: AnalysisReport,
+                 fetch_names: Optional[Sequence[str]] = None,
+                 feed_names: Optional[Sequence[str]] = None):
+        self.program = program
+        self.report = report
+        self.fetch_names = list(fetch_names) if fetch_names else []
+        self.feed_names = list(feed_names) if feed_names else []
+        self._pass_name = ""
+
+    # -- emission -------------------------------------------------------------
+    def emit(self, code: str, message: str, block=None, op_idx=None,
+             op=None, var: Optional[str] = None,
+             severity: Optional[str] = None,
+             suggestion: Optional[str] = None) -> Optional[Diagnostic]:
+        if op is not None and is_suppressed(op, code):
+            return None
+        loc = Location(
+            block_idx=getattr(block, "idx", block),
+            op_idx=op_idx,
+            op_type=getattr(op, "type", None),
+            var=var,
+        )
+        diag = Diagnostic(code, message, loc=loc, severity=severity,
+                          pass_name=self._pass_name, suggestion=suggestion)
+        self.report.add(diag)
+        return diag
+
+    # -- IR walking helpers ---------------------------------------------------
+    def iter_ops(self):
+        """Yield (block, op_idx, op) over every block of the program."""
+        for blk in self.program.blocks:
+            for i, op in enumerate(blk.ops):
+                yield blk, i, op
+
+    def sub_blocks_of(self, op):
+        """Blocks referenced from an op's attrs (control-flow bodies)."""
+        from ..core.framework import Block
+
+        return [v for v in op.attrs.values() if isinstance(v, Block)]
+
+    def data_var_names(self) -> set:
+        return {
+            v.name
+            for blk in self.program.blocks
+            for v in blk.vars.values()
+            if getattr(v, "is_data", False)
+        }
+
+    def persistable_names(self) -> set:
+        return {
+            v.name
+            for blk in self.program.blocks
+            for v in blk.vars.values()
+            if getattr(v, "persistable", False)
+        }
+
+
+def analyze_program(program, fetch_names=None, feed_names=None,
+                    passes: Optional[Sequence[str]] = None,
+                    label: str = "<program>") -> AnalysisReport:
+    """Run the analyzer over `program` and return the report.
+
+    ``passes`` selects a subset by name (default: all registered, in
+    registration order). A pass that itself crashes is reported as a
+    PTL090 error diagnostic rather than aborting the run — a broken
+    program must produce diagnostics, not tracebacks, and a crashed
+    pass means the program was NOT verified (fail closed, not open).
+    """
+    from . import passes as _passes  # noqa: F401  (registers on import)
+
+    report = AnalysisReport(label)
+    ctx = PassContext(program, report, fetch_names=fetch_names,
+                      feed_names=feed_names)
+    selected = list(_PASS_REGISTRY) if passes is None else list(passes)
+    for name in selected:
+        if name not in _PASS_REGISTRY:
+            raise ValueError(
+                f"unknown analysis pass {name!r}; "
+                f"registered: {registered_passes()}")
+        fn, _ = _PASS_REGISTRY[name]
+        ctx._pass_name = name
+        try:
+            fn(ctx)
+        except Exception as exc:
+            _logger.exception("analysis pass %r crashed", name)
+            report.add(Diagnostic(
+                "PTL090",
+                f"analysis pass {name!r} crashed: "
+                f"{type(exc).__name__}: {exc} — the program was NOT "
+                "verified by this pass",
+                pass_name=name))
+        report.passes_run.append(name)
+    return report
+
+
+def validate_for_run(program, fetch_names=None, feed_names=None,
+                     mode: str = "warn",
+                     label: str = "<program>") -> AnalysisReport:
+    """Executor pre-lowering hook (core/executor.py::_compile).
+
+    off    — no-op: returns an empty (ok) report.
+    warn   — cheap structural passes; findings logged, never raises.
+    strict — all passes; error-severity findings raise
+             ProgramVerificationError BEFORE any lowering happens.
+    """
+    from . import passes as _passes  # noqa: F401
+
+    if mode == "off":
+        return AnalysisReport(label)  # disabled: an empty, ok report
+    if mode not in ("warn", "strict"):
+        raise ValueError(
+            f"validate_program mode must be 'off', 'warn' or 'strict', "
+            f"got {mode!r}")
+    cheap = [n for n, (_, expensive) in _PASS_REGISTRY.items()
+             if not expensive]
+    report = analyze_program(program, fetch_names=fetch_names,
+                             feed_names=feed_names, passes=cheap,
+                             label=label)
+    if mode == "strict":
+        # structural errors reject BEFORE the expensive passes so that
+        # no op lowering is consulted (even abstractly) for a program
+        # that is not well-formed
+        if not report.ok:
+            raise ProgramVerificationError(report)
+        expensive = [n for n, (_, e) in _PASS_REGISTRY.items() if e]
+        deep = analyze_program(program, fetch_names=fetch_names,
+                               feed_names=feed_names, passes=expensive,
+                               label=label)
+        report.extend(deep.diagnostics)
+        report.passes_run.extend(deep.passes_run)
+        if not report.ok:
+            raise ProgramVerificationError(report)
+    for d in report.errors + report.warnings:
+        _logger.warning("validate_program: %s", d.format())
+    return report

@@ -1,0 +1,221 @@
+"""Structured diagnostics for the static Program-IR analyzer.
+
+Every finding the analyzer (analysis/passes.py) produces is a
+``Diagnostic``: a stable code (``PTL0xx``), a severity, a human
+message, and an IR location (block idx / op idx / op type / var name).
+Reports aggregate diagnostics, render them for humans, and serialize
+to JSON for the CLI (tools/proglint.py) and CI.
+
+Suppression: an op silences specific diagnostics by carrying the
+``lint_suppress`` attr — either the string ``"all"`` or a list of
+codes, e.g. ``op.attrs["lint_suppress"] = ["PTL040"]``. Matching the
+reference's mindset of per-op attrs carrying policy (op_proto_maker.h
+role attrs), suppression travels with the serialized program.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+ERROR = "error"
+WARN = "warn"
+INFO = "info"
+
+_SEVERITY_RANK = {ERROR: 2, WARN: 1, INFO: 0}
+
+# op attr consulted for suppression
+SUPPRESS_ATTR = "lint_suppress"
+
+# code -> (default severity, short title). The codes are a stable
+# public contract (documented in README); never renumber.
+CODES: Dict[str, tuple] = {
+    "PTL001": (ERROR, "op input names an undeclared variable"),
+    "PTL002": (ERROR, "op output names an undeclared variable"),
+    "PTL003": (WARN, "variable shadows an outer definition with different metadata"),
+    "PTL004": (ERROR, "invalid block parent chain"),
+    "PTL005": (ERROR, "control-flow op references an invalid sub-block"),
+    "PTL010": (ERROR, "variable read before any write"),
+    "PTL020": (ERROR, "inferred shape differs from declared shape"),
+    "PTL021": (WARN, "inferred dtype differs from declared dtype"),
+    "PTL022": (WARN, "abstract shape inference failed for op"),
+    "PTL030": (ERROR, "op type has no registered lowering"),
+    "PTL040": (WARN, "op unreachable from fetch targets / persistable state"),
+    "PTL041": (INFO, "declared variable never used by any op"),
+    "PTL050": (ERROR, "same variable written by two pipeline stages (WAW)"),
+    "PTL051": (ERROR, "variable read by an earlier pipeline stage is written by a later one (WAR)"),
+    "PTL052": (ERROR, "pipeline segmentation is inconsistent"),
+    "PTL090": (ERROR, "analysis pass crashed (internal error)"),
+}
+
+
+class Location:
+    """Where in the Program IR a diagnostic points."""
+
+    def __init__(self, block_idx: Optional[int] = None,
+                 op_idx: Optional[int] = None,
+                 op_type: Optional[str] = None,
+                 var: Optional[str] = None):
+        self.block_idx = block_idx
+        self.op_idx = op_idx
+        self.op_type = op_type
+        self.var = var
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "block": self.block_idx,
+            "op": self.op_idx,
+            "op_type": self.op_type,
+            "var": self.var,
+        }
+
+    def __str__(self) -> str:
+        parts = []
+        if self.block_idx is not None:
+            parts.append(f"block {self.block_idx}")
+        if self.op_idx is not None:
+            parts.append(f"op {self.op_idx}")
+        if self.op_type:
+            parts.append(f"({self.op_type})")
+        if self.var:
+            parts.append(f"var {self.var!r}")
+        return " ".join(parts) or "<program>"
+
+
+class Diagnostic:
+    def __init__(self, code: str, message: str,
+                 loc: Optional[Location] = None,
+                 severity: Optional[str] = None,
+                 pass_name: str = "",
+                 suggestion: Optional[str] = None):
+        if code not in CODES:
+            raise ValueError(f"unknown diagnostic code {code!r}")
+        self.code = code
+        self.severity = severity or CODES[code][0]
+        if self.severity not in _SEVERITY_RANK:
+            raise ValueError(f"unknown severity {self.severity!r}")
+        self.message = message
+        self.loc = loc or Location()
+        self.pass_name = pass_name
+        self.suggestion = suggestion
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = {
+            "code": self.code,
+            "severity": self.severity,
+            "message": self.message,
+            "location": self.loc.to_dict(),
+            "pass": self.pass_name,
+        }
+        if self.suggestion:
+            d["suggestion"] = self.suggestion
+        return d
+
+    def format(self) -> str:
+        s = f"{self.code} {self.severity}: {self.message} [{self.loc}]"
+        if self.suggestion:
+            s += f" — {self.suggestion}"
+        if self.pass_name:
+            s += f" (pass: {self.pass_name})"
+        return s
+
+    __str__ = format
+
+    def __repr__(self) -> str:
+        return f"Diagnostic({self.format()!r})"
+
+
+def is_suppressed(op, code: str) -> bool:
+    """True when `op` carries a lint_suppress attr covering `code`."""
+    sup = op.attrs.get(SUPPRESS_ATTR) if hasattr(op, "attrs") else None
+    if sup is None:
+        return False
+    if isinstance(sup, str):
+        return sup == "all" or sup == code
+    return "all" in sup or code in sup
+
+
+class AnalysisReport:
+    """Ordered collection of diagnostics + render/serialize helpers."""
+
+    def __init__(self, program_label: str = "<program>"):
+        self.program_label = program_label
+        self.diagnostics: List[Diagnostic] = []
+        self.passes_run: List[str] = []
+
+    def add(self, diag: Diagnostic) -> None:
+        self.diagnostics.append(diag)
+
+    def extend(self, diags) -> None:
+        self.diagnostics.extend(diags)
+
+    @property
+    def errors(self) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity == ERROR]
+
+    @property
+    def warnings(self) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity == WARN]
+
+    @property
+    def ok(self) -> bool:
+        return not self.errors
+
+    def by_code(self, code: str) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.code == code]
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "program": self.program_label,
+            "passes": list(self.passes_run),
+            "diagnostics": [d.to_dict() for d in self.diagnostics],
+            "summary": {
+                "errors": len(self.errors),
+                "warnings": len(self.warnings),
+                "infos": len(self.diagnostics)
+                - len(self.errors) - len(self.warnings),
+            },
+        }
+
+    def to_json(self, indent: Optional[int] = None) -> str:
+        return json.dumps(self.to_dict(), indent=indent)
+
+    def format_human(self, min_severity: str = INFO) -> str:
+        rank = _SEVERITY_RANK[min_severity]
+        shown = [d for d in self.diagnostics
+                 if _SEVERITY_RANK[d.severity] >= rank]
+        lines = [f"proglint: {self.program_label}"]
+        order = {ERROR: 0, WARN: 1, INFO: 2}
+        for d in sorted(shown, key=lambda d: order[d.severity]):
+            lines.append("  " + d.format())
+        s = self.to_dict()["summary"]
+        lines.append(
+            f"  {s['errors']} error(s), {s['warnings']} warning(s), "
+            f"{s['infos']} info(s) — passes: {', '.join(self.passes_run)}"
+        )
+        return "\n".join(lines)
+
+
+class ProgramVerificationError(RuntimeError):
+    """Raised by strict validation; carries the full report."""
+
+    def __init__(self, report: AnalysisReport):
+        self.report = report
+        errs = report.errors
+        head = "\n".join("  " + d.format() for d in errs[:10])
+        more = f"\n  ... and {len(errs) - 10} more" if len(errs) > 10 else ""
+        super().__init__(
+            f"program failed static verification with {len(errs)} "
+            f"error(s):\n{head}{more}"
+        )
+
+
+def emit_eager(diag: Diagnostic) -> None:
+    """Surface a diagnostic produced OUTSIDE a full analyzer run (the
+    eager layer-construction path in layer_helper.py): logged at
+    warning level so it is visible by default. Escalation to an
+    exception is the caller's job (layer_helper re-raises the original
+    error under FLAGS_print_op_shape_errors / strict)."""
+    import logging
+
+    logging.getLogger("paddle_tpu.analysis").warning("%s", diag.format())

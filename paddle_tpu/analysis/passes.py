@@ -1,0 +1,517 @@
+"""The analysis passes over the Program IR.
+
+Registered in dependency-safe order:
+
+  well-formedness   PTL001/002/003/004/005 — slot->Variable resolution,
+                    shadowing, block parent chains, sub-block refs.
+  unregistered-op   PTL030 — op types with no lowering in the registry,
+                    with a nearest-registered-op suggestion.
+  def-before-use    PTL010 — program-order reaching definitions per
+                    block, recursing into control-flow sub-blocks.
+  shape-dtype       PTL020/021/022 — abstract re-inference of every
+                    op's output shapes/dtypes via jax.eval_shape over
+                    its registered lowering, diffed against the
+                    shapes/dtypes recorded on Variables (the static
+                    replacement for the eager-probe-and-swallow path
+                    layers/auto.py used to rely on).
+  dead-code         PTL040/041 — ops unreachable from fetch targets /
+                    persistable state (needs fetch names to be sound),
+                    declared-but-never-used vars.
+  write-hazard      PTL050/051/052 — WAW/WAR on one var across
+                    pipeline stages (core/pipeline_program.py), the
+                    static analogue of the reference ParallelExecutor
+                    SSA-graph race rules.
+
+Severity philosophy: anything that would make the executor's lowering
+raise (or silently mis-run under pipelining) is an error; things that
+are legal but suspicious (shadowing, dead ops, dtype drift) warn.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set
+
+from .analyzer import PassContext, register_pass
+from .diagnostics import INFO, WARN
+
+# op types interpreted by the executor itself rather than the registry
+_PSEUDO_OPS = ("feed", "fetch")
+
+
+def _control_flow_types() -> Set[str]:
+    from ..core.executor import _CONTROL_FLOW
+
+    return set(_CONTROL_FLOW)
+
+
+def _resolve_var(blk, name: str):
+    """block._find_var_recursive, but safe on malformed parent chains
+    (out-of-range or cyclic parent_idx — PTL004's territory): the
+    analyzer must keep producing diagnostics, not crash."""
+    seen: Set[int] = set()
+    cur = blk
+    while cur is not None and cur.idx not in seen:
+        seen.add(cur.idx)
+        if name in cur.vars:
+            return cur.vars[name]
+        pi = cur.parent_idx
+        if pi < 0 or pi >= len(cur.program.blocks):
+            return None
+        cur = cur.program.blocks[pi]
+    return None
+
+
+def _op_reads(op) -> List[str]:
+    return [n for ns in op.inputs.values() for n in ns]
+
+
+def _op_writes(op) -> List[str]:
+    return [n for ns in op.outputs.values() for n in ns]
+
+
+def _sub_blocks(ctx: PassContext, op):
+    return ctx.sub_blocks_of(op)
+
+
+def _all_written_names(block, acc: Optional[Set[str]] = None) -> Set[str]:
+    """Every var name written by `block`'s ops, recursing into nested
+    control-flow sub-blocks (superset of control_flow._written_names,
+    which filters on runtime env membership)."""
+    from ..core.framework import Block
+
+    acc = set() if acc is None else acc
+    for op in block.ops:
+        acc.update(_op_writes(op))
+        for v in op.attrs.values():
+            if isinstance(v, Block):
+                _all_written_names(v, acc)
+    return acc
+
+
+def _all_read_names(block, acc: Optional[Set[str]] = None) -> Set[str]:
+    """Every var name read by `block`'s ops, recursing into nested
+    control-flow sub-blocks (arbitrary depth — a var consumed only by
+    a while-inside-while body is still a real use)."""
+    from ..core.framework import Block
+
+    acc = set() if acc is None else acc
+    for op in block.ops:
+        acc.update(_op_reads(op))
+        for v in op.attrs.values():
+            if isinstance(v, Block):
+                _all_read_names(v, acc)
+    return acc
+
+
+# --------------------------------------------------------------------------
+# 1. well-formedness
+# --------------------------------------------------------------------------
+
+
+@register_pass("well-formedness")
+def check_well_formed(ctx: PassContext) -> None:
+    from ..core.framework import Block
+
+    program = ctx.program
+
+    # block parent chains: block 0 is the root; every other block must
+    # reach it through in-range, acyclic parent links (PTL004)
+    nblocks = len(program.blocks)
+    for blk in program.blocks:
+        if blk.idx == 0:
+            if blk.parent_idx >= 0:
+                ctx.emit("PTL004",
+                         "global block 0 must have no parent "
+                         f"(parent_idx={blk.parent_idx})", block=blk)
+            continue
+        seen = set()
+        cur = blk
+        while cur.idx != 0:
+            if cur.parent_idx < 0 or cur.parent_idx >= nblocks:
+                ctx.emit("PTL004",
+                         f"block {cur.idx} has out-of-range parent_idx "
+                         f"{cur.parent_idx}", block=blk)
+                break
+            if cur.idx in seen:
+                ctx.emit("PTL004",
+                         f"block parent chain of block {blk.idx} is cyclic",
+                         block=blk)
+                break
+            seen.add(cur.idx)
+            cur = program.blocks[cur.parent_idx]
+
+    # variable shadowing with conflicting metadata (PTL003)
+    for blk in program.blocks:
+        if blk.idx == 0:
+            continue
+        if not (0 <= blk.parent_idx < nblocks):
+            continue  # PTL004 already emitted above
+        outer = blk.parent_block()
+        for name, v in blk.vars.items():
+            o = _resolve_var(outer, name) if outer is not None else None
+            if o is None or o is v:
+                continue
+            if (v.shape is not None and o.shape is not None
+                    and tuple(v.shape) != tuple(o.shape)) or v.dtype != o.dtype:
+                ctx.emit(
+                    "PTL003",
+                    f"var {name!r} in block {blk.idx} (shape={v.shape}, "
+                    f"dtype={v.dtype}) shadows an outer definition with "
+                    f"shape={o.shape}, dtype={o.dtype}",
+                    block=blk, var=name)
+
+    # per-op slot resolution + sub-block refs (PTL001/002/005)
+    cf_types = _control_flow_types()
+    for blk, i, op in ctx.iter_ops():
+        for slot, names in op.inputs.items():
+            for n in names:
+                if op.type == "feed":
+                    continue
+                if _resolve_var(blk, n) is None:
+                    ctx.emit(
+                        "PTL001",
+                        f"op input {slot}={n!r} does not name a declared "
+                        f"Variable in block {blk.idx} or its ancestors",
+                        block=blk, op_idx=i, op=op, var=n)
+        for slot, names in op.outputs.items():
+            for n in names:
+                if _resolve_var(blk, n) is None:
+                    ctx.emit(
+                        "PTL002",
+                        f"op output {slot}={n!r} does not name a declared "
+                        f"Variable in block {blk.idx} or its ancestors",
+                        block=blk, op_idx=i, op=op, var=n)
+        if op.type in cf_types:
+            sub = op.attrs.get("sub_block")
+            if sub is None:
+                ctx.emit("PTL005",
+                         f"control-flow op {op.type!r} has no sub_block attr",
+                         block=blk, op_idx=i, op=op)
+            elif not isinstance(sub, Block):
+                ctx.emit("PTL005",
+                         f"control-flow op {op.type!r} sub_block attr is "
+                         f"{type(sub).__name__}, not a Block (unresolved "
+                         "block reference?)",
+                         block=blk, op_idx=i, op=op)
+            elif (sub.program is not program
+                  or sub.idx >= len(program.blocks)
+                  or program.blocks[sub.idx] is not sub):
+                ctx.emit("PTL005",
+                         f"control-flow op {op.type!r} references sub-block "
+                         f"{sub.idx} that does not belong to this program",
+                         block=blk, op_idx=i, op=op)
+
+
+# --------------------------------------------------------------------------
+# 2. unregistered-op detection
+# --------------------------------------------------------------------------
+
+
+@register_pass("unregistered-op")
+def check_unregistered_ops(ctx: PassContext) -> None:
+    from ..core.registry import has_op, suggest_ops
+
+    cf_types = _control_flow_types()
+    for blk, i, op in ctx.iter_ops():
+        if op.type in _PSEUDO_OPS or op.type in cf_types:
+            continue
+        if has_op(op.type):
+            continue
+        near = suggest_ops(op.type)
+        ctx.emit(
+            "PTL030",
+            f"op type {op.type!r} has no registered lowering",
+            block=blk, op_idx=i, op=op,
+            suggestion=("did you mean " + " / ".join(repr(n) for n in near)
+                        + "?") if near else None)
+
+
+# --------------------------------------------------------------------------
+# 3. def-before-use
+# --------------------------------------------------------------------------
+
+
+@register_pass("def-before-use")
+def check_def_before_use(ctx: PassContext) -> None:
+    """Program-order reaching definitions. A read is satisfied by: a
+    feed (is_data var or explicit feed name), scope state (persistable
+    var / Parameter), or an earlier write in program order — including
+    writes inside already-executed control-flow sub-blocks. Reads of
+    never-written non-parameter vars are the executor's
+    "did you run the startup program?" KeyError, caught statically."""
+    program = ctx.program
+    cf_types = _control_flow_types()
+
+    defined: Set[str] = set(ctx.feed_names)
+    defined |= ctx.data_var_names()
+    defined |= ctx.persistable_names()
+
+    def visit(block, defined: Set[str], local_names: Set[str]):
+        for i, op in enumerate(block.ops):
+            if op.type == "feed":
+                defined.update(_op_writes(op))
+                continue
+            if op.type == "fetch":
+                continue
+            for slot, names in op.inputs.items():
+                for n in names:
+                    if n in defined or n in local_names:
+                        continue
+                    var = _resolve_var(block, n)
+                    if var is None:
+                        continue  # PTL001's finding, not ours
+                    if getattr(var, "persistable", False) or \
+                            getattr(var, "is_data", False):
+                        defined.add(n)
+                        continue
+                    ctx.emit(
+                        "PTL010",
+                        f"op reads {slot}={n!r} before any write: the var "
+                        "is neither a parameter, a fed data var, nor "
+                        "produced by an earlier op in program order",
+                        block=block, op_idx=i, op=op, var=n)
+            for sub in _sub_blocks(ctx, op):
+                # sub-block-local vars (recurrent memories, loop
+                # temporaries) are bound by the structured op's
+                # lowering; everything else follows normal rules
+                visit(sub, defined, local_names | set(sub.vars))
+            if op.type in cf_types:
+                # after the op, its sub-block writes are (possibly)
+                # materialized in the enclosing env
+                for sub in _sub_blocks(ctx, op):
+                    defined |= _all_written_names(sub)
+            defined.update(_op_writes(op))
+
+    visit(program.global_block(), defined, set())
+
+
+# --------------------------------------------------------------------------
+# 4. shape/dtype consistency (abstract re-inference)
+# --------------------------------------------------------------------------
+
+
+def _static_size(dims) -> int:
+    """Product of the static dims only — wildcards (None / negative)
+    count as 1, so a pure-wildcard shape has static size 1."""
+    out = 1
+    for x in dims:
+        if x is None or int(x) < 0:
+            continue
+        out *= int(x)
+    return out
+
+
+def _dims_compatible(declared, inferred) -> bool:
+    """Dim lists match, treating declared -1/None as wildcards and a
+    batch-substituted inferred dim of 1 as compatible with any declared
+    dynamic dim. Size-1 rank differences ((1,) vs ()) are tolerated —
+    scalar metrics are declared [1] across the layer surface."""
+    d = tuple(declared)
+    f = tuple(inferred)
+    if len(d) != len(f):
+        return _static_size(d) == 1 and _static_size(f) == 1
+    for dd, ff in zip(d, f):
+        if dd is None or int(dd) == -1:
+            continue
+        if int(dd) != int(ff):
+            return False
+    return True
+
+
+_DTYPE_EQUIV = {
+    frozenset({"int32", "int64"}),   # executor downcasts with x64 off
+    frozenset({"float32", "float64"}),
+}
+
+
+def _dtypes_compatible(declared: str, inferred: str) -> bool:
+    return declared == inferred or \
+        frozenset({declared, inferred}) in _DTYPE_EQUIV
+
+
+@register_pass("shape-dtype", expensive=True)
+def check_shapes_dtypes(ctx: PassContext) -> None:
+    """Re-infer every op's output shapes/dtypes with jax.eval_shape
+    over its registered lowering and diff against the Variables. No
+    real computation happens — eval_shape traces with abstract values,
+    so this is safe to run on any host, before any TPU is touched."""
+    import jax
+
+    from ..core.registry import (LoweringContext, abstract_arg_specs,
+                                 get_op_def, has_op)
+
+    cf_types = _control_flow_types()
+    for blk, i, op in ctx.iter_ops():
+        if op.type in _PSEUDO_OPS or op.type in cf_types:
+            continue
+        if not has_op(op.type):
+            continue  # PTL030's finding
+        opdef = get_op_def(op.type)
+
+        specs = abstract_arg_specs({
+            slot: [_resolve_var(blk, n) for n in names]
+            for slot, names in op.inputs.items()
+        })
+        if specs is None:
+            continue  # shape-less inputs: nothing to re-infer against
+
+        try:
+            res = jax.eval_shape(
+                lambda ins: opdef.lower(LoweringContext(), op, ins), specs)
+        except Exception as exc:
+            ctx.emit(
+                "PTL022",
+                f"abstract shape inference failed for op {op.type!r}: "
+                f"{type(exc).__name__}: {exc}",
+                block=blk, op_idx=i, op=op, severity=WARN)
+            continue
+
+        for slot, names in op.outputs.items():
+            inferred = res.get(slot, []) if hasattr(res, "get") else []
+            for j, n in enumerate(names):
+                if j >= len(inferred):
+                    continue
+                var = _resolve_var(blk, n)
+                if var is None or var.shape is None:
+                    continue
+                a = inferred[j]
+                if not hasattr(a, "shape"):
+                    continue
+                if not _dims_compatible(var.shape, a.shape):
+                    ctx.emit(
+                        "PTL020",
+                        f"op output {slot}={n!r} declares shape "
+                        f"{tuple(var.shape)} but the lowering produces "
+                        f"{tuple(a.shape)}",
+                        block=blk, op_idx=i, op=op, var=n)
+                elif not _dtypes_compatible(str(var.dtype), str(a.dtype)):
+                    ctx.emit(
+                        "PTL021",
+                        f"op output {slot}={n!r} declares dtype "
+                        f"{var.dtype} but the lowering produces {a.dtype}",
+                        block=blk, op_idx=i, op=op, var=n)
+
+
+# --------------------------------------------------------------------------
+# 5. dead code / fetch reachability + pipeline write hazards
+# --------------------------------------------------------------------------
+
+
+@register_pass("dead-code", expensive=True)
+def check_dead_code(ctx: PassContext) -> None:
+    """Backward reachability from the program's observable effects:
+    fetch targets (when known), persistable writes, and side-effectful
+    ops. Sound op-deadness needs fetch names — without them only
+    never-referenced vars are reported (PTL041)."""
+    program = ctx.program
+    cf_types = _control_flow_types()
+    block = program.global_block()
+
+    used_anywhere: Set[str] = set()
+    for _, _, op in ctx.iter_ops():
+        used_anywhere.update(_op_reads(op))
+        used_anywhere.update(_op_writes(op))
+
+    for blk in program.blocks:
+        for name, v in blk.vars.items():
+            if name in used_anywhere or name in ctx.fetch_names:
+                continue
+            if getattr(v, "persistable", False) or \
+                    getattr(v, "is_data", False):
+                continue
+            ctx.emit("PTL041",
+                     f"var {name!r} is declared but never read or written "
+                     "by any op", block=blk, var=name, severity=INFO)
+
+    if not ctx.fetch_names:
+        return
+
+    persistable = ctx.persistable_names()
+    needed: Set[str] = set(ctx.fetch_names)
+    live_extra_types = cf_types | {"fetch"}
+    for i in range(len(block.ops) - 1, -1, -1):
+        op = block.ops[i]
+        if op.type == "feed":
+            continue
+        writes = _op_writes(op)
+        live = (
+            op.type in live_extra_types
+            or not writes  # output-less ops act by side effect
+            or any(n in needed for n in writes)
+            or any(n in persistable for n in writes)
+        )
+        if live:
+            needed.update(_op_reads(op))
+            for sub in _sub_blocks(ctx, op):
+                _all_read_names(sub, needed)
+        else:
+            ctx.emit(
+                "PTL040",
+                f"op {op.type!r} is unreachable from the fetch targets "
+                f"{sorted(ctx.fetch_names)!r} and writes no persistable "
+                "state", block=block, op_idx=i, op=op, severity=WARN)
+
+
+@register_pass("write-hazard")
+def check_write_hazards(ctx: PassContext) -> None:
+    """Static WAW/WAR detection across pipeline stages. Stages execute
+    concurrently over microbatches, so one var name written by two
+    stages (WAW) or read by an earlier stage than a writer (WAR) is a
+    race the SPMD schedule cannot order — the reference encodes the
+    same rules on its SSA graph in multi_devices_graph_pass."""
+    program = ctx.program
+    cuts = list(getattr(program, "_pipeline_cuts", None) or ())
+    if not cuts:
+        return
+    from ..core.framework import OpRole
+    from ..core.pipeline_program import _segment_ops
+
+    block = program.global_block()
+
+    def role(op):
+        return int(op.attrs.get("op_role", 0))
+
+    fwd_ops = [
+        op for op in block.ops
+        if op.type not in _PSEUDO_OPS
+        and role(op) & (OpRole.Backward | OpRole.Optimize | OpRole.LRSched) == 0
+    ]
+    try:
+        segments = _segment_ops(fwd_ops, cuts)
+    except ValueError as exc:
+        ctx.emit("PTL052", f"pipeline segmentation failed: {exc}",
+                 block=block)
+        return
+
+    op_index = {id(op): i for i, op in enumerate(block.ops)}
+    writers: Dict[str, List[tuple]] = {}
+    readers: Dict[str, List[tuple]] = {}
+    for s, seg in enumerate(segments):
+        for op in seg:
+            for n in _op_reads(op):
+                readers.setdefault(n, []).append((s, op))
+            for n in _op_writes(op):
+                writers.setdefault(n, []).append((s, op))
+
+    for n, ws in writers.items():
+        stages = sorted({s for s, _ in ws})
+        if len(stages) > 1:
+            s2, op2 = next((s, op) for s, op in ws if s == stages[1])
+            ctx.emit(
+                "PTL050",
+                f"var {n!r} is written by pipeline stages {stages} — "
+                "stages run concurrently over microbatches, so the final "
+                "value is schedule-dependent (WAW)",
+                block=block, op_idx=op_index.get(id(op2)), op=op2, var=n)
+            continue  # WAR on the same var would be noise on top
+        wstage = stages[0]
+        early_readers = [(s, op) for s, op in readers.get(n, [])
+                         if s < wstage]
+        if early_readers:
+            s1, op1 = early_readers[0]
+            ctx.emit(
+                "PTL051",
+                f"var {n!r} is read by stage {s1} but written by the "
+                f"later stage {wstage} — an anti-dependence across "
+                "concurrent stages (WAR)",
+                block=block, op_idx=op_index.get(id(op1)), op=op1, var=n)

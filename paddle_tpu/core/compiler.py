@@ -303,6 +303,26 @@ class CompiledProgram:
                         *(("dp",) + (None,) * (len(v.shape) - 1)))
         return self
 
+    def validate(self, fetch_list=None, strict: bool = False):
+        """Run the static analyzer (paddle_tpu.analysis) over the
+        wrapped program and return the AnalysisReport; with
+        ``strict=True`` error-severity findings raise
+        ProgramVerificationError. The same verification the executor
+        performs pre-lowering under the ``validate_program`` flag,
+        exposed here so build pipelines can lint a CompiledProgram
+        before ever constructing an Executor."""
+        from ..analysis import analyze_program, ProgramVerificationError
+
+        fetch_names = [
+            getattr(v, "name", str(v)) for v in (fetch_list or [])
+        ]
+        report = analyze_program(
+            self._program, fetch_names=fetch_names,
+            label=f"CompiledProgram uid={self._program.uid}")
+        if strict and not report.ok:
+            raise ProgramVerificationError(report)
+        return report
+
     # graph passthroughs used by reference code
     @property
     def program(self):

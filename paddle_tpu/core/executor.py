@@ -644,6 +644,21 @@ class Executor:
         state_shardings=None,
         axis_env=None,
     ) -> _CompiledBlock:
+        from ..flags import flag
+
+        # static Program-IR verification (analysis/) BEFORE any lowering:
+        # "warn" runs the structural passes and logs findings; "strict"
+        # runs everything (incl. abstract shape re-inference) and raises
+        # ProgramVerificationError so no JAX tracing ever starts on a
+        # malformed program. Runs on compile-cache misses only.
+        mode = flag("validate_program")
+        if mode and mode != "off":
+            from ..analysis import validate_for_run
+
+            validate_for_run(
+                program, fetch_names=fetch_names, feed_names=feed_names,
+                mode=mode, label=f"program uid={program.uid}")
+
         state_names, written_names = self._analyze_block(program, block, feed_names)
 
         # multi-PROCESS collective mode (reference: NCCL2 transpile +

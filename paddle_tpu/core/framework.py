@@ -52,22 +52,33 @@ _DTYPE_ALIASES = {
 
 
 def convert_dtype(dtype) -> str:
-    """Normalize any dtype spec (str, np.dtype, jnp dtype) to a string."""
+    """Normalize any dtype spec (str, np.dtype, jnp dtype) to a string.
+
+    Unknown specs raise one consistent ``ValueError`` naming the
+    offending object — np.dtype() raises a mix of TypeError/ValueError
+    with messages that don't mention the spec (bfloat16-like extension
+    types were the worst offenders), so every failure path funnels
+    through the same error here.
+    """
     if dtype is None:
         return "float32"
     if isinstance(dtype, str):
         key = dtype.lower()
         if key in _DTYPE_ALIASES:
             return _DTYPE_ALIASES[key]
-        raise ValueError(f"unsupported dtype string: {dtype}")
-    if hasattr(dtype, "name"):  # np.dtype or jnp types
-        name = dtype.name
-        if name in _DTYPE_ALIASES:
-            return _DTYPE_ALIASES[name]
+        raise ValueError(f"unsupported dtype string: {dtype!r}")
+    name = getattr(dtype, "name", None)  # np.dtype, jnp/ml_dtypes types
+    if isinstance(name, str) and name in _DTYPE_ALIASES:
+        return _DTYPE_ALIASES[name]
     try:
-        return np.dtype(dtype).name
-    except TypeError:
-        raise ValueError(f"unsupported dtype: {dtype!r}")
+        resolved = np.dtype(dtype)
+    except (TypeError, ValueError):
+        raise ValueError(f"unsupported dtype: {dtype!r}") from None
+    if resolved.kind in ("O", "U", "S", "V", "M", "m"):
+        raise ValueError(
+            f"unsupported dtype: {dtype!r} (resolves to np.{resolved.name}, "
+            "which has no tensor mapping)")
+    return _DTYPE_ALIASES.get(resolved.name, resolved.name)
 
 
 # --------------------------------------------------------------------------

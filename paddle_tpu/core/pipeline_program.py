@@ -81,6 +81,26 @@ def build_pipeline_fn(
     from ..parallel.pipeline import pipeline_schedule
 
     program = block.program
+
+    # static WAW/WAR verification up front (analysis/passes.py
+    # write-hazard): a var name written by two stages, or read by an
+    # earlier stage than its writer, races across concurrent
+    # microbatches — mis-executing silently under the SPMD schedule.
+    # Surface it as a structured diagnostic before building anything.
+    # Honors validate_program=off; only actual hazard findings raise
+    # (an analyzer-internal crash, PTL090, must not block training).
+    from ..flags import flag
+
+    if flag("validate_program") != "off":
+        from ..analysis import ProgramVerificationError, analyze_program
+
+        hazard_report = analyze_program(
+            program, passes=["write-hazard"],
+            label=f"pipeline program uid={program.uid}")
+        if any(d.code in ("PTL050", "PTL051", "PTL052")
+               for d in hazard_report.errors):
+            raise ProgramVerificationError(hazard_report)
+
     cut_names = list(program._pipeline_cuts)
     M = int(getattr(program, "_pipeline_microbatches", 0) or 4)
     S = len(cut_names) + 1

@@ -135,7 +135,10 @@ def get_op_def(type: str) -> OpDef:
             gd = _make_auto_grad(fwd)
             _OP_REGISTRY[type] = gd
             return gd
-    raise NotImplementedError(f"op type {type!r} has no registered lowering")
+    near = suggest_ops(type)
+    hint = f" (did you mean {' / '.join(repr(n) for n in near)}?)" if near else ""
+    raise NotImplementedError(
+        f"op type {type!r} has no registered lowering{hint}")
 
 
 def has_op(type: str) -> bool:
@@ -146,6 +149,43 @@ def has_op(type: str) -> bool:
 
 def registered_ops() -> List[str]:
     return sorted(_OP_REGISTRY)
+
+
+def abstract_arg_specs(vars_by_slot) -> Optional[Dict[str, List[Any]]]:
+    """{slot: [Variable]} -> {slot: [jax.ShapeDtypeStruct]} for
+    abstract (eval_shape) re-inference of an op's lowering, with
+    -1/None dims mapped to 1. Returns None when any input is missing a
+    Variable, a shape, or a resolvable dtype — nothing to infer
+    against. Shared by the eager layer path
+    (layer_helper.infer_op_shapes) and the static shape-dtype analysis
+    pass (analysis/passes.py)."""
+    specs: Dict[str, List[Any]] = {}
+    for slot, vs in vars_by_slot.items():
+        lst = []
+        for v in vs:
+            if v is None or getattr(v, "shape", None) is None:
+                return None
+            try:
+                dt = jnp.dtype(str(v.dtype or "float32"))
+            except TypeError:
+                return None
+            shape = tuple(1 if (d is None or int(d) < 0) else int(d)
+                          for d in v.shape)
+            lst.append(jax.ShapeDtypeStruct(shape, dt))
+        specs[slot] = lst
+    return specs
+
+
+def suggest_ops(name: str, n: int = 3) -> List[str]:
+    """Nearest registered op types for an unknown `name` (typo help in
+    NotImplementedError messages and the PTL030 lint diagnostic)."""
+    import difflib
+
+    base = name[: -len("_grad")] if name.endswith("_grad") else name
+    hits = difflib.get_close_matches(base, registered_ops(), n=n, cutoff=0.6)
+    if base is not name:
+        hits = [h + "_grad" for h in hits]
+    return hits
 
 
 # --------------------------------------------------------------------------

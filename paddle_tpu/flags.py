@@ -21,6 +21,11 @@ _FLAG_DEFS: Dict[str, Any] = {
     "check_nan_inf": False,            # per-op nan/inf scan (details/nan_inf_utils.h)
     "benchmark": False,                # Executor.run sync + wall-time print
     "print_op_shape_errors": False,    # escalate swallowed layer shape-inference failures
+    # static Program-IR verification before lowering (analysis/):
+    # "off" | "warn" (log structural findings, never raise) | "strict"
+    # (all passes incl. shape re-inference; errors raise
+    # ProgramVerificationError BEFORE any JAX lowering)
+    "validate_program": "warn",
     "eager_delete_tensor_gb": 0.0,     # inert: XLA frees by liveness
     # accepted-but-inert parity flags (reference platform/flags.cc)
     "fraction_of_gpu_memory_to_use": 0.92,

@@ -14,6 +14,74 @@ from .core.framework import Parameter, Variable, default_main_program, default_s
 from .initializer import ConstantInitializer, XavierInitializer
 from .param_attr import ParamAttr
 
+# op types whose eager shape inference already failed once this
+# process — later failures of the same type log at debug, not warning
+_shape_warned_types = set()
+
+
+def infer_op_shapes(op_type, ins, attrs, out_slots):
+    """Eager output shapes via jax.eval_shape over the op's OWN
+    lowering (the codebase invariant: layer outputs carry shapes so
+    downstream layers can size parameters). Returns
+    ``{slot: [(shape, dtype), ...]}`` or None when any input is
+    shape-less.
+
+    Failures route through the analysis diagnostics (PTL022): a
+    shape-less output is a legitimate outcome for data-dependent ops,
+    but a BUG in a lowering surfaces the same way — so the first
+    failure per op type warns (visible by default), and
+    FLAGS_print_op_shape_errors or validate_program=strict escalate to
+    the original exception instead of discarding it.
+    """
+    import jax
+
+    from .core.registry import abstract_arg_specs, get_op_def, LoweringContext
+
+    opdef = get_op_def(op_type)
+
+    class _P:
+        pass
+
+    op = _P()
+    op.type = op_type
+    op.attrs = dict(attrs)
+    op.attrs.setdefault("op_ident", 0)
+    op.attrs.setdefault("seed", 0)
+    op.inputs = {s: [getattr(v, "name", "x") for v in vs]
+                 for s, vs in ins.items()}
+    op.outputs = {s: [f"{op_type}_o"] for s in out_slots}
+    specs = abstract_arg_specs(ins)
+    if specs is None:
+        return None
+    try:
+        res = jax.eval_shape(
+            lambda i: opdef.lower(LoweringContext(), op, i), specs)
+    except Exception as exc:
+        from .analysis.diagnostics import Diagnostic, Location, emit_eager
+        from .flags import flag
+
+        if flag("print_op_shape_errors") or \
+                flag("validate_program") == "strict":
+            raise
+        diag = Diagnostic(
+            "PTL022",
+            f"eager shape inference for op {op_type!r} failed "
+            f"({type(exc).__name__}: {exc}); its output Variables will "
+            "carry shape=None",
+            loc=Location(op_type=op_type),
+            pass_name="layer-helper")
+        if op_type not in _shape_warned_types:
+            _shape_warned_types.add(op_type)
+            emit_eager(diag)
+        else:
+            import logging
+
+            logging.getLogger("paddle_tpu.analysis").debug(
+                "%s", diag.format())
+        return None
+    return {s: [(tuple(a.shape), str(a.dtype)) for a in res.get(s, [])]
+            for s in out_slots}
+
 
 class LayerHelper:
     def __init__(self, layer_type: str, **kwargs):

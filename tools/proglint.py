@@ -1,0 +1,124 @@
+#!/usr/bin/env python
+"""proglint — standalone static verifier/linter for serialized Programs.
+
+    python tools/proglint.py model/main.json [model/startup.json ...]
+    python tools/proglint.py --json main.json          # machine-readable
+    python tools/proglint.py --fetch loss_var main.json
+    python tools/proglint.py --passes well-formedness,def-before-use main.json
+
+Input files are Program JSON as produced by ``Program.to_json()``
+(examples/author_trainer_program.py writes them). Runs every
+registered analysis pass (paddle_tpu/analysis/passes.py) by default
+and prints a human report, or one JSON document with ``--json``.
+
+Exit code: 0 when no error-severity diagnostics were found in any
+input, 1 when at least one program has errors, 2 on usage/IO problems.
+With ``--strict``, warnings are promoted to failures (exit 1) too.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# runnable from anywhere: `python tools/proglint.py` puts tools/ (not
+# the repo root) on sys.path
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def _load_program(path: str):
+    from paddle_tpu.core.framework import Program
+
+    with open(path) as f:
+        return Program.from_json(f.read())
+
+
+def lint_path(path: str, fetch_names=None, passes=None):
+    """Analyze one serialized program; returns its AnalysisReport."""
+    from paddle_tpu import analysis
+
+    program = _load_program(path)
+    return analysis.analyze_program(
+        program, fetch_names=fetch_names, passes=passes,
+        label=os.path.basename(path))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="proglint",
+        description="static Program-IR verifier & linter")
+    ap.add_argument("programs", nargs="+", metavar="program.json",
+                    help="serialized Program files (Program.to_json())")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit one JSON document instead of human text")
+    ap.add_argument("--fetch", action="append", default=[],
+                    metavar="VAR", help="fetch target var name (enables "
+                    "sound dead-code reachability); repeatable")
+    ap.add_argument("--passes", default=None,
+                    help="comma-separated subset of passes to run "
+                    "(default: all registered)")
+    ap.add_argument("--strict", action="store_true",
+                    help="treat warnings as failures for the exit code")
+    ap.add_argument("--min-severity", default="info",
+                    choices=["info", "warn", "error"],
+                    help="lowest severity shown in the human report")
+    args = ap.parse_args(argv)
+
+    passes = args.passes.split(",") if args.passes else None
+    if passes is not None:
+        from paddle_tpu.analysis import registered_passes
+
+        unknown = [p for p in passes if p not in registered_passes()]
+        if unknown:
+            print(f"proglint: unknown pass(es) {unknown}; registered: "
+                  f"{registered_passes()}", file=sys.stderr)
+            return 2
+    # fetch targets are per-program; applying one program's roots to
+    # another would flag every op of the second as dead
+    if args.fetch and len(args.programs) > 1:
+        print("proglint: --fetch requires exactly one program file "
+              "(fetch targets are per-program)", file=sys.stderr)
+        return 2
+
+    reports = []
+    for path in args.programs:
+        if not os.path.exists(path):
+            print(f"proglint: {path}: no such file", file=sys.stderr)
+            return 2
+        try:
+            reports.append(lint_path(path, fetch_names=args.fetch,
+                                     passes=passes))
+        except (ValueError, KeyError, TypeError, AttributeError,
+                json.JSONDecodeError) as exc:
+            # valid JSON with an invalid Program structure surfaces as
+            # TypeError/AttributeError from Program.from_dict — all
+            # load failures must exit 2, distinct from lint findings
+            print(f"proglint: {path}: cannot load program: "
+                  f"{type(exc).__name__}: {exc}", file=sys.stderr)
+            return 2
+
+    if args.as_json:
+        doc = {
+            "programs": [r.to_dict() for r in reports],
+            "summary": {
+                "errors": sum(len(r.errors) for r in reports),
+                "warnings": sum(len(r.warnings) for r in reports),
+            },
+        }
+        print(json.dumps(doc, indent=2))
+    else:
+        for r in reports:
+            print(r.format_human(min_severity=args.min_severity))
+
+    failed = any(r.errors for r in reports)
+    if args.strict:
+        failed = failed or any(r.warnings for r in reports)
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
