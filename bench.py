@@ -445,7 +445,30 @@ def run_stage_inproc(kind, model, batch, seq, steps, warmup, flash):
         "s_per_step_dispatch": round(dispatch_dt / steps, 5),
         "s_per_step_device_loop": (round(device_loop / steps, 5)
                                    if device_loop is not None else None),
+        # python-dispatch overhead this stage pays per step: the gap
+        # between the host-driven loop and the pure device loop (None
+        # when the device loop didn't run — CPU stages)
+        "dispatch_overhead_s_per_step": (
+            round(max(dispatch_dt - device_loop, 0.0) / steps, 5)
+            if device_loop is not None else None),
+        "dispatch_cache_stats": _dispatch_cache_snapshot(),
     }
+
+
+def _dispatch_cache_snapshot():
+    """Process-wide compile/cache counters (runtime/dispatch) at the
+    end of a stage — shows how much compile time the persistent cache
+    amortized on a relay capture. Guarded: a broken import must never
+    cost the stage row."""
+    try:
+        from paddle_tpu.runtime import dispatch as _dispatch
+
+        st = _dispatch.cache_stats()
+        return {k: st[k] for k in ("jit_compiles", "shared_cache_hits",
+                                   "compile_time_s",
+                                   "persistent_cache_dir")}
+    except Exception:  # noqa: BLE001
+        return None
 
 
 def _multi_child():

@@ -73,6 +73,35 @@ class CompiledProgram:
         self._axis_env = None
         # which with_* strategy built _mesh (chaining guard)
         self._strategy = None
+        # cache-key fragment (mesh/device fingerprint, sharding tuples)
+        # precomputed once for the executor's hot-path dispatch cache
+        # instead of per Executor.run call (runtime/dispatch)
+        self._frag = None
+
+    def _dispatch_fragment(self):
+        """Hashable summary of everything about THIS CompiledProgram
+        that the executor's dispatch cache must key on. Built lazily
+        after the single with_* strategy ran (the _claim_strategy guard
+        makes mesh/shardings immutable from then on), then reused every
+        step."""
+        frag = self._frag
+        if frag is None:
+            mesh = self._mesh
+            frag = self._frag = (
+                (tuple(sorted(dict(mesh.shape).items())),
+                 tuple(d.id for d in mesh.devices.flat))
+                if mesh is not None else None,
+                tuple(sorted((k, tuple(v))
+                             for k, v in self._in_shardings.items()))
+                if self._in_shardings else None,
+                tuple(sorted((k, tuple(v))
+                             for k, v in self._state_shardings.items()))
+                if self._state_shardings else None,
+                tuple(sorted(self._axis_env.items()))
+                if self._axis_env else None,
+                self._strategy,
+            )
+        return frag
 
     def _claim_strategy(self, name: str) -> None:
         """Each compile takes exactly ONE with_* strategy. Chaining
@@ -87,6 +116,11 @@ class CompiledProgram:
                 f"the dp= argument of {self._strategy} (or a fresh "
                 f"CompiledProgram) for combined meshes")
         self._strategy = name
+        # a run BEFORE the strategy may have cached the mesh-less
+        # fragment — drop it so the next dispatch re-keys on the real
+        # mesh/shardings instead of silently reusing the unsharded
+        # executable
+        self._frag = None
 
     def with_data_parallel(
         self,
@@ -283,6 +317,21 @@ class CompiledProgram:
                 "program has no pipeline cuts — minimize with "
                 "PipelineOptimizer(cut_list=...) first"
             )
+        if dp > 1:
+            # data vars with a STATIC leading dim must divide over dp;
+            # dynamic (-1) batch dims are validated against the actual
+            # feed at dispatch-bind time (runtime/dispatch
+            # validate_feed_shardings) — either way the failure is a
+            # clear message here, not an opaque GSPMD/shard_map error
+            for v in self._program.global_block().vars.values():
+                if not (getattr(v, "is_data", False) and v.shape):
+                    continue
+                lead = v.shape[0]
+                if lead is not None and lead > 0 and lead % dp:
+                    raise ValueError(
+                        f"with_pipeline(dp={dp}): data var {v.name!r} has "
+                        f"leading (batch) dim {lead}, not divisible by "
+                        f"dp={dp} — adjust the batch size or dp")
         self._claim_strategy("with_pipeline")
         n = len(cuts) + 1
         need = n * dp

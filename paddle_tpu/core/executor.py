@@ -26,6 +26,7 @@ from __future__ import annotations
 import contextlib
 import functools
 import itertools
+import threading
 import time as _time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -35,7 +36,7 @@ import jax
 import jax.numpy as jnp
 
 from . import framework
-from .framework import Program, Block, Variable, convert_dtype
+from .framework import Program, Block, Variable
 from .registry import LoweringContext, get_op_def
 from .places import Place, TPUPlace
 
@@ -50,11 +51,25 @@ class Scope:
     """
 
     _uid_counter = itertools.count(1)
+    # shared by all scopes: generation bumps must not lose increments
+    # under concurrent mutation (python's `+= 1` is a non-atomic
+    # read/add/store) — a lost bump would let a BoundStep keep stale
+    # state refs past the documented one-step staleness window
+    _gen_lock = threading.Lock()
 
     def __init__(self, parent: Optional["Scope"] = None):
         self.vars: Dict[str, Any] = {}
         self.parent = parent
         self.uid = next(Scope._uid_counter)
+        # bumped on every mutation: the dispatch fast path
+        # (runtime/dispatch.BoundStep) caches state-var refs and
+        # re-resolves only when this counter moves, instead of walking
+        # the scope every step
+        self.generation = 0
+
+    def _bump_generation(self):
+        with Scope._gen_lock:
+            self.generation += 1
 
     def find_var(self, name: str):
         s: Optional[Scope] = self
@@ -69,9 +84,11 @@ class Scope:
 
     def set_var(self, name: str, value):
         self.vars[name] = value
+        self._bump_generation()
 
     def erase(self, name: str):
         self.vars.pop(name, None)
+        self._bump_generation()
 
     def new_scope(self) -> "Scope":
         return Scope(parent=self)
@@ -106,7 +123,12 @@ def scope_guard(scope: Scope):
 
 
 class _CompiledBlock:
-    """One jitted executable for (program version, feed signature)."""
+    """One jitted executable for (program version, feed signature).
+
+    ``fn`` has signature ``(base_key, step_index, *feeds, *state)`` —
+    the per-step PRNG fold runs INSIDE the executable so the hot path
+    pays exactly one dispatch per step (pre-dispatch-cache it was two:
+    a jitted fold_in, then the step)."""
 
     def __init__(self, fn, feed_names, state_names, fetch_names, written_names, donate):
         self.fn = fn
@@ -115,6 +137,10 @@ class _CompiledBlock:
         self.fetch_names = fetch_names
         self.written_names = written_names
         self.donate = donate
+        # set on first invocation (trace + XLA compile happen there);
+        # None marks "not yet compiled" for the stats instrumentation
+        self.compile_time: Optional[float] = None
+        self.tag = ""
 
 
 def _lower_block(
@@ -335,6 +361,14 @@ def _build_gradient_merge_fn(
     return fn
 
 
+def _cpu_only_target(mesh) -> bool:
+    """True when the step will run exclusively on CPU devices (donation
+    is pure overhead there)."""
+    if mesh is not None:
+        return all(d.platform == "cpu" for d in mesh.devices.flat)
+    return jax.default_backend() == "cpu"
+
+
 def _fetch_to_host(v):
     """numpy-ify a fetched value; SelectedRows fetches (sparse grads,
     e.g. the PS trainer fetching embedding grads) come back as a host
@@ -349,6 +383,21 @@ def _fetch_to_host(v):
 # control-flow ops that need sub-block lowering (registered by
 # core/control_flow.py to avoid a circular import)
 _FOLD_JIT = None  # module-level: one compiled fold_in for all Executors
+
+_COMPILED_PROGRAM_CLS = None
+
+
+def _compiled_program_cls():
+    """CompiledProgram, imported once (core.compiler imports this
+    module's siblings — a top-level import would be circular; a
+    function-local import costs a sys.modules lookup on the hot path)."""
+    global _COMPILED_PROGRAM_CLS
+    if _COMPILED_PROGRAM_CLS is None:
+        from .compiler import CompiledProgram
+
+        _COMPILED_PROGRAM_CLS = CompiledProgram
+    return _COMPILED_PROGRAM_CLS
+
 
 _CONTROL_FLOW: Dict[str, Any] = {}
 
@@ -372,6 +421,43 @@ class Executor:
         # hogwild path: concurrent steps over a shared scope must not
         # alias-donate the same param buffers
         self.disable_donation = False
+        # tools/dispatch_bench.py pre-PR emulation: donate even on CPU
+        # (the pre-dispatch-cache executor always donated)
+        self._force_donation = False
+        # hot-path dispatch (runtime/dispatch): fully-resolved BoundSteps
+        # keyed on the cheap raw signature; fast_dispatch=False forces
+        # the slow path every call (dispatch-overhead benchmarking).
+        # LRU-capped: each entry pins a scope's state arrays via its
+        # cached refs, and dead scopes / superseded flag generations
+        # mint new keys without retiring old ones
+        import collections
+
+        self._bound: "collections.OrderedDict[Tuple, Any]" = (
+            collections.OrderedDict())
+        self._bound_cap = 256
+        self.fast_dispatch = True
+        self._stats: Dict[str, Any] = {
+            "bound_hits": 0, "bound_misses": 0, "jit_compiles": 0,
+            "shared_cache_hits": 0, "build_time_s": 0.0,
+            "compile_time_s": 0.0,
+        }
+
+    def cache_stats(self) -> Dict[str, Any]:
+        """Dispatch/compilation cache counters for THIS executor, plus
+        the process-wide view (shared compiled-block cache, persistent
+        on-disk cache). ``jit_compiles`` counts executables this
+        executor actually built — a second Executor running an
+        already-compiled program reports 0 here and positive
+        ``shared_cache_hits`` instead. ``compile_time_s`` is first-call
+        time (jax trace + XLA compile + one step); ``build_time_s`` is
+        the python-side program analysis + function construction."""
+        from ..runtime import dispatch as _dispatch
+
+        out = dict(self._stats)
+        out["bound_steps"] = len(self._bound)
+        out["compiled_blocks"] = len(self._cache)
+        out["process"] = _dispatch.cache_stats()
+        return out
 
     # -- public API -----------------------------------------------------------
     def aot_compile(self, program, feed, fetch_list, scope=None,
@@ -432,7 +518,8 @@ class Executor:
         compiled_blk = self._compile(
             program, block, feed_names, fetch_names, scope, mesh,
             in_shardings, state_shardings, axis_env)
-        abstract = [jax.ShapeDtypeStruct((2,), jnp.uint32)]
+        abstract = [jax.ShapeDtypeStruct((2,), jnp.uint32),
+                    jax.ShapeDtypeStruct((), jnp.int32)]
         for n in compiled_blk.feed_names:
             a = np.asarray(feed_vals[n])
             abstract.append(jax.ShapeDtypeStruct(a.shape, a.dtype))
@@ -453,41 +540,116 @@ class Executor:
         return_numpy: bool = True,
         use_program_cache: bool = True,
     ):
-        from .compiler import CompiledProgram
+        if program is None:
+            program = framework.default_main_program()
+        scope = scope or global_scope()
+        feed = feed if feed is not None else {}
+        fetch_list = fetch_list if fetch_list is not None else []
+
+        # -- hot path: one dict hit resolves the whole dispatch --------
+        bkey = None
+        if use_program_cache and self.fast_dispatch:
+            bkey = self._bound_key(program, feed, fetch_list, scope)
+            if bkey is not None:
+                bound = self._bound.get(bkey)
+                if bound is not None:
+                    self._stats["bound_hits"] += 1
+                    self._bound.move_to_end(bkey)
+                    return bound.run(feed, return_numpy)
+        self._stats["bound_misses"] += 1
+        return self._run_slow(
+            program, dict(feed), list(fetch_list), scope, return_numpy,
+            use_program_cache, bkey,
+        )
+
+    def _bound_key(self, program, feed, fetch_list, scope):
+        """Cheap raw-signature key for the BoundStep cache; None when
+        the feed holds non-array values (those take the slow path,
+        which normalizes them first)."""
+        frag = None
+        if isinstance(program, _compiled_program_cls()):
+            frag = program._dispatch_fragment()
+            program = program._program
+        try:
+            fsig = tuple((n, v.shape, v.dtype) for n, v in feed.items())
+        except AttributeError:
+            return None
+        from .. import flags as _flags
+
+        return (
+            program.uid,
+            program.version,
+            # random_seed is a plain attr (no version bump) read at
+            # BoundStep bind; changing it must re-bind
+            program.random_seed,
+            scope.uid,
+            fsig,
+            tuple(v.name if isinstance(v, Variable) else str(v)
+                  for v in fetch_list),
+            frag,
+            _flags._generation,
+            self.disable_donation,
+            self._force_donation,
+        )
+
+    def _run_slow(
+        self, program, feed, fetch_list, scope, return_numpy,
+        use_program_cache, bkey,
+    ):
+        from ..runtime import dispatch as _dispatch
+
+        # level-2 on disk: route XLA through the persistent compilation
+        # cache before anything might compile (bind time, not per step —
+        # an in-memory cache hit can still be a fresh jit in a process
+        # whose flag changed)
+        _dispatch.ensure_persistent_cache()
 
         mesh = None
         in_shardings = None
         state_shardings = None
         axis_env = None
-        if isinstance(program, CompiledProgram):
+        strategy = None
+        if isinstance(program, _compiled_program_cls()):
             mesh = program._mesh
             in_shardings = program._in_shardings
             state_shardings = getattr(program, "_state_shardings", None)
             axis_env = getattr(program, "_axis_env", None)
+            strategy = getattr(program, "_strategy", None)
             program = program._program
-        if program is None:
-            program = framework.default_main_program()
-        scope = scope or global_scope()
-        feed = dict(feed or {})
-        fetch_list = list(fetch_list or [])
         fetch_names = [
             v.name if isinstance(v, Variable) else str(v) for v in fetch_list
         ]
 
         block = program.global_block()
         feed_vals, feed_sig = self._prepare_feed(block, feed)
+        # the CALLER's dtypes, pre-normalization: the BoundStep's
+        # normalization plan must be derived from what arrives each
+        # step (e.g. an undeclared float64 feed), not from the
+        # already-normalized signature
+        raw_dtypes = {
+            n: (v.dtype if hasattr(v, "dtype") else np.asarray(v).dtype)
+            for n, v in feed.items()
+        }
         from ..flags import flag
 
-        # NOTE: no scope identity in the key — state analysis depends
-        # only on the program, and jax.jit already retraces when a
-        # different scope supplies different shapes/dtypes. Keying on
-        # scope.uid forced a recompile per Scope, which made the
-        # predictor's clone-per-thread pattern recompile per clone.
-        key = (
-            program.uid,
-            program.version,
+        # NOTE: no scope identity in the compiled-block key — state
+        # analysis depends only on the program, and jax.jit already
+        # retraces when a different scope supplies different
+        # shapes/dtypes. Keying on scope.uid forced a recompile per
+        # Scope, which made the predictor's clone-per-thread pattern
+        # recompile per clone. (The BoundStep key DOES carry scope.uid
+        # — bound steps cache scope-resolved state refs — but bound
+        # steps for two scopes share one compiled block.)
+        inshard_key = (
+            tuple(sorted((k, tuple(v)) for k, v in in_shardings.items()))
+            if in_shardings else None)
+        common = (
             feed_sig,
             tuple(fetch_names),
+            # feed shardings are part of the executable's identity: two
+            # CompiledPrograms on one mesh with different input specs
+            # must not share an executable
+            inshard_key,
             # the mesh SHAPE, DEVICE SET and sharding choices, not just
             # presence: the same program compiled dp-then-sp (or with
             # different expert placements) must not hit the stale
@@ -502,69 +664,70 @@ class Executor:
             tuple(sorted(axis_env.items())) if axis_env else None,
             flag("check_nan_inf"),
             self.disable_donation,
+            self._force_donation,
         )
+        key = (program.uid, program.version) + common
         compiled = self._cache.get(key) if use_program_cache else None
         if compiled is None:
-            compiled = self._compile(
-                program, block, sorted(feed), fetch_names, scope, mesh,
-                in_shardings, state_shardings, axis_env
-            )
+            shared_key = None
+            if use_program_cache:
+                # level-2 in-memory: compiled blocks shared across ALL
+                # Executor instances, keyed on program CONTENT — the
+                # PS/hogwild/predictor clone-per-thread patterns stop
+                # re-jitting the same program per instance
+                shared_key = (
+                    _dispatch.program_fingerprint(program),
+                ) + common
+                compiled = _dispatch.shared_cache_get(shared_key)
+                if compiled is not None:
+                    self._stats["shared_cache_hits"] += 1
+            if compiled is None:
+                t0 = _time.perf_counter()
+                compiled = self._compile(
+                    program, block, sorted(feed), fetch_names, scope, mesh,
+                    in_shardings, state_shardings, axis_env
+                )
+                dt = _time.perf_counter() - t0
+                compiled.tag = f"uid={program.uid} v={program.version}"
+                self._stats["jit_compiles"] += 1
+                self._stats["build_time_s"] += dt
+                _dispatch._GLOBAL_STATS["jit_compiles"] += 1
+                _dispatch._GLOBAL_STATS["build_time_s"] += dt
+                if shared_key is not None:
+                    _dispatch.shared_cache_put(shared_key, compiled)
             if use_program_cache:
                 self._cache[key] = compiled
 
-        # assemble args in compiled order
-        state_vals = []
-        for n in compiled.state_names:
-            v = scope.find_var(n)
-            if v is None:
-                if block.has_var(n) and block.var(n).is_data:
-                    raise RuntimeError(
-                        f"data var {n!r} was not fed — add it to the feed dict"
-                    )
-                raise RuntimeError(
-                    f"persistable var {n!r} not found in scope — run the "
-                    "startup program first"
-                )
-            state_vals.append(v)
-        self._run_counter += 1
-        step_key = self._step_key(program.random_seed or 0, self._run_counter)
+        # pre-flight: sharded feeds must divide over their mesh axes —
+        # fail HERE with the strategy named, not inside GSPMD
+        if mesh is not None and in_shardings:
+            _dispatch.validate_feed_shardings(
+                compiled.feed_names,
+                [np.shape(feed_vals[n]) for n in compiled.feed_names],
+                in_shardings, mesh, strategy,
+            )
 
-        ordered_feed = [feed_vals[n] for n in compiled.feed_names]
-        benchmark = flag("benchmark")
-        t0 = _time.perf_counter() if benchmark else 0.0
-        outs = compiled.fn(step_key, *ordered_feed, *state_vals)
-        n_fetch = len(compiled.fetch_names)
-        fetched = list(outs[:n_fetch])
-        new_state = outs[n_fetch:]
-        for n, v in zip(compiled.written_names, new_state):
-            scope.set_var(n, v)
-        if benchmark:
-            # FLAGS_benchmark (reference operator.cc:1006 adds per-op
-            # device syncs): force device sync + report wall time
-            for v in list(fetched) + list(new_state[:1]):
-                np.asarray(v)
-            print(f"[benchmark] Executor.run: {(_time.perf_counter() - t0) * 1e3:.3f} ms")
-        if return_numpy:
-            fetched = [_fetch_to_host(v) for v in fetched]
-        return fetched
+        bound = _dispatch.BoundStep(self, compiled, scope, block, raw_dtypes)
+        if bkey is not None:
+            self._bound[bkey] = bound
+            while len(self._bound) > self._bound_cap:
+                self._bound.popitem(last=False)
+        return bound.run(feed, return_numpy)
 
     # -- internals ------------------------------------------------------------
-    def _step_key(self, seed: int, counter: int):
-        """Per-run PRNG key. Eager PRNGKey+fold_in cost ~0.35 ms/run in
-        python dispatch — dominant for small models — so the base key is
-        cached per seed and the fold runs through one MODULE-LEVEL
-        cached jit (shared by every Executor: PS/hogwild paths create
-        many short-lived ones)."""
+    def _base_key(self, seed: int):
+        """Cached per-seed base PRNG key. The per-step fold_in runs
+        INSIDE the compiled step function (one dispatch per step); only
+        the base key is materialized host-side."""
         base = self._base_keys.get(seed)
         if base is None:
             base = jax.random.PRNGKey(seed)
             self._base_keys[seed] = base
-        global _FOLD_JIT
-        if _FOLD_JIT is None:
-            _FOLD_JIT = jax.jit(jax.random.fold_in)
-        return _FOLD_JIT(base, counter)
+        return base
 
     def _prepare_feed(self, block: Block, feed: Dict[str, Any]):
+        from ..runtime.dispatch import _want_dtype
+
         vals = {}
         sig = []
         for name in sorted(feed):
@@ -576,16 +739,11 @@ class Executor:
                 sig.append((name, tuple(v.shape), str(v.dtype)))
                 continue
             arr = np.asarray(v)
-            # honor declared var dtype (and keep everything x64-free)
-            if block.has_var(name):
-                want = convert_dtype(block.var(name).dtype)
-                if want in ("int64",):
-                    want = "int32" if not jax.config.jax_enable_x64 else "int64"
+            # honor declared var dtype (and keep everything x64-free) —
+            # ONE policy, shared with the BoundStep feed normalizers
+            want = _want_dtype(block, name, arr.dtype)
+            if want is not None:
                 arr = arr.astype(want, copy=False)
-            elif arr.dtype == np.float64:
-                arr = arr.astype(np.float32)
-            elif arr.dtype == np.int64 and not jax.config.jax_enable_x64:
-                arr = arr.astype(np.int32)
             vals[name] = arr
             sig.append((name, arr.shape, str(arr.dtype)))
         return vals, tuple(sig)
@@ -687,17 +845,28 @@ class Executor:
                     "launch (jax.distributed) or compile with "
                     "with_data_parallel()"
                 )
-        fn = build_block_fn(block, feed_names, state_names, fetch_names,
-                            written_names, mesh, axis_env=axis_env)
+        raw_fn = build_block_fn(block, feed_names, state_names, fetch_names,
+                                written_names, mesh, axis_env=axis_env)
+
+        # fold the per-step PRNG key INSIDE the executable: the hot
+        # path passes (base_key, step_index) and pays ONE dispatch per
+        # step instead of a separate jitted fold_in + the step
+        def step_fn(base_key, step_index, *args):
+            return raw_fn(jax.random.fold_in(base_key, step_index), *args)
 
         # donate the state args that are rewritten (buffer aliasing for
-        # in-place param update, reference ParamOut=Param convention)
+        # in-place param update, reference ParamOut=Param convention).
+        # Skipped on CPU-only targets: there is no HBM to save there,
+        # and jax's per-call donated-buffer bookkeeping costs ~35us PER
+        # DONATED ARG on the host — measured 294us vs 90us per step for
+        # a 6-param MLP — which would dominate small-model dispatch.
         donate = tuple(
-            1 + len(feed_names) + i
+            2 + len(feed_names) + i
             for i, n in enumerate(state_names)
             if n in set(written_names)
         )
-        if self.disable_donation:
+        if self.disable_donation or (
+                _cpu_only_target(mesh) and not self._force_donation):
             donate = ()
         jit_kwargs: Dict[str, Any] = {"donate_argnums": donate}
         if mesh is not None:
@@ -720,7 +889,8 @@ class Executor:
                         return NamedSharding(mesh, P(*spec))
                 return NamedSharding(mesh, P())
 
-            shardings = [NamedSharding(mesh, P())]  # step_key replicated
+            # base_key + step_index replicated
+            shardings = [NamedSharding(mesh, P()), NamedSharding(mesh, P())]
             for n in feed_names:
                 spec = in_shardings.get(n, P())
                 shardings.append(NamedSharding(mesh, spec))
@@ -735,7 +905,7 @@ class Executor:
                 [NamedSharding(mesh, P())] * len(fetch_names)
                 + [_state_sharding(n) for n in written_names]
             )
-        jitted = jax.jit(fn, **jit_kwargs)
+        jitted = jax.jit(step_fn, **jit_kwargs)
         return _CompiledBlock(
             jitted, list(feed_names), state_names, fetch_names, written_names, donate
         )
@@ -769,7 +939,11 @@ class Executor:
         )
         pfn = jax.pmap(fn, axis_name="dp", donate_argnums=donate)
 
-        def wrapped(step_key, *args):
+        def wrapped(base_key, step_index, *args):
+            global _FOLD_JIT
+            if _FOLD_JIT is None:
+                _FOLD_JIT = jax.jit(jax.random.fold_in)
+            step_key = _FOLD_JIT(base_key, step_index)
             expand = lambda a: jnp.asarray(a)[None]
             outs = pfn(expand(step_key), *map(expand, args))
             return tuple(o[0] for o in outs)
@@ -829,3 +1003,4 @@ class Executor:
 
     def close(self):
         self._cache.clear()
+        self._bound.clear()

@@ -26,6 +26,12 @@ _FLAG_DEFS: Dict[str, Any] = {
     # (all passes incl. shape re-inference; errors raise
     # ProgramVerificationError BEFORE any JAX lowering)
     "validate_program": "warn",
+    # persistent cross-process XLA compilation cache (runtime/dispatch):
+    # directory for jax_compilation_cache_dir; "" disables. A new
+    # process re-running an already-seen program loads the serialized
+    # executable from disk instead of re-compiling (the scarce-TPU-
+    # window amortization the whole-program compile model depends on).
+    "compile_cache_dir": os.path.join("~", ".cache", "paddle_tpu", "xla"),
     "eager_delete_tensor_gb": 0.0,     # inert: XLA frees by liveness
     # accepted-but-inert parity flags (reference platform/flags.cc)
     "fraction_of_gpu_memory_to_use": 0.92,
@@ -46,6 +52,11 @@ _FLAG_DEFS: Dict[str, Any] = {
 }
 
 _flags: Dict[str, Any] = {}
+
+# bumped on every set_flags: the dispatch fast path (runtime/dispatch)
+# snapshots flag-dependent choices per BoundStep and keys on this
+# counter instead of re-reading flags every step
+_generation = 0
 
 
 def _coerce(default, raw: str):
@@ -80,11 +91,17 @@ def get_flags(names):
 
 
 def set_flags(flag_dict: Dict[str, Any]):
+    global _generation
     for n, v in flag_dict.items():
         key = n[len("FLAGS_"):] if n.startswith("FLAGS_") else n
         if key not in _flags:
             raise ValueError(f"unknown flag {n!r}")
         _flags[key] = v
+    _generation += 1
+
+
+def generation() -> int:
+    return _generation
 
 
 def flag(name: str):

@@ -57,15 +57,21 @@ class Config:
         shapes, so each new shape is a fresh compile).
 
         Every feed is padded UP to a bucket: dim 0 (batch, when
-        pad_batch) to the next batch bucket, dim 1 (sequence, rank>=2
-        feeds) to the next seq bucket. The executor's program cache
-        then holds one executable per touched bucket pair instead of
-        one per distinct request shape. Outputs are sliced back to the
-        request's true batch (and true seq, where an output dim still
-        equals the padded seq). Padding is zeros — models that take a
-        padding mask (the BERT input_mask convention) are exact;
-        bucket_stats() reports the padding-waste fraction so capacity
-        planning can see the pad/recompile trade."""
+        pad_batch) to the next batch bucket, and dim 1 to the next seq
+        bucket — but ONLY for feeds whose declared dim 1 is dynamic
+        (-1, the variable-sequence convention) or that carry a LoD
+        level. Feeds with a STATIC dim 1 — NCHW images ([N, C, H, W]),
+        [B, F] feature matrices — are never sequence-padded:
+        zero-padding a channel/feature dimension would silently corrupt
+        the computation. (Their batch dim still buckets.) The
+        executor's program cache then holds one executable per touched
+        bucket pair instead of one per distinct request shape. Outputs
+        are sliced back to the request's true batch (and true seq,
+        where an output dim still equals the padded seq). Padding is
+        zeros — models that take a padding mask (the BERT input_mask
+        convention) are exact; bucket_stats() reports the padding-waste
+        fraction so capacity planning can see the pad/recompile
+        trade."""
         self._bucketing = True
         self._seq_buckets = sorted(seq_buckets or
                                    (16, 32, 64, 96, 128, 192, 256,
@@ -152,6 +158,16 @@ class Predictor:
                               "real_elements": 0, "shapes_seen": set(),
                               "buckets_used": set()}
         self._trueshape_cache = {}
+        # feeds whose dim 1 may be sequence-padded under bucketing:
+        # declared-dynamic (-1) second dim or a LoD level — a static
+        # dim 1 (NCHW channels, [B, F] features) must never be padded
+        self._seq_feed_names = {
+            n for n in self._feed_names
+            if block.has_var(n) and (
+                (len(block.var(n).shape) >= 2
+                 and (block.var(n).shape[1] or -1) < 0)
+                or getattr(block.var(n), "lod_level", 0) > 0)
+        }
 
     # -- reference API --------------------------------------------------------
     def get_input_names(self) -> List[str]:
@@ -180,7 +196,10 @@ class Predictor:
 
     def _pad_feed(self, feed):
         """Pad every feed up to its (batch, seq) bucket; returns the
-        padded dict + (real_elements, padded_elements) for stats."""
+        padded dict + (real_elements, padded_elements) for stats.
+        Dim 1 buckets only for declared-dynamic/sequence feeds
+        (_seq_feed_names) — zero-padding a static channel/feature dim
+        would corrupt non-sequence models."""
         cfg = self._config
         padded = {}
         n_real = n_pad = 0
@@ -190,7 +209,7 @@ class Predictor:
             if a.ndim >= 1 and cfg._pad_batch:
                 pads[0] = (0, self._bucket_of(a.shape[0], cfg._batch_buckets)
                            - a.shape[0])
-            if a.ndim >= 2:
+            if a.ndim >= 2 and n in self._seq_feed_names:
                 pads[1] = (0, self._bucket_of(a.shape[1], cfg._seq_buckets)
                            - a.shape[1])
             padded[n] = (np.pad(a, pads) if any(p != (0, 0) for p in pads)
@@ -264,19 +283,23 @@ class Predictor:
     def run(self, inputs: Optional[Sequence[np.ndarray]] = None):
         import paddle_tpu as fluid
 
-        if inputs is not None:
-            for n, a in zip(self._feed_names, inputs):
-                self._inputs[n].copy_from_cpu(a)
-        feed = {n: t._value for n, t in self._inputs.items()}
-        true_shapes = None
-        if self._config._bucketing:
-            req_sig = tuple(np.asarray(a).shape for a in feed.values())
-            true_shapes = self._true_fetch_shapes(feed)
-            feed, counts = self._pad_feed(feed)
+        # EVERYTHING touching shared per-Predictor state happens under
+        # the lock: the _inputs/_outputs handles, and the bucketing
+        # work — _true_fetch_shapes enters scope_guard on the
+        # module-global (non-thread-local) scope stack and mutates the
+        # shared _trueshape_cache; concurrent Predictor.run from two
+        # threads used to interleave scope pushes/pops and resolve vars
+        # against the wrong scope (use clone() for lock-free threading)
         with self._lock, fluid.scope_guard(self._scope):
-            if true_shapes is not None:
-                # stats under the run lock: concurrent run() on a
-                # shared Predictor is supported, counters must not race
+            if inputs is not None:
+                for n, a in zip(self._feed_names, inputs):
+                    self._inputs[n].copy_from_cpu(a)
+            feed = {n: t._value for n, t in self._inputs.items()}
+            true_shapes = None
+            if self._config._bucketing:
+                req_sig = tuple(np.asarray(a).shape for a in feed.values())
+                true_shapes = self._true_fetch_shapes(feed)
+                feed, counts = self._pad_feed(feed)
                 st = self._bucket_stats
                 st["runs"] += 1
                 st["shapes_seen"].add(req_sig)
@@ -287,11 +310,11 @@ class Predictor:
             outs = self._exe.run(
                 self._program, feed=feed, fetch_list=self._fetch_vars
             )
-        if true_shapes is not None:
-            outs = [self._slice_to(o, s)
-                    for o, s in zip(outs, true_shapes)]
-        for t, o in zip(self._outputs.values(), outs):
-            t._value = o
+            if true_shapes is not None:
+                outs = [self._slice_to(o, s)
+                        for o, s in zip(outs, true_shapes)]
+            for t, o in zip(self._outputs.values(), outs):
+                t._value = o
         return outs
 
     # ZeroCopyRun parity: run() without args uses the handles
@@ -319,6 +342,7 @@ class Predictor:
                            "real_elements": 0, "shapes_seen": set(),
                            "buckets_used": set()}
         p._trueshape_cache = self._trueshape_cache  # same program
+        p._seq_feed_names = self._seq_feed_names
         return p
 
 

@@ -73,6 +73,36 @@ def host_events():
     return list(_host_events)
 
 
+# compile-event history (runtime/dispatch._first_call): kept
+# unconditionally — knowing WHEN each executable was built matters for
+# post-hoc TPU-window accounting — and mirrored into the host-event
+# log when a profiling session is active so compiles show as named
+# ranges in tools/timeline.py traces. Ring-capped: use_program_cache=
+# False loops compile every step, which must not grow memory forever.
+_compile_events: list = []
+_COMPILE_EVENTS_CAP = 1000
+
+
+def record_compile(name: str, dur: float):
+    import threading
+
+    ev = {
+        "name": name,
+        "ts": time.time() - dur,
+        "dur": dur,
+        "tid": threading.get_ident() % 10_000,
+    }
+    _compile_events.append(ev)
+    if len(_compile_events) > _COMPILE_EVENTS_CAP:
+        del _compile_events[:_COMPILE_EVENTS_CAP // 2]
+    if _recording:
+        _host_events.append(ev)
+
+
+def compile_events():
+    return list(_compile_events)
+
+
 def start_profiler(state="All"):
     import jax
 

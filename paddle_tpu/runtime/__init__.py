@@ -1,0 +1,16 @@
+"""Runtime support for the executor hot path.
+
+`dispatch` holds the two-level dispatch/compilation caching layer:
+BoundStep (per-step python dispatch resolved once per signature), the
+module-level shared compiled-block cache, and the persistent on-disk
+XLA compilation cache wiring.
+"""
+
+from .dispatch import (  # noqa: F401
+    BoundStep,
+    cache_stats,
+    ensure_persistent_cache,
+    program_fingerprint,
+    reset_cache_stats,
+    shared_cache_size,
+)

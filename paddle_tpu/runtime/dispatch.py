@@ -1,0 +1,425 @@
+"""Executor hot-path dispatch + compilation caching (two levels).
+
+The whole point of the TPU-native redesign is that the reference's
+per-op interpreter loop disappears into ONE XLA executable per program
+— but that only pays off if the per-step python control path stays out
+of the way of the fused kernels, and if compile cost is amortized
+across processes.
+
+Level 1 — hot-path dispatch (`BoundStep`): everything `Executor.run`
+used to redo every step — cache-key assembly, `sorted(feed)`, feed
+dtype normalization decisions, the scope walk for state vars, flag
+reads, the separate jitted PRNG fold dispatch — is resolved ONCE per
+(program uid, version, feed signature, fetch names, mesh fingerprint,
+scope, flags generation) and reused. Per step the bound path does: one
+dict lookup, one list comprehension over precomputed normalizers, one
+jitted call (the RNG fold runs INSIDE the executable — no second
+dispatch), and an in-place state write-back. State refs are
+re-resolved only when the scope's generation counter bumps (any
+external `Scope.set_var`/`erase`), so `scope.set_var` invalidation
+stays exact without a per-step scope walk.
+
+Level 2 — compilation caching:
+  * a MODULE-LEVEL shared compiled-block cache keyed on a canonical
+    program fingerprint (content hash, not object identity), so
+    multiple `Executor` instances — the PS/hogwild/predictor
+    clone-per-thread patterns — stop re-jitting the same program;
+  * the persistent on-disk XLA compilation cache
+    (`jax_compilation_cache_dir`) wired behind the live flag
+    `compile_cache_dir`, so a NEW PROCESS re-running an already-seen
+    program deserializes the executable instead of re-compiling —
+    compile cost amortizes across exactly the scarce TPU windows the
+    project keeps losing.
+
+Counters for all of it are surfaced via `Executor.cache_stats()` and
+the profiler host-event log (compiles show up as named ranges in
+`tools/timeline.py` traces).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+# -- global (process-wide) state -------------------------------------------
+
+# canonical-fingerprint-keyed compiled blocks, shared by every Executor.
+# LRU-bounded: every Program mutation mints a new fingerprint, and
+# nothing else ever evicts the stranded executables of old versions in
+# a long-lived process
+_SHARED_CACHE: "OrderedDict[Tuple, Any]" = OrderedDict()
+_SHARED_CACHE_CAP = 512
+
+# process-wide counters; per-Executor counters live on the Executor
+_GLOBAL_STATS: Dict[str, Any] = {
+    "jit_compiles": 0,          # compiled blocks built in this process
+    "shared_cache_hits": 0,     # per-executor miss served by shared cache
+    "build_time_s": 0.0,        # python-side analysis + fn construction
+    "compile_time_s": 0.0,      # first-call time: trace + XLA compile (+1 step)
+}
+
+_PERSISTENT_DIR: Optional[str] = None
+_PERSISTENT_FAILED_PATH: Optional[str] = None
+
+
+def ensure_persistent_cache() -> Optional[str]:
+    """Apply the `compile_cache_dir` flag to jax's persistent
+    compilation cache (idempotent; re-applies when the flag changes).
+    Returns the active directory or None when disabled/unavailable."""
+    global _PERSISTENT_DIR, _PERSISTENT_FAILED_PATH
+    from ..flags import flag
+
+    raw = flag("compile_cache_dir")
+    if not raw:
+        return _PERSISTENT_DIR
+    path = os.path.expanduser(raw)
+    # skip only paths already applied or already KNOWN bad — a flag
+    # pointed at a new directory always gets a fresh attempt
+    if path == _PERSISTENT_DIR or path == _PERSISTENT_FAILED_PATH:
+        return _PERSISTENT_DIR
+    import jax
+
+    try:
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        if _PERSISTENT_DIR is not None:
+            # jax pins its cache singleton to the first directory it
+            # initialized with; a flag change mid-process needs a reset
+            # (private API — best-effort on future jax)
+            try:
+                from jax._src import compilation_cache as _cc
+
+                _cc.reset_cache()
+            except Exception:  # noqa: BLE001
+                pass
+        # default thresholds skip small/fast compiles — a framework
+        # whose unit of compilation is the WHOLE train step wants
+        # every executable persisted, including the tiny eval/infer
+        # programs that dominate cold-start counts
+        for knob, val in (
+            ("jax_persistent_cache_min_entry_size_bytes", -1),
+            ("jax_persistent_cache_min_compile_time_secs", 0.0),
+        ):
+            try:
+                jax.config.update(knob, val)
+            except Exception:  # noqa: BLE001 — knob absent on old jax
+                pass
+        _PERSISTENT_DIR = path
+    except OSError as e:
+        # read-only HOME / container without the dir: dispatch caching
+        # still works, only cross-process persistence is lost
+        _PERSISTENT_FAILED_PATH = path
+        import sys
+
+        sys.stderr.write(
+            f"[paddle_tpu] compile_cache_dir {path!r} unusable ({e}); "
+            "persistent compilation cache disabled\n")
+    return _PERSISTENT_DIR
+
+
+def persistent_cache_dir() -> Optional[str]:
+    return _PERSISTENT_DIR
+
+
+def program_fingerprint(program) -> str:
+    """Canonical content hash of a Program: two Programs with identical
+    IR (e.g. `clone()`s, or the same model re-built in two processes)
+    fingerprint equal, so they share compiled blocks. Cached per
+    (uid, version); volatile identity fields are excluded."""
+    cached = getattr(program, "_fp_cache", None)
+    if cached is not None and cached[0] == program.version:
+        return cached[1]
+    try:
+        d = program.to_dict()
+        d.pop("version", None)
+        d.pop("random_seed", None)  # consumed at step-key time, not compile
+        # compile-affecting Program attrs that to_dict() does not
+        # serialize — two content-identical programs differing in any
+        # of these must NOT share an executable (e.g. gpipe vs 1f1b
+        # schedules lower to different step functions)
+        extra = {
+            "pipeline_cuts": getattr(program, "_pipeline_cuts", None),
+            "pipeline_mb": getattr(program, "_pipeline_microbatches", None),
+            "pipeline_sched": getattr(program, "_pipeline_schedule", None),
+            "gm_k": getattr(program, "_gradient_merge_k", None),
+            "gm_avg": getattr(program, "_gradient_merge_avg", None),
+            "dist_plan": getattr(program, "_dist_plan", None),
+        }
+        digest = hashlib.sha256(
+            json.dumps([d, extra], sort_keys=True, default=str).encode()
+        ).hexdigest()
+    except Exception:  # noqa: BLE001 — unserializable attr: identity fallback
+        digest = f"uid:{program.uid}"
+    program._fp_cache = (program.version, digest)
+    return digest
+
+
+def shared_cache_get(key):
+    hit = _SHARED_CACHE.get(key)
+    if hit is not None:
+        _SHARED_CACHE.move_to_end(key)
+    return hit
+
+
+def shared_cache_put(key, compiled) -> None:
+    _SHARED_CACHE[key] = compiled
+    while len(_SHARED_CACHE) > _SHARED_CACHE_CAP:
+        _SHARED_CACHE.popitem(last=False)
+
+
+def shared_cache_size() -> int:
+    return len(_SHARED_CACHE)
+
+
+def cache_stats() -> Dict[str, Any]:
+    """Process-wide dispatch/compile counters (Executor.cache_stats()
+    merges these under the "process" key)."""
+    out = dict(_GLOBAL_STATS)
+    out["shared_compiled_blocks"] = len(_SHARED_CACHE)
+    out["persistent_cache_dir"] = _PERSISTENT_DIR
+    return out
+
+
+def reset_cache_stats() -> None:
+    for k in _GLOBAL_STATS:
+        _GLOBAL_STATS[k] = 0.0 if isinstance(_GLOBAL_STATS[k], float) else 0
+
+
+def scope_chain_generation(scope) -> int:
+    """Sum of generation counters along the parent chain: bumps when
+    any scope a lookup could resolve through is mutated. Chains are
+    1-2 deep in practice, so this is a handful of attribute reads."""
+    g = scope.generation
+    s = scope.parent
+    while s is not None:
+        g += s.generation
+        s = s.parent
+    return g
+
+
+def validate_feed_shardings(feed_names, feed_shapes, in_shardings, mesh,
+                            strategy: Optional[str]) -> None:
+    """Pre-flight divisibility check for sharded feeds: a batch that
+    does not divide over the mesh axis surfaces here as a clear
+    message naming the strategy, not as an opaque GSPMD/shard_map
+    failure three layers down."""
+    if mesh is None or not in_shardings:
+        return
+    axis_size = dict(mesh.shape)
+    label = strategy or "the compiled mesh"
+    for name, shape in zip(feed_names, feed_shapes):
+        spec = in_shardings.get(name)
+        if spec is None:
+            continue
+        for dim, axes in enumerate(tuple(spec)):
+            if axes is None or dim >= len(shape):
+                continue
+            axes_t = (axes,) if isinstance(axes, str) else tuple(axes)
+            k = 1
+            for a in axes_t:
+                k *= int(axis_size.get(a, 1))
+            if k > 1 and shape[dim] % k:
+                raise ValueError(
+                    f"{label}: feed {name!r} dim {dim} has size "
+                    f"{shape[dim]}, not divisible by mesh axis"
+                    f"{'es' if len(axes_t) > 1 else ''} "
+                    f"{'x'.join(axes_t)} (size {k}) — pad the "
+                    f"{'batch' if dim == 0 else 'dimension'} or change "
+                    "the parallel degree")
+
+
+# -- feed normalization plans ----------------------------------------------
+
+
+def _feed_normalizer(want: Optional[str]) -> Callable[[Any], Any]:
+    """One per feed name. jax.Arrays pass through zero-copy (DataLoader
+    prefetch already device_put the batch — a numpy round-trip would
+    undo the async H2D); everything else is np.asarray'd and cast to
+    the precomputed target dtype."""
+    import jax
+
+    if want is None:
+        def norm(v):
+            if isinstance(v, jax.Array):
+                return v
+            return np.asarray(v)
+    else:
+        want_np = np.dtype(want)
+
+        def norm(v):
+            if isinstance(v, jax.Array):
+                return v
+            arr = np.asarray(v)
+            if arr.dtype != want_np:
+                arr = arr.astype(want_np, copy=False)
+            return arr
+    return norm
+
+
+def _want_dtype(block, name: str, raw_dtype) -> Optional[str]:
+    """The same dtype policy as Executor._prepare_feed, decided once at
+    bind time instead of per step."""
+    import jax
+
+    from ..core.framework import convert_dtype
+
+    if block.has_var(name):
+        want = convert_dtype(block.var(name).dtype)
+        if want == "int64" and not jax.config.jax_enable_x64:
+            want = "int32"
+        return want
+    raw = np.dtype(raw_dtype) if raw_dtype is not None else None
+    if raw == np.float64:
+        return "float32"
+    if raw == np.int64 and not jax.config.jax_enable_x64:
+        return "int32"
+    return None
+
+
+# -- the bound step ---------------------------------------------------------
+
+
+class BoundStep:
+    """One fully-resolved dispatch path: (program, feed signature,
+    fetch list, mesh, scope, flags snapshot) -> compiled executable +
+    precomputed arg assembly. `Executor.run` resolves this once and
+    thereafter the per-step work is a dict hit + one jitted call."""
+
+    __slots__ = (
+        "executor", "compiled", "scope", "block", "base_key",
+        "feed_plan", "state_vals", "written_into_state", "scope_gen",
+        "n_fetch", "benchmark",
+    )
+
+    def __init__(self, executor, compiled, scope, block, raw_dtypes):
+        from ..flags import flag
+
+        self.executor = executor
+        self.compiled = compiled
+        self.scope = scope
+        self.block = block
+        self.benchmark = bool(flag("benchmark"))
+        # raw_dtypes: the CALLER's per-feed dtypes (pre-normalization)
+        # — the plan must normalize what actually arrives each step
+        raw_dtypes = raw_dtypes or {}
+        self.feed_plan = [
+            (n, _feed_normalizer(_want_dtype(block, n, raw_dtypes.get(n))))
+            for n in compiled.feed_names
+        ]
+        self.n_fetch = len(compiled.fetch_names)
+        # positions of written state inside the state arg list (for the
+        # in-place cached-ref update after each step); written names
+        # that are not state inputs only go to the scope
+        state_pos = {n: i for i, n in enumerate(compiled.state_names)}
+        self.written_into_state = [
+            (j, state_pos.get(n)) for j, n in enumerate(compiled.written_names)
+        ]
+        seed = 0
+        prog = getattr(block, "program", None)
+        if prog is not None:
+            seed = prog.random_seed or 0
+        self.base_key = executor._base_key(seed)
+        self.state_vals: List[Any] = []
+        self.scope_gen = -1  # force first resolve
+
+    # -- state resolution ---------------------------------------------------
+    def _resolve_state(self):
+        scope, block = self.scope, self.block
+        # snapshot BEFORE the walk: a concurrent set_var mid-walk must
+        # leave the counters unequal so the next step re-resolves
+        gen = scope_chain_generation(scope)
+        vals = []
+        for n in self.compiled.state_names:
+            v = scope.find_var(n)
+            if v is None:
+                if block.has_var(n) and block.var(n).is_data:
+                    raise RuntimeError(
+                        f"data var {n!r} was not fed — add it to the feed dict"
+                    )
+                raise RuntimeError(
+                    f"persistable var {n!r} not found in scope — run the "
+                    "startup program first"
+                )
+            vals.append(v)
+        self.state_vals = vals
+        self.scope_gen = gen
+
+    # -- the hot path -------------------------------------------------------
+    def run(self, feed: Dict[str, Any], return_numpy: bool):
+        scope = self.scope
+        entry_gen = scope_chain_generation(scope)
+        if entry_gen != self.scope_gen:
+            self._resolve_state()
+            entry_gen = self.scope_gen
+        ordered = [norm(feed[n]) for n, norm in self.feed_plan]
+        ex = self.executor
+        ex._run_counter += 1
+        compiled = self.compiled
+        fn = compiled.fn
+        counter = np.int32(ex._run_counter)
+        t0 = time.perf_counter() if self.benchmark else 0.0
+        if compiled.compile_time is None:
+            outs = self._first_call(fn, counter, ordered)
+        else:
+            outs = fn(self.base_key, counter, *ordered, *self.state_vals)
+        n_fetch = self.n_fetch
+        new_state = outs[n_fetch:]
+        if new_state:
+            state_vals = self.state_vals
+            sv = scope.vars
+            for j, pos in self.written_into_state:
+                v = new_state[j]
+                sv[compiled.written_names[j]] = v
+                if pos is not None:
+                    state_vals[pos] = v
+            # the write-back stored directly (no per-name set_var
+            # bump): stamp the generation once so OTHER programs bound
+            # to this scope re-resolve. Record entry_gen + 1 — OUR one
+            # bump — not the live counter: a concurrent external
+            # set_var during the jitted call (the PS communicator
+            # pattern) must leave the counters unequal so the next
+            # step re-resolves instead of absorbing the update
+            scope._bump_generation()
+            self.scope_gen = entry_gen + 1
+        fetched = list(outs[:n_fetch])
+        if self.benchmark:
+            # FLAGS_benchmark (reference operator.cc:1006 adds per-op
+            # device syncs): force device sync + report wall time
+            for v in fetched + list(new_state[:1]):
+                np.asarray(v)
+            print(f"[benchmark] Executor.run: "
+                  f"{(time.perf_counter() - t0) * 1e3:.3f} ms")
+        if return_numpy:
+            from ..core.executor import _fetch_to_host
+
+            fetched = [_fetch_to_host(v) for v in fetched]
+        return fetched
+
+    def _first_call(self, fn, counter, ordered):
+        """First invocation of a fresh compiled block: this is where
+        jax traces + XLA compiles. Timed, counted, and surfaced as a
+        profiler host event so compiles are visible in timelines."""
+        import jax
+
+        from .. import profiler
+
+        tag = f"jit_compile:{self.compiled.tag or 'program'}"
+        t0 = time.perf_counter()
+        # raw TraceAnnotation (device trace), NOT profiler.record_event
+        # — record_compile below already mirrors into the host-event
+        # log; going through both would duplicate every compile range
+        with jax.profiler.TraceAnnotation(tag):
+            outs = fn(self.base_key, counter, *ordered, *self.state_vals)
+        dt = time.perf_counter() - t0
+        profiler.record_compile(tag, dt)
+        self.compiled.compile_time = dt
+        _GLOBAL_STATS["compile_time_s"] += dt
+        ex = self.executor
+        ex._stats["compile_time_s"] = ex._stats.get("compile_time_s", 0.0) + dt
+        return outs
