@@ -68,6 +68,7 @@ from .flags import get_flags, set_flags
 from . import debugger
 from . import flags
 from . import analysis  # static Program-IR verifier / lint (proglint)
+from . import serving  # dynamic-batching inference serving (engine/server)
 
 # ``fluid``-style alias so reference user code reads naturally:
 #   import paddle_tpu as fluid
@@ -110,6 +111,7 @@ __all__ = [
     "DataFeeder",
     "DataLoader",
     "analysis",
+    "serving",
 ]
 
 

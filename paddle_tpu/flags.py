@@ -32,6 +32,16 @@ _FLAG_DEFS: Dict[str, Any] = {
     # executable from disk instead of re-compiling (the scarce-TPU-
     # window amortization the whole-program compile model depends on).
     "compile_cache_dir": os.path.join("~", ".cache", "paddle_tpu", "xla"),
+    # serving/engine.py defaults (overridable per ServingEngine):
+    # batch closes at serving_max_batch_size ROWS or after
+    # serving_batch_timeout_ms from the first queued request, whichever
+    # first; a full admission queue (serving_queue_capacity pending)
+    # rejects with serving.Overloaded; serving_num_workers Predictor
+    # clones share compiled executables via the dispatch cache
+    "serving_max_batch_size": 16,
+    "serving_batch_timeout_ms": 5.0,
+    "serving_queue_capacity": 256,
+    "serving_num_workers": 2,
     "eager_delete_tensor_gb": 0.0,     # inert: XLA frees by liveness
     # accepted-but-inert parity flags (reference platform/flags.cc)
     "fraction_of_gpu_memory_to_use": 0.92,

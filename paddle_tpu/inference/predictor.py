@@ -156,7 +156,7 @@ class Predictor:
         self._lock = threading.Lock()
         self._bucket_stats = {"runs": 0, "padded_elements": 0,
                               "real_elements": 0, "shapes_seen": set(),
-                              "buckets_used": set()}
+                              "buckets_used": set(), "bucket_hits": {}}
         self._trueshape_cache = {}
         # feeds whose dim 1 may be sequence-padded under bucketing:
         # declared-dynamic (-1) second dim or a LoD level — a static
@@ -270,14 +270,27 @@ class Predictor:
 
     def bucket_stats(self):
         """Serving-efficiency report for enable_shape_bucketing:
-        compiled-shape count vs request-shape count, and the fraction
-        of device FLOPs spent on padding."""
-        st = dict(self._bucket_stats)
-        st["request_shapes"] = len(st.pop("shapes_seen"))
-        st["compiled_shapes"] = len(st.pop("buckets_used"))
-        tot = st.pop("padded_elements"), st.pop("real_elements")
-        st["padding_waste"] = (round(1.0 - tot[1] / tot[0], 4)
-                               if tot[0] else 0.0)
+        compiled-shape count vs request-shape count, the fraction of
+        device FLOPs spent on padding, and a per-bucket hit histogram
+        ("batch,seq|batch,seq|..." per feed -> run count) that the
+        serving layer aggregates across predictor clones.
+
+        Taken under the same lock run() mutates the counters with —
+        an unlocked read concurrent with a clone's run() could see a
+        half-updated dict (runs bumped, elements not yet)."""
+        with self._lock:
+            st = dict(self._bucket_stats)
+            st["bucket_hits"] = dict(st["bucket_hits"])
+            shapes_seen = len(st.pop("shapes_seen"))
+            buckets_used = len(st.pop("buckets_used"))
+        st["request_shapes"] = shapes_seen
+        st["compiled_shapes"] = buckets_used
+        # raw element counters stay in the report: aggregators (the
+        # serving layer sums them across clones) need exact counts, not
+        # the pre-rounded ratio
+        st["padding_waste"] = (
+            round(1.0 - st["real_elements"] / st["padded_elements"], 4)
+            if st["padded_elements"] else 0.0)
         return st
 
     def run(self, inputs: Optional[Sequence[np.ndarray]] = None):
@@ -303,8 +316,10 @@ class Predictor:
                 st = self._bucket_stats
                 st["runs"] += 1
                 st["shapes_seen"].add(req_sig)
-                st["buckets_used"].add(
-                    tuple(a.shape for a in feed.values()))
+                bucket = tuple(a.shape for a in feed.values())
+                st["buckets_used"].add(bucket)
+                bkey = "|".join(",".join(str(d) for d in s) for s in bucket)
+                st["bucket_hits"][bkey] = st["bucket_hits"].get(bkey, 0) + 1
                 st["real_elements"] += counts[0]
                 st["padded_elements"] += counts[1]
             outs = self._exe.run(
@@ -340,7 +355,7 @@ class Predictor:
         p._lock = threading.Lock()
         p._bucket_stats = {"runs": 0, "padded_elements": 0,
                            "real_elements": 0, "shapes_seen": set(),
-                           "buckets_used": set()}
+                           "buckets_used": set(), "bucket_hits": {}}
         p._trueshape_cache = self._trueshape_cache  # same program
         p._seq_feed_names = self._seq_feed_names
         return p

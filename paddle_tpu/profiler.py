@@ -12,6 +12,7 @@ from __future__ import annotations
 import contextlib
 import os
 import tempfile
+import threading
 import time
 
 
@@ -22,7 +23,8 @@ def profiler(state="All", sorted_key=None, profile_path="/tmp/profile"):
     global _recording
     logdir = profile_path if os.path.isdir(profile_path) else tempfile.mkdtemp(prefix="pt_prof_")
     jax.profiler.start_trace(logdir)
-    _host_events.clear()  # fresh session: no stale events in the trace
+    with _events_lock:
+        _host_events.clear()  # fresh session: no stale events in the trace
     _recording = True
     t0 = time.time()
     try:
@@ -34,14 +36,23 @@ def profiler(state="All", sorted_key=None, profile_path="/tmp/profile"):
         if profile_path and not os.path.isdir(profile_path):
             from .tools_timeline import save_chrome_trace
 
-            save_chrome_trace(profile_path, _host_events)
+            save_chrome_trace(profile_path, host_events())
         print(f"[paddle_tpu.profiler] traced {dt:.3f}s -> {logdir} "
               f"(open with tensorboard --logdir or perfetto)")
 
 
 # host-side event log (reference platform/profiler.cc's Event vector):
 # populated by record_event while profiling is on; rendered to a
-# chrome trace by tools/timeline.py
+# chrome trace by tools/timeline.py.
+#
+# Appends arrive from ARBITRARY threads — serving workers, DataLoader
+# prefetch, dispatch first-call compiles — and the ring-trim below
+# deletes a slice. Unsynchronized list mutation + `del` can drop or
+# duplicate events (and a reader can see a half-trimmed list), so every
+# mutation and snapshot goes through one module lock. The lock guards
+# the LISTS only; `_recording` stays a plain bool (a racy read at worst
+# drops the first/last event of a session, never corrupts state).
+_events_lock = threading.Lock()
 _host_events: list = []
 _recording = False
 
@@ -51,8 +62,6 @@ def record_event(name: str):
     """RAII event annotation (reference platform/profiler.h:124
     RecordEvent). Shows up as a named range in the XLA trace AND in the
     host event log consumed by tools/timeline.py."""
-    import threading
-
     import jax
 
     t0 = time.time()
@@ -61,16 +70,18 @@ def record_event(name: str):
             yield
         finally:
             if _recording:
-                _host_events.append({
-                    "name": name,
-                    "ts": t0,
-                    "dur": time.time() - t0,
-                    "tid": threading.get_ident() % 10_000,
-                })
+                with _events_lock:
+                    _host_events.append({
+                        "name": name,
+                        "ts": t0,
+                        "dur": time.time() - t0,
+                        "tid": threading.get_ident() % 10_000,
+                    })
 
 
 def host_events():
-    return list(_host_events)
+    with _events_lock:
+        return list(_host_events)
 
 
 # compile-event history (runtime/dispatch._first_call): kept
@@ -84,23 +95,23 @@ _COMPILE_EVENTS_CAP = 1000
 
 
 def record_compile(name: str, dur: float):
-    import threading
-
     ev = {
         "name": name,
         "ts": time.time() - dur,
         "dur": dur,
         "tid": threading.get_ident() % 10_000,
     }
-    _compile_events.append(ev)
-    if len(_compile_events) > _COMPILE_EVENTS_CAP:
-        del _compile_events[:_COMPILE_EVENTS_CAP // 2]
-    if _recording:
-        _host_events.append(ev)
+    with _events_lock:
+        _compile_events.append(ev)
+        if len(_compile_events) > _COMPILE_EVENTS_CAP:
+            del _compile_events[:_COMPILE_EVENTS_CAP // 2]
+        if _recording:
+            _host_events.append(ev)
 
 
 def compile_events():
-    return list(_compile_events)
+    with _events_lock:
+        return list(_compile_events)
 
 
 def start_profiler(state="All"):
@@ -108,7 +119,8 @@ def start_profiler(state="All"):
 
     global _trace_dir, _recording
     _trace_dir = tempfile.mkdtemp(prefix="pt_prof_")
-    _host_events.clear()  # fresh session
+    with _events_lock:
+        _host_events.clear()  # fresh session
     _recording = True
     jax.profiler.start_trace(_trace_dir)
 
@@ -122,12 +134,13 @@ def stop_profiler(sorted_key=None, profile_path=None):
     if profile_path:
         from .tools_timeline import save_chrome_trace
 
-        save_chrome_trace(profile_path, _host_events)
+        save_chrome_trace(profile_path, host_events())
     print(f"[paddle_tpu.profiler] trace in {_trace_dir}")
 
 
 def reset_profiler():
-    _host_events.clear()
+    with _events_lock:
+        _host_events.clear()
 
 
 @contextlib.contextmanager

@@ -158,6 +158,9 @@ class GeneratorLoader:
                 for b in self._batch_reader():
                     q.put(self._to_device(b))
             except BaseException as e:  # surfaced to the consumer
+                # record BEFORE the stop sentinel: the consumer checks
+                # err on every get, so ordering guarantees the error is
+                # visible by the time stop (or any later batch) arrives
                 err.append(e)
             finally:
                 q.put(stop)
@@ -166,9 +169,23 @@ class GeneratorLoader:
         t.start()
         while True:
             b = q.get()
+            if err:
+                # fail fast on the NEXT __next__, even if good batches
+                # are still buffered ahead of the sentinel — silently
+                # training on a known-truncated epoch skews the data,
+                # and the old drain-then-raise path delayed the error
+                # by up to `maxsize` consumer steps. Drain the queue
+                # first: once err is set the only pending put is the
+                # stop sentinel, and leaving the queue full would wedge
+                # the worker in that put forever, pinning the buffered
+                # device batches for the life of the process.
+                try:
+                    while True:
+                        q.get_nowait()
+                except queue.Empty:
+                    pass
+                raise err[0]
             if b is stop:
-                if err:
-                    raise err[0]
                 break
             yield b
 

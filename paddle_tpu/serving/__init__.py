@@ -1,0 +1,44 @@
+"""Inference serving: dynamic micro-batching over the Predictor.
+
+Reference: paddle/fluid/inference/ ended at a clone-per-thread
+predictor; the server layer above it — request coalescing, admission
+control, deadlines, metrics — is what this subsystem adds, TPU-native:
+concurrent single requests become dense bucketed batches (one XLA
+executable per bucket, batch assembled up to `serving_max_batch_size`
+rows or `serving_batch_timeout_ms`, whichever first), dispatched over
+a pool of Predictor clones that share compiled executables through the
+runtime dispatch cache.
+
+    from paddle_tpu.inference import Config, create_predictor
+    from paddle_tpu.serving import ServingEngine, ServingServer
+
+    cfg = Config(model_dir); cfg.enable_shape_bucketing()
+    engine = ServingEngine(create_predictor(cfg))
+    outs = engine.predict({"ids": ids, "mask": mask}, deadline_ms=50)
+    srv = ServingServer(engine, port=8500)   # /v1/predict /healthz /metrics
+"""
+
+from .engine import (
+    DeadlineExceeded,
+    EngineClosed,
+    Overloaded,
+    RequestCancelled,
+    ServingEngine,
+    ServingError,
+    ServingFuture,
+)
+from .metrics import ServingMetrics, StreamingHistogram
+from .server import ServingServer
+
+__all__ = [
+    "ServingEngine",
+    "ServingServer",
+    "ServingMetrics",
+    "StreamingHistogram",
+    "ServingFuture",
+    "ServingError",
+    "Overloaded",
+    "DeadlineExceeded",
+    "EngineClosed",
+    "RequestCancelled",
+]

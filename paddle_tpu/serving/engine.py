@@ -1,0 +1,590 @@
+"""ServingEngine: dynamic micro-batching over the Predictor.
+
+Reference: paddle/fluid/inference/ shipped a server story around the
+AnalysisPredictor (clone-per-thread, each caller holding its own IO
+handles). That leaves batching to the caller — and on TPU an unbatched
+request stream is the worst case: XLA executables are compiled per
+shape, and a batch-1 call wastes the systolic array. Modern TPU
+serving (Ragged Paged Attention etc., PAPERS.md) assumes a layer that
+coalesces concurrent requests into dense batches; this module is that
+layer.
+
+Shape of the machine:
+
+    submit() ──> bounded admission queue ──> batcher thread
+                                               │  coalesce up to
+                                               │  max_batch_size rows or
+                                               │  batch_timeout_ms,
+                                               │  whichever first
+                                               ▼
+                              batch queue ──> N worker threads, each
+                                              holding a Predictor.clone()
+
+* Admission control: the queue is bounded (`queue_capacity`); a full
+  queue rejects with `Overloaded` at submit time instead of growing
+  unboundedly — the caller sheds load explicitly, it is never queued
+  into a latency cliff.
+* Coalescing: requests group by a compatibility key — identical
+  non-batch dims, except sequence dims (the predictor's declared
+  dynamic feeds) which group by their shape *bucket* when bucketing is
+  enabled, reusing `Config.enable_shape_bucketing`'s ladder so padding
+  waste stays accounted in one place. Within a group the engine pads
+  each request's sequence dim up to the group bucket and concatenates
+  along the batch dim; outputs are split back by row offsets.
+* Deadlines: `submit(..., deadline_ms=)` — a request whose deadline
+  passes while still queued is completed with `DeadlineExceeded` and
+  never batched. `ServingFuture.cancel()` does the same on demand.
+  Once batched, a request runs to completion (a TPU batch in flight
+  cannot be recalled).
+* Workers: `num_workers` Predictor clones. Clones share weights
+  (scope) and compiled executables through the runtime dispatch cache
+  (runtime/dispatch.py shared compiled-block cache), so N workers cost
+  N python threads, not N XLA compiles.
+* Drain: `close(drain=True)` stops admission, lets the batcher flush
+  everything already queued (without waiting out batch timeouts), and
+  joins the workers. `close(drain=False)` fails queued requests with
+  `EngineClosed`.
+
+Defaults come from the live flags `serving_max_batch_size`,
+`serving_batch_timeout_ms`, `serving_queue_capacity`,
+`serving_num_workers` (flags.py), overridable per engine.
+"""
+
+from __future__ import annotations
+
+import collections
+import queue as _queue_mod
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .metrics import ServingMetrics
+
+
+class ServingError(RuntimeError):
+    """Base class for serving-layer failures."""
+
+
+class Overloaded(ServingError):
+    """Admission queue full: the request was rejected, not queued."""
+
+
+class DeadlineExceeded(ServingError):
+    """The request's deadline passed before it was batched."""
+
+
+class EngineClosed(ServingError):
+    """submit() after close(), or queued work failed by a hard close."""
+
+
+class RequestCancelled(ServingError):
+    """The caller cancelled the request before it was batched."""
+
+
+class ServingFuture:
+    """Completion handle for one submitted request. `result()` returns
+    the per-fetch output list (predictor order) or raises the serving
+    error the request was completed with."""
+
+    __slots__ = ("_ev", "_lock", "_result", "_error", "_engine")
+
+    def __init__(self, engine: "ServingEngine"):
+        self._ev = threading.Event()
+        self._lock = threading.Lock()
+        self._result: Optional[List[np.ndarray]] = None
+        self._error: Optional[BaseException] = None
+        self._engine = engine
+
+    def _complete(self, result=None, error=None) -> bool:
+        """First completion wins (batcher expiry vs caller cancel vs
+        worker result race); returns whether THIS call won."""
+        with self._lock:
+            if self._ev.is_set():
+                return False
+            self._result, self._error = result, error
+            self._ev.set()
+            return True
+
+    def cancel(self) -> bool:
+        """Cancel if not yet completed/batched. True if the request
+        will never run; False if it already completed (or is past the
+        point of no return and its result/error will arrive)."""
+        won = self._complete(error=RequestCancelled(
+            "request cancelled before batching"))
+        if won:
+            self._engine.metrics.inc("cancelled_total")
+        return won
+
+    def done(self) -> bool:
+        return self._ev.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> List[np.ndarray]:
+        if not self._ev.wait(timeout):
+            raise TimeoutError(
+                f"serving result not ready within {timeout}s")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+    def exception(self, timeout: Optional[float] = None):
+        if not self._ev.wait(timeout):
+            raise TimeoutError(
+                f"serving result not ready within {timeout}s")
+        return self._error
+
+
+class _Request:
+    __slots__ = ("arrays", "n_rows", "key", "deadline", "enqueue_t",
+                 "future")
+
+    def __init__(self, arrays, n_rows, key, deadline, future):
+        self.arrays = arrays        # per-feed, predictor feed order
+        self.n_rows = n_rows
+        self.key = key              # batch-compatibility key (None: solo)
+        self.deadline = deadline    # absolute time.monotonic() or None
+        self.enqueue_t = time.monotonic()
+        self.future = future
+
+
+class ServingEngine:
+    """Dynamic-batching front end over a `Predictor`.
+
+    In-process API:
+
+        engine = ServingEngine(predictor)            # flags defaults
+        fut = engine.submit({"x": arr}, deadline_ms=50)
+        outs = fut.result(timeout=1.0)               # per-fetch list
+        outs = engine.predict({"x": arr})            # submit+result
+        engine.metrics.snapshot()                    # serving metrics
+        engine.predictor_stats()                     # bucket stats, all clones
+        engine.close(drain=True)
+
+    `server.ServingServer` wraps this with the HTTP front end.
+    """
+
+    def __init__(self, predictor, max_batch_size: Optional[int] = None,
+                 batch_timeout_ms: Optional[float] = None,
+                 queue_capacity: Optional[int] = None,
+                 num_workers: Optional[int] = None, start: bool = True):
+        from ..flags import flag
+
+        self._predictor = predictor
+        self._feed_names: List[str] = list(predictor.get_input_names())
+        self._fetch_names: List[str] = list(predictor.get_output_names())
+        cfg = predictor._config
+        self._bucketing = bool(getattr(cfg, "_bucketing", False))
+        self._seq_buckets = tuple(getattr(cfg, "_seq_buckets", ()) or ())
+        self._seq_feeds = set(getattr(predictor, "_seq_feed_names", ()))
+
+        self.max_batch_size = int(max_batch_size if max_batch_size is not None
+                                  else flag("serving_max_batch_size"))
+        self.batch_timeout_s = float(
+            batch_timeout_ms if batch_timeout_ms is not None
+            else flag("serving_batch_timeout_ms")) / 1e3
+        self.queue_capacity = int(queue_capacity if queue_capacity is not None
+                                  else flag("serving_queue_capacity"))
+        self.num_workers = max(1, int(num_workers if num_workers is not None
+                                      else flag("serving_num_workers")))
+        if self.max_batch_size < 1:
+            raise ValueError("max_batch_size must be >= 1")
+        if self.queue_capacity < 1:
+            raise ValueError("queue_capacity must be >= 1")
+
+        self.metrics = ServingMetrics()
+        self._cond = threading.Condition()
+        self._pending: "collections.deque[_Request]" = collections.deque()
+        self._closed = False      # admission stopped
+        self._stop = False        # batcher should flush and exit
+        # depth num_workers: natural backpressure — when every worker
+        # is busy the batcher blocks here and requests accumulate in
+        # the (bounded) admission queue until Overloaded fires
+        self._batch_q: "_queue_mod.Queue" = _queue_mod.Queue(
+            maxsize=self.num_workers)
+        # worker 0 reuses the caller's predictor; the rest are clones
+        # sharing scope + compiled executables via the dispatch cache
+        self._worker_preds = [predictor] + [
+            predictor.clone() for _ in range(self.num_workers - 1)]
+        self._batcher: Optional[threading.Thread] = None
+        self._workers: List[threading.Thread] = []
+        self._started = False
+        if start:
+            self.start()
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "ServingEngine":
+        """Idempotent: spawn the batcher + worker threads."""
+        with self._cond:
+            if self._started:
+                return self
+            if self._closed:
+                raise EngineClosed("engine already closed")
+            self._started = True
+        self._batcher = threading.Thread(
+            target=self._batcher_loop, name="pt-serving-batcher", daemon=True)
+        self._batcher.start()
+        for i, pred in enumerate(self._worker_preds):
+            t = threading.Thread(target=self._worker_loop, args=(pred,),
+                                 name=f"pt-serving-worker-{i}", daemon=True)
+            t.start()
+            self._workers.append(t)
+        return self
+
+    def close(self, drain: bool = True, timeout: Optional[float] = 30.0):
+        """Stop admission; drain (default) or fail queued requests;
+        join the batcher and workers. Safe to call twice."""
+        with self._cond:
+            already = self._closed and self._stop
+            self._closed = True
+            if not drain:
+                while self._pending:
+                    req = self._pending.popleft()
+                    req.future._complete(error=EngineClosed(
+                        "engine closed before the request was batched"))
+                self.metrics.set_queue_depth(0)
+            self._stop = True
+            self._cond.notify_all()
+        if already:
+            return
+        if self._started:
+            # the batcher emits the worker-stop sentinels itself when
+            # its flush completes, so a join timeout here just returns
+            # early — in-flight work still finishes, nothing strands
+            self._batcher.join(timeout)
+            for t in self._workers:
+                t.join(timeout)
+        else:
+            # never started: nothing will ever serve the queue
+            with self._cond:
+                while self._pending:
+                    self._pending.popleft().future._complete(
+                        error=EngineClosed("engine closed before start()"))
+                self.metrics.set_queue_depth(0)
+
+    def __enter__(self) -> "ServingEngine":
+        return self
+
+    def __exit__(self, *exc):
+        self.close(drain=exc[0] is None)
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    # -- submission ----------------------------------------------------------
+    def submit(self, feed: Union[Dict[str, Any], Sequence[Any]],
+               deadline_ms: Optional[float] = None) -> ServingFuture:
+        """Admit one request (dict name->array, or sequence in feed
+        order). Raises `Overloaded` when the queue is full and
+        `EngineClosed` after close() — both BEFORE any work is queued."""
+        arrays = self._normalize_feed(feed)
+        n_rows = self._request_rows(arrays)
+        key = self._group_key(arrays)
+        deadline = (time.monotonic() + deadline_ms / 1e3
+                    if deadline_ms is not None else None)
+        fut = ServingFuture(self)
+        req = _Request(arrays, n_rows, key, deadline, fut)
+        with self._cond:
+            if self._closed:
+                raise EngineClosed("ServingEngine is closed")
+            if len(self._pending) >= self.queue_capacity:
+                self.metrics.inc("rejected_total")
+                raise Overloaded(
+                    f"serving queue full ({self.queue_capacity} pending); "
+                    "retry with backoff or raise serving_queue_capacity")
+            self._pending.append(req)
+            self.metrics.inc("requests_total")
+            self.metrics.set_queue_depth(len(self._pending))
+            self._cond.notify_all()
+        return fut
+
+    def predict(self, feed, deadline_ms: Optional[float] = None,
+                timeout: Optional[float] = None) -> List[np.ndarray]:
+        """Synchronous submit + result."""
+        return self.submit(feed, deadline_ms=deadline_ms).result(timeout)
+
+    # -- introspection -------------------------------------------------------
+    def predictor_stats(self) -> Dict[str, Any]:
+        """`Predictor.bucket_stats()` aggregated across every worker
+        clone: summed runs, exact padding waste from the raw element
+        counters, distinct compiled buckets from the union of the
+        per-bucket hit histograms — the device-side companion to
+        `metrics.snapshot()`'s queue-side view. `request_shapes` is a
+        lower bound (per-clone signatures are counted, not exposed, so
+        overlaps across clones can't be deduplicated)."""
+        runs = real = padded = 0
+        hits: Dict[str, int] = {}
+        request_shapes = 0
+        for p in self._worker_preds:
+            st = p.bucket_stats()
+            runs += st["runs"]
+            real += st["real_elements"]
+            padded += st["padded_elements"]
+            request_shapes = max(request_shapes, st["request_shapes"])
+            for k, v in st.get("bucket_hits", {}).items():
+                hits[k] = hits.get(k, 0) + v
+        return {
+            "runs": runs,
+            "padding_waste": (round(1.0 - real / padded, 4)
+                              if padded else 0.0),
+            "request_shapes": request_shapes,
+            "compiled_shapes": len(hits),
+            "bucket_hits": hits,
+        }
+
+    def stats(self) -> Dict[str, Any]:
+        """Serving metrics + aggregated predictor bucket stats in one
+        JSON-serializable dict (what /metrics renders)."""
+        return {"serving": self.metrics.snapshot(),
+                "predictor": self.predictor_stats()}
+
+    # -- request shaping -----------------------------------------------------
+    def _normalize_feed(self, feed) -> List[np.ndarray]:
+        if isinstance(feed, dict):
+            missing = [n for n in self._feed_names if n not in feed]
+            extra = [n for n in feed if n not in self._feed_names]
+            if missing or extra:
+                raise ValueError(
+                    f"feed names mismatch: missing {missing}, "
+                    f"unexpected {extra}; expected {self._feed_names}")
+            ordered = [feed[n] for n in self._feed_names]
+        else:
+            ordered = list(feed)
+            if len(ordered) != len(self._feed_names):
+                raise ValueError(
+                    f"expected {len(self._feed_names)} feeds "
+                    f"({self._feed_names}), got {len(ordered)}")
+        return [np.asarray(a) for a in ordered]
+
+    def _request_rows(self, arrays: List[np.ndarray]) -> int:
+        rows = {int(a.shape[0]) for a in arrays if a.ndim >= 1}
+        if len(rows) > 1:
+            raise ValueError(
+                f"inconsistent batch dims across feeds: {sorted(rows)}")
+        return rows.pop() if rows else 1
+
+    def _group_key(self, arrays: List[np.ndarray]):
+        """Two requests batch together iff their keys are equal: same
+        dtypes, same non-batch dims — except sequence dims, which
+        compare by shape bucket when bucketing is on (the predictor
+        pads them up anyway, so requests of length 7 and 21 share a
+        32-bucket batch). Scalar feeds can't concatenate: key None
+        means the request is always served alone."""
+        key = []
+        for name, a in zip(self._feed_names, arrays):
+            if a.ndim == 0:
+                return None
+            dims = list(a.shape[1:])
+            if (self._bucketing and name in self._seq_feeds
+                    and a.ndim >= 2 and self._seq_buckets):
+                dims[0] = self._predictor._bucket_of(
+                    int(a.shape[1]), self._seq_buckets)
+            key.append((name, a.dtype.str, tuple(dims)))
+        return tuple(key)
+
+    # -- batcher -------------------------------------------------------------
+    def _pop_next_live_locked(self) -> Optional[_Request]:
+        """Pop the oldest request that is still worth serving;
+        complete+drop expired/cancelled ones on the way."""
+        now = time.monotonic()
+        while self._pending:
+            req = self._pending.popleft()
+            if req.future.done():            # cancelled by the caller
+                continue
+            if req.deadline is not None and now > req.deadline:
+                if req.future._complete(error=DeadlineExceeded(
+                        f"deadline passed after "
+                        f"{(now - req.enqueue_t) * 1e3:.1f}ms in queue")):
+                    self.metrics.inc("expired_total")
+                continue
+            return req
+        return None
+
+    def _pop_compatible_locked(self, key, max_rows: int) -> Optional[_Request]:
+        """Pop the oldest queued request that fits the open batch
+        (same key, <= max_rows rows); expired/cancelled requests are
+        completed and dropped regardless of compatibility."""
+        now = time.monotonic()
+        i = 0
+        while i < len(self._pending):
+            req = self._pending[i]
+            if req.future.done():
+                del self._pending[i]
+                continue
+            if req.deadline is not None and now > req.deadline:
+                del self._pending[i]
+                if req.future._complete(error=DeadlineExceeded(
+                        f"deadline passed after "
+                        f"{(now - req.enqueue_t) * 1e3:.1f}ms in queue")):
+                    self.metrics.inc("expired_total")
+                continue
+            if key is not None and req.key == key and req.n_rows <= max_rows:
+                del self._pending[i]
+                return req
+            i += 1
+        return None
+
+    def _collect_batch(self) -> Optional[List[_Request]]:
+        """Block until a batch is ready (first request + compatible
+        followers up to max_batch_size rows or batch_timeout, whichever
+        first; no timeout wait while draining). None = shut down."""
+        with self._cond:
+            while True:
+                first = self._pop_next_live_locked()
+                if first is not None:
+                    break
+                if self._stop:
+                    self.metrics.set_queue_depth(len(self._pending))
+                    return None
+                self._cond.wait(0.1)
+            batch = [first]
+            rows = first.n_rows
+            t_close = time.monotonic() + self.batch_timeout_s
+            while rows < self.max_batch_size and first.key is not None:
+                nxt = self._pop_compatible_locked(
+                    first.key, self.max_batch_size - rows)
+                if nxt is not None:
+                    batch.append(nxt)
+                    rows += nxt.n_rows
+                    continue
+                if self._stop:
+                    break            # draining: never wait for traffic
+                remaining = t_close - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cond.wait(min(remaining, 0.05))
+            self.metrics.set_queue_depth(len(self._pending))
+        return batch
+
+    def _batcher_loop(self):
+        try:
+            while True:
+                batch = self._collect_batch()
+                if batch is None:
+                    return
+                rows = sum(r.n_rows for r in batch)
+                self.metrics.observe_batch(len(batch), rows,
+                                           self.max_batch_size)
+                now = time.monotonic()
+                for r in batch:
+                    self.metrics.observe_queue_wait(
+                        (now - r.enqueue_t) * 1e3)
+                self._batch_q.put(batch)
+        finally:
+            # the batcher owns the end of the stream: worker-stop
+            # sentinels go in HERE, strictly after the last batch —
+            # close() putting them could race ahead of batches still
+            # being flushed (FIFO would hand workers the sentinel
+            # first and strand those requests' futures forever)
+            for _ in range(self.num_workers):
+                self._batch_q.put(None)
+
+    # -- workers -------------------------------------------------------------
+    def _worker_loop(self, pred):
+        while True:
+            batch = self._batch_q.get()
+            if batch is None:
+                return
+            self._execute(pred, batch)
+
+    def _assemble(self, batch: List[_Request]):
+        """Concatenate member requests along the batch dim, padding
+        sequence dims up to the group bucket first (group key fixes the
+        target, so members always align). Engine-level padding elements
+        feed the metrics' padding-waste gauge; the predictor's own
+        bucket padding is accounted by bucket_stats. Returns
+        (feeds, padded_any) — padded_any flags that member outputs may
+        come back at the padded seq length and need true-shape
+        slicing."""
+        feeds = []
+        real = total = 0
+        padded_any = False
+        for fi, name in enumerate(self._feed_names):
+            parts = []
+            target = None
+            if len(batch) > 1 and batch[0].key is not None:
+                target = batch[0].key[fi][2]  # non-batch dims, bucketed
+            for req in batch:
+                a = req.arrays[fi]
+                if target is not None and a.ndim >= 2 \
+                        and tuple(a.shape[1:]) != target:
+                    pads = [(0, 0)] + [
+                        (0, t - s) for t, s in zip(target, a.shape[1:])]
+                    a = np.pad(a, pads)
+                    padded_any = True
+                real += int(req.arrays[fi].size)
+                total += int(a.size)
+                parts.append(a)
+            feeds.append(np.concatenate(parts, axis=0)
+                         if len(parts) > 1 else parts[0])
+        if total:
+            self.metrics.record_padding(real, total)
+        return feeds, padded_any
+
+    def _true_shapes_for(self, pred, req: _Request):
+        """Per-fetch output shapes for the request at its TRUE feed
+        shapes (the predictor's own eval_shape machinery, cached per
+        signature). Needed when the engine seq-padded the request into
+        a co-batch: the predictor then only sees the padded feed, so
+        per-token outputs come back at the bucket length — a request
+        must get the same output shape whether it was served solo or
+        coalesced."""
+        feed = dict(zip(self._feed_names, req.arrays))
+        with pred._lock:
+            return pred._true_fetch_shapes(feed)
+
+    def _execute(self, pred, batch: List[_Request]):
+        from .. import profiler
+
+        t_exec = time.monotonic()
+        try:
+            feeds, padded_any = self._assemble(batch)
+            with profiler.record_event(
+                    f"serving/batch_execute[n={len(batch)}]"):
+                outs = pred.run(feeds)
+            true_shapes = ([self._true_shapes_for(pred, r) for r in batch]
+                           if padded_any else None)
+            done = self._split_and_complete(batch, outs, true_shapes)
+            now = time.monotonic()
+            for req in batch:
+                self.metrics.observe_latency((now - req.enqueue_t) * 1e3)
+            self.metrics.inc("responses_total", done)
+        except Exception as e:  # noqa: BLE001 — a bad batch must not kill the worker
+            n = 0
+            for req in batch:
+                if req.future._complete(error=ServingError(
+                        f"predictor execution failed: {e!r}")):
+                    n += 1
+            self.metrics.inc("errors_total", n)
+
+    def _split_and_complete(self, batch: List[_Request],
+                            outs: Sequence[np.ndarray],
+                            true_shapes=None) -> int:
+        """Row-split the batched outputs back per request (and, when
+        the engine seq-padded the batch, slice each member's outputs
+        down to its true shapes); returns how many futures this call
+        actually completed (a concurrent cancel() can win the race and
+        keep its error)."""
+        total_rows = sum(r.n_rows for r in batch)
+        offset = 0
+        won = 0
+        for i, req in enumerate(batch):
+            sliced = []
+            for j, o in enumerate(outs):
+                o = np.asarray(o)
+                if o.ndim >= 1 and o.shape[0] == total_rows:
+                    o = o[offset:offset + req.n_rows]
+                    if true_shapes is not None:
+                        # e.g. a per-token [rows, seq, H] output padded
+                        # to the bucket seq: back to the true length
+                        ts = tuple(true_shapes[i][j])
+                        if o.shape != ts:
+                            o = o[tuple(slice(0, s) for s in ts)]
+                # else: batch-invariant output (a scalar metric, a
+                # table) — every member gets the whole thing
+                sliced.append(o)
+            offset += req.n_rows
+            if req.future._complete(result=sliced):
+                won += 1
+        return won

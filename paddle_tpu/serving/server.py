@@ -1,0 +1,178 @@
+"""Stdlib-only HTTP front end over the ServingEngine.
+
+Reference: the reference's inference-server demo exposed the
+AnalysisPredictor over an RPC front end; here it is `http.server`
+(zero new dependencies — the container bakes nothing extra) with the
+three endpoints a serving deployment actually needs:
+
+    POST /v1/predict   {"inputs": {name: nested-list} | [..], "deadline_ms": n}
+                       -> 200 {"outputs": {name: nested-list}}
+                          503 overloaded (shed load, retry with backoff)
+                          504 deadline exceeded
+                          400 malformed request
+    GET  /healthz      -> 200 while serving, 503 once closed (a load
+                          balancer drains on this flip)
+    GET  /metrics      -> Prometheus text: serving counters/quantiles +
+                          aggregated predictor bucket stats
+
+Each request handler thread just blocks in `engine.predict` — the
+coalescing into dense TPU batches happens in the engine's batcher, so
+N concurrent HTTP callers become ~N/max_batch predictor calls.
+Requests are wrapped in `profiler.record_event` spans, so a profiling
+session shows `serving/http_predict` ranges in `tools/timeline.py`
+traces right next to the executor's compile/step events.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+from .engine import DeadlineExceeded, EngineClosed, Overloaded, ServingEngine
+
+
+def _json_default(o):
+    if isinstance(o, (np.integer,)):
+        return int(o)
+    if isinstance(o, (np.floating,)):
+        return float(o)
+    if isinstance(o, np.ndarray):
+        return o.tolist()
+    raise TypeError(f"not JSON serializable: {type(o)}")
+
+
+class _Handler(BaseHTTPRequestHandler):
+    engine: ServingEngine = None  # set by the subclass ServingServer makes
+    server_version = "paddle_tpu_serving/1.0"
+    protocol_version = "HTTP/1.1"
+
+    # -- plumbing ------------------------------------------------------------
+    def log_message(self, fmt, *args):  # noqa: A003 — quiet by default
+        pass
+
+    def _reply(self, code: int, body: bytes, ctype: str):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _reply_json(self, code: int, obj):
+        self._reply(code, json.dumps(obj, default=_json_default).encode(),
+                    "application/json")
+
+    # -- endpoints -----------------------------------------------------------
+    def do_GET(self):  # noqa: N802 — http.server contract
+        if self.path == "/healthz":
+            if self.engine.closed:
+                self._reply_json(503, {"status": "draining"})
+            else:
+                self._reply_json(200, {"status": "ok"})
+        elif self.path == "/metrics":
+            text = self.engine.metrics.to_prometheus_text(
+                extra={("predictor_" + k): v
+                       for k, v in self.engine.predictor_stats().items()
+                       if isinstance(v, (int, float))})
+            self._reply(200, text.encode(), "text/plain; version=0.0.4")
+        else:
+            self._reply_json(404, {"error": f"no such endpoint {self.path}"})
+
+    def do_POST(self):  # noqa: N802
+        if self.path != "/v1/predict":
+            self._reply_json(404, {"error": f"no such endpoint {self.path}"})
+            return
+        from .. import profiler
+
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+            payload = json.loads(self.rfile.read(length) or b"{}")
+            inputs = payload["inputs"]
+            deadline_ms = payload.get("deadline_ms")
+            timeout = payload.get("timeout_s")
+        except (ValueError, KeyError, TypeError) as e:
+            self._reply_json(400, {"error": f"malformed request: {e!r}"})
+            return
+        for name, v in (("deadline_ms", deadline_ms), ("timeout_s", timeout)):
+            if v is not None and (isinstance(v, bool)
+                                  or not isinstance(v, (int, float))):
+                # client-input errors are 400s, never 500s: a string
+                # deadline would otherwise surface as a TypeError deep
+                # in the engine and read as a server fault
+                self._reply_json(
+                    400, {"error": f"{name} must be a number, got {v!r}"})
+                return
+        try:
+            with profiler.record_event("serving/http_predict"):
+                outs = self.engine.predict(inputs, deadline_ms=deadline_ms,
+                                           timeout=timeout)
+        except Overloaded as e:
+            self._reply_json(503, {"error": str(e), "kind": "overloaded"})
+        except (DeadlineExceeded, TimeoutError) as e:
+            self._reply_json(504, {"error": str(e), "kind": "deadline"})
+        except EngineClosed as e:
+            self._reply_json(503, {"error": str(e), "kind": "closed"})
+        except (ValueError, KeyError) as e:
+            self._reply_json(400, {"error": str(e)})
+        except Exception as e:  # noqa: BLE001 — the server must survive any request
+            self._reply_json(500, {"error": repr(e)})
+        else:
+            names = self.engine._fetch_names
+            self._reply_json(200, {"outputs": {
+                n: np.asarray(o) for n, o in zip(names, outs)}})
+
+
+class _QuietThreadingServer(ThreadingHTTPServer):
+    daemon_threads = True
+
+    def handle_error(self, request, client_address):
+        import sys
+
+        et = sys.exc_info()[0]
+        if et is not None and issubclass(et, (ConnectionError, TimeoutError)):
+            return  # client hung up mid-request: routine, not a server bug
+        super().handle_error(request, client_address)
+
+
+class ServingServer:
+    """Own the HTTP listener; the engine's lifecycle stays the
+    caller's. `port=0` binds an ephemeral port (tests, examples);
+    `.port` reports the bound one."""
+
+    def __init__(self, engine: ServingEngine, host: str = "127.0.0.1",
+                 port: int = 0, start: bool = True):
+        self.engine = engine
+        handler = type("_BoundHandler", (_Handler,), {"engine": engine})
+        self._httpd = _QuietThreadingServer((host, port), handler)
+        self.host, self.port = self._httpd.server_address[:2]
+        self._thread: Optional[threading.Thread] = None
+        if start:
+            self.start()
+
+    @property
+    def address(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> "ServingServer":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever,
+                name="pt-serving-http", daemon=True)
+            self._thread.start()
+        return self
+
+    def close(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(10)
+            self._thread = None
+
+    def __enter__(self) -> "ServingServer":
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -81,6 +81,45 @@ def test_worker_exception_propagates():
         list(loader)
 
 
+def test_worker_exception_before_first_batch():
+    """A generator that dies before producing anything must raise at
+    the first __next__, not silently yield an empty epoch."""
+    def bad():
+        raise RuntimeError("boom at start")
+        yield  # pragma: no cover — makes it a generator
+
+    loader = DataLoader.from_generator(capacity=2, use_double_buffer=True)
+    loader.set_batch_generator(bad)
+    it = iter(loader)
+    with pytest.raises(RuntimeError, match="boom at start"):
+        next(it)
+
+
+def test_worker_exception_fails_fast_over_buffered_batches():
+    """Once the producer has died, the very next __next__ re-raises —
+    batches still sitting in the prefetch queue are NOT drained first.
+    (Training on a known-truncated epoch silently skews the data; the
+    old drain-then-raise path delayed the error by up to queue-depth
+    consumer steps.)"""
+    def bad():
+        yield {"x": np.zeros((1,), "float32")}
+        yield {"x": np.ones((1,), "float32")}
+        raise RuntimeError("mid-epoch explosion")
+
+    loader = DataLoader.from_generator(capacity=4, use_double_buffer=True)
+    loader.set_batch_generator(bad)
+    seen = []
+    with pytest.raises(RuntimeError, match="mid-epoch explosion"):
+        for b in loader:
+            seen.append(float(np.asarray(b["x"])[0]))
+            # a slow consumer step: the producer runs to its death
+            # while good batches are still buffered in the queue
+            time.sleep(0.2)
+    # fail-fast: once the error landed, buffered batches are NOT
+    # drained first — the old path would have yielded both (seen == 2)
+    assert len(seen) <= 1, seen
+
+
 def test_rank_sharding_splits_samples(monkeypatch):
     def samples():
         for i in range(8):

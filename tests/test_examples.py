@@ -52,3 +52,9 @@ def test_train_pipeline_dp():
 def test_serve_bucketed():
     out = _run("serve_bucketed.py")
     assert "bucketed serving OK" in out
+
+
+@pytest.mark.slow  # tier-1 runs `-m 'not slow'`; tests/test_serving.py
+def test_serve_engine():  # covers the subsystem itself in-process
+    out = _run("serve_engine.py")
+    assert "engine serving OK" in out

@@ -1,0 +1,459 @@
+"""paddle_tpu.serving: dynamic batching, admission control, deadlines,
+drain, metrics, HTTP front end.
+
+All CPU-only and thread-based; the only sleeps are shorter than the
+batch timeout they race against. Deterministic coalescing uses
+`ServingEngine(start=False)`: requests queue first, the batcher starts
+after, so "N concurrent requests -> one predictor call" is a fact, not
+a timing hope.
+"""
+
+import http.client
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.inference import Config, create_predictor
+from paddle_tpu.serving import (
+    DeadlineExceeded,
+    EngineClosed,
+    Overloaded,
+    RequestCancelled,
+    ServingEngine,
+    ServingError,
+    ServingServer,
+    StreamingHistogram,
+)
+
+
+# -- fixtures: one exported model + predictor per module (compile once) -----
+
+
+def _export_static_model(path):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data("x", [6])
+        h = fluid.layers.fc(x, 12, act="relu")
+        out = fluid.layers.fc(h, 3, act="softmax")
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        fluid.io.save_inference_model(path, ["x"], [out], exe, main)
+
+
+def _export_masked_model(path):
+    """Mask-aware pooled classifier (padding-exact, like
+    examples/serve_bucketed.py): bucket/batch padding cannot change
+    its outputs, so coalesced results must EQUAL solo results."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        ids = fluid.layers.data("ids", [-1], dtype="int64")
+        mask = fluid.layers.data("mask", [-1], dtype="float32")
+        emb = fluid.layers.embedding(ids, size=[50, 8])
+        m = fluid.layers.unsqueeze(mask, [2])
+        pooled = fluid.layers.elementwise_div(
+            fluid.layers.reduce_sum(
+                fluid.layers.elementwise_mul(emb, m), dim=[1]),
+            fluid.layers.reduce_sum(m, dim=[1]))
+        out = fluid.layers.fc(pooled, 16, act="softmax")
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        fluid.io.save_inference_model(path, ["ids", "mask"], [out], exe, main)
+
+
+@pytest.fixture(scope="module")
+def static_pred(tmp_path_factory):
+    d = tmp_path_factory.mktemp("srv_static")
+    _export_static_model(str(d))
+    return create_predictor(Config(str(d)))
+
+
+@pytest.fixture(scope="module")
+def masked_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("srv_masked")
+    _export_masked_model(str(d))
+    return str(d)
+
+
+def _xv(seed=0, rows=1):
+    return np.random.RandomState(seed).randn(rows, 6).astype("float32")
+
+
+# -- coalescing -------------------------------------------------------------
+
+
+def test_concurrent_requests_coalesce_into_one_batch(static_pred):
+    """The acceptance-criterion test: >= 2 concurrent requests end up
+    in ONE batched Predictor call, observable via the engine's
+    batch-occupancy metric > 1."""
+    xv = _xv()
+    (oracle,) = static_pred.run([xv])
+    eng = ServingEngine(static_pred, max_batch_size=4, batch_timeout_ms=100,
+                        num_workers=2, start=False)
+    futs = [eng.submit({"x": xv}) for _ in range(4)]
+    eng.start()
+    for f in futs:
+        (got,) = f.result(timeout=60)
+        np.testing.assert_allclose(got, oracle, rtol=1e-5, atol=1e-6)
+    snap = eng.metrics.snapshot()
+    eng.close()
+    assert snap["batches_total"] == 1, snap
+    assert snap["batch_occupancy"]["max"] == 4
+    assert snap["batch_occupancy"]["mean"] > 1
+    assert snap["requests_total"] == snap["responses_total"] == 4
+
+
+def test_threaded_clients_coalesce(static_pred):
+    """Thread-based clients through the live engine: a barrier releases
+    8 submitters inside one batch window; with max_batch_size=8 the
+    engine must coalesce at least once (occupancy > 1)."""
+    xv = _xv(1)
+    (oracle,) = static_pred.run([xv])
+    eng = ServingEngine(static_pred, max_batch_size=8,
+                        batch_timeout_ms=150, num_workers=2)
+    barrier = threading.Barrier(8)
+    errors = []
+
+    def client(i):
+        try:
+            barrier.wait(timeout=30)
+            (got,) = eng.predict({"x": xv}, timeout=60)
+            np.testing.assert_allclose(got, oracle, rtol=1e-5, atol=1e-6)
+        except Exception as e:  # noqa: BLE001
+            errors.append((i, repr(e)))
+
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not [t for t in threads if t.is_alive()], "hung serving clients"
+    assert not errors, errors
+    snap = eng.metrics.snapshot()
+    eng.close()
+    assert snap["responses_total"] == 8
+    assert snap["batch_occupancy"]["max"] > 1, snap
+    assert snap["batches_total"] < 8, snap
+
+
+def test_bucketed_mixed_lengths_share_one_batch(masked_dir):
+    """Lengths 7/21/30 all bucket to seq 32 -> one coalesced call;
+    every output equals the exact-shape reference predictor's."""
+    cfg = Config(masked_dir)
+    cfg.enable_shape_bucketing(seq_buckets=(32,), batch_buckets=(4, 8))
+    pred = create_predictor(cfg)
+    ref = create_predictor(Config(masked_dir))
+
+    rng = np.random.RandomState(0)
+    reqs = []
+    for length, rows in ((7, 1), (21, 2), (30, 1)):
+        ids = rng.randint(1, 50, (rows, length)).astype("int64")
+        mask = np.ones((rows, length), np.float32)
+        (want,) = ref.run([ids, mask])
+        reqs.append((ids, mask, want))
+
+    eng = ServingEngine(pred, max_batch_size=8, batch_timeout_ms=100,
+                        num_workers=2, start=False)
+    futs = [eng.submit({"ids": i, "mask": m}) for i, m, _ in reqs]
+    eng.start()
+    for (ids, mask, want), f in zip(reqs, futs):
+        (got,) = f.result(timeout=60)
+        assert got.shape == want.shape
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    snap = eng.metrics.snapshot()
+    stats = eng.predictor_stats()
+    eng.close()
+    assert snap["batches_total"] == 1, snap
+    assert snap["batch_occupancy"]["max"] == 3
+    # engine-side seq padding is accounted (7->32 etc. is real waste)
+    assert snap["padding_waste"] > 0
+    # ... and the predictor saw ONE bucketed shape, hit once
+    assert stats["runs"] == 1
+    assert sum(stats["bucket_hits"].values()) == 1, stats
+
+
+def test_per_token_outputs_keep_true_length_when_coalesced(tmp_path):
+    """A request must get the SAME output shape whether served solo or
+    coalesced: per-token outputs of a seq-padded co-batch are sliced
+    back to each member's true length, not left at the bucket length."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        ids = fluid.layers.data("ids", [-1], dtype="int64")
+        emb = fluid.layers.embedding(ids, size=[50, 8])  # [B, L, 8]
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        fluid.io.save_inference_model(str(tmp_path), ["ids"], [emb],
+                                      exe, main)
+    cfg = Config(str(tmp_path))
+    cfg.enable_shape_bucketing(seq_buckets=(32,), batch_buckets=(4, 8))
+    pred = create_predictor(cfg)
+    ref = create_predictor(Config(str(tmp_path)))
+
+    rng = np.random.RandomState(0)
+    reqs = []
+    for length in (7, 21):
+        a = rng.randint(1, 50, (2, length)).astype("int64")
+        (want,) = ref.run([a])
+        assert want.shape == (2, length, 8)
+        reqs.append((a, want))
+
+    eng = ServingEngine(pred, max_batch_size=8, batch_timeout_ms=100,
+                        num_workers=1, start=False)
+    futs = [eng.submit({"ids": a}) for a, _ in reqs]
+    eng.start()
+    for (a, want), f in zip(reqs, futs):
+        (got,) = f.result(timeout=60)
+        assert got.shape == want.shape, (got.shape, want.shape)
+        np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
+    snap = eng.metrics.snapshot()
+    eng.close()
+    assert snap["batches_total"] == 1, snap  # really was one co-batch
+
+
+def test_incompatible_shapes_do_not_batch(static_pred):
+    """Requests with different non-batch dims must not be concatenated
+    — the 4-col request is served alone (here: as an error, since the
+    model wants 6 cols), and never corrupts the 6-col batch."""
+    good = _xv(2)
+    eng = ServingEngine(static_pred, max_batch_size=8, batch_timeout_ms=50,
+                        num_workers=1, start=False)
+    f_good = eng.submit({"x": good})
+    f_bad = eng.submit({"x": np.zeros((1, 4), "float32")})
+    eng.start()
+    (got,) = f_good.result(timeout=60)
+    (oracle,) = static_pred.run([good])
+    np.testing.assert_allclose(got, oracle, rtol=1e-5, atol=1e-6)
+    with pytest.raises(ServingError):
+        f_bad.result(timeout=60)
+    snap = eng.metrics.snapshot()
+    eng.close()
+    assert snap["batches_total"] == 2  # never merged
+    assert snap["errors_total"] == 1
+    assert snap["responses_total"] == 1
+
+
+# -- admission control / deadlines / cancellation / drain -------------------
+
+
+def test_queue_full_rejects_with_overloaded(static_pred):
+    eng = ServingEngine(static_pred, max_batch_size=2, batch_timeout_ms=20,
+                        queue_capacity=2, start=False)
+    xv = _xv()
+    eng.submit({"x": xv})
+    eng.submit({"x": xv})
+    with pytest.raises(Overloaded, match="queue full"):
+        eng.submit({"x": xv})
+    assert eng.metrics.snapshot()["rejected_total"] == 1
+    eng.start()
+    eng.close(drain=True)
+    # the two admitted requests still completed
+    assert eng.metrics.snapshot()["responses_total"] == 2
+
+
+def test_deadline_expired_request_never_batched(static_pred):
+    eng = ServingEngine(static_pred, max_batch_size=2, batch_timeout_ms=50,
+                        start=False)
+    fut = eng.submit({"x": _xv()}, deadline_ms=1)
+    time.sleep(0.01)  # < batch timeout; expires the 1ms deadline
+    eng.start()
+    with pytest.raises(DeadlineExceeded):
+        fut.result(timeout=30)
+    eng.close()
+    snap = eng.metrics.snapshot()
+    assert snap["expired_total"] == 1
+    assert snap["batches_total"] == 0  # never reached the predictor
+
+
+def test_generous_deadline_is_met(static_pred):
+    eng = ServingEngine(static_pred, max_batch_size=2, batch_timeout_ms=5)
+    (got,) = eng.predict({"x": _xv(3)}, deadline_ms=60_000, timeout=60)
+    eng.close()
+    assert got.shape == (1, 3)
+
+
+def test_cancel_before_batching(static_pred):
+    eng = ServingEngine(static_pred, max_batch_size=2, batch_timeout_ms=50,
+                        start=False)
+    fut = eng.submit({"x": _xv()})
+    assert fut.cancel() is True
+    assert fut.cancel() is False  # already completed
+    eng.start()
+    with pytest.raises(RequestCancelled):
+        fut.result(timeout=30)
+    eng.close()
+    snap = eng.metrics.snapshot()
+    assert snap["cancelled_total"] == 1
+    assert snap["batches_total"] == 0
+
+
+def test_drain_on_shutdown_completes_queued_requests(static_pred):
+    xv = _xv(4)
+    (oracle,) = static_pred.run([xv])
+    eng = ServingEngine(static_pred, max_batch_size=8, batch_timeout_ms=30,
+                        num_workers=2)
+    futs = [eng.submit({"x": xv}) for _ in range(5)]
+    eng.close(drain=True)
+    for f in futs:
+        (got,) = f.result(timeout=0)  # already done: drain guaranteed it
+        np.testing.assert_allclose(got, oracle, rtol=1e-5, atol=1e-6)
+    with pytest.raises(EngineClosed):
+        eng.submit({"x": xv})
+    assert eng.metrics.snapshot()["responses_total"] == 5
+
+
+def test_close_without_drain_fails_queued(static_pred):
+    eng = ServingEngine(static_pred, max_batch_size=4, batch_timeout_ms=50,
+                        start=False)
+    futs = [eng.submit({"x": _xv()}) for _ in range(3)]
+    eng.close(drain=False)
+    for f in futs:
+        with pytest.raises(EngineClosed):
+            f.result(timeout=10)
+
+
+def test_feed_validation(static_pred):
+    eng = ServingEngine(static_pred, start=False)
+    with pytest.raises(ValueError, match="mismatch"):
+        eng.submit({"wrong_name": _xv()})
+    with pytest.raises(ValueError, match="expected 1 feeds"):
+        eng.submit([_xv(), _xv()])
+    eng.close()
+
+
+# -- metrics ----------------------------------------------------------------
+
+
+def test_streaming_histogram_quantiles():
+    h = StreamingHistogram()
+    for v in range(1, 1001):  # 1..1000 ms, uniform
+        h.record(float(v))
+    s = h.snapshot()
+    assert s["count"] == 1000
+    assert s["min"] == 1.0 and s["max"] == 1000.0
+    # log-bucketed: ~8% relative error bound, allow 15% slack
+    assert abs(s["p50"] - 500) / 500 < 0.15, s
+    assert abs(s["p99"] - 990) / 990 < 0.15, s
+    assert s["p50"] <= s["p95"] <= s["p99"]
+    assert StreamingHistogram().snapshot()["p99"] == 0.0
+
+
+def test_metrics_snapshot_sane_and_json_serializable(static_pred):
+    eng = ServingEngine(static_pred, max_batch_size=4, batch_timeout_ms=10)
+    for i in range(6):
+        eng.predict({"x": _xv(i)}, timeout=60)
+    snap = eng.metrics.snapshot()
+    eng.close()
+    json.dumps(snap)  # must be JSON-clean for /metrics + bench output
+    assert snap["requests_total"] == snap["responses_total"] == 6
+    assert snap["rejected_total"] == snap["errors_total"] == 0
+    assert snap["batches_total"] >= 1
+    lat = snap["latency_ms"]
+    assert lat["count"] == 6
+    assert 0 < lat["p50"] <= lat["p95"] <= lat["p99"]
+    assert snap["queue_wait_ms"]["count"] == 6
+    assert snap["queue_depth"] == 0
+    assert 0 < snap["batch_fill"] <= 1.0
+
+
+def test_predictor_bucket_hits_histogram(masked_dir):
+    """Satellite: bucket_stats() carries a per-bucket hit histogram,
+    snapshot-consistent, and clones count independently."""
+    cfg = Config(masked_dir)
+    cfg.enable_shape_bucketing(seq_buckets=(16, 32), pad_batch=False)
+    pred = create_predictor(cfg)
+    rng = np.random.RandomState(0)
+    for length in (7, 11, 20):
+        ids = rng.randint(1, 50, (2, length)).astype("int64")
+        pred.run([ids, np.ones((2, length), np.float32)])
+    st = pred.bucket_stats()
+    assert sum(st["bucket_hits"].values()) == st["runs"] == 3
+    assert len(st["bucket_hits"]) == st["compiled_shapes"] == 2
+    assert pred.clone().bucket_stats()["bucket_hits"] == {}
+
+
+# -- HTTP front end ---------------------------------------------------------
+
+
+def _http(conn, method, path, payload=None, raw_body=None):
+    """One request/response on a keep-alive connection; ALWAYS reads
+    the body (an unread body poisons the next request)."""
+    body = raw_body if raw_body is not None else (
+        json.dumps(payload).encode() if payload is not None else None)
+    conn.request(method, path, body=body,
+                 headers={"Content-Type": "application/json"}
+                 if body is not None else {})
+    r = conn.getresponse()
+    return r.status, r.read()
+
+
+def test_http_endpoints(static_pred):
+    xv = _xv(7)
+    (oracle,) = static_pred.run([xv])
+    out_name = static_pred.get_output_names()[0]
+    eng = ServingEngine(static_pred, max_batch_size=4, batch_timeout_ms=10)
+    with ServingServer(eng) as srv:
+        conn = http.client.HTTPConnection(srv.host, srv.port, timeout=30)
+
+        status, body = _http(conn, "GET", "/healthz")
+        assert status == 200 and json.loads(body)["status"] == "ok"
+
+        status, body = _http(conn, "POST", "/v1/predict",
+                             {"inputs": {"x": xv.tolist()}})
+        assert status == 200
+        np.testing.assert_allclose(
+            np.array(json.loads(body)["outputs"][out_name]),
+            oracle, rtol=1e-5, atol=1e-5)
+
+        status, body = _http(conn, "GET", "/metrics")
+        text = body.decode()
+        assert status == 200
+        assert "paddle_serving_requests_total 1" in text
+        assert "paddle_serving_responses_total 1" in text
+        assert 'paddle_serving_latency_ms{quantile="0.5"}' in text
+        assert "paddle_serving_predictor_runs" in text
+
+        status, _ = _http(conn, "POST", "/v1/predict", raw_body=b"not json")
+        assert status == 400
+
+        status, body = _http(conn, "POST", "/v1/predict",
+                             {"inputs": {"x": xv.tolist()},
+                              "deadline_ms": "50"})
+        assert status == 400  # client-input error, not a 500
+        assert "deadline_ms" in json.loads(body)["error"]
+
+        status, _ = _http(conn, "GET", "/nope")
+        assert status == 404
+
+        # drain flip: a closed engine reports unhealthy + 503s predicts
+        eng.close(drain=True)
+        status, body = _http(conn, "GET", "/healthz")
+        assert status == 503 and json.loads(body)["status"] == "draining"
+
+        status, body = _http(conn, "POST", "/v1/predict",
+                             {"inputs": {"x": xv.tolist()}})
+        assert status == 503 and json.loads(body)["kind"] == "closed"
+        conn.close()
+
+
+def test_http_deadline_maps_to_504(static_pred):
+    eng = ServingEngine(static_pred, max_batch_size=2, batch_timeout_ms=40,
+                        start=False)  # batcher never started: queued forever
+    with ServingServer(eng) as srv:
+        conn = http.client.HTTPConnection(srv.host, srv.port, timeout=30)
+        status, body = _http(conn, "POST", "/v1/predict",
+                             {"inputs": {"x": _xv().tolist()},
+                              "deadline_ms": 5, "timeout_s": 0.5})
+        assert status == 504
+        assert json.loads(body)["kind"] == "deadline"
+        conn.close()
+    eng.close()
