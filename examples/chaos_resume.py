@@ -1,0 +1,105 @@
+"""Fault-tolerant training demo: a supervised loop that survives an
+injected transient failure and a NaN loss, then a preemption, and
+resumes bit-exactly.
+
+    python examples/chaos_resume.py [--steps 24]
+
+Phase 1 trains under injected faults (a raised exception at step 5 is
+retried; a NaN loss at step 14 rolls back to the last committed
+checkpoint and fires the on_nan hook) and then "dies" without a final
+checkpoint. Phase 2 builds everything fresh — new program, scope,
+executor, as a restarted process would — and auto-resumes from the
+last COMMITTED checkpoint, finishing the run. The demo asserts the
+resumed trajectory matches an uninterrupted reference run bitwise
+(dropout in the model makes every step consume the per-step PRNG, so
+this exercises the RNG-state round-trip, not just parameter state).
+"""
+
+import argparse
+import tempfile
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import resilience
+
+
+def build(seed=41):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = seed
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data("x", [12])
+        y = fluid.layers.data("y", [1], dtype="int64")
+        h = fluid.layers.dropout(fluid.layers.fc(x, 32, act="relu"),
+                                 dropout_prob=0.1)
+        loss = fluid.layers.mean(fluid.layers.softmax_with_cross_entropy(
+            fluid.layers.fc(h, 4), y))
+        fluid.optimizer.Adam(5e-3).minimize(loss)
+    return main, startup, loss
+
+
+def feed_fn(step):
+    rng = np.random.RandomState(10_000 + step)
+    x = rng.randn(8, 12).astype("float32")
+    return {"x": x, "y": (x[:, :1] > 0).astype("int64")}
+
+
+def run(ckpt_dir, steps, fault="", final_checkpoint=True):
+    main, startup, loss = build()
+    scope = fluid.Scope()
+    losses = {}
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        sup = resilience.Supervisor(
+            exe, main, checkpoint_dir=ckpt_dir,
+            feed_fn=feed_fn, fetch_list=[loss],
+            policy=resilience.CheckpointPolicy(ckpt_dir, every_steps=4,
+                                               keep_last=3),
+            fault_injector=resilience.FaultInjector(fault),
+            on_nan=lambda step, val: print(
+                f"  on_nan hook: loss={val} at step {step} -> rolling back"),
+            on_retry=lambda step, e: print(
+                f"  on_retry hook: step {step} failed ({e}) -> retrying"),
+            on_step=lambda s, f: losses.__setitem__(
+                s, float(np.asarray(f[0]))))
+        stats = sup.run_loop(steps, final_checkpoint=final_checkpoint)
+    return losses, stats
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=24)
+    args = p.parse_args()
+
+    ck = tempfile.mkdtemp(prefix="chaos_resume_")
+    print(f"checkpoints -> {ck}")
+
+    print(f"\n[reference] uninterrupted {args.steps}-step run")
+    ref, _ = run(tempfile.mkdtemp(), args.steps)
+
+    half = args.steps * 2 // 3
+    print(f"\n[phase 1] train to step {half} under faults, then die "
+          "without a final checkpoint")
+    part, stats1 = run(ck, half, fault="raise@5,nan@14",
+                       final_checkpoint=False)
+    print(f"  stats: retries={stats1['retries']} "
+          f"rollbacks={stats1['rollbacks']} "
+          f"checkpoints_written={stats1['checkpoints_written']}")
+
+    print("\n[phase 2] fresh program/scope/executor auto-resumes")
+    res, stats2 = run(ck, args.steps)
+    print(f"  resumed_from={stats2['resumed_from']} "
+          f"steps_completed={stats2['steps_completed']}")
+
+    full = dict(part)
+    full.update(res)
+    diverged = {s for s in full if full[s] != ref[s]}
+    assert not diverged, f"trajectory diverged at steps {sorted(diverged)}"
+    print(f"\nall {len(full)} recovered losses bitwise-identical to the "
+          f"uninterrupted run; final loss={full[args.steps - 1]:.6f}")
+    print("chaos resume OK")
+
+
+if __name__ == "__main__":
+    main()

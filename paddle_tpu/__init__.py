@@ -69,6 +69,7 @@ from . import debugger
 from . import flags
 from . import analysis  # static Program-IR verifier / lint (proglint)
 from . import serving  # dynamic-batching inference serving (engine/server)
+from . import resilience  # fault-tolerant training supervisor (chaos-tested)
 
 # ``fluid``-style alias so reference user code reads naturally:
 #   import paddle_tpu as fluid
@@ -112,6 +113,7 @@ __all__ = [
     "DataLoader",
     "analysis",
     "serving",
+    "resilience",
 ]
 
 

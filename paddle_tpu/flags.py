@@ -42,6 +42,24 @@ _FLAG_DEFS: Dict[str, Any] = {
     "serving_batch_timeout_ms": 5.0,
     "serving_queue_capacity": 256,
     "serving_num_workers": 2,
+    # resilience/supervisor.py defaults (overridable per Supervisor /
+    # CheckpointPolicy): checkpoint cadence is every-N-steps OR
+    # every-T-seconds, whichever fires first (0 disables that trigger);
+    # keep_last bounds the retention GC; a step that raises is retried
+    # up to resilience_max_retries times with exponential backoff from
+    # resilience_retry_backoff_s; a non-finite loss rolls back to the
+    # last committed checkpoint at most resilience_max_rollbacks times;
+    # resilience_watchdog_timeout_s > 0 runs each step under a hang
+    # watchdog; resilience_fault_spec injects deterministic faults
+    # ("raise@12,nan@20,hang@30:2.5,kill@40") for chaos testing
+    "resilience_ckpt_every_steps": 50,
+    "resilience_ckpt_every_secs": 0.0,
+    "resilience_keep_last": 3,
+    "resilience_max_retries": 3,
+    "resilience_retry_backoff_s": 0.05,
+    "resilience_max_rollbacks": 2,
+    "resilience_watchdog_timeout_s": 0.0,
+    "resilience_fault_spec": "",
     "eager_delete_tensor_gb": 0.0,     # inert: XLA frees by liveness
     # accepted-but-inert parity flags (reference platform/flags.cc)
     "fraction_of_gpu_memory_to_use": 0.92,

@@ -51,6 +51,9 @@ class FS:
     def rename(self, src, dst):
         raise NotImplementedError
 
+    def atomic_rename(self, src, dst):
+        raise NotImplementedError
+
 
 class LocalFS(FS):
     """Reference fs.cc localfs_* functions."""
@@ -86,6 +89,38 @@ class LocalFS(FS):
         if not self.is_exist(src):
             raise FSFileNotExistsError(src)
         os.replace(src, dst)
+
+    def atomic_rename(self, src, dst):
+        """Crash-safe publication: rename src over dst, DURABLE (parent
+        directory fsync'd) — the checkpoint commit primitive
+        (io.save_checkpoint's write-to-temp + marker + rename protocol
+        funnels through here). For files and a fresh dst this is one
+        atomic os.replace. POSIX cannot rename over a non-empty
+        DIRECTORY, so an existing dst dir is first moved aside and
+        deleted after the publish — that leaves a short crash window
+        where dst is absent (never partial); callers needing dst to
+        always exist must not target a live directory (CheckpointPolicy
+        skips re-publishing committed steps for exactly this reason)."""
+        if not self.is_exist(src):
+            raise FSFileNotExistsError(src)
+        aside = None
+        if self.is_dir(dst):
+            aside = f"{dst}.old.{os.getpid()}"
+            if self.is_exist(aside):
+                shutil.rmtree(aside)
+            os.replace(dst, aside)
+        os.replace(src, dst)
+        parent = os.path.dirname(os.path.abspath(dst)) or "."
+        try:
+            fd = os.open(parent, os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+        except OSError:
+            pass  # fsync on a directory is unsupported on some FSes
+        if aside is not None:
+            shutil.rmtree(aside, ignore_errors=True)
 
     def mv(self, src, dst, overwrite=False):
         if not overwrite and self.is_exist(dst):
@@ -201,6 +236,15 @@ class HDFSClient(FS):
         if overwrite:
             self._run(["-rm", "-r", "-f", dst])
         self._run(["-mv", src, dst])
+
+    def atomic_rename(self, src, dst):
+        raise NotImplementedError(
+            "HDFSClient.atomic_rename: `hadoop fs -mv` gives no "
+            "atomicity or durability guarantee when dst exists (it can "
+            "move src INSIDE a dst directory), so it cannot implement "
+            "the checkpoint commit protocol — write checkpoints to a "
+            "LocalFS staging dir and upload() the committed result"
+        )
 
     def cat(self, path) -> str:
         _, out = self._run(["-cat", path])

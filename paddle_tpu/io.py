@@ -196,15 +196,96 @@ def load_inference_model(
 
 # -- sharded / async checkpointing (orbax) ----------------------------------
 
+# Commit protocol (resilience/): a checkpoint directory is COMMITTED
+# only once it contains this marker, written AFTER every array file has
+# landed. The marker carries a manifest (relative path -> size) of the
+# directory at commit time, so a later truncation (crash during GC,
+# fault injection, partial copy) is detected, plus caller `extra`
+# metadata — the supervisor stores step counter, RNG state and reader
+# position here, alongside the persistables.
+_COMMIT_MARKER = "_PT_COMMIT.json"
+
+
+def _checkpoint_manifest(path):
+    out = {}
+    for root, _, files in os.walk(path):
+        for fn in files:
+            if fn == _COMMIT_MARKER:
+                continue
+            full = os.path.join(root, fn)
+            out[os.path.relpath(full, path)] = os.path.getsize(full)
+    return out
+
+
+def write_commit_marker(path, extra=None):
+    """Mark a checkpoint directory committed. Written atomically (temp
+    + rename) so a crash mid-write leaves no marker — i.e. the dir
+    stays uncommitted — never a truncated JSON that half-parses."""
+    import time
+
+    marker = {
+        "manifest": _checkpoint_manifest(path),
+        "commit_time": time.time(),
+        "extra": dict(extra or {}),
+    }
+    tmp = os.path.join(path, _COMMIT_MARKER + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump(marker, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, os.path.join(path, _COMMIT_MARKER))
+    return marker
+
+
+def read_commit_marker(path):
+    """The commit marker dict, or None when the dir is uncommitted (no
+    marker / unparseable marker)."""
+    try:
+        with open(os.path.join(path, _COMMIT_MARKER)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def is_committed_checkpoint(path):
+    """True when `path` holds a complete, committed checkpoint.
+
+    Marker present -> verify every manifest file still exists with its
+    committed size (catches truncation after commit). No marker ->
+    legacy fallback: accept only directories orbax itself finalized
+    (its _CHECKPOINT_METADATA lands last), so checkpoints written
+    before this protocol existed still resume, while a crash
+    mid-`save_checkpoint` is never picked up.
+    """
+    if not os.path.isdir(path):
+        return False
+    marker = read_commit_marker(path)
+    if marker is not None:
+        for rel, size in marker.get("manifest", {}).items():
+            full = os.path.join(path, rel)
+            try:
+                if os.path.getsize(full) != size:
+                    return False
+            except OSError:
+                return False
+        return True
+    return os.path.isfile(os.path.join(path, "_CHECKPOINT_METADATA"))
+
 
 def save_checkpoint(dirname, main_program=None, scope=None, step=None,
-                    async_save=False):
+                    async_save=False, extra=None):
     """Sharded checkpoint of all persistables via orbax (SURVEY §5's
     checkpoint/resume target; reference io.py save_persistables +
     fleet util checkpoints, but TPU-native: device/GSPMD-sharded
     arrays are saved in their sharded layout without gathering to one
     host, and async_save overlaps the write with training — orbax's
-    job, the reference's CheckpointNotifyOp analogue)."""
+    job, the reference's CheckpointNotifyOp analogue).
+
+    Every completed save is stamped with a commit marker (manifest +
+    caller `extra` metadata); `latest_checkpoint` only ever selects
+    committed directories, so a crash mid-save can never be resumed
+    from. Async saves commit from a background thread once the write
+    lands."""
     import orbax.checkpoint as ocp
 
     main_program = main_program or framework.default_main_program()
@@ -218,12 +299,58 @@ def save_checkpoint(dirname, main_program=None, scope=None, step=None,
     if step is not None:
         path = os.path.join(path, str(int(step)))
     if async_save:
+        import threading
+
         ckptr = _async_checkpointer()
         ckptr.save(path, state, force=True)
-        return ckptr  # .wait_until_finished() to block; atexit waits too
+        # commit once the write lands; wait_until_finished blocks until
+        # every save issued so far has finalized, so the marker can
+        # only ever cover a complete directory. Non-daemon: interpreter
+        # exit must not strand a finished write uncommitted (the same
+        # guarantee the atexit wait gives the data itself).
+        commit_err: list = []
+
+        def _commit():
+            try:
+                ckptr.wait_until_finished()
+                write_commit_marker(path, extra)
+            except BaseException as e:  # noqa: BLE001 — re-raised at wait
+                commit_err.append(e)
+                raise
+
+        committer = threading.Thread(target=_commit)
+        committer.start()
+        # the caller's wait must cover the COMMIT, not just the data —
+        # otherwise a restore racing the marker thread reads the dir as
+        # committed-without-extra (legacy fallback) and loses the
+        # resume metadata. Commit failures surface there too instead of
+        # dying silently with the thread.
+        return _AsyncSaveHandle(ckptr, committer, commit_err)
     ocp.Checkpointer(ocp.StandardCheckpointHandler()).save(
         path, state, force=True)
+    write_commit_marker(path, extra)
     return None
+
+
+class _AsyncSaveHandle:
+    """Handle for one async save: ``wait_until_finished`` blocks until
+    the data AND its commit marker are on disk, re-raising any commit
+    failure. Other attributes delegate to the shared
+    AsyncCheckpointer."""
+
+    def __init__(self, ckptr, committer, commit_err):
+        self._ckptr = ckptr
+        self._committer = committer
+        self._commit_err = commit_err
+
+    def wait_until_finished(self):
+        self._ckptr.wait_until_finished()
+        self._committer.join()
+        if self._commit_err:
+            raise self._commit_err[0]
+
+    def __getattr__(self, name):
+        return getattr(self._ckptr, name)
 
 
 _ASYNC_CKPTR = None
@@ -259,6 +386,13 @@ def load_checkpoint(dirname, main_program=None, scope=None, step=None):
     path = os.path.abspath(dirname)
     if step is not None:
         path = os.path.join(path, str(int(step)))
+    if not is_committed_checkpoint(path):
+        raise ValueError(
+            f"checkpoint {path!r} is uncommitted or corrupt (missing/"
+            "invalid commit marker, or manifest files truncated) — it "
+            "was likely interrupted mid-save; resume from "
+            "latest_checkpoint(), which skips such directories"
+        )
     ckptr = ocp.Checkpointer(ocp.StandardCheckpointHandler())
     state = ckptr.restore(path)
     for name, val in state.items():
@@ -267,11 +401,28 @@ def load_checkpoint(dirname, main_program=None, scope=None, step=None):
 
 
 def latest_checkpoint(dirname):
-    """Highest numeric step directory under dirname (resume helper)."""
+    """Highest COMMITTED numeric step directory under dirname (resume
+    helper). Directories left by a crash mid-`save_checkpoint` — no
+    commit marker, or a manifest whose files were truncated — are
+    skipped, so resume can never pick up a half-written checkpoint."""
     if not os.path.isdir(dirname):
         return None
-    steps = [int(d) for d in os.listdir(dirname) if d.isdigit()]
+    steps = [
+        int(d) for d in os.listdir(dirname)
+        if d.isdigit() and is_committed_checkpoint(os.path.join(dirname, d))
+    ]
     return max(steps) if steps else None
+
+
+def committed_checkpoint_steps(dirname):
+    """All committed step directories under dirname, ascending (the
+    retention-GC and rollback helpers iterate this)."""
+    if not os.path.isdir(dirname):
+        return []
+    return sorted(
+        int(d) for d in os.listdir(dirname)
+        if d.isdigit() and is_committed_checkpoint(os.path.join(dirname, d))
+    )
 
 
 def get_program_parameter(program):

@@ -58,10 +58,13 @@ _recording = False
 
 
 @contextlib.contextmanager
-def record_event(name: str):
+def record_event(name: str, args=None):
     """RAII event annotation (reference platform/profiler.h:124
     RecordEvent). Shows up as a named range in the XLA trace AND in the
-    host event log consumed by tools/timeline.py."""
+    host event log consumed by tools/timeline.py. ``args`` attaches
+    structured metadata (step number, checkpoint path, retry count —
+    the resilience supervisor's spans use this) that tools/timeline.py
+    renders as the chrome-trace event's args panel."""
     import jax
 
     t0 = time.time()
@@ -70,13 +73,16 @@ def record_event(name: str):
             yield
         finally:
             if _recording:
+                ev = {
+                    "name": name,
+                    "ts": t0,
+                    "dur": time.time() - t0,
+                    "tid": threading.get_ident() % 10_000,
+                }
+                if args:
+                    ev["args"] = dict(args)
                 with _events_lock:
-                    _host_events.append({
-                        "name": name,
-                        "ts": t0,
-                        "dur": time.time() - t0,
-                        "tid": threading.get_ident() % 10_000,
-                    })
+                    _host_events.append(ev)
 
 
 def host_events():

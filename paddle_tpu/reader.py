@@ -43,6 +43,12 @@ class GeneratorLoader:
         self._gen: Optional[Callable] = None
         self._places = None
         self._batch_reader = None
+        # resumable position (resilience/): batches handed to the
+        # consumer since iteration started; checkpointed by the
+        # Supervisor so a resumed run fast-forwards the data stream to
+        # where the killed run left off instead of re-reading the epoch
+        self._position = 0
+        self._resume_from = 0
         # rank sharding (reference DistributedBatchSampler): defaults
         # from the launcher's env contract
         self.trainer_id = (
@@ -140,11 +146,45 @@ class GeneratorLoader:
             out[k] = jax.device_put(arr, dev)
         return out
 
+    # -- resumable position (checkpoint/restore contract) -------------------
+    def position(self) -> int:
+        """Batches handed to the consumer since iteration started (==
+        the step count a supervised training loop has consumed)."""
+        return self._position
+
+    def state_dict(self) -> dict:
+        return {"position": self._position}
+
+    def set_state(self, state: dict):
+        self.set_resume_position(int(state.get("position", 0)))
+
+    def set_resume_position(self, n: int):
+        """Fast-forward the NEXT iteration past its first n batches —
+        they are drawn from the generator (keeping any stateful reader
+        deterministic) but neither transferred to device nor yielded."""
+        self._resume_from = max(0, int(n))
+
+    def _positioned_batches(self):
+        """The batch stream with resume fast-forward applied; bumps no
+        counters (the consumer-visible position is counted at yield)."""
+        skip = self._resume_from
+        self._resume_from = 0
+        self._position = skip
+        for i, b in enumerate(self._batch_reader()):
+            if i < skip:
+                continue
+            yield b
+
     def __iter__(self):
         if self._batch_reader is None:
             raise RuntimeError("no generator set; call set_*_generator first")
         if not self.use_double_buffer:
-            yield from self._batch_reader()
+            for b in self._positioned_batches():
+                # count BEFORE the yield: code after a yield only runs
+                # on the NEXT pull, which would leave the final batch
+                # uncounted in a checkpoint taken mid-iteration
+                self._position += 1
+                yield b
             return
         # depth-2 DEVICE buffer (true double buffering): the queue pins
         # device memory per entry, so `capacity` host batches would
@@ -155,7 +195,7 @@ class GeneratorLoader:
 
         def worker():
             try:
-                for b in self._batch_reader():
+                for b in self._positioned_batches():
                     q.put(self._to_device(b))
             except BaseException as e:  # surfaced to the consumer
                 # record BEFORE the stop sentinel: the consumer checks
@@ -187,6 +227,7 @@ class GeneratorLoader:
                 raise err[0]
             if b is stop:
                 break
+            self._position += 1
             yield b
 
     # non-iterable (start/reset) mode parity

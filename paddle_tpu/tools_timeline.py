@@ -24,7 +24,7 @@ def to_chrome_trace(events: List[Dict]) -> Dict:
     ]
     t0 = min((e["ts"] for e in events), default=0.0)
     for e in events:
-        trace_events.append({
+        ch = {
             "name": e["name"],
             "ph": "X",  # complete event
             "pid": 0,
@@ -32,7 +32,10 @@ def to_chrome_trace(events: List[Dict]) -> Dict:
             "ts": (e["ts"] - t0) * 1e6,   # microseconds
             "dur": e["dur"] * 1e6,
             "cat": "host",
-        })
+        }
+        if e.get("args"):
+            ch["args"] = e["args"]  # structured span metadata
+        trace_events.append(ch)
     return {"traceEvents": trace_events, "displayTimeUnit": "ms"}
 
 

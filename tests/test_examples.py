@@ -58,3 +58,9 @@ def test_serve_bucketed():
 def test_serve_engine():  # covers the subsystem itself in-process
     out = _run("serve_engine.py")
     assert "engine serving OK" in out
+
+
+@pytest.mark.slow  # tier-1 runs `-m 'not slow'`; tests/test_resilience.py
+def test_chaos_resume():  # covers the subsystem itself in-process
+    out = _run("chaos_resume.py", "--steps", "12")
+    assert "chaos resume OK" in out

@@ -13,6 +13,7 @@ import sys
 MODULES = [
     "paddle_tpu",
     "paddle_tpu.serving",
+    "paddle_tpu.resilience",
     "paddle_tpu.layers",
     "paddle_tpu.optimizer",
     "paddle_tpu.nets",
