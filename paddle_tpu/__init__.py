@@ -70,6 +70,7 @@ from . import flags
 from . import analysis  # static Program-IR verifier / lint (proglint)
 from . import serving  # dynamic-batching inference serving (engine/server)
 from . import resilience  # fault-tolerant training supervisor (chaos-tested)
+from . import observability  # unified telemetry: metrics/tracing/flight
 
 # ``fluid``-style alias so reference user code reads naturally:
 #   import paddle_tpu as fluid
@@ -114,6 +115,7 @@ __all__ = [
     "analysis",
     "serving",
     "resilience",
+    "observability",
 ]
 
 

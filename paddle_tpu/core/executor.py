@@ -441,6 +441,11 @@ class Executor:
             "shared_cache_hits": 0, "build_time_s": 0.0,
             "compile_time_s": 0.0,
         }
+        # unified telemetry: live executors aggregate into the
+        # paddle_executor_* families of observability's one registry
+        from ..observability import watch_executor
+
+        watch_executor(self)
 
     def cache_stats(self) -> Dict[str, Any]:
         """Dispatch/compilation cache counters for THIS executor, plus

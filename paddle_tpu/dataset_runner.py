@@ -13,9 +13,14 @@ in-flight steps would otherwise alias-donate the same param buffers.
 
 from __future__ import annotations
 
+import logging
 import queue
 import threading
 from typing import Optional
+
+# status lines keep off stdout — a serving process or pipe-reading tool
+# shares this process's stdout (observability PR: library paths log)
+_log = logging.getLogger("paddle_tpu.dataset")
 
 
 def run_from_dataset(
@@ -51,7 +56,7 @@ def run_from_dataset(
             msgs = ", ".join(
                 f"{n}={float(r.reshape(-1)[0]):.6f}" for n, r in zip(fetch_info, results)
             )
-            print(f"[dataset] step {step}: {msgs}")
+            _log.info("[dataset] step %d: %s", step, msgs)
         step += 1
     return results
 
@@ -93,7 +98,8 @@ def _run_hogwild(executor, program, dataset, scope, fetch_list, fetch_info,
                         f"{n}={float(v.reshape(-1)[0]):.6f}"
                         for n, v in zip(fetch_info, r)
                     )
-                    print(f"[dataset hogwild t{tid}] step {step}: {msgs}")
+                    _log.info("[dataset hogwild t%d] step %d: %s",
+                              tid, step, msgs)
         except BaseException as e:
             errors.append(e)
 

@@ -60,6 +60,24 @@ _FLAG_DEFS: Dict[str, Any] = {
     "resilience_max_rollbacks": 2,
     "resilience_watchdog_timeout_s": 0.0,
     "resilience_fault_spec": "",
+    # observability/ (unified telemetry): observability_metrics turns
+    # on per-step telemetry instruments (wall time, examples/sec) in
+    # the dispatch hot path; observability_tracing upgrades span call
+    # sites from plain record_event ranges to trace-id/span-id spans
+    # (cross-thread flow arrows in timeline traces) and logs each span
+    # into the flight recorder; observability_flight keeps the
+    # constant-memory crash-time ring buffer (capacity entries) that
+    # dumps JSON to observability_dump_dir ("" = the system tempdir)
+    # on NaN rollback / watchdog hang / SIGTERM / SIGUSR2;
+    # observability_xla_analysis additionally surfaces per-executable
+    # XLA memory_analysis()/cost_analysis() gauges at compile time
+    # (costs one extra lower+compile per executable — debugging knob)
+    "observability_metrics": True,
+    "observability_tracing": False,
+    "observability_flight": True,
+    "observability_flight_capacity": 512,
+    "observability_dump_dir": "",
+    "observability_xla_analysis": False,
     "eager_delete_tensor_gb": 0.0,     # inert: XLA frees by liveness
     # accepted-but-inert parity flags (reference platform/flags.cc)
     "fraction_of_gpu_memory_to_use": 0.92,

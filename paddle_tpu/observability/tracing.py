@@ -1,0 +1,205 @@
+"""Trace spans with parentage, layered on ``profiler.record_event``.
+
+``profiler.record_event`` gives named host ranges; what it cannot say
+is which serving request a micro-batch served, or which supervisor
+step a rollback undid — ranges on different threads have no shared
+identity. A span adds exactly that: a ``trace_id`` (one per root
+request/step), a ``span_id``, and a ``parent_id``, carried in the
+event's ``args`` so ``tools_timeline`` can draw Perfetto flow arrows
+across threads (serving request -> admission queue -> micro-batch ->
+worker -> dispatch -> jit step).
+
+Propagation is ambient within a thread (a thread-local stack: nested
+``span()`` calls parent automatically) and explicit across threads —
+the submitting side stores ``ctx = span(...)``'s yielded context on
+the work item, and the consuming thread opens its span with
+``parent=ctx`` (or wraps its whole handling in ``attach(ctx)``).
+
+Cost model: with ``observability_tracing`` off (the default), ``span``
+is exactly ``profiler.record_event`` — the pre-existing behavior of
+every call site this API replaced. With it on, a span is a slotted
+class-based context manager (no generator frames on the hot path):
+two lock-free id draws, one TraceAnnotation, one conditional
+host-event append, one flight-ring append. ``tools/obs_bench.py``
+gates the combined metrics+tracing per-step cost at <3% of a bare
+step.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import threading
+import time
+from typing import Any, Dict, NamedTuple, Optional
+
+from .. import profiler
+from ..flags import _flags  # hot path: direct flag-store reads
+from . import flight
+
+__all__ = ["SpanContext", "span", "traced", "attach", "current", "enabled"]
+
+
+class SpanContext(NamedTuple):
+    trace_id: str
+    span_id: str
+
+
+_tls = threading.local()
+
+# process-unique ids without locks or syscalls: a per-process random
+# prefix + a per-thread random prefix + a per-thread counter. Spans
+# from two processes (or a reused OS thread ident) stay distinct.
+_proc_prefix = os.urandom(4).hex()
+
+
+def _new_id() -> str:
+    n = getattr(_tls, "id_n", None)
+    if n is None:
+        _tls.id_prefix = f"{_proc_prefix}{os.urandom(3).hex()}"
+        n = 0
+    _tls.id_n = n + 1
+    return f"{_tls.id_prefix}{n:08x}"
+
+
+def _stack():
+    st = getattr(_tls, "stack", None)
+    if st is None:
+        st = _tls.stack = []
+    return st
+
+
+def enabled() -> bool:
+    return bool(_flags["observability_tracing"])
+
+
+def current() -> Optional[SpanContext]:
+    """The innermost active span on THIS thread (the ambient parent),
+    or None outside any span."""
+    st = getattr(_tls, "stack", None)
+    return st[-1] if st else None
+
+
+class _AmbientType:
+    """Sentinel for "parent from the thread-local stack". Stable repr:
+    the api-spec ratchet records default values, and a bare object()'s
+    repr embeds a memory address."""
+
+    def __repr__(self):
+        return "<ambient parent>"
+
+
+_AMBIENT = _AmbientType()
+
+
+class _Span:
+    """One traced range. Slotted class CM instead of a
+    @contextmanager generator: the per-step/per-request path cannot
+    afford two generator frames per span."""
+
+    __slots__ = ("name", "meta", "ctx", "t0", "_ta", "_stack")
+
+    def __init__(self, name: str, args: Optional[Dict[str, Any]], parent):
+        st = _stack()
+        par = (st[-1] if st else None) if parent is _AMBIENT else parent
+        ctx = SpanContext(par.trace_id if par is not None else _new_id(),
+                          _new_id())
+        meta = dict(args) if args else {}
+        meta["trace_id"] = ctx.trace_id
+        meta["span_id"] = ctx.span_id
+        if par is not None:
+            meta["parent_id"] = par.span_id
+        self.name = name
+        self.meta = meta
+        self.ctx = ctx
+        self._stack = st
+
+    def __enter__(self) -> SpanContext:
+        self._stack.append(self.ctx)
+        # the device-trace annotation only matters inside a profiling
+        # session (sessions started via paddle_tpu.profiler flip
+        # _recording); outside one, skipping it keeps the per-step
+        # span within the obs_bench overhead budget
+        if profiler._recording:
+            import jax
+
+            self._ta = jax.profiler.TraceAnnotation(self.name)
+            self._ta.__enter__()
+        else:
+            self._ta = None
+        self.t0 = time.time()
+        return self.ctx
+
+    # entry keys the recorder owns: user span args must not be able to
+    # collide with them (a span("x", {"name": ...}) would otherwise
+    # TypeError at exit)
+    _RESERVED = frozenset(("kind", "t", "name", "ts", "dur", "tid"))
+
+    def __exit__(self, *exc):
+        dur = time.time() - self.t0
+        if self._ta is not None:
+            self._ta.__exit__(*exc)
+        self._stack.pop()
+        profiler.emit_event(self.name, self.t0, dur, self.meta)
+        entry = {"kind": "span", "t": self.t0, "name": self.name,
+                 "ts": self.t0, "dur": dur, "tid": profiler.thread_tid()}
+        for k, v in self.meta.items():
+            if k not in self._RESERVED:
+                entry[k] = v
+        flight.append_entry(entry)
+        return False
+
+
+def span(name: str, args: Optional[Dict[str, Any]] = None, parent=_AMBIENT):
+    """Context manager for one traced range. Yields the SpanContext
+    (or None when tracing is off — it then degrades to a plain
+    ``profiler.record_event``, which is what these call sites did
+    before tracing existed).
+
+    ``parent``: default is the ambient thread-local span; pass an
+    explicit SpanContext to stitch across threads, or None to force a
+    new root trace."""
+    if not _flags["observability_tracing"]:
+        return profiler.record_event(name, args)
+    return _Span(name, args, parent)
+
+
+class _Attach:
+    __slots__ = ("ctx", "_st")
+
+    def __init__(self, ctx):
+        self.ctx = ctx
+
+    def __enter__(self):
+        self._st = _stack() if self.ctx is not None else None
+        if self._st is not None:
+            self._st.append(self.ctx)
+        return self.ctx
+
+    def __exit__(self, *exc):
+        if self._st is not None:
+            self._st.pop()
+        return False
+
+
+def attach(ctx: Optional[SpanContext]) -> _Attach:
+    """Adopt ``ctx`` as this thread's ambient parent for the duration
+    — the cross-thread handoff primitive (a worker wraps its handling
+    in ``attach(req.ctx)`` and every span inside parents correctly)."""
+    return _Attach(ctx)
+
+
+def traced(name: Optional[str] = None, args: Optional[Dict[str, Any]] = None):
+    """Decorator form: ``@traced("serving/rebatch")``."""
+
+    def deco(fn):
+        span_name = name or f"{fn.__module__}.{fn.__qualname__}"
+
+        @functools.wraps(fn)
+        def wrapper(*a, **kw):
+            with span(span_name, args):
+                return fn(*a, **kw)
+
+        return wrapper
+
+    return deco

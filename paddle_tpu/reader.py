@@ -59,6 +59,14 @@ class GeneratorLoader:
             int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
             if num_trainers is None else int(num_trainers)
         )
+        # unified telemetry: live loaders export queue depth + resume
+        # position as paddle_reader_* gauges (the device prefetch queue
+        # draining to 0 is the "input-bound" signal every perf
+        # investigation starts from)
+        self._obs_queue = None
+        from .observability import watch_loader
+
+        watch_loader(self)
 
     # reference API: set_sample_generator / set_sample_list_generator /
     # set_batch_generator
@@ -190,6 +198,7 @@ class GeneratorLoader:
         # device memory per entry, so `capacity` host batches would
         # hold capacity x batch_bytes of HBM for no extra overlap
         q: "queue.Queue" = queue.Queue(maxsize=2)
+        self._obs_queue = q  # scraped as paddle_reader_queue_depth
         stop = object()
         err: List[BaseException] = []
 

@@ -41,8 +41,14 @@ position, and feeds consumed since the last checkpoint are buffered so
 rollback can replay them.
 
 Checkpoint save/restore paths are wrapped in structured
-``profiler.record_event`` spans (``resilience/checkpoint`` etc.) so
-they show up, with step/path metadata, in timeline traces.
+``observability.tracing`` spans (``resilience/checkpoint`` etc. —
+plain ``profiler.record_event`` ranges when tracing is off) so they
+show up, with step/path metadata and trace parentage, in timeline
+traces. Every fault-lifecycle event (retry, rollback, NaN, watchdog,
+zombie) also lands in the crash-time flight recorder, and the recorder
+dumps a JSON snapshot on NaN rollback, watchdog hang, any exception
+that escapes the loop, and the SIGTERM preemption flush
+(``stats()["flight_dumps"]`` lists the paths).
 """
 
 from __future__ import annotations
@@ -56,7 +62,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
-from .. import profiler
+from ..observability import flight, tracing
 from .checkpoint import CheckpointPolicy
 from .faults import FaultInjector
 
@@ -206,6 +212,7 @@ class Supervisor:
         self._last_commit_step: Optional[int] = None
         self._abandoned: List[Dict[str, Any]] = []  # watchdog-orphaned tokens
         self._data_exhausted = False
+        self._flight_dumps: List[str] = []
         self._stats: Dict[str, Any] = {
             "steps_completed": 0,
             "checkpoints_written": 0,
@@ -219,13 +226,23 @@ class Supervisor:
             "preempted": False,
             "resumed_from": None,
         }
+        # unified registry: counters export as paddle_resilience_*
+        from ..observability import watch_supervisor
+
+        watch_supervisor(self)
 
     # -- introspection ------------------------------------------------------
     def stats(self) -> Dict[str, Any]:
         """Counter snapshot (copies; safe to mutate)."""
         out = dict(self._stats)
         out["faults_injected"] = len(self.fault.fired())
+        out["flight_dumps"] = list(self._flight_dumps)
         return out
+
+    def _flight_dump(self, reason: str, **extra) -> None:
+        path = flight.dump(reason, extra=extra or None)
+        if path is not None:
+            self._flight_dumps.append(path)
 
     def request_preempt(self):
         """What the SIGTERM handler does — callable directly (tests,
@@ -237,8 +254,8 @@ class Supervisor:
     def resume(self) -> int:
         """Load the latest committed checkpoint (if any) and return the
         step index to continue from."""
-        with profiler.record_event("resilience/restore",
-                                   {"dir": self.policy.dirname}):
+        with tracing.span("resilience/restore",
+                          {"dir": self.policy.dirname}):
             restored = self.policy.restore(main_program=self._main,
                                            scope=self.scope)
         if restored is None:
@@ -279,7 +296,7 @@ class Supervisor:
             # the rolled-back pulls)
             "reader_position": int(completed_steps),
         }
-        with profiler.record_event(
+        with tracing.span(
                 "resilience/checkpoint",
                 {"step": completed_steps, "reason": reason}):
             path = self.policy.save(completed_steps,
@@ -302,8 +319,8 @@ class Supervisor:
         run's commits must not silently restore foreign state."""
         if self._last_commit_step is None:
             return None
-        with profiler.record_event("resilience/rollback",
-                                   {"dir": self.policy.dirname}):
+        with tracing.span("resilience/rollback",
+                          {"dir": self.policy.dirname}):
             restored = self.policy.restore(main_program=self._main,
                                           scope=self.scope,
                                           step=self._last_commit_step)
@@ -315,6 +332,8 @@ class Supervisor:
         self._stats["checkpoints_loaded"] += 1
         self._stats["rollbacks"] += 1
         self._last_commit_step = int(extra.get("step", step))
+        flight.note("event", what="rollback",
+                    to_step=self._last_commit_step)
         return self._last_commit_step
 
     # -- feeds --------------------------------------------------------------
@@ -379,6 +398,10 @@ class Supervisor:
                 token = getattr(e, "token", None)
                 if token is not None:
                     self._abandoned.append(token)
+                flight.note("event", what="watchdog_fire", step=step,
+                            timeout_s=self.watchdog_timeout_s)
+                self._flight_dump("watchdog_hang", step=step,
+                                  timeout_s=self.watchdog_timeout_s)
                 raise
             if out is None:
                 raise WatchdogTimeout("step cancelled by watchdog")
@@ -432,9 +455,21 @@ class Supervisor:
         # handler installs so a SIGTERM landing in between is kept.
         self._preempted.clear()
         in_main = threading.current_thread() is threading.main_thread()
+        # cleared BEFORE the handler installs (same discipline as
+        # _preempted above): a SIGTERM landing mid-install must keep
+        # its dump request, not have it wiped by a late reset
+        self._dump_on_preempt = False
         if in_main:
-            old_handler = signal.signal(
-                signal.SIGTERM, lambda signum, frame: self.request_preempt())
+            def _on_sigterm(signum, frame):
+                # flag-set ONLY: the handler runs on the main thread,
+                # which may hold the flight/telemetry locks mid-step —
+                # dumping here would self-deadlock on those
+                # non-reentrant locks. The loop body dumps at the next
+                # step boundary (safe context) before the flush.
+                self._dump_on_preempt = True
+                self.request_preempt()
+
+            old_handler = signal.signal(signal.SIGTERM, _on_sigterm)
         try:
             step = self.resume() if resume else 0
             rollbacks_left = self.max_rollbacks
@@ -457,6 +492,10 @@ class Supervisor:
                     break
                 if self._preempted.is_set():
                     self._stats["preempted"] = True
+                    if getattr(self, "_dump_on_preempt", False):
+                        # evidence of what was in flight when the
+                        # reclaim landed, captured BEFORE the flush
+                        self._flight_dump("sigterm", step=step)
                     if final_checkpoint:
                         self._save(step, reason="preempt")
                     break
@@ -473,7 +512,14 @@ class Supervisor:
                     # the NaN guard tripped with rollback budget left:
                     # restore OUTSIDE the retry try/except — a failing
                     # restore must propagate, not be retried as a
-                    # transient step fault
+                    # transient step fault. The flight dump happens
+                    # BEFORE the rollback: the evidence of interest is
+                    # the state that produced the NaN, not the restored
+                    # one.
+                    flight.note("event", what="nan_loss", step=step,
+                                loss=repr(nan_loss))
+                    self._flight_dump("nan_rollback", step=step,
+                                      loss=repr(nan_loss))
                     if self.on_nan is not None:
                         self.on_nan(step, nan_loss)
                     rolled = self._rollback()
@@ -497,6 +543,16 @@ class Supervisor:
                         continue
                     self._save(step, reason="policy")
             return self.stats()
+        except SystemExit:
+            raise
+        except BaseException as e:
+            # an exception escaping the supervisor IS the crash the
+            # flight recorder exists for: dump before propagating
+            # (retryable faults never reach here — _attempt absorbed
+            # them — so this fires once per terminal failure)
+            self._flight_dump(f"exception:{type(e).__name__}",
+                              error=repr(e))
+            raise
         finally:
             if in_main and old_handler is not None:
                 signal.signal(signal.SIGTERM, old_handler)
@@ -512,7 +568,14 @@ class Supervisor:
         attempts = 0
         while True:
             try:
-                fetched = self._run_step(step, feed)
+                if tracing.enabled():
+                    # per-attempt span: a retried step renders as two
+                    # sibling ranges, each carrying its attempt index
+                    with tracing.span("resilience/step",
+                                      {"step": step, "attempt": attempts}):
+                        fetched = self._run_step(step, feed)
+                else:
+                    fetched = self._run_step(step, feed)
                 fetched = self.fault.after_step(step, fetched,
                                                 self.loss_index)
                 loss = self._loss_of(fetched)
@@ -534,6 +597,8 @@ class Supervisor:
                 if attempts > self.max_retries:
                     raise
                 self._stats["retries"] += 1
+                flight.note("event", what="retry", step=step,
+                            attempt=attempts, error=repr(e))
                 if self.on_retry is not None:
                     self.on_retry(step, e)
                 time.sleep(self.retry_backoff_s * (2 ** (attempts - 1)))

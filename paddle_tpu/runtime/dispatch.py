@@ -40,12 +40,15 @@ from __future__ import annotations
 
 import hashlib
 import json
+import logging
 import os
 import time
 from collections import OrderedDict
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
+
+_log = logging.getLogger("paddle_tpu.dispatch")
 
 # -- global (process-wide) state -------------------------------------------
 
@@ -294,7 +297,7 @@ class BoundStep:
     __slots__ = (
         "executor", "compiled", "scope", "block", "base_key",
         "feed_plan", "state_vals", "written_into_state", "scope_gen",
-        "n_fetch", "benchmark",
+        "n_fetch", "benchmark", "obs_tel", "trace",
     )
 
     def __init__(self, executor, compiled, scope, block, raw_dtypes):
@@ -305,6 +308,22 @@ class BoundStep:
         self.scope = scope
         self.block = block
         self.benchmark = bool(flag("benchmark"))
+        # observability, resolved ONCE at bind time (the bound key
+        # carries the flags generation, so a flag flip re-binds):
+        # obs_tel holds pre-resolved registry instruments — per step
+        # the cost is one perf_counter pair + a few locked adds
+        self.obs_tel = None
+        if flag("observability_metrics"):
+            from ..observability.registry import step_telemetry
+
+            self.obs_tel = step_telemetry()
+        # the tracing module itself when spans are on, else None —
+        # saves a per-step sys.modules lookup on the traced path
+        self.trace = None
+        if flag("observability_tracing"):
+            from ..observability import tracing
+
+            self.trace = tracing
         # raw_dtypes: the CALLER's per-feed dtypes (pre-normalization)
         # — the plan must normalize what actually arrives each step
         raw_dtypes = raw_dtypes or {}
@@ -364,8 +383,20 @@ class BoundStep:
         fn = compiled.fn
         counter = np.int32(ex._run_counter)
         t0 = time.perf_counter() if self.benchmark else 0.0
+        tel = self.obs_tel
+        if compiled.compile_time is None:
+            # compile path: counted as a compile event, NOT a step
+            # sample — seconds of XLA compile in the step histogram
+            # would bury the real quantiles
+            tel = None
+        t_obs = time.perf_counter() if tel is not None else 0.0
         if compiled.compile_time is None:
             outs = self._first_call(fn, counter, ordered)
+        elif self.trace is not None:
+            with self.trace.span("executor/step",
+                                 {"step": int(counter),
+                                  "tag": compiled.tag or "program"}):
+                outs = fn(self.base_key, counter, *ordered, *self.state_vals)
         else:
             outs = fn(self.base_key, counter, *ordered, *self.state_vals)
         n_fetch = self.n_fetch
@@ -388,13 +419,25 @@ class BoundStep:
             scope._bump_generation()
             self.scope_gen = entry_gen + 1
         fetched = list(outs[:n_fetch])
+        if tel is not None:
+            # host-side step cadence (the device work is NOT forced
+            # synchronous — steady-state examples/sec only needs the
+            # dispatch-to-dispatch interval, and a sync here would
+            # serialize the async pipeline the loader exists to fill)
+            ms = (time.perf_counter() - t_obs) * 1e3
+            rows = 0
+            if ordered:
+                shp = getattr(ordered[0], "shape", None)
+                if shp:
+                    rows = int(shp[0])
+            tel.record(ms, rows, step=int(counter))
         if self.benchmark:
             # FLAGS_benchmark (reference operator.cc:1006 adds per-op
             # device syncs): force device sync + report wall time
             for v in fetched + list(new_state[:1]):
                 np.asarray(v)
-            print(f"[benchmark] Executor.run: "
-                  f"{(time.perf_counter() - t0) * 1e3:.3f} ms")
+            _log.info("[benchmark] Executor.run: %.3f ms",
+                      (time.perf_counter() - t0) * 1e3)
         if return_numpy:
             from ..core.executor import _fetch_to_host
 
@@ -422,4 +465,59 @@ class BoundStep:
         _GLOBAL_STATS["compile_time_s"] += dt
         ex = self.executor
         ex._stats["compile_time_s"] = ex._stats.get("compile_time_s", 0.0) + dt
+        self._xla_analysis(fn, counter, ordered)
         return outs
+
+    def _xla_analysis(self, fn, counter, ordered):
+        """Per-executable XLA ``memory_analysis()``/``cost_analysis()``
+        surfaced as registry gauges (labeled by executable tag) and a
+        flight-recorder entry. Behind ``observability_xla_analysis``:
+        it costs one extra lower+compile per executable (jax exposes
+        the analyses only on an AOT-compiled object, not on the jit
+        path that just ran — the persistent compilation cache makes
+        the recompile a deserialization in practice). Every sub-step
+        is best-effort: backends expose different analysis subsets."""
+        from ..flags import flag
+
+        if not flag("observability_xla_analysis"):
+            return
+        try:
+            comp = fn.lower(self.base_key, counter, *ordered,
+                            *self.state_vals).compile()
+        except Exception:  # noqa: BLE001 — analysis must never fail a step
+            return
+        vals: Dict[str, float] = {}
+        try:
+            mem = comp.memory_analysis()
+            for attr in ("temp_size_in_bytes", "argument_size_in_bytes",
+                         "output_size_in_bytes",
+                         "generated_code_size_in_bytes"):
+                v = getattr(mem, attr, None)
+                if isinstance(v, (int, float)):
+                    vals["paddle_xla_"
+                         + attr.replace("_size_in_bytes", "_bytes")] = v
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            cost = comp.cost_analysis()
+            if isinstance(cost, (list, tuple)):
+                cost = cost[0] if cost else {}
+            for key, name in (("flops", "paddle_xla_flops"),
+                              ("bytes accessed", "paddle_xla_bytes_accessed")):
+                v = cost.get(key) if hasattr(cost, "get") else None
+                if isinstance(v, (int, float)):
+                    vals[name] = v
+        except Exception:  # noqa: BLE001
+            pass
+        if not vals:
+            return
+        from ..observability import flight
+        from ..observability.registry import registry
+
+        tag = self.compiled.tag or "program"
+        reg = registry()
+        for name, v in vals.items():
+            reg.gauge(name, "XLA compile-time analysis").labels(
+                executable=tag).set(v)
+        self.compiled.analysis = dict(vals)
+        flight.note("xla_analysis", executable=tag, **vals)

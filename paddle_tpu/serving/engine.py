@@ -53,6 +53,7 @@ Defaults come from the live flags `serving_max_batch_size`,
 from __future__ import annotations
 
 import collections
+import contextlib
 import queue as _queue_mod
 import threading
 import time
@@ -137,15 +138,16 @@ class ServingFuture:
 
 class _Request:
     __slots__ = ("arrays", "n_rows", "key", "deadline", "enqueue_t",
-                 "future")
+                 "future", "ctx")
 
-    def __init__(self, arrays, n_rows, key, deadline, future):
+    def __init__(self, arrays, n_rows, key, deadline, future, ctx=None):
         self.arrays = arrays        # per-feed, predictor feed order
         self.n_rows = n_rows
         self.key = key              # batch-compatibility key (None: solo)
         self.deadline = deadline    # absolute time.monotonic() or None
         self.enqueue_t = time.monotonic()
         self.future = future
+        self.ctx = ctx              # tracing.SpanContext of the submit span
 
 
 class ServingEngine:
@@ -193,6 +195,15 @@ class ServingEngine:
             raise ValueError("queue_capacity must be >= 1")
 
         self.metrics = ServingMetrics()
+        # unified registry: aggregated predictor bucket stats join the
+        # scrape as paddle_serving_predictor_*{engine=...} gauges.
+        # Share the metrics object's registry id so paddle_serving_*
+        # and paddle_serving_predictor_* series for THIS engine carry
+        # the same engine= label and dashboards can join on it.
+        from ..observability import watch_engine
+
+        self._obs_id = self.metrics._obs_id
+        watch_engine(self)
         self._cond = threading.Condition()
         self._pending: "collections.deque[_Request]" = collections.deque()
         self._closed = False      # admission stopped
@@ -278,25 +289,34 @@ class ServingEngine:
         """Admit one request (dict name->array, or sequence in feed
         order). Raises `Overloaded` when the queue is full and
         `EngineClosed` after close() — both BEFORE any work is queued."""
+        from ..observability import tracing
+
         arrays = self._normalize_feed(feed)
         n_rows = self._request_rows(arrays)
         key = self._group_key(arrays)
         deadline = (time.monotonic() + deadline_ms / 1e3
                     if deadline_ms is not None else None)
         fut = ServingFuture(self)
-        req = _Request(arrays, n_rows, key, deadline, fut)
-        with self._cond:
-            if self._closed:
-                raise EngineClosed("ServingEngine is closed")
-            if len(self._pending) >= self.queue_capacity:
-                self.metrics.inc("rejected_total")
-                raise Overloaded(
-                    f"serving queue full ({self.queue_capacity} pending); "
-                    "retry with backoff or raise serving_queue_capacity")
-            self._pending.append(req)
-            self.metrics.inc("requests_total")
-            self.metrics.set_queue_depth(len(self._pending))
-            self._cond.notify_all()
+        # root span of the request's trace: admission happens inside
+        # it, and the context rides on the request so the worker's
+        # batch-execute span (another thread) can parent/flow to it.
+        # Gated on the flag (unlike the _execute span, submit had NO
+        # profiler call before this PR — tracing off must stay free)
+        with (tracing.span("serving/submit", {"rows": n_rows})
+              if tracing.enabled() else contextlib.nullcontext()) as ctx:
+            req = _Request(arrays, n_rows, key, deadline, fut, ctx=ctx)
+            with self._cond:
+                if self._closed:
+                    raise EngineClosed("ServingEngine is closed")
+                if len(self._pending) >= self.queue_capacity:
+                    self.metrics.inc("rejected_total")
+                    raise Overloaded(
+                        f"serving queue full ({self.queue_capacity} pending);"
+                        " retry with backoff or raise serving_queue_capacity")
+                self._pending.append(req)
+                self.metrics.inc("requests_total")
+                self.metrics.set_queue_depth(len(self._pending))
+                self._cond.notify_all()
         return fut
 
     def predict(self, feed, deadline_ms: Optional[float] = None,
@@ -332,6 +352,14 @@ class ServingEngine:
             "compiled_shapes": len(hits),
             "bucket_hits": hits,
         }
+
+    def predictor_stats_numeric(self) -> Dict[str, Any]:
+        """The registry collector's view: predictor_stats() with the
+        per-bucket histogram reduced to its size (labels are the
+        registry's job, nested dicts are not)."""
+        st = self.predictor_stats()
+        st.pop("bucket_hits", None)
+        return st
 
     def stats(self) -> Dict[str, Any]:
         """Serving metrics + aggregated predictor bucket stats in one
@@ -535,13 +563,24 @@ class ServingEngine:
             return pred._true_fetch_shapes(feed)
 
     def _execute(self, pred, batch: List[_Request]):
-        from .. import profiler
+        from ..observability import tracing
 
         t_exec = time.monotonic()
         try:
             feeds, padded_any = self._assemble(batch)
-            with profiler.record_event(
-                    f"serving/batch_execute[n={len(batch)}]"):
+            # the batch-execute span parents to the first member's
+            # submit span and carries flow_from for every OTHER member
+            # — tools_timeline renders the cross-thread handoff arrows
+            # submit(caller thread) -> execute(worker thread); nested
+            # spans below (predictor run -> executor/step) parent to
+            # this one via the worker thread's ambient context
+            first_ctx = batch[0].ctx
+            flow = [r.ctx.span_id for r in batch[1:] if r.ctx is not None]
+            with tracing.span(
+                    f"serving/batch_execute[n={len(batch)}]",
+                    {"rows": sum(r.n_rows for r in batch),
+                     **({"flow_from": flow} if flow else {})},
+                    parent=first_ctx):
                 outs = pred.run(feeds)
             true_shapes = ([self._true_shapes_for(pred, r) for r in batch]
                            if padded_any else None)

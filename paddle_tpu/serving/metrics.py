@@ -97,6 +97,13 @@ class ServingMetrics:
 
     def __init__(self):
         self._lock = threading.Lock()
+        # unified telemetry: every live ServingMetrics is a labeled
+        # series group (paddle_serving_*{engine="N"}) in the one
+        # process-wide registry — /metrics on ANY server shows every
+        # engine. Weakly held: a closed engine drops out of the scrape.
+        from ..observability import watch_serving
+
+        watch_serving(self)
         self._c: Dict[str, int] = {k: 0 for k in _COUNTERS}
         self._latency_ms = StreamingHistogram()
         self._queue_wait_ms = StreamingHistogram()

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
@@ -47,6 +48,7 @@ def _json_default(o):
 
 class _Handler(BaseHTTPRequestHandler):
     engine: ServingEngine = None  # set by the subclass ServingServer makes
+    started_at: float = 0.0       # time.monotonic() at server start
     server_version = "paddle_tpu_serving/1.0"
     protocol_version = "HTTP/1.1"
 
@@ -68,16 +70,27 @@ class _Handler(BaseHTTPRequestHandler):
     # -- endpoints -----------------------------------------------------------
     def do_GET(self):  # noqa: N802 — http.server contract
         if self.path == "/healthz":
-            if self.engine.closed:
-                self._reply_json(503, {"status": "draining"})
-            else:
-                self._reply_json(200, {"status": "ok"})
+            from .. import version
+
+            body = {
+                "status": "draining" if self.engine.closed else "ok",
+                # uptime + build info: a load balancer's drain check and
+                # a fleet-rollout "which build is this" probe share one
+                # endpoint
+                "uptime_s": round(time.monotonic() - self.started_at, 3),
+                "version": version.full_version,
+                "tpu": version.tpu(),
+            }
+            self._reply_json(503 if self.engine.closed else 200, body)
         elif self.path == "/metrics":
-            text = self.engine.metrics.to_prometheus_text(
-                extra={("predictor_" + k): v
-                       for k, v in self.engine.predictor_stats().items()
-                       if isinstance(v, (int, float))})
-            self._reply(200, text.encode(), "text/plain; version=0.0.4")
+            # the UNIFIED registry: serving counters (this engine and
+            # any sibling, labeled), dispatch/compile caches, executor,
+            # supervisor, reader and step families in ONE scrape
+            from .. import observability
+
+            text = observability.to_prometheus_text()
+            self._reply(200, text.encode(),
+                        "text/plain; version=0.0.4; charset=utf-8")
         else:
             self._reply_json(404, {"error": f"no such endpoint {self.path}"})
 
@@ -85,7 +98,7 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path != "/v1/predict":
             self._reply_json(404, {"error": f"no such endpoint {self.path}"})
             return
-        from .. import profiler
+        from ..observability import tracing
 
         try:
             length = int(self.headers.get("Content-Length") or 0)
@@ -106,7 +119,10 @@ class _Handler(BaseHTTPRequestHandler):
                     400, {"error": f"{name} must be a number, got {v!r}"})
                 return
         try:
-            with profiler.record_event("serving/http_predict"):
+            # span (record_event when tracing is off): the HTTP handler
+            # thread is the trace root; engine.submit's span nests under
+            # it via the ambient thread-local context
+            with tracing.span("serving/http_predict"):
                 outs = self.engine.predict(inputs, deadline_ms=deadline_ms,
                                            timeout=timeout)
         except Overloaded as e:
@@ -145,7 +161,8 @@ class ServingServer:
     def __init__(self, engine: ServingEngine, host: str = "127.0.0.1",
                  port: int = 0, start: bool = True):
         self.engine = engine
-        handler = type("_BoundHandler", (_Handler,), {"engine": engine})
+        handler = type("_BoundHandler", (_Handler,),
+                       {"engine": engine, "started_at": time.monotonic()})
         self._httpd = _QuietThreadingServer((host, port), handler)
         self.host, self.port = self._httpd.server_address[:2]
         self._thread: Optional[threading.Thread] = None

@@ -4,16 +4,42 @@ Reference: tools/timeline.py:36 (_ChromeTraceFormatter) / :131
 (Timeline) — converts profiler output to the chrome://tracing JSON
 format. Device-side timing here comes from jax.profiler's
 xplane/perfetto traces; this writer covers the HOST event log
-(profiler.record_event ranges), same viewer."""
+(profiler.record_event ranges + observability.tracing spans), same
+viewer.
+
+Two things beyond plain "X" ranges:
+
+* **thread metadata** — events carry the profiler's stable per-thread
+  tids; each tid gets a ``thread_name`` metadata event so lanes read
+  "pt-serving-worker-1", not a bare number.
+* **flow arrows** — spans carry ``span_id``/``parent_id`` (and
+  optionally ``flow_from``, a list of source span ids) in their args.
+  When parent and child ran on DIFFERENT threads, a ``ph: s`` /
+  ``ph: f`` flow-event pair is emitted so Perfetto draws the arrow:
+  a serving request's submit span visibly hands off to the worker
+  thread's batch-execute span.
+"""
 
 from __future__ import annotations
 
 import json
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 
-def to_chrome_trace(events: List[Dict]) -> Dict:
-    """events: [{name, ts (s), dur (s), tid}] -> chrome trace dict."""
+def to_chrome_trace(events: List[Dict],
+                    thread_names: Optional[Dict[int, str]] = None) -> Dict:
+    """events: [{name, ts (s), dur (s), tid, args?}] -> chrome trace
+    dict. ``thread_names`` overrides/extends the profiler's registry
+    (tid -> display name)."""
+    names = {}
+    try:
+        from . import profiler
+
+        names.update(profiler.thread_names())
+    except Exception:  # noqa: BLE001 — standalone use on raw event dicts
+        pass
+    names.update(thread_names or {})
+
     trace_events = [
         {
             "name": "process_name",
@@ -23,23 +49,68 @@ def to_chrome_trace(events: List[Dict]) -> Dict:
         }
     ]
     t0 = min((e["ts"] for e in events), default=0.0)
+    # index span_id -> its rendered (tid, ts, dur) for flow linking
+    span_index: Dict[str, Dict] = {}
+    rendered = []
+    seen_tids = set()
     for e in events:
+        tid = int(e.get("tid", 0))
+        seen_tids.add(tid)
         ch = {
             "name": e["name"],
             "ph": "X",  # complete event
             "pid": 0,
-            "tid": int(e.get("tid", 0)),
+            "tid": tid,
             "ts": (e["ts"] - t0) * 1e6,   # microseconds
             "dur": e["dur"] * 1e6,
             "cat": "host",
         }
         if e.get("args"):
             ch["args"] = e["args"]  # structured span metadata
-        trace_events.append(ch)
+            sid = e["args"].get("span_id")
+            if sid:
+                span_index[sid] = ch
+        rendered.append(ch)
+
+    for tid in sorted(seen_tids):
+        name = names.get(tid)
+        if name:
+            trace_events.append({
+                "name": "thread_name", "ph": "M", "pid": 0, "tid": tid,
+                "args": {"name": name},
+            })
+
+    trace_events.extend(rendered)
+
+    # flow arrows for cross-thread parentage: s at the source span's
+    # end, f (binding point "e": enclosing slice) at the child's start
+    flow_n = 0
+    for ch in rendered:
+        args = ch.get("args") or {}
+        sources = []
+        if args.get("parent_id"):
+            sources.append(args["parent_id"])
+        sources.extend(args.get("flow_from") or [])
+        for src_id in sources:
+            src = span_index.get(src_id)
+            if src is None or src["tid"] == ch["tid"]:
+                continue  # same-lane nesting needs no arrow
+            flow_n += 1
+            fid = f"flow{flow_n}"
+            trace_events.append({
+                "name": "handoff", "ph": "s", "cat": "flow", "id": fid,
+                "pid": 0, "tid": src["tid"],
+                "ts": src["ts"] + src["dur"] * 0.5,
+            })
+            trace_events.append({
+                "name": "handoff", "ph": "f", "bp": "e", "cat": "flow",
+                "id": fid, "pid": 0, "tid": ch["tid"], "ts": ch["ts"],
+            })
     return {"traceEvents": trace_events, "displayTimeUnit": "ms"}
 
 
-def save_chrome_trace(path: str, events: List[Dict]) -> str:
+def save_chrome_trace(path: str, events: List[Dict],
+                      thread_names: Optional[Dict[int, str]] = None) -> str:
     with open(path, "w") as f:
-        json.dump(to_chrome_trace(events), f)
+        json.dump(to_chrome_trace(events, thread_names), f)
     return path

@@ -417,10 +417,16 @@ def test_http_endpoints(static_pred):
         status, body = _http(conn, "GET", "/metrics")
         text = body.decode()
         assert status == 200
-        assert "paddle_serving_requests_total 1" in text
-        assert "paddle_serving_responses_total 1" in text
-        assert 'paddle_serving_latency_ms{quantile="0.5"}' in text
+        # /metrics now serves the UNIFIED observability registry:
+        # this engine's series are labeled with its registry id, and
+        # other subsystems' families ride in the same scrape
+        eid = eng.metrics._obs_id
+        assert f'paddle_serving_requests_total{{engine="{eid}"}} 1' in text
+        assert f'paddle_serving_responses_total{{engine="{eid}"}} 1' in text
+        assert f'paddle_serving_latency_ms_p50{{engine="{eid}"}}' in text
         assert "paddle_serving_predictor_runs" in text
+        assert "paddle_dispatch_jit_compiles" in text
+        assert "paddle_executor_bound_hits" in text
 
         status, _ = _http(conn, "POST", "/v1/predict", raw_body=b"not json")
         assert status == 400

@@ -14,6 +14,7 @@ MODULES = [
     "paddle_tpu",
     "paddle_tpu.serving",
     "paddle_tpu.resilience",
+    "paddle_tpu.observability",
     "paddle_tpu.layers",
     "paddle_tpu.optimizer",
     "paddle_tpu.nets",
