@@ -69,6 +69,7 @@ from . import debugger
 from . import flags
 from . import analysis  # static Program-IR verifier / lint (proglint)
 from . import serving  # dynamic-batching inference serving (engine/server)
+from . import generation  # paged KV-cache + continuous-batching decode
 from . import resilience  # fault-tolerant training supervisor (chaos-tested)
 from . import observability  # unified telemetry: metrics/tracing/flight
 
@@ -114,6 +115,7 @@ __all__ = [
     "DataLoader",
     "analysis",
     "serving",
+    "generation",
     "resilience",
     "observability",
 ]

@@ -597,9 +597,44 @@ class Executor:
             self._force_donation,
         )
 
+    def bind(self, program, feed, fetch_list, scope=None, tag=None):
+        """Resolve (compiling if needed, running nothing) the
+        ``runtime.dispatch.BoundStep`` for this exact (program, feed
+        signature, fetch list, scope) and return it. A caller looping
+        a fixed-shape step — the generation engine's per-token decode
+        — holds the bound step directly and pays neither the bound-key
+        assembly nor the dict probe ``Executor.run`` does per call.
+
+        ``feed`` supplies example arrays (shapes/dtypes are what bind;
+        values are never executed here). ``tag`` labels the compiled
+        block for trace spans / compile events — only meaningful for
+        programs not shared with other call sites, since the compiled
+        block (and its tag) is shared by content fingerprint."""
+        scope = scope or global_scope()
+        feed = dict(feed)
+        fetch_list = list(fetch_list)
+        bkey = self._bound_key(program, feed, fetch_list, scope)
+        bound = self._bound.get(bkey) if bkey is not None else None
+        if bound is None:
+            self._stats["bound_misses"] += 1
+            bound = self._resolve_bound(
+                program, feed, fetch_list, scope, True, bkey)
+        else:
+            self._stats["bound_hits"] += 1
+        if tag is not None:
+            bound.compiled.tag = tag
+        return bound
+
     def _run_slow(
         self, program, feed, fetch_list, scope, return_numpy,
         use_program_cache, bkey,
+    ):
+        bound = self._resolve_bound(
+            program, feed, fetch_list, scope, use_program_cache, bkey)
+        return bound.run(feed, return_numpy)
+
+    def _resolve_bound(
+        self, program, feed, fetch_list, scope, use_program_cache, bkey,
     ):
         from ..runtime import dispatch as _dispatch
 
@@ -717,7 +752,7 @@ class Executor:
             self._bound[bkey] = bound
             while len(self._bound) > self._bound_cap:
                 self._bound.popitem(last=False)
-        return bound.run(feed, return_numpy)
+        return bound
 
     # -- internals ------------------------------------------------------------
     def _base_key(self, seed: int):

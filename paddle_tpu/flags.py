@@ -42,6 +42,22 @@ _FLAG_DEFS: Dict[str, Any] = {
     "serving_batch_timeout_ms": 5.0,
     "serving_queue_capacity": 256,
     "serving_num_workers": 2,
+    # generation/engine.py defaults (overridable per GenerationEngine):
+    # the paged KV cache preallocates generation_num_pages pages of
+    # generation_page_size token slots per layer; the continuous-
+    # batching decode lane is a FIXED batch of
+    # generation_max_decode_batch sequences (one compiled executable
+    # for the engine's whole life); admission queues up to
+    # generation_queue_capacity requests before Overloaded; prompts
+    # pad up to the generation_prefill_buckets ladder (one prefill
+    # executable per touched bucket); generation_max_new_tokens is the
+    # per-request default stop
+    "generation_page_size": 16,
+    "generation_num_pages": 512,
+    "generation_max_decode_batch": 8,
+    "generation_queue_capacity": 64,
+    "generation_max_new_tokens": 64,
+    "generation_prefill_buckets": "16,32,64,128,256,512",
     # resilience/supervisor.py defaults (overridable per Supervisor /
     # CheckpointPolicy): checkpoint cadence is every-N-steps OR
     # every-T-seconds, whichever fires first (0 disables that trigger);

@@ -1,0 +1,47 @@
+"""paddle_tpu.generation — paged KV-cache + continuous-batching
+autoregressive decode (the stateful LLM serving lane).
+
+The serving subsystem (PR 3) coalesces stateless predict calls; this
+package serves the workload that made TPU serving hard: autoregressive
+decode under heavy concurrent traffic. K/V lives in fixed-size pages
+behind per-sequence block tables (Ragged Paged Attention,
+arXiv:2604.15464); prefill and decode run as separate micro-batch
+lanes; sequences join/leave the running decode batch every step; every
+token streams to its caller the moment it is sampled.
+
+    from paddle_tpu.inference import Config, create_predictor
+    from paddle_tpu import generation
+
+    main, startup, feeds, fetches = generation.build_lm_program(cfg, 64)
+    ...train / load...; fluid.io.save_inference_model(d, ["tokens"],
+                                                      [fetches["logits"]], exe, main)
+    pred = create_predictor(Config(d))
+    eng = generation.GenerationEngine(pred, cfg)     # cfg: GPTConfig
+    for tok in eng.submit([1, 5, 9], max_new_tokens=32, eos_id=2):
+        ...                                          # streamed tokens
+    eng.close(drain=True)
+
+`serving.ServingServer(serve_engine, generation_engine=eng)` exposes
+the streamed `POST /v1/generate` HTTP endpoint. Flags: the
+``generation_*`` family (flags.py). The decode attention kernel is
+``paddle_tpu.kernels.paged_attention`` (Mosaic on TPU, pure-JAX
+reference on CPU CI).
+"""
+
+from .engine import GenerationEngine, GenerationMetrics, GenerationStream
+from .kvcache import PagedKVCache, PagePoolExhausted
+from .model import (CacheGeometry, GPTConfig, build_decode_program,
+                    build_lm_program, build_prefill_program)
+
+__all__ = [
+    "GenerationEngine",
+    "GenerationStream",
+    "GenerationMetrics",
+    "PagedKVCache",
+    "PagePoolExhausted",
+    "CacheGeometry",
+    "GPTConfig",
+    "build_lm_program",
+    "build_prefill_program",
+    "build_decode_program",
+]

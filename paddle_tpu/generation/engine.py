@@ -1,0 +1,782 @@
+"""GenerationEngine: continuous-batching autoregressive decode.
+
+The serving stack (serving/engine.py) coalesces stateless predict
+calls; what it cannot serve is the LLM workload — a request is not one
+forward pass but a *sequence* of hundreds of dependent steps, each
+producing one token. Batching those naively (gang-schedule N requests,
+wait for the longest) wastes the accelerator on every finished-early
+lane; re-running the growing prefix per token (the only thing a
+stateless Predictor can do) wastes O(len) work per token. This engine
+does what modern LLM serving does instead:
+
+* **Paged KV cache** (kvcache.py): each sequence's K/V lives in
+  fixed-size pages behind a block table; join/leave never copies or
+  reallocates.
+* **Two lanes, one loop.** Prefill (the prompt's full forward, batched
+  by seq bucket) and decode (ONE token for every running sequence, a
+  fixed-lane batch) are separate executables; a single step loop
+  interleaves them, so sequences join the running decode batch the
+  step after their prefill and leave the moment they finish — classic
+  continuous batching.
+* **One jitted call per token.** The decode program's batch dim is the
+  fixed lane count, so the whole engine life is ONE executable; the
+  loop holds its ``runtime.dispatch.BoundStep`` (``Executor.bind``)
+  directly — the per-token hot path is a feed-dict assembly and one
+  jitted call, nothing else. Page pools ride feeds/fetches as jax
+  arrays (zero-copy through the dispatch normalizers).
+* **Streaming.** ``submit()`` returns a ``GenerationStream`` —
+  iterate it for tokens as they are sampled (time-to-first-token is a
+  prefill, not a whole generation), or ``result()`` for the full list.
+  Stop conditions: max_new_tokens, EOS, deadline, cancel, drain.
+* **Backpressure + eviction.** A full admission queue (or a prompt
+  that could never fit the pool) raises ``serving.Overloaded`` at
+  submit — BEFORE any prefill work. A pool that runs dry mid-decode
+  evicts the youngest sequence (pages freed, request re-queued for
+  re-prefill of prompt+generated — greedy decode makes the resumed
+  continuation identical), so the oldest work always completes.
+
+The engine runs *over a cloned Predictor*: the clone shares the loaded
+weights (scope) and executor, so generation and plain ``/v1/predict``
+serving coexist on one model instance, and the caller's predictor
+lock is never held by the step loop.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..serving.engine import (DeadlineExceeded, EngineClosed, Overloaded,
+                              RequestCancelled, ServingError)
+from ..serving.metrics import StreamingHistogram
+from .kvcache import PagedKVCache, PagePoolExhausted
+from .model import CacheGeometry, build_decode_program, build_prefill_program
+
+__all__ = ["GenerationEngine", "GenerationStream", "GenerationMetrics"]
+
+_DONE = object()  # stream sentinel
+
+
+class GenerationStream:
+    """Per-request handle: an iterator over tokens as they are
+    sampled, plus future-style ``result()``/``cancel()``. One of
+    ``finish_reason`` in {"eos", "length", "deadline", "cancelled",
+    "closed", "capacity", "error"} is set by the time iteration
+    ends."""
+
+    def __init__(self, engine: "GenerationEngine", on_token=None):
+        self._engine = engine
+        self._q: "collections.deque" = collections.deque()
+        self._cond = threading.Condition()
+        self._done = threading.Event()
+        self._on_token = on_token
+        self._tokens: List[int] = []
+        self.finish_reason: Optional[str] = None
+        self.error: Optional[BaseException] = None
+        self._cancelled = False
+        self.first_token_at: Optional[float] = None
+
+    # -- engine side ---------------------------------------------------------
+    def _push(self, token: int) -> None:
+        if self.first_token_at is None:
+            self.first_token_at = time.monotonic()
+        self._tokens.append(int(token))
+        with self._cond:
+            self._q.append(int(token))
+            self._cond.notify_all()
+        if self._on_token is not None:
+            try:
+                self._on_token(int(token))
+            except Exception:  # noqa: BLE001 — a bad callback is the caller's bug
+                pass
+
+    def _finish(self, reason: str, error: Optional[BaseException] = None):
+        if self._done.is_set():
+            return
+        self.finish_reason = reason
+        self.error = error
+        self._done.set()
+        with self._cond:
+            self._q.append(_DONE)
+            self._cond.notify_all()
+
+    # -- caller side ---------------------------------------------------------
+    def __iter__(self):
+        while True:
+            with self._cond:
+                while not self._q:
+                    self._cond.wait(0.1)
+                item = self._q.popleft()
+            if item is _DONE:
+                if self.error is not None:
+                    raise self.error
+                return
+            yield item
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        """Block until the request finishes; the full generated token
+        list (raises the terminal error for rejected/failed
+        requests)."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(f"generation not finished within {timeout}s")
+        if self.error is not None:
+            raise self.error
+        return list(self._tokens)
+
+    @property
+    def tokens(self) -> List[int]:
+        """Tokens sampled so far (grows while streaming)."""
+        return list(self._tokens)
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def cancel(self) -> bool:
+        """Request cancellation; the step loop retires the sequence at
+        the next step boundary. False if already finished."""
+        if self._done.is_set():
+            return False
+        self._cancelled = True
+        self._engine._kick()
+        return True
+
+
+class _GenRequest:
+    __slots__ = ("prompt", "orig_prompt", "max_new", "eos_id", "deadline",
+                 "stream", "enqueue_t", "slot", "pending", "n_generated",
+                 "ctx", "admit_seq", "last_tok_t")
+
+    def __init__(self, prompt, max_new, eos_id, deadline, stream, ctx):
+        self.prompt = prompt            # context to prefill (grows on resume)
+        self.orig_prompt = prompt       # the caller's prompt, immutable
+        self.max_new = max_new
+        self.eos_id = eos_id
+        self.deadline = deadline        # absolute monotonic or None
+        self.stream = stream
+        self.enqueue_t = time.monotonic()
+        self.slot: Optional[int] = None
+        self.pending: Optional[int] = None   # sampled, K/V not yet cached
+        self.n_generated = 0                 # across evict/resume cycles
+        self.ctx = ctx                       # tracing ctx of the submit span
+        self.admit_seq = 0                   # admission order (evict victim)
+        self.last_tok_t: Optional[float] = None
+
+
+class GenerationMetrics:
+    """Lock-protected counters + streaming histograms for the engine.
+    The ENGINE (which also owns the page-pool stats) self-registers
+    into the PR-5 unified registry via observability.watch_generation,
+    exporting everything here as ``paddle_generation_*{engine=}``
+    series."""
+
+    _COUNTERS = ("requests_total", "responses_total", "rejected_total",
+                 "expired_total", "cancelled_total", "evicted_total",
+                 "prefill_batches_total", "decode_steps_total",
+                 "prefill_tokens_total", "decode_tokens_total",
+                 "prefill_rows_total", "prefill_capacity_rows_total",
+                 "decode_active_lane_steps_total",
+                 "decode_capacity_lane_steps_total")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._c: Dict[str, int] = {k: 0 for k in self._COUNTERS}
+        self.ttft_ms = StreamingHistogram()
+        self.itl_ms = StreamingHistogram()
+        self.decode_step_ms = StreamingHistogram()
+        self.prefill_ms = StreamingHistogram()
+        self.queue_wait_ms = StreamingHistogram()
+        self._queue_depth = 0
+        self._active = 0
+        self._decode_wall_s = 0.0
+
+    def inc(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._c[name] += n
+
+    def observe(self, hist: str, v: float) -> None:
+        with self._lock:
+            getattr(self, hist).record(v)
+
+    def observe_decode_step(self, ms: float, active: int, lanes: int) -> None:
+        with self._lock:
+            self.decode_step_ms.record(ms)
+            self._decode_wall_s += ms / 1e3
+            self._c["decode_steps_total"] += 1
+            self._c["decode_tokens_total"] += active
+            self._c["decode_active_lane_steps_total"] += active
+            self._c["decode_capacity_lane_steps_total"] += lanes
+
+    def set_gauges(self, queue_depth: int, active: int) -> None:
+        with self._lock:
+            self._queue_depth = queue_depth
+            self._active = active
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            out: Dict[str, Any] = dict(self._c)
+            out["queue_depth"] = self._queue_depth
+            out["active_seqs"] = self._active
+            out["ttft_ms"] = self.ttft_ms.snapshot()
+            out["itl_ms"] = self.itl_ms.snapshot()
+            out["decode_step_ms"] = self.decode_step_ms.snapshot()
+            out["prefill_ms"] = self.prefill_ms.snapshot()
+            out["queue_wait_ms"] = self.queue_wait_ms.snapshot()
+            cap = self._c["decode_capacity_lane_steps_total"]
+            out["decode_occupancy"] = (
+                round(self._c["decode_active_lane_steps_total"] / cap, 4)
+                if cap else 0.0)
+            pcap = self._c["prefill_capacity_rows_total"]
+            out["prefill_occupancy"] = (
+                round(self._c["prefill_rows_total"] / pcap, 4)
+                if pcap else 0.0)
+            out["decode_tokens_per_s"] = (
+                round(self._c["decode_tokens_total"] / self._decode_wall_s, 2)
+                if self._decode_wall_s > 0 else 0.0)
+            return out
+
+
+class GenerationEngine:
+    """Continuous-batching autoregressive decode over a cloned
+    Predictor's weights.
+
+        pred = create_predictor(Config(lm_model_dir))
+        eng = generation.GenerationEngine(pred, cfg)   # cfg: GPTConfig
+        stream = eng.submit([1, 5, 9], max_new_tokens=32, eos_id=2)
+        for tok in stream: ...                         # tokens as sampled
+        eng.generate([1, 5, 9])                        # sync helper
+        eng.close(drain=True)
+
+    ``serving.ServingServer(engine, generation_engine=eng)`` adds the
+    streamed ``POST /v1/generate`` HTTP endpoint on top.
+    """
+
+    def __init__(self, predictor, config, *,
+                 page_size: Optional[int] = None,
+                 num_pages: Optional[int] = None,
+                 max_decode_batch: Optional[int] = None,
+                 queue_capacity: Optional[int] = None,
+                 prefill_buckets: Optional[Sequence[int]] = None,
+                 eos_id: Optional[int] = None,
+                 dtype: str = "float32",
+                 warmup: bool = False, start: bool = True):
+        from ..flags import flag
+
+        self.config = config
+        # the clone shares scope + executor + compiled executables with
+        # the caller's predictor but owns its own lock/IO handles — the
+        # step loop never contends with concurrent predictor.run users
+        self._pred = predictor.clone()
+        self._exe = self._pred._exe
+        self._scope = self._pred._scope
+        self.page_size = int(page_size or flag("generation_page_size"))
+        self.num_pages = int(num_pages or flag("generation_num_pages"))
+        self.lanes = int(max_decode_batch
+                         or flag("generation_max_decode_batch"))
+        self.queue_capacity = int(queue_capacity
+                                  or flag("generation_queue_capacity"))
+        self.default_max_new = int(flag("generation_max_new_tokens"))
+        self.default_eos = eos_id
+        if prefill_buckets is None:
+            prefill_buckets = tuple(
+                int(x) for x in
+                str(flag("generation_prefill_buckets")).split(",") if x)
+        max_seq = int(config.max_position)
+        self._seq_buckets = tuple(sorted(
+            {min(b, max_seq) for b in prefill_buckets} | {max_seq}))
+        maxp = -(-max_seq // self.page_size)
+        self.geom = CacheGeometry(num_pages=self.num_pages,
+                                  page_size=self.page_size,
+                                  max_pages_per_seq=maxp)
+        self.cache = PagedKVCache(
+            config.num_layers, config.num_heads,
+            config.hidden_size // config.num_heads,
+            num_pages=self.num_pages, page_size=self.page_size,
+            max_seqs=self.lanes, max_pages_per_seq=maxp, dtype=dtype)
+        self.metrics = GenerationMetrics()
+        # unified telemetry: this engine's counters + page-pool stats
+        # join the scrape as paddle_generation_*{engine=} series
+        from ..observability import watch_generation
+
+        watch_generation(self)
+
+        self._decode_prog, self._decode_fetches = build_decode_program(
+            config, self.geom)
+        self._decode_bound = None       # resolved on first decode step
+        self._prefill_progs: Dict[int, Any] = {}    # seq bucket -> (prog, fetches)
+
+        self._cond = threading.Condition()
+        self._queue: "collections.deque[_GenRequest]" = collections.deque()
+        self._by_slot: Dict[int, _GenRequest] = {}
+        self._admit_counter = 0
+        self._closed = False
+        self._stop = False
+        self._loop_thread: Optional[threading.Thread] = None
+        self._started = False
+        if warmup:
+            self._warmup()
+        if start:
+            self.start()
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "GenerationEngine":
+        with self._cond:
+            if self._started:
+                return self
+            if self._closed:
+                raise EngineClosed("generation engine already closed")
+            self._started = True
+        self._loop_thread = threading.Thread(
+            target=self._loop, name="pt-generation-loop", daemon=True)
+        self._loop_thread.start()
+        return self
+
+    def close(self, drain: bool = True, timeout: Optional[float] = 60.0):
+        """Stop admission. ``drain=True`` (the PR-3 serving contract)
+        serves everything already submitted — running sequences AND
+        queued requests — to their stop conditions, then exits;
+        ``drain=False`` retires everything immediately."""
+        with self._cond:
+            already = self._closed and self._stop
+            self._closed = True
+            if not drain:
+                self._stop = True
+            self._cond.notify_all()
+        if already:
+            return
+        if self._started:
+            self._loop_thread.join(timeout)
+        else:
+            self._fail_queued(EngineClosed("engine closed before start()"))
+
+    def __enter__(self) -> "GenerationEngine":
+        return self
+
+    def __exit__(self, *exc):
+        self.close(drain=exc[0] is None)
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def _kick(self):
+        with self._cond:
+            self._cond.notify_all()
+
+    # -- submission ----------------------------------------------------------
+    def submit(self, prompt, max_new_tokens: Optional[int] = None,
+               eos_id: Optional[int] = "default",  # type: ignore[assignment]
+               deadline_ms: Optional[float] = None,
+               on_token=None) -> GenerationStream:
+        """Admit one prompt (1-D int sequence). Raises ``Overloaded``
+        when the admission queue is full OR when the prompt + budget
+        could never fit the page pool — both BEFORE any prefill
+        work; raises ``EngineClosed`` after close()."""
+        from ..observability import tracing
+
+        prompt = np.asarray(prompt, dtype=np.int64).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("prompt must hold at least one token")
+        max_new = int(max_new_tokens if max_new_tokens is not None
+                      else self.default_max_new)
+        if max_new < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        eos = self.default_eos if eos_id == "default" else eos_id
+        total = int(prompt.size) + max_new
+        if total > self.config.max_position:
+            raise ValueError(
+                f"prompt ({prompt.size}) + max_new_tokens ({max_new}) "
+                f"exceeds max_position {self.config.max_position}")
+        if not self.cache.can_fit_ever(total):
+            # exhaustion surfaces at ADMISSION, not three layers into a
+            # prefill: this request can never be served by this pool
+            self.metrics.inc("rejected_total")
+            raise Overloaded(
+                f"request needs {self.cache.pages_needed(total)} pages; "
+                f"pool holds {self.cache.usable_pages} "
+                f"(generation_num_pages x generation_page_size)")
+        deadline = (time.monotonic() + deadline_ms / 1e3
+                    if deadline_ms is not None else None)
+        stream = GenerationStream(self, on_token=on_token)
+        with (tracing.span("generation/submit", {"prompt": int(prompt.size),
+                                                 "max_new": max_new})
+              if tracing.enabled() else contextlib.nullcontext()) as ctx:
+            req = _GenRequest(prompt, max_new, eos, deadline, stream, ctx)
+            with self._cond:
+                if self._closed:
+                    raise EngineClosed("GenerationEngine is closed")
+                if len(self._queue) >= self.queue_capacity:
+                    self.metrics.inc("rejected_total")
+                    raise Overloaded(
+                        f"generation queue full ({self.queue_capacity} "
+                        "pending); retry with backoff or raise "
+                        "generation_queue_capacity")
+                self._queue.append(req)
+                self.metrics.inc("requests_total")
+                self._cond.notify_all()
+        return stream
+
+    def generate(self, prompt, max_new_tokens: Optional[int] = None,
+                 eos_id="default", deadline_ms: Optional[float] = None,
+                 timeout: Optional[float] = None) -> List[int]:
+        """Synchronous submit + result."""
+        return self.submit(prompt, max_new_tokens, eos_id,
+                           deadline_ms).result(timeout)
+
+    # -- introspection -------------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        out = self.metrics.snapshot()
+        out["cache"] = self.cache.stats()
+        return out
+
+    def stats_numeric(self) -> Dict[str, Any]:
+        """The registry collector's view (nested histograms flatten in
+        the registry; this just merges cache stats in)."""
+        return self.stats()
+
+    # -- the step loop -------------------------------------------------------
+    def _loop(self):
+        try:
+            while True:
+                with self._cond:
+                    while (not self._queue and not self._by_slot
+                           and not self._stop and not self._closed):
+                        self._cond.wait(0.05)
+                    if self._stop or (self._closed and not self._queue
+                                      and not self._by_slot):
+                        break
+                self._admit_and_prefill()
+                if self._by_slot:
+                    self._decode_step()
+                self.metrics.set_gauges(len(self._queue), len(self._by_slot))
+        finally:
+            # loop exit — normal drain leaves nothing live; anything
+            # still here (hard close, or the loop thread dying on an
+            # unexpected exception) must fail loudly, and the engine
+            # must reject future submits instead of queueing requests
+            # nobody will ever serve
+            with self._cond:
+                self._closed = True
+            self._fail_queued(EngineClosed(
+                "engine closed before the request was served"))
+            for slot, req in list(self._by_slot.items()):
+                self.cache.release(slot)
+                req.stream._finish("closed", EngineClosed(
+                    "engine closed mid-generation"))
+            self._by_slot.clear()
+            self.metrics.set_gauges(0, 0)
+
+    def _fail_queued(self, err: BaseException):
+        with self._cond:
+            while self._queue:
+                req = self._queue.popleft()
+                req.stream._finish("closed", err)
+
+    # -- admission + prefill lane -------------------------------------------
+    def _seq_bucket(self, n: int) -> int:
+        for b in self._seq_buckets:
+            if n <= b:
+                return b
+        return self._seq_buckets[-1]
+
+    def _pop_admissible(self) -> List[_GenRequest]:
+        """FIFO admission: take queue-head requests while a slot AND
+        pages for the whole prompt window are available (head-of-line
+        blocking is deliberate — pool pressure must never starve the
+        oldest request). Expired/cancelled requests drop here."""
+        admitted: List[_GenRequest] = []
+        now = time.monotonic()
+        with self._cond:
+            while self._queue:
+                req = self._queue[0]
+                if req.stream._cancelled:
+                    self._queue.popleft()
+                    self.metrics.inc("cancelled_total")
+                    req.stream._finish("cancelled", RequestCancelled(
+                        "cancelled while queued"))
+                    continue
+                if req.deadline is not None and now > req.deadline:
+                    self._queue.popleft()
+                    self.metrics.inc("expired_total")
+                    req.stream._finish("deadline", DeadlineExceeded(
+                        f"deadline passed after "
+                        f"{(now - req.enqueue_t) * 1e3:.1f}ms in queue"))
+                    continue
+                # allocate_slot marks slot + pages taken immediately,
+                # so these checks already see earlier admissions
+                if (self.cache.free_slots() <= 0
+                        or not self.cache.can_allocate(int(req.prompt.size))):
+                    break
+                admitted.append(self._queue.popleft())
+                req.slot = self.cache.allocate_slot(int(req.prompt.size))
+                if req.admit_seq == 0:
+                    # first admission only: an evicted-and-resumed
+                    # request keeps its original seniority, otherwise
+                    # it would rank as the youngest and be the next
+                    # eviction victim — thrashing the exact sequence
+                    # the evict-youngest policy promises to finish
+                    self._admit_counter += 1
+                    req.admit_seq = self._admit_counter
+                self.metrics.observe(
+                    "queue_wait_ms", (now - req.enqueue_t) * 1e3)
+        return admitted
+
+    def _admit_and_prefill(self):
+        admitted = self._pop_admissible()
+        if not admitted:
+            return
+        # group by seq bucket; each group is one prefill executable run
+        groups: Dict[int, List[_GenRequest]] = {}
+        for req in admitted:
+            groups.setdefault(self._seq_bucket(int(req.prompt.size)),
+                              []).append(req)
+        for bucket, reqs in sorted(groups.items()):
+            self._prefill(bucket, reqs)
+
+    def _prefill_prog(self, bucket: int):
+        entry = self._prefill_progs.get(bucket)
+        if entry is None:
+            entry = build_prefill_program(self.config, bucket, self.geom)
+            self._prefill_progs[bucket] = entry
+        return entry
+
+    def _prefill(self, bucket: int, reqs: List[_GenRequest]):
+        from ..observability import tracing
+
+        t0 = time.monotonic()
+        prog, fetches = self._prefill_prog(bucket)
+        # FIXED prefill batch (the lane count): exactly ONE executable
+        # per seq bucket for the engine's whole life — a variable batch
+        # dim would mint an executable per (bucket, batch) pair and pay
+        # XLA compiles mid-traffic (the padding rows are junk-routed
+        # and nearly free; the compile stall is not)
+        B = self.lanes
+        L = self.config.num_layers
+        tokens = np.zeros((B, bucket), np.int64)
+        num_valid = np.zeros(B, np.int32)
+        last_index = np.zeros(B, np.int64)
+        tables = np.zeros((B, self.geom.max_pages_per_seq), np.int32)
+        for i, req in enumerate(reqs):
+            n = int(req.prompt.size)
+            tokens[i, :n] = req.prompt
+            num_valid[i] = n
+            last_index[i] = n - 1
+            tables[i] = self.cache.block_tables[req.slot]
+        feed = {
+            "gen_tokens": tokens,
+            "gen_positions": np.zeros(B, np.int64),
+            "gen_num_valid": num_valid,
+            "gen_last_index": last_index,
+            "gen_block_tables": tables,
+        }
+        for li in range(L):
+            feed[f"gen_k_pages_{li}"] = self.cache.k_pages[li]
+            feed[f"gen_v_pages_{li}"] = self.cache.v_pages[li]
+        span_cm = contextlib.nullcontext()
+        if tracing.enabled():
+            flow = [r.ctx.span_id for r in reqs[1:] if r.ctx is not None]
+            span_cm = tracing.span(
+                f"generation/prefill[n={len(reqs)}]",
+                {"bucket": bucket, "rows": int(num_valid.sum()),
+                 **({"flow_from": flow} if flow else {})},
+                parent=reqs[0].ctx)
+        try:
+            with span_cm:
+                outs = self._exe.run(prog, feed=feed, fetch_list=fetches,
+                                     scope=self._scope, return_numpy=False)
+        except Exception as e:  # noqa: BLE001 — a bad prompt batch must not kill the loop
+            for req in reqs:
+                self.cache.release(req.slot)
+                req.stream._finish("error", ServingError(
+                    f"prefill execution failed: {e!r}"))
+            return
+        next_tok = np.asarray(outs[0]).reshape(-1)
+        self.cache.set_buffers(list(outs[1:1 + L]), list(outs[1 + L:]))
+        now = time.monotonic()
+        self.metrics.inc("prefill_batches_total")
+        self.metrics.inc("prefill_tokens_total", int(num_valid.sum()))
+        self.metrics.inc("prefill_rows_total", len(reqs))
+        self.metrics.inc("prefill_capacity_rows_total", B)
+        self.metrics.observe("prefill_ms", (now - t0) * 1e3)
+        for i, req in enumerate(reqs):
+            self.cache.lengths[req.slot] = int(req.prompt.size)
+            self._by_slot[req.slot] = req
+            self._emit(req, int(next_tok[i]), now)
+
+    # -- decode lane ---------------------------------------------------------
+    def _bind_decode(self, feed):
+        if self._decode_bound is None:
+            self._decode_bound = self._exe.bind(
+                self._decode_prog, feed, self._decode_fetches,
+                scope=self._scope, tag="generation/decode")
+        return self._decode_bound
+
+    def _make_room(self, slot: int) -> bool:
+        """The pool is dry and `slot` needs one more page: evict the
+        YOUNGEST other sequence (its request re-queues at the queue
+        head; greedy decode resumes identically after re-prefill).
+        Returns False when slot is alone and simply cannot grow — the
+        engine finishes it early ("capacity")."""
+        victims = sorted(
+            (r for s, r in self._by_slot.items() if s != slot),
+            key=lambda r: -r.admit_seq)
+        if not victims:
+            return False
+        victim = victims[0]
+        vslot = victim.slot
+        del self._by_slot[vslot]
+        self.cache.evict(vslot)
+        self.metrics.inc("evicted_total")
+        # resume context = the caller's prompt + every token emitted so
+        # far (the evicted cache held all but the pending one; the
+        # re-prefill recomputes the lot and samples the NEXT token, so
+        # nothing is re-emitted and nothing is skipped)
+        victim.prompt = np.concatenate(
+            [victim.orig_prompt,
+             np.asarray(victim.stream._tokens, np.int64)])
+        victim.slot = None
+        victim.pending = None
+        with self._cond:
+            self._queue.appendleft(victim)
+            self._cond.notify_all()
+        return True
+
+    def _decode_step(self):
+        from ..observability import tracing
+
+        Bd, L = self.lanes, self.config.num_layers
+        now = time.monotonic()
+        # retire cancelled/expired before spending a step on them
+        for slot, req in list(self._by_slot.items()):
+            if req.stream._cancelled:
+                self._retire(slot, "cancelled")
+                self.metrics.inc("cancelled_total")
+            elif req.deadline is not None and now > req.deadline:
+                self._retire(slot, "deadline")
+                self.metrics.inc("expired_total")
+        if not self._by_slot:
+            return
+        # grow page chains for the rows about to be written; evict on
+        # exhaustion (youngest first), finish early when truly stuck
+        for slot, req in list(self._by_slot.items()):
+            if slot not in self._by_slot:   # evicted by an earlier row
+                continue
+            while True:
+                try:
+                    self.cache.ensure_capacity(
+                        slot, int(self.cache.lengths[slot]) + 1)
+                    break
+                except PagePoolExhausted:
+                    if not self._make_room(slot):
+                        self._retire(slot, "capacity")
+                        break
+        if not self._by_slot:
+            return
+        tokens = np.zeros((Bd, 1), np.int64)
+        positions = np.zeros(Bd, np.int64)
+        num_valid = np.zeros(Bd, np.int32)
+        attend = np.ones(Bd, np.int32)   # idle lanes read 1 junk slot
+        for slot, req in self._by_slot.items():
+            tokens[slot, 0] = req.pending
+            positions[slot] = int(self.cache.lengths[slot])
+            num_valid[slot] = 1
+            attend[slot] = int(self.cache.lengths[slot]) + 1
+        feed = {
+            "gen_tokens": tokens,
+            "gen_positions": positions,
+            "gen_num_valid": num_valid,
+            "gen_attend_lens": attend,
+            "gen_block_tables": np.ascontiguousarray(
+                self.cache.block_tables),
+        }
+        for li in range(L):
+            feed[f"gen_k_pages_{li}"] = self.cache.k_pages[li]
+            feed[f"gen_v_pages_{li}"] = self.cache.v_pages[li]
+        bound = self._bind_decode(feed)
+        active = list(self._by_slot.items())
+        bound.rows_hint = len(active)
+        span_cm = contextlib.nullcontext()
+        if tracing.enabled():
+            flow = [r.ctx.span_id for _, r in active if r.ctx is not None]
+            span_cm = tracing.span(
+                f"generation/decode_step[n={len(active)}]",
+                {"lanes": Bd, **({"flow_from": flow} if flow else {})})
+        t0 = time.monotonic()
+        try:
+            with span_cm:
+                outs = bound.run(feed, False)
+        except Exception as e:  # noqa: BLE001
+            for slot, req in active:
+                self._retire(slot, "error", ServingError(
+                    f"decode execution failed: {e!r}"))
+            return
+        next_tok = np.asarray(outs[0]).reshape(-1)
+        self.cache.set_buffers(list(outs[1:1 + L]), list(outs[1 + L:]))
+        now = time.monotonic()
+        self.metrics.observe_decode_step((now - t0) * 1e3, len(active), Bd)
+        for slot, req in active:
+            self.cache.advance(slot)    # pending's K/V is cached now
+            self._emit(req, int(next_tok[slot]), now)
+
+    # -- token emission + retirement ----------------------------------------
+    def _emit(self, req: _GenRequest, token: int, now: float):
+        """A token was just sampled for req: stream it, update timing
+        metrics, apply stop conditions, otherwise leave it pending for
+        the next decode step."""
+        first = req.stream.first_token_at is None
+        if req.last_tok_t is not None:
+            self.metrics.observe("itl_ms", (now - req.last_tok_t) * 1e3)
+        req.stream._push(token)
+        req.last_tok_t = now
+        if first:
+            self.metrics.observe(
+                "ttft_ms", (now - req.enqueue_t) * 1e3)
+        req.pending = token
+        req.n_generated += 1
+        if req.eos_id is not None and token == req.eos_id:
+            self._retire(req.slot, "eos")
+        elif req.n_generated >= req.max_new:
+            self._retire(req.slot, "length")
+        elif (int(self.cache.lengths[req.slot]) + 1
+                >= self.config.max_position):
+            self._retire(req.slot, "length")
+        elif req.deadline is not None and now > req.deadline:
+            self._retire(req.slot, "deadline")
+            self.metrics.inc("expired_total")
+
+    def _retire(self, slot: int, reason: str,
+                error: Optional[BaseException] = None):
+        req = self._by_slot.pop(slot, None)
+        self.cache.release(slot)
+        if req is not None:
+            if error is None and reason in ("eos", "length", "capacity"):
+                self.metrics.inc("responses_total")
+            req.slot = None
+            req.stream._finish(reason, error)
+
+    # -- warmup --------------------------------------------------------------
+    def _warmup(self):
+        """Compile EVERY prefill-bucket executable plus the decode
+        executable before serving traffic, so no request ever pays an
+        XLA compile mid-generation (the first prefill of a cold bucket
+        would otherwise stall every running sequence's next token)."""
+        for bucket in self._seq_buckets:
+            slot = self.cache.allocate_slot(2)
+            try:
+                req = _GenRequest(np.asarray([0, 0], np.int64), 2, None,
+                                  None, GenerationStream(self), None)
+                req.slot = slot
+                self._prefill(bucket, [req])   # compiles this bucket
+                if slot in self._by_slot:
+                    self._decode_step()        # compiles + binds decode
+            finally:
+                if slot in self._by_slot:
+                    self._retire(slot, "length")
+                elif self.cache.is_active(slot):
+                    self.cache.release(slot)
+        # warmup traffic must not pollute the serving metrics
+        self.metrics.__init__()

@@ -1,0 +1,257 @@
+"""PagedKVCache: the page pool + block tables behind continuous
+batching.
+
+Design (Ragged Paged Attention, arXiv:2604.15464): K/V live in
+fixed-size pages inside ONE preallocated device buffer per layer;
+each sequence owns a block table (ordered list of page ids) and a true
+length. Growing a sequence by one token never reallocates — at worst
+it pops one page off the free list. Completion returns the pages in
+O(pages). The pool is sized once (``num_pages * page_size`` token
+slots) so device memory is a configuration decision, not a runtime
+surprise — exactly the property serving under heavy traffic needs.
+
+This class is the HOST-side manager: block tables, lengths, the free
+list, slot assignment, admission accounting. The device-side page
+buffers (jax arrays, [num_kv_heads, num_pages, page_size, head_dim]
+per layer) are held here too, but they are only ever *mutated* inside
+the compiled prefill/decode steps (kernels/paged_attention.py
+``kv_cache_write``) — the engine fetches the functionally-updated
+pools and swaps them back via ``set_buffers``. All bookkeeping methods
+are called from the engine's single step loop; the lock only protects
+the metric-reader path (``stats()`` from a scrape thread).
+
+Page 0 is permanently reserved as the JUNK page: idle decode lanes and
+batch-padding rows point their tables at it, so their (discarded)
+writes can never corrupt a live sequence.
+
+Exhaustion is backpressure, not corruption: ``allocate_slot`` /
+``ensure_capacity`` raise ``PagePoolExhausted``; the engine responds
+by delaying admission (queued requests wait for pages) or by evicting
+a victim sequence (whose request is re-queued for re-prefill — greedy
+decode makes the recomputed continuation identical).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["PagedKVCache", "PagePoolExhausted"]
+
+
+class PagePoolExhausted(RuntimeError):
+    """No free pages for the requested growth — admission backpressure
+    (or eviction) must resolve it; never an allocation."""
+
+
+class PagedKVCache:
+    def __init__(self, num_layers: int, num_kv_heads: int, head_dim: int, *,
+                 num_pages: int, page_size: int, max_seqs: int,
+                 max_pages_per_seq: int, dtype: str = "float32"):
+        if num_pages < 2:
+            raise ValueError("num_pages must be >= 2 (page 0 is reserved)")
+        if page_size < 1 or max_seqs < 1 or max_pages_per_seq < 1:
+            raise ValueError("page_size/max_seqs/max_pages_per_seq >= 1")
+        self.num_layers = int(num_layers)
+        self.num_kv_heads = int(num_kv_heads)
+        self.head_dim = int(head_dim)
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        self.max_seqs = int(max_seqs)
+        self.max_pages_per_seq = int(max_pages_per_seq)
+        self.dtype = dtype
+        self._lock = threading.Lock()
+        # device pools, one K + one V per layer (lazy: first access
+        # allocates, so constructing a cache in a test costs nothing)
+        self._k_pages: Optional[List[Any]] = None
+        self._v_pages: Optional[List[Any]] = None
+        # host bookkeeping
+        self.block_tables = np.zeros((max_seqs, max_pages_per_seq), np.int32)
+        self.lengths = np.zeros(max_seqs, np.int32)
+        self._pages_of: List[List[int]] = [[] for _ in range(max_seqs)]
+        self._active = [False] * max_seqs
+        # page 0 = junk page, never on the free list
+        self._free = list(range(num_pages - 1, 0, -1))
+        self.evictions_total = 0
+        self.allocations_total = 0
+
+    # -- device buffers ------------------------------------------------------
+    def _ensure_buffers(self):
+        if self._k_pages is None:
+            import jax.numpy as jnp
+
+            shape = (self.num_kv_heads, self.num_pages, self.page_size,
+                     self.head_dim)
+            self._k_pages = [jnp.zeros(shape, self.dtype)
+                             for _ in range(self.num_layers)]
+            self._v_pages = [jnp.zeros(shape, self.dtype)
+                             for _ in range(self.num_layers)]
+
+    @property
+    def k_pages(self) -> List[Any]:
+        self._ensure_buffers()
+        return self._k_pages
+
+    @property
+    def v_pages(self) -> List[Any]:
+        self._ensure_buffers()
+        return self._v_pages
+
+    def set_buffers(self, k_pages: List[Any], v_pages: List[Any]) -> None:
+        """Swap in the functionally-updated pools fetched from a
+        prefill/decode step."""
+        if len(k_pages) != self.num_layers or len(v_pages) != self.num_layers:
+            raise ValueError("set_buffers: wrong layer count")
+        self._k_pages = list(k_pages)
+        self._v_pages = list(v_pages)
+
+    # -- capacity accounting -------------------------------------------------
+    def pages_needed(self, n_tokens: int) -> int:
+        return -(-max(int(n_tokens), 0) // self.page_size)
+
+    @property
+    def usable_pages(self) -> int:
+        """Pool capacity available to sequences (junk page excluded)."""
+        return self.num_pages - 1
+
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    def can_fit_ever(self, n_tokens: int) -> bool:
+        """Could a sequence of n_tokens EVER be served by this pool —
+        the admission-time sanity check (Overloaded before prefill)."""
+        need = self.pages_needed(n_tokens)
+        return (need <= self.usable_pages
+                and need <= self.max_pages_per_seq
+                and n_tokens <= self.max_pages_per_seq * self.page_size)
+
+    def can_allocate(self, n_tokens: int) -> bool:
+        return self.pages_needed(n_tokens) <= len(self._free)
+
+    def free_slots(self) -> int:
+        return sum(1 for a in self._active if not a)
+
+    # -- sequence lifecycle --------------------------------------------------
+    def allocate_slot(self, n_tokens: int) -> int:
+        """Claim a batch slot + pages for an n_tokens prompt. Returns
+        the slot id; raises PagePoolExhausted when pages or slots are
+        unavailable *right now* (backpressure, not rejection)."""
+        need = self.pages_needed(n_tokens)
+        if need > self.max_pages_per_seq:
+            raise ValueError(
+                f"{n_tokens} tokens need {need} pages > max_pages_per_seq="
+                f"{self.max_pages_per_seq}")
+        with self._lock:
+            slot = next((i for i, a in enumerate(self._active) if not a),
+                        None)
+            if slot is None:
+                raise PagePoolExhausted("no free decode slots")
+            if need > len(self._free):
+                raise PagePoolExhausted(
+                    f"need {need} pages, {len(self._free)} free")
+            pages = [self._free.pop() for _ in range(need)]
+            self._pages_of[slot] = pages
+            row = self.block_tables[slot]
+            row[:] = 0
+            row[:len(pages)] = pages
+            self.lengths[slot] = 0
+            self._active[slot] = True
+            self.allocations_total += need
+            return slot
+
+    def ensure_capacity(self, slot: int, new_len: int) -> None:
+        """Grow slot's page chain to cover new_len tokens; raises
+        PagePoolExhausted when the pool is dry (engine evicts then)."""
+        need = self.pages_needed(new_len)
+        if new_len > self.max_pages_per_seq * self.page_size:
+            raise ValueError(
+                f"sequence of {new_len} tokens exceeds max_pages_per_seq="
+                f"{self.max_pages_per_seq} x page_size={self.page_size}")
+        with self._lock:
+            pages = self._pages_of[slot]
+            while len(pages) < need:
+                if not self._free:
+                    raise PagePoolExhausted(
+                        f"slot {slot} needs page {len(pages)}, pool dry")
+                p = self._free.pop()
+                self.block_tables[slot, len(pages)] = p
+                pages.append(p)
+                self.allocations_total += 1
+
+    def advance(self, slot: int, n: int = 1) -> int:
+        self.lengths[slot] += n
+        return int(self.lengths[slot])
+
+    def release(self, slot: int) -> None:
+        """Sequence done: pages back on the free list, table row back
+        to the junk page, slot reusable."""
+        with self._lock:
+            self._free.extend(self._pages_of[slot])
+            self._pages_of[slot] = []
+            self.block_tables[slot, :] = 0
+            self.lengths[slot] = 0
+            self._active[slot] = False
+
+    def evict(self, slot: int) -> None:
+        """Preemption: identical to release, but counted — the engine
+        re-queues the victim's request for re-prefill."""
+        self.release(slot)
+        with self._lock:
+            self.evictions_total += 1
+
+    def is_active(self, slot: int) -> bool:
+        return self._active[slot]
+
+    def active_slots(self) -> List[int]:
+        return [i for i, a in enumerate(self._active) if a]
+
+    # -- introspection -------------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            in_use = self.usable_pages - len(self._free)
+            return {
+                "pages_total": self.usable_pages,
+                "pages_free": len(self._free),
+                "pages_in_use": in_use,
+                "page_utilization": (round(in_use / self.usable_pages, 4)
+                                     if self.usable_pages else 0.0),
+                "active_seqs": sum(1 for a in self._active if a),
+                "max_seqs": self.max_seqs,
+                "evictions_total": self.evictions_total,
+                "page_allocations_total": self.allocations_total,
+            }
+
+    def check_integrity(self) -> None:
+        """Invariant audit (tests call this after concurrent
+        join/leave churn): every allocated page appears in exactly one
+        chain, free + allocated covers the pool, tables mirror chains."""
+        seen: Dict[int, int] = {}
+        with self._lock:
+            for slot in range(self.max_seqs):
+                pages = self._pages_of[slot]
+                if not self._active[slot] and pages:
+                    raise AssertionError(f"inactive slot {slot} holds pages")
+                for j, p in enumerate(pages):
+                    if p in seen:
+                        raise AssertionError(
+                            f"page {p} in slots {seen[p]} and {slot}")
+                    if p == 0:
+                        raise AssertionError("junk page 0 inside a chain")
+                    seen[p] = slot
+                    if int(self.block_tables[slot, j]) != p:
+                        raise AssertionError(
+                            f"table/chain mismatch at slot {slot} idx {j}")
+                covered = len(pages) * self.page_size
+                if self._active[slot] and int(self.lengths[slot]) > covered:
+                    raise AssertionError(
+                        f"slot {slot} length {self.lengths[slot]} > "
+                        f"allocated {covered}")
+            dup = set(self._free) & set(seen)
+            if dup:
+                raise AssertionError(f"pages both free and allocated: {dup}")
+            if len(self._free) + len(seen) != self.usable_pages:
+                raise AssertionError(
+                    f"page leak: {len(self._free)} free + {len(seen)} "
+                    f"allocated != {self.usable_pages}")

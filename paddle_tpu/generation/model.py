@@ -1,0 +1,247 @@
+"""Program builders for the generation subsystem.
+
+The GenerationEngine drives TWO Program-IR executables against the
+predictor's scope (same parameter names as models/gpt.py, so the
+weights a saved LM was trained/exported with serve both lanes):
+
+* ``build_prefill_program(cfg, seq_len, geom)`` — full causal forward
+  over a [B, S] prompt window (flash attention when the config asks
+  for it), PLUS per-layer ``kv_cache_write`` of the prompt's K/V into
+  the page pool, PLUS in-graph last-token selection and greedy argmax.
+  One executable per (batch-bucket, seq-bucket) pair.
+* ``build_decode_program(cfg, geom)`` — ONE token per sequence: embed,
+  per layer (ln -> fused qkv -> kv_cache_write of the new row ->
+  ``paged_attention`` over the updated pool -> proj/ffn), head matmul,
+  in-graph argmax. The batch dim is the engine's fixed decode-lane
+  count, so the whole continuous-batching life of the engine is ONE
+  compiled executable driven through the PR-2 BoundStep cache.
+
+``build_lm_program(cfg, seq_len)`` is the loss-free LM used to export
+an inference model for the Predictor (build_gpt_lm always wires a CE
+loss, which would drag a labels feed into serving).
+
+Feed-name contract (the engine assembles these every step):
+  gen_tokens       [B, S] / [B, 1] int64
+  gen_positions    [B] int64   absolute position of each new row
+                               (prefill: 0; decode: current length)
+  gen_num_valid    [B] int32   real rows in this window (prefill: the
+                               true prompt length; decode: 1 active /
+                               0 idle lane)
+  gen_attend_lens  [B] int32   decode only: tokens to attend over
+                               (= position + 1)
+  gen_last_index   [B] int64   prefill only: index of the true last
+                               prompt token (length - 1)
+  gen_block_tables [B, max_pages_per_seq] int32
+  gen_k_pages_{l} / gen_v_pages_{l}   the per-layer page pools
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from .. import layers, nets
+from ..core.framework import Program, program_guard, unique_name
+from ..models.gpt import GPTConfig, _attr
+from ..param_attr import ParamAttr
+
+__all__ = ["CacheGeometry", "build_lm_program", "build_prefill_program",
+           "build_decode_program", "GPTConfig"]
+
+
+@dataclasses.dataclass(frozen=True)
+class CacheGeometry:
+    """The page-pool shape both programs compile against."""
+    num_pages: int
+    page_size: int
+    max_pages_per_seq: int
+
+    @property
+    def max_tokens_per_seq(self) -> int:
+        return self.max_pages_per_seq * self.page_size
+
+
+def _page_feeds(cfg: GPTConfig, geom: CacheGeometry):
+    kvh = cfg.num_heads
+    d = cfg.hidden_size // cfg.num_heads
+    shape = [kvh, geom.num_pages, geom.page_size, d]
+    kps = [layers.data(f"gen_k_pages_{i}", shape, append_batch_size=False)
+           for i in range(cfg.num_layers)]
+    vps = [layers.data(f"gen_v_pages_{i}", shape, append_batch_size=False)
+           for i in range(cfg.num_layers)]
+    return kps, vps
+
+
+def _ln(x, name):
+    return layers.layer_norm(
+        x, begin_norm_axis=2,
+        param_attr=ParamAttr(name=f"{name}.scale"),
+        bias_attr=ParamAttr(name=f"{name}.bias"))
+
+
+def _qkv_split(x, cfg: GPTConfig, pre: str):
+    qkv = layers.fc(
+        x, 3 * cfg.hidden_size, num_flatten_dims=2,
+        param_attr=_attr(f"{pre}_qkv.w", cfg.initializer_range),
+        bias_attr=ParamAttr(name=f"{pre}_qkv.b"))
+    return layers.split(qkv, 3, dim=2)
+
+
+def _proj_ffn(x, ctx, cfg: GPTConfig, pre: str):
+    """Post-attention half of the decoder layer (shared verbatim by
+    both lanes so prefill and decode numerics can only diverge in the
+    attention read itself)."""
+    h, std = cfg.hidden_size, cfg.initializer_range
+    proj = layers.fc(
+        ctx, h, num_flatten_dims=2,
+        param_attr=_attr(f"{pre}_proj.w", std),
+        bias_attr=ParamAttr(name=f"{pre}_proj.b"))
+    x = layers.elementwise_add(x, proj)
+    ln2 = _ln(x, f"{pre}_ln2")
+    ffn1 = layers.fc(
+        ln2, cfg.ffn_size, num_flatten_dims=2, act="gelu",
+        param_attr=_attr(f"{pre}_ffn1.w", std),
+        bias_attr=ParamAttr(name=f"{pre}_ffn1.b"))
+    ffn2 = layers.fc(
+        ffn1, h, num_flatten_dims=2,
+        param_attr=_attr(f"{pre}_ffn2.w", std),
+        bias_attr=ParamAttr(name=f"{pre}_ffn2.b"))
+    return layers.elementwise_add(x, ffn2)
+
+
+def _head(x, cfg: GPTConfig):
+    x = _ln(x, "gpt_lnf")
+    return layers.fc(
+        x, cfg.vocab_size, num_flatten_dims=2,
+        param_attr=_attr("gpt_head.w", cfg.initializer_range),
+        bias_attr=ParamAttr(name="gpt_head.b"))
+
+
+def _embed(tokens, cfg: GPTConfig):
+    return layers.embedding(
+        tokens, size=[cfg.vocab_size, cfg.hidden_size],
+        param_attr=_attr("gpt_tok_emb", cfg.initializer_range))
+
+
+def _pos_embed(ids, cfg: GPTConfig):
+    return layers.embedding(
+        ids, size=[cfg.max_position, cfg.hidden_size],
+        param_attr=_attr("gpt_pos_emb", cfg.initializer_range))
+
+
+def build_lm_program(cfg: GPTConfig, seq_len: int):
+    """Loss-free causal LM: tokens [B, S] -> logits [B, S, V]. The
+    exportable inference twin of models/gpt.build_gpt_lm (which always
+    appends a CE loss and therefore a labels feed)."""
+    main, startup = Program(), Program()
+    with program_guard(main, startup), unique_name.guard():
+        tokens = layers.data("tokens", [seq_len], dtype="int64")
+        x = layers.elementwise_add(
+            _embed(tokens, cfg),
+            _pos_embed(layers.assign(
+                np.arange(seq_len, dtype="int64")[None, :]), cfg))
+        for i in range(cfg.num_layers):
+            pre = f"dec{i}"
+            ln1 = _ln(x, f"{pre}_ln1")
+            q, k, v = _qkv_split(ln1, cfg, pre)
+            if cfg.use_flash_attention:
+                from ..kernels import flash_attention_layer
+
+                ctx = flash_attention_layer(q, k, v, cfg.num_heads,
+                                            causal=True)
+            else:
+                ctx = nets.scaled_dot_product_attention(
+                    q, k, v, num_heads=cfg.num_heads, causal=True)
+            x = _proj_ffn(x, ctx, cfg, pre)
+        logits = _head(x, cfg)
+    return main, startup, {"tokens": tokens}, {"logits": logits}
+
+
+def build_prefill_program(cfg: GPTConfig, seq_len: int, geom: CacheGeometry):
+    """Prefill lane: forward the prompt window, write its K/V into the
+    page pool, emit the first greedy token per row — all one
+    executable. Returns (program, fetch_vars) where fetch order is
+    [next_token, k_pages_0.., v_pages_0..]."""
+    main, startup = Program(), Program()
+    with program_guard(main, startup), unique_name.guard():
+        tokens = layers.data("gen_tokens", [seq_len], dtype="int64")
+        positions = layers.data("gen_positions", [], dtype="int64")
+        num_valid = layers.data("gen_num_valid", [], dtype="int32")
+        last_index = layers.data("gen_last_index", [], dtype="int64")
+        tables = layers.data("gen_block_tables", [geom.max_pages_per_seq],
+                             dtype="int32")
+        kps, vps = _page_feeds(cfg, geom)
+        from ..kernels import kv_cache_write_layer
+
+        x = layers.elementwise_add(
+            _embed(tokens, cfg),
+            _pos_embed(layers.assign(
+                np.arange(seq_len, dtype="int64")[None, :]), cfg))
+        out_pages = []
+        for i in range(cfg.num_layers):
+            pre = f"dec{i}"
+            ln1 = _ln(x, f"{pre}_ln1")
+            q, k, v = _qkv_split(ln1, cfg, pre)
+            ko, vo = kv_cache_write_layer(
+                kps[i], vps[i], k, v, tables, positions, num_valid,
+                cfg.num_heads)
+            out_pages.append((ko, vo))
+            if cfg.use_flash_attention:
+                from ..kernels import flash_attention_layer
+
+                ctx = flash_attention_layer(q, k, v, cfg.num_heads,
+                                            causal=True)
+            else:
+                ctx = nets.scaled_dot_product_attention(
+                    q, k, v, num_heads=cfg.num_heads, causal=True)
+            x = _proj_ffn(x, ctx, cfg, pre)
+        logits = _head(x, cfg)                      # [B, S, V]
+        # in-graph last-token selection: one_hot(last_index) row-dots
+        # the logits so the [B, S, V] tensor never leaves the device
+        sel = layers.one_hot(layers.unsqueeze(last_index, [1]), seq_len)
+        last_logits = layers.reduce_sum(
+            layers.elementwise_mul(logits, layers.unsqueeze(sel, [2])),
+            dim=[1])                                # [B, V]
+        next_tok = layers.argmax(last_logits, axis=-1)   # [B]
+    fetches = [next_tok] + [p[0] for p in out_pages] + \
+        [p[1] for p in out_pages]
+    return main, fetches
+
+
+def build_decode_program(cfg: GPTConfig, geom: CacheGeometry):
+    """Decode lane: one new token per sequence through the paged
+    cache. Fetch order matches prefill: [next_token, k_pages..,
+    v_pages..]."""
+    main, startup = Program(), Program()
+    with program_guard(main, startup), unique_name.guard():
+        tokens = layers.data("gen_tokens", [1], dtype="int64")
+        positions = layers.data("gen_positions", [], dtype="int64")
+        num_valid = layers.data("gen_num_valid", [], dtype="int32")
+        attend = layers.data("gen_attend_lens", [], dtype="int32")
+        tables = layers.data("gen_block_tables", [geom.max_pages_per_seq],
+                             dtype="int32")
+        kps, vps = _page_feeds(cfg, geom)
+        from ..kernels import kv_cache_write_layer, paged_attention_layer
+
+        x = layers.elementwise_add(
+            layers.unsqueeze(_embed(tokens, cfg), [1]),
+            layers.unsqueeze(_pos_embed(positions, cfg), [1]))  # [B, 1, H]
+        out_pages = []
+        for i in range(cfg.num_layers):
+            pre = f"dec{i}"
+            ln1 = _ln(x, f"{pre}_ln1")
+            q, k, v = _qkv_split(ln1, cfg, pre)
+            ko, vo = kv_cache_write_layer(
+                kps[i], vps[i], k, v, tables, positions, num_valid,
+                cfg.num_heads)
+            out_pages.append((ko, vo))
+            ctx = paged_attention_layer(q, ko, vo, tables, attend,
+                                        cfg.num_heads)
+            x = _proj_ffn(x, ctx, cfg, pre)
+        logits = _head(x, cfg)                      # [B, 1, V]
+        next_tok = layers.argmax(
+            layers.reshape(logits, [-1, cfg.vocab_size]), axis=-1)  # [B]
+    fetches = [next_tok] + [p[0] for p in out_pages] + \
+        [p[1] for p in out_pages]
+    return main, fetches

@@ -10,6 +10,10 @@ attention/ffn/layer_norm/adam/softmax-ce):
     (kernels/layer_norm.py), wired into the layer_norm lowering
   * fused softmax cross-entropy — loss+lse row kernel, fused backward
     (kernels/softmax_xent.py), wired into softmax_with_cross_entropy
+  * paged attention + kv_cache_write — decode-step attention over
+    paged K/V with block tables (kernels/paged_attention.py, wrapping
+    jax.experimental.pallas.ops.tpu.paged_attention on TPU), the
+    kernel layer under paddle_tpu.generation's continuous batching
   * adam — deliberately NOT a kernel: a pure elementwise chain that
     XLA already fuses into one loop (verified in lowered HLO)
 
@@ -20,4 +24,6 @@ back to the pure-XLA implementation with identical numerics
 
 from .flash_attention import flash_attention, flash_attention_layer
 from .layer_norm import fused_layer_norm, layer_norm_pallas
+from .paged_attention import (kv_cache_write, kv_cache_write_layer,
+                              paged_attention, paged_attention_layer)
 from .softmax_xent import fused_softmax_xent

@@ -1,0 +1,272 @@
+"""Paged-attention decode kernel + KV-page write ops.
+
+The generation subsystem (paddle_tpu/generation/) keeps each
+sequence's K/V in fixed-size pages inside one preallocated pool —
+Ragged Paged Attention (PAPERS.md, arXiv:2604.15464): the decode-side
+attention reads K/V *through a block table* (per-sequence list of page
+ids) and masks by the sequence's true length, so a running batch of
+sequences with wildly different lengths shares one dense executable
+and zero per-step reallocation.
+
+Two ops, both registered in the op registry (proglint PTL030 knows
+them; PTL020-022 re-infer their shapes through the same lowerings):
+
+  paged_attention  Q [B, 1, H*D] x pages -> Out [B, 1, H*D].
+                   On TPU (or PADDLE_TPU_FORCE_PALLAS=1, the AOT-check
+                   path) this wraps jax's Mosaic kernel
+                   ``jax.experimental.pallas.ops.tpu.paged_attention``
+                   (SNIPPETS.md [1] wraps the same entry point);
+                   everywhere else — including the
+                   PADDLE_TPU_KERNEL_INTERPRET CI mode — it runs the
+                   pure-JAX reference below, which is also the
+                   numerics oracle the tests diff against.
+  kv_cache_write   scatter new K/V rows into the page pool at
+                   positions derived from the block table. Covers both
+                   lanes: prefill writes a whole [B, S] prompt window,
+                   decode writes the single new row per sequence.
+                   Rows flagged invalid are routed to the reserved
+                   junk page 0, so inactive decode lanes in the fixed
+                   batch cost a wasted write, never a corrupted page.
+
+The page-pool layout matches the jax kernel exactly:
+k_pages/v_pages [num_kv_heads, total_pages, page_size, head_dim],
+block tables [batch, pages_per_sequence] int32, lengths [batch] int32.
+
+Reference analogue: the reference's decoder stack materializes the
+whole K/V prefix per step (beam_search_decoder re-runs attention over
+a dense cache); pages + block tables are the TPU-native replacement.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_logger = logging.getLogger("paddle_tpu.paged_attention")
+
+NEG_INF = -1e30
+
+
+def _pallas_mode() -> Optional[str]:
+    # same routing contract as the other fused kernels
+    # (flash_attention._pallas_mode): interpret env wins, then real
+    # TPU / forced-Pallas AOT validation, else None -> reference
+    from .flash_attention import _pallas_mode as _fa_mode
+
+    return _fa_mode()
+
+
+def _reference_paged_attention(q, k_pages, v_pages, lengths, page_indices,
+                               sm_scale: float):
+    """Pure-JAX oracle: gather each sequence's pages into a contiguous
+    [maxp * page_size] window, mask by true length, plain softmax
+    attention. O(B * maxp * page_size * D) HBM — fine for CPU CI and
+    the correctness tests, which is its whole job."""
+    B, H, D = q.shape
+    KVH, _P, ps, _ = k_pages.shape
+    maxp = page_indices.shape[1]
+    # [KVH, B, maxp, ps, D] -> [B, KVH, maxp*ps, D]
+    k = jnp.transpose(k_pages[:, page_indices], (1, 0, 2, 3, 4)).reshape(
+        B, KVH, maxp * ps, D)
+    v = jnp.transpose(v_pages[:, page_indices], (1, 0, 2, 3, 4)).reshape(
+        B, KVH, maxp * ps, D)
+    if KVH != H:  # grouped-query: repeat KV heads over the query groups
+        k = jnp.repeat(k, H // KVH, axis=1)
+        v = jnp.repeat(v, H // KVH, axis=1)
+    s = jnp.einsum("bhd,bhkd->bhk", q.astype(jnp.float32) * sm_scale,
+                   k.astype(jnp.float32))
+    valid = jnp.arange(maxp * ps, dtype=jnp.int32)[None, :] \
+        < lengths[:, None]                                   # [B, K]
+    s = jnp.where(valid[:, None, :], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhk,bhkd->bhd", p, v.astype(jnp.float32))
+    # a length-0 row is all-masked (softmax of all -inf = NaN): define
+    # its output as zeros instead of letting NaN escape into the batch
+    o = jnp.where(lengths[:, None, None] > 0, o, 0.0)
+    return o.astype(q.dtype)
+
+
+def _compute_block_pages(pages_per_seq: int) -> int:
+    """Largest divisor of the block-table width that is <= 8 — the
+    jax kernel requires pages_per_compute_block | pages_per_sequence,
+    and small blocks keep the VMEM working set bounded."""
+    for c in (8, 4, 2, 1):
+        if pages_per_seq % c == 0:
+            return c
+    return 1
+
+
+def paged_attention(q, k_pages, v_pages, lengths, page_indices, *,
+                    sm_scale: Optional[float] = None,
+                    pages_per_compute_block: Optional[int] = None):
+    """Decode-step attention over paged K/V.
+
+    q:            [B, num_heads, head_dim] — one query row per sequence
+    k_pages/v_pages: [num_kv_heads, total_pages, page_size, head_dim]
+    lengths:      [B] int32 — tokens to attend over per sequence
+                  (INCLUDING the row just written for this step)
+    page_indices: [B, pages_per_sequence] int32 block tables
+
+    Returns [B, num_heads, head_dim]. The softmax scale (default
+    1/sqrt(head_dim)) is applied to q here — the jax Mosaic kernel
+    expects pre-scaled queries, and both paths must agree so the CPU
+    CI numerics are the TPU numerics.
+    """
+    B, H, D = q.shape
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(D)
+    lengths = lengths.astype(jnp.int32)
+    page_indices = page_indices.astype(jnp.int32)
+    mode = _pallas_mode()
+    if mode == "tpu":
+        try:
+            from jax.experimental.pallas.ops.tpu.paged_attention import (
+                paged_attention as _jax_paged_attention,
+            )
+
+            blk = (pages_per_compute_block
+                   or _compute_block_pages(page_indices.shape[1]))
+            return _jax_paged_attention(
+                (q * scale).astype(q.dtype), k_pages, v_pages,
+                lengths, page_indices,
+                pages_per_compute_block=blk,
+            )
+        except Exception:  # noqa: BLE001 — a kernel regression must be loud
+            import os
+
+            if os.environ.get("PADDLE_TPU_FORCE_PALLAS") == "1":
+                # the AOT-validation path (tools/aot_check.py) exists
+                # to catch exactly this — a silent fallback here would
+                # record ok=true for a kernel that never compiled
+                raise
+            _logger.warning(
+                "paged_attention Mosaic kernel failed; falling back to the "
+                "reference gather implementation", exc_info=True)
+    return _reference_paged_attention(q, k_pages, v_pages, lengths,
+                                      page_indices, scale)
+
+
+def kv_cache_write(k_pages, v_pages, k_new, v_new, page_indices,
+                   positions, num_valid):
+    """Functional scatter of new K/V rows into the page pool.
+
+    k_new/v_new:  [B, S, KVH, D] rows for positions
+                  positions[b] .. positions[b] + S - 1
+    positions:    [B] int32 — each sequence's first absolute slot
+                  (decode: the current length; prefill: 0)
+    num_valid:    [B] int32 — rows of S that are real; the rest (batch
+                  padding, idle decode lanes) are routed to junk page 0
+
+    Returns (k_pages', v_pages'). Pure functional update — on TPU the
+    executor's donation machinery aliases the pool buffers, on CPU XLA
+    copies (the smoke-bench regime, where the pool is small).
+    """
+    B, S, KVH, D = k_new.shape
+    ps = int(k_pages.shape[2])
+    page_indices = page_indices.astype(jnp.int32)
+    positions = positions.astype(jnp.int32)
+    num_valid = num_valid.astype(jnp.int32)
+    offs = positions[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]
+    valid = jnp.arange(S, dtype=jnp.int32)[None, :] < num_valid[:, None]
+    table_col = jnp.clip(offs // ps, 0, page_indices.shape[1] - 1)
+    page = jnp.take_along_axis(page_indices, table_col, axis=1)   # [B, S]
+    page = jnp.where(valid, page, 0)        # invalid rows -> junk page 0
+    slot = jnp.where(valid, offs % ps, 0)
+    # target selection [KVH, B, S, D]; values arrive as [KVH, B, S, D]
+    kv_k = jnp.transpose(k_new, (2, 0, 1, 3)).astype(k_pages.dtype)
+    kv_v = jnp.transpose(v_new, (2, 0, 1, 3)).astype(v_pages.dtype)
+    k_pages = k_pages.at[:, page, slot, :].set(kv_k)
+    v_pages = v_pages.at[:, page, slot, :].set(kv_v)
+    return k_pages, v_pages
+
+
+# -- program-level layers ----------------------------------------------------
+
+
+def paged_attention_layer(q_var, k_pages_var, v_pages_var, tables_var,
+                          lengths_var, num_heads: int):
+    """Emit the fused ``paged_attention`` op: Q [B, 1, H*D] attending
+    over the page pool through the block tables. One op per decoder
+    layer — the whole decode step stays a single XLA executable."""
+    from ..layer_helper import LayerHelper
+    from ..layers.nn import _out
+
+    helper = LayerHelper("paged_attention")
+    out = _out(helper, q_var, shape=q_var.shape)
+    helper.append_op(
+        type="paged_attention",
+        inputs={"Q": [q_var], "KPages": [k_pages_var],
+                "VPages": [v_pages_var], "BlockTables": [tables_var],
+                "Lengths": [lengths_var]},
+        outputs={"Out": [out]},
+        attrs={"num_heads": num_heads},
+    )
+    return out
+
+
+def kv_cache_write_layer(k_pages_var, v_pages_var, k_var, v_var,
+                         tables_var, positions_var, num_valid_var,
+                         num_heads: int):
+    """Emit the ``kv_cache_write`` op; returns the (functionally)
+    updated page-pool Variables, which downstream paged_attention ops
+    read and the engine fetches back each step."""
+    from ..layer_helper import LayerHelper
+    from ..layers.nn import _out
+
+    helper = LayerHelper("kv_cache_write")
+    out_k = _out(helper, k_pages_var, shape=k_pages_var.shape)
+    out_v = _out(helper, v_pages_var, shape=v_pages_var.shape)
+    helper.append_op(
+        type="kv_cache_write",
+        inputs={"KPages": [k_pages_var], "VPages": [v_pages_var],
+                "K": [k_var], "V": [v_var], "BlockTables": [tables_var],
+                "Positions": [positions_var], "NumValid": [num_valid_var]},
+        outputs={"OutKPages": [out_k], "OutVPages": [out_v]},
+        attrs={"num_heads": num_heads},
+    )
+    return out_k, out_v
+
+
+# -- op registration ---------------------------------------------------------
+from ..core.registry import register_op  # noqa: E402
+
+
+@register_op("paged_attention",
+             inputs=("Q", "KPages", "VPages", "BlockTables", "Lengths"),
+             outputs=("Out",),
+             no_grad=("BlockTables", "Lengths"), stop_gradient=True)
+def _paged_attention_op(ctx, op, ins):
+    q = ins["Q"][0]                       # [B, 1, H*D] layer layout
+    kp, vp = ins["KPages"][0], ins["VPages"][0]
+    tables, lengths = ins["BlockTables"][0], ins["Lengths"][0]
+    h = int(op.attrs["num_heads"])
+    B, S1, HD = q.shape
+    if S1 != 1:
+        raise ValueError(
+            f"paged_attention is a decode op: Q must be [B, 1, H*D], got "
+            f"seq dim {S1} (use flash_attention for the prefill lane)")
+    D = HD // h
+    o = paged_attention(q.reshape(B, h, D), kp, vp, lengths, tables)
+    return {"Out": [o.reshape(B, 1, HD)]}
+
+
+@register_op("kv_cache_write",
+             inputs=("KPages", "VPages", "K", "V", "BlockTables",
+                     "Positions", "NumValid"),
+             outputs=("OutKPages", "OutVPages"),
+             no_grad=("BlockTables", "Positions", "NumValid"),
+             stop_gradient=True)
+def _kv_cache_write_op(ctx, op, ins):
+    kp, vp = ins["KPages"][0], ins["VPages"][0]
+    k, v = ins["K"][0], ins["V"][0]       # [B, S, H*D] layer layout
+    h = int(op.attrs["num_heads"])
+    B, S, HD = k.shape
+    D = HD // h
+    kp, vp = kv_cache_write(
+        kp, vp, k.reshape(B, S, h, D), v.reshape(B, S, h, D),
+        ins["BlockTables"][0], ins["Positions"][0], ins["NumValid"][0])
+    return {"OutKPages": [kp], "OutVPages": [vp]}

@@ -37,7 +37,7 @@ from . import flight
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "registry",
     "watch_serving", "watch_engine", "watch_executor", "watch_supervisor",
-    "watch_loader", "step_telemetry",
+    "watch_loader", "watch_generation", "step_telemetry",
 ]
 
 
@@ -292,6 +292,7 @@ _engines: "weakref.WeakSet" = weakref.WeakSet()
 _executors: "weakref.WeakSet" = weakref.WeakSet()
 _supervisors: "weakref.WeakSet" = weakref.WeakSet()
 _loaders: "weakref.WeakSet" = weakref.WeakSet()
+_generation: "weakref.WeakSet" = weakref.WeakSet()
 
 
 def watch_serving(metrics) -> None:
@@ -319,6 +320,16 @@ def watch_supervisor(sup) -> None:
 def watch_loader(loader) -> None:
     _obs_id(loader)
     _loaders.add(loader)
+
+
+def watch_generation(metrics) -> None:
+    """Called by generation.GenerationMetrics.__init__: the engine's
+    counters/histograms + page-pool stats become the
+    ``paddle_generation_*{engine=}`` family group — per-phase
+    prefill/decode occupancy, page-pool utilization, tokens/sec and
+    the TTFT / inter-token latency quantiles in the one scrape."""
+    _obs_id(metrics)
+    _generation.add(metrics)
 
 
 def _flatten(prefix: str, d: Dict[str, Any], out: Dict[str, float]) -> None:
@@ -409,6 +420,14 @@ def _collect_loaders():
     return merged
 
 
+def _collect_generation():
+    # engines expose stats_numeric(): counters + flattened hist
+    # snapshots + cache pool stats; nested dicts flatten to
+    # paddle_generation_<group>_<field> gauges
+    return _labeled(_generation, "engine", "paddle_generation",
+                    lambda e: e.stats_numeric())
+
+
 def _collect_build_info():
     from .. import version
 
@@ -423,6 +442,7 @@ for _name, _fn in (
     ("dispatch", _collect_dispatch),
     ("resilience", _collect_supervisors),
     ("reader", _collect_loaders),
+    ("generation", _collect_generation),
     ("build_info", _collect_build_info),
 ):
     _REGISTRY.register_collector(_name, _fn)

@@ -297,7 +297,7 @@ class BoundStep:
     __slots__ = (
         "executor", "compiled", "scope", "block", "base_key",
         "feed_plan", "state_vals", "written_into_state", "scope_gen",
-        "n_fetch", "benchmark", "obs_tel", "trace",
+        "n_fetch", "benchmark", "obs_tel", "trace", "rows_hint",
     )
 
     def __init__(self, executor, compiled, scope, block, raw_dtypes):
@@ -346,6 +346,11 @@ class BoundStep:
         self.base_key = executor._base_key(seed)
         self.state_vals: List[Any] = []
         self.scope_gen = -1  # force first resolve
+        # callers whose first feed's dim 0 is NOT the example count
+        # (generation's fixed decode-lane batch is mostly idle padding;
+        # its first sorted feed is a page pool) set this per step so
+        # the paddle_step_* examples/sec telemetry stays honest
+        self.rows_hint: Optional[int] = None
 
     # -- state resolution ---------------------------------------------------
     def _resolve_state(self):
@@ -425,11 +430,13 @@ class BoundStep:
             # dispatch-to-dispatch interval, and a sync here would
             # serialize the async pipeline the loader exists to fill)
             ms = (time.perf_counter() - t_obs) * 1e3
-            rows = 0
-            if ordered:
-                shp = getattr(ordered[0], "shape", None)
-                if shp:
-                    rows = int(shp[0])
+            rows = self.rows_hint
+            if rows is None:
+                rows = 0
+                if ordered:
+                    shp = getattr(ordered[0], "shape", None)
+                    if shp:
+                        rows = int(shp[0])
             tel.record(ms, rows, step=int(counter))
         if self.benchmark:
             # FLAGS_benchmark (reference operator.cc:1006 adds per-op

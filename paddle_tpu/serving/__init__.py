@@ -16,6 +16,10 @@ runtime dispatch cache.
     engine = ServingEngine(create_predictor(cfg))
     outs = engine.predict({"ids": ids, "mask": mask}, deadline_ms=50)
     srv = ServingServer(engine, port=8500)   # /v1/predict /healthz /metrics
+
+Stateful autoregressive decode (streamed ``POST /v1/generate``) lives
+in paddle_tpu.generation; pass its engine via
+``ServingServer(engine, generation_engine=...)``.
 """
 
 from .engine import (

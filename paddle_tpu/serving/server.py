@@ -10,6 +10,17 @@ three endpoints a serving deployment actually needs:
                           503 overloaded (shed load, retry with backoff)
                           504 deadline exceeded
                           400 malformed request
+    POST /v1/generate  {"tokens": [..], "max_new_tokens": n, "eos_id": id,
+                        "deadline_ms": n, "stream": true}
+                       -> 200 chunked application/x-ndjson: one
+                          {"index": i, "token": t} line per token AS IT
+                          IS SAMPLED (first line lands at
+                          time-to-first-token, long before the
+                          generation completes), then a final
+                          {"done": true, "finish_reason": ..} line.
+                          stream=false buffers into one JSON object.
+                          Requires a GenerationEngine
+                          (ServingServer(..., generation_engine=)).
     GET  /healthz      -> 200 while serving, 503 once closed (a load
                           balancer drains on this flip)
     GET  /metrics      -> Prometheus text: serving counters/quantiles +
@@ -48,6 +59,7 @@ def _json_default(o):
 
 class _Handler(BaseHTTPRequestHandler):
     engine: ServingEngine = None  # set by the subclass ServingServer makes
+    gen_engine = None             # generation.GenerationEngine (optional)
     started_at: float = 0.0       # time.monotonic() at server start
     server_version = "paddle_tpu_serving/1.0"
     protocol_version = "HTTP/1.1"
@@ -95,6 +107,9 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply_json(404, {"error": f"no such endpoint {self.path}"})
 
     def do_POST(self):  # noqa: N802
+        if self.path == "/v1/generate":
+            self._generate()
+            return
         if self.path != "/v1/predict":
             self._reply_json(404, {"error": f"no such endpoint {self.path}"})
             return
@@ -140,6 +155,95 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply_json(200, {"outputs": {
                 n: np.asarray(o) for n, o in zip(names, outs)}})
 
+    # -- autoregressive generation (streamed) -------------------------------
+    def _write_chunk(self, data: bytes):
+        self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+        self.wfile.flush()
+
+    def _generate(self):
+        from ..observability import tracing
+
+        if self.gen_engine is None:
+            self._reply_json(404, {
+                "error": "no GenerationEngine attached — construct "
+                         "ServingServer(engine, generation_engine=...)"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+            payload = json.loads(self.rfile.read(length) or b"{}")
+            tokens = payload["tokens"]
+            if (not isinstance(tokens, list) or not tokens
+                    or not all(isinstance(t, int) for t in tokens)):
+                raise ValueError("tokens must be a non-empty int list")
+            max_new = payload.get("max_new_tokens")
+            eos_id = payload.get("eos_id")
+            deadline_ms = payload.get("deadline_ms")
+            do_stream = bool(payload.get("stream", True))
+            for name, v in (("max_new_tokens", max_new),
+                            ("eos_id", eos_id),
+                            ("deadline_ms", deadline_ms)):
+                if v is not None and (isinstance(v, bool)
+                                      or not isinstance(v, (int, float))):
+                    raise ValueError(f"{name} must be a number, got {v!r}")
+        except (ValueError, KeyError, TypeError) as e:
+            self._reply_json(400, {"error": f"malformed request: {e!r}"})
+            return
+        from .engine import DeadlineExceeded as _DE
+        from .engine import EngineClosed as _EC
+        from .engine import Overloaded as _OV
+
+        try:
+            with tracing.span("serving/http_generate"):
+                stream = self.gen_engine.submit(
+                    tokens, max_new_tokens=max_new,
+                    eos_id=eos_id if eos_id is not None else "default",
+                    deadline_ms=deadline_ms)
+        except _OV as e:
+            self._reply_json(503, {"error": str(e), "kind": "overloaded"})
+            return
+        except _EC as e:
+            self._reply_json(503, {"error": str(e), "kind": "closed"})
+            return
+        except ValueError as e:
+            self._reply_json(400, {"error": str(e)})
+            return
+        if not do_stream:
+            try:
+                out = stream.result()
+            except (_DE, TimeoutError) as e:
+                self._reply_json(504, {"error": str(e), "kind": "deadline"})
+                return
+            except Exception as e:  # noqa: BLE001
+                self._reply_json(500, {"error": repr(e)})
+                return
+            self._reply_json(200, {"tokens": out,
+                                   "finish_reason": stream.finish_reason})
+            return
+        # streamed: chunked NDJSON, one line per token the moment the
+        # engine samples it — the whole point of continuous batching is
+        # that this first line does NOT wait for the generation to end
+        self.send_response(200)
+        self.send_header("Content-Type", "application/x-ndjson")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        n = 0
+        try:
+            for tok in stream:
+                self._write_chunk(json.dumps(
+                    {"index": n, "token": int(tok)}).encode() + b"\n")
+                n += 1
+            tail = {"done": True, "finish_reason": stream.finish_reason,
+                    "n_tokens": n}
+        except Exception as e:  # noqa: BLE001 — deadline/cancel mid-stream
+            tail = {"done": True, "finish_reason": stream.finish_reason
+                    or "error", "n_tokens": n, "error": str(e)}
+        try:
+            self._write_chunk(json.dumps(tail).encode() + b"\n")
+            self.wfile.write(b"0\r\n\r\n")
+            self.wfile.flush()
+        except (ConnectionError, BrokenPipeError):
+            stream.cancel()   # client hung up: stop wasting decode lanes
+
 
 class _QuietThreadingServer(ThreadingHTTPServer):
     daemon_threads = True
@@ -159,10 +263,12 @@ class ServingServer:
     `.port` reports the bound one."""
 
     def __init__(self, engine: ServingEngine, host: str = "127.0.0.1",
-                 port: int = 0, start: bool = True):
+                 port: int = 0, start: bool = True, generation_engine=None):
         self.engine = engine
+        self.generation_engine = generation_engine
         handler = type("_BoundHandler", (_Handler,),
-                       {"engine": engine, "started_at": time.monotonic()})
+                       {"engine": engine, "gen_engine": generation_engine,
+                        "started_at": time.monotonic()})
         self._httpd = _QuietThreadingServer((host, port), handler)
         self.host, self.port = self._httpd.server_address[:2]
         self._thread: Optional[threading.Thread] = None

@@ -64,3 +64,9 @@ def test_serve_engine():  # covers the subsystem itself in-process
 def test_chaos_resume():  # covers the subsystem itself in-process
     out = _run("chaos_resume.py", "--steps", "12")
     assert "chaos resume OK" in out
+
+
+@pytest.mark.slow  # tier-1 runs `-m 'not slow'`; tests/test_generation.py
+def test_generate_stream():  # covers the subsystem itself in-process
+    out = _run("generate_stream.py")
+    assert "streamed generation OK" in out

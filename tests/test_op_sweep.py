@@ -532,6 +532,11 @@ NOOP_OPS = ["delete_var",  # scope-level free; nothing to lower (dist_compute.py
 # ops with dedicated tests elsewhere in the suite (regenerate with
 # paddle_tpu.core.registry.exercised_ops() after a full run)
 COVERED_ELSEWHERE = {
+    # PR-6 generation ops (tests/test_generation.py: paged_attention
+    # vs dense-softmax oracle incl. length masking + len-0 rows;
+    # kv_cache_write scatter vs oracle + junk-page isolation; both
+    # driven end-to-end by the continuous==naive greedy equivalence)
+    'paged_attention', 'kv_cache_write',
     # round-4 MoE (tests/test_moe.py: dense training, ep parity,
     # capacity drops, gpt integration)
     'switch_moe',

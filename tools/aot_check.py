@@ -73,9 +73,15 @@ def _child():
         results["rows"].append(kw)
         print(json.dumps(kw), flush=True)
 
+    # PT_AOT_ONLY=<substring>: compile only matching rows (iterating on
+    # one kernel must not pay the whole flash sweep every run)
+    only = os.environ.get("PT_AOT_ONLY", "")
+
     def aot(name, fn, abstract_args, **meta):
         """Compile fn for the v5e target; record ok/compile_s/memory
         or the compiler's rejection."""
+        if only and only not in name:
+            return True
         t0 = time.time()
         try:
             n = len(jax.tree_util.tree_leaves(abstract_args))
@@ -160,6 +166,31 @@ def _child():
     aot("softmax_xent_train",
         jax.grad(lambda s, lbl: fused_softmax_xent(s, lbl).sum()),
         (s, lbl))
+
+    # -- paged-attention decode kernel + page write (generation/) -----
+    # PADDLE_TPU_FORCE_PALLAS=1 routes the wrapper onto the real jax
+    # Mosaic kernel, so these rows prove the decode hot path compiles
+    # for v5e BEFORE a live TPU window ever runs continuous batching.
+    from paddle_tpu.kernels.paged_attention import (
+        kv_cache_write, paged_attention as paged)
+
+    for tag, dt in (("f32", jnp.float32), ("bf16", bf)):
+        Bd, Hh, Dd, Pp, psz, maxp = 8, 8, 128, 128, 16, 16
+        qa = jax.ShapeDtypeStruct((Bd, Hh, Dd), dt)
+        kpg = jax.ShapeDtypeStruct((Hh, Pp, psz, Dd), dt)
+        lens = jax.ShapeDtypeStruct((Bd,), jnp.int32)
+        pidx = jax.ShapeDtypeStruct((Bd, maxp), jnp.int32)
+        aot(f"paged_attention_decode_{tag}",
+            lambda q, k, v, ln, pi: paged(q, k, v, ln, pi,
+                                          pages_per_compute_block=4),
+            (qa, kpg, kpg, lens, pidx),
+            B=Bd, heads=Hh, head_dim=Dd, pages=Pp, page_size=psz)
+        knew = jax.ShapeDtypeStruct((Bd, 1, Hh, Dd), dt)
+        aot(f"paged_kv_write_{tag}",
+            lambda kp, vp, k, v, pi, pos, nv: kv_cache_write(
+                kp, vp, k, v, pi, pos, nv),
+            (kpg, kpg, knew, knew, pidx, lens, lens),
+            B=Bd, heads=Hh, head_dim=Dd, pages=Pp, page_size=psz)
 
     # -- the bench stages: full train steps at their REAL shapes -------
     # the exact (kind, model, batch, seq) of bench.py's stage ladder,

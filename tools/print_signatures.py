@@ -13,6 +13,7 @@ import sys
 MODULES = [
     "paddle_tpu",
     "paddle_tpu.serving",
+    "paddle_tpu.generation",
     "paddle_tpu.resilience",
     "paddle_tpu.observability",
     "paddle_tpu.layers",
