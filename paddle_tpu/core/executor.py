@@ -141,6 +141,16 @@ class _CompiledBlock:
         # None marks "not yet compiled" for the stats instrumentation
         self.compile_time: Optional[float] = None
         self.tag = ""
+        # donation-audit metadata (tools/donation_audit.py): which
+        # rewritten-state args COULD alias their input buffer, which
+        # actually do, and why the gap is deliberate when it is
+        # ("cpu" skip / disable_donation); mesh marks executables whose
+        # arg placement is owned by GSPMD (the async feed stage must
+        # not device_put those onto the default device)
+        self.donatable_names: List[str] = []
+        self.donated_names: List[str] = []
+        self.donation_skip_reason: Optional[str] = None
+        self.mesh = None
 
 
 def _lower_block(
@@ -436,6 +446,11 @@ class Executor:
             collections.OrderedDict())
         self._bound_cap = 256
         self.fast_dispatch = True
+        # serializes bind/resolve (NOT the per-step fast path): serving
+        # workers and predictor clones share one Executor, and two
+        # threads resolving the same signature concurrently would race
+        # the bound cache and duplicate the jit compile
+        self._dispatch_lock = threading.Lock()
         self._stats: Dict[str, Any] = {
             "bound_hits": 0, "bound_misses": 0, "jit_compiles": 0,
             "shared_cache_hits": 0, "build_time_s": 0.0,
@@ -567,6 +582,67 @@ class Executor:
             use_program_cache, bkey,
         )
 
+    def run_pipelined(
+        self,
+        program=None,
+        feeds: Optional[Any] = None,
+        fetch_list: Optional[Sequence] = None,
+        scope: Optional[Scope] = None,
+        return_numpy: bool = True,
+        depth: Optional[int] = None,
+    ):
+        """Overlapped step driver: a generator yielding ``run``'s
+        fetches for every feed dict in ``feeds`` (any iterable —
+        a list, a generator, a ``GeneratorLoader``), bit-identical to
+        calling ``run`` per feed but with the host side of step N+1
+        (feed normalization, padding casts, the H2D ``device_put``)
+        running on a feeder thread while step N executes on device
+        (``runtime.dispatch.BoundStep.run_pipelined``).
+
+        Feeds whose signature (shapes/dtypes) changes mid-stream are
+        handled by draining the pipeline and re-binding — churny-shape
+        streams stay correct, they just pay a bubble at each boundary.
+        ``depth`` defaults to the ``dispatch_pipeline_depth`` flag
+        (2 = classic double buffering)."""
+        from ..flags import flag
+        from ..runtime.dispatch import feed_signature
+
+        if program is None:
+            program = framework.default_main_program()
+        scope = scope or global_scope()
+        fetch_list = list(fetch_list) if fetch_list is not None else []
+        if depth is None:
+            depth = int(flag("dispatch_pipeline_depth"))
+        it = iter(feeds if feeds is not None else ())
+        _END = object()
+        pending = next(it, _END)
+        while pending is not _END:
+            bound = self.bind(program, pending, fetch_list, scope=scope)
+            sig = feed_signature(pending)
+
+            def _segment():
+                # consumed on the FEEDER thread; `pending` is read back
+                # on the caller thread only after the pipeline's end
+                # sentinel, which the queue orders after this write
+                nonlocal pending
+                while pending is not _END and feed_signature(pending) == sig:
+                    f = pending
+                    try:
+                        pending = next(it, _END)
+                    except BaseException:
+                        # the lookahead pull for the NEXT feed failed:
+                        # the current good feed must still reach the
+                        # device before the error surfaces, or an input
+                        # error at feed K would cost step K-1 too
+                        pending = _END
+                        yield f
+                        raise
+                    yield f
+
+            for outs in bound.run_pipelined(
+                    _segment(), return_numpy=return_numpy, depth=depth):
+                yield outs
+
     def _bound_key(self, program, feed, fetch_list, scope):
         """Cheap raw-signature key for the BoundStep cache; None when
         the feed holds non-array values (those take the slow path,
@@ -614,13 +690,24 @@ class Executor:
         feed = dict(feed)
         fetch_list = list(fetch_list)
         bkey = self._bound_key(program, feed, fetch_list, scope)
+        # double-checked: a cache hit must not serialize behind a
+        # concurrent _resolve_bound (tens of ms of lowering under the
+        # lock) — the generation prefill path binds per batch and a
+        # hit stalling on another thread's compile would spike TTFT
         bound = self._bound.get(bkey) if bkey is not None else None
-        if bound is None:
-            self._stats["bound_misses"] += 1
-            bound = self._resolve_bound(
-                program, feed, fetch_list, scope, True, bkey)
-        else:
+        if bound is not None:
             self._stats["bound_hits"] += 1
+            self._bound.move_to_end(bkey)
+        else:
+            with self._dispatch_lock:
+                bound = self._bound.get(bkey) if bkey is not None else None
+                if bound is None:
+                    self._stats["bound_misses"] += 1
+                    bound = self._resolve_bound(
+                        program, feed, fetch_list, scope, True, bkey)
+                else:
+                    self._stats["bound_hits"] += 1
+                    self._bound.move_to_end(bkey)
         if tag is not None:
             bound.compiled.tag = tag
         return bound
@@ -629,8 +716,9 @@ class Executor:
         self, program, feed, fetch_list, scope, return_numpy,
         use_program_cache, bkey,
     ):
-        bound = self._resolve_bound(
-            program, feed, fetch_list, scope, use_program_cache, bkey)
+        with self._dispatch_lock:
+            bound = self._resolve_bound(
+                program, feed, fetch_list, scope, use_program_cache, bkey)
         return bound.run(feed, return_numpy)
 
     def _resolve_bound(
@@ -900,14 +988,20 @@ class Executor:
         # and jax's per-call donated-buffer bookkeeping costs ~35us PER
         # DONATED ARG on the host — measured 294us vs 90us per step for
         # a 6-param MLP — which would dominate small-model dispatch.
+        written_set = set(written_names)
+        donatable = [n for n in state_names if n in written_set]
         donate = tuple(
             2 + len(feed_names) + i
             for i, n in enumerate(state_names)
-            if n in set(written_names)
+            if n in written_set
         )
-        if self.disable_donation or (
-                _cpu_only_target(mesh) and not self._force_donation):
+        skip_reason = None
+        if self.disable_donation:
             donate = ()
+            skip_reason = "disable_donation"
+        elif _cpu_only_target(mesh) and not self._force_donation:
+            donate = ()
+            skip_reason = "cpu"
         jit_kwargs: Dict[str, Any] = {"donate_argnums": donate}
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
@@ -946,9 +1040,14 @@ class Executor:
                 + [_state_sharding(n) for n in written_names]
             )
         jitted = jax.jit(step_fn, **jit_kwargs)
-        return _CompiledBlock(
+        blk = _CompiledBlock(
             jitted, list(feed_names), state_names, fetch_names, written_names, donate
         )
+        blk.donatable_names = donatable
+        blk.donated_names = donatable if donate else []
+        blk.donation_skip_reason = skip_reason
+        blk.mesh = mesh
+        return blk
 
     def _compile_multiprocess(
         self, block, feed_names, fetch_names, state_names, written_names
@@ -988,9 +1087,14 @@ class Executor:
             outs = pfn(expand(step_key), *map(expand, args))
             return tuple(o[0] for o in outs)
 
-        return _CompiledBlock(
+        blk = _CompiledBlock(
             wrapped, list(feed_names), state_names, fetch_names, written_names, donate
         )
+        written_set = set(written_names)
+        blk.donatable_names = [n for n in state_names if n in written_set]
+        blk.donated_names = list(blk.donatable_names) if donate else []
+        blk.mesh = "pmap"  # placement owned by pmap, not the feeder
+        return blk
 
     def export_fn(self, program, feed, fetch_list, scope=None, mesh=None):
         """Return (raw_fn, example_args) for a program — the un-jitted

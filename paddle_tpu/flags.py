@@ -32,6 +32,19 @@ _FLAG_DEFS: Dict[str, Any] = {
     # executable from disk instead of re-compiling (the scarce-TPU-
     # window amortization the whole-program compile model depends on).
     "compile_cache_dir": os.path.join("~", ".cache", "paddle_tpu", "xla"),
+    # async host/device pipeline (runtime/dispatch BoundStep
+    # .run_pipelined / Executor.run_pipelined): number of prepared
+    # feeds the feeder thread may run ahead of the device step. 2 is
+    # classic double buffering (one batch in flight on device, one
+    # being normalized/device_put on the feeder); each extra slot pins
+    # one more batch of device memory for marginal jitter absorption
+    "dispatch_pipeline_depth": 2,
+    # reader.py GeneratorLoader: depth of the async DEVICE-side
+    # prefetch buffer (each entry pins batch_bytes of device memory;
+    # the historical hard-coded value was 2 — raise it only when
+    # paddle_reader_buffer_empty_stall_total shows feed starvation
+    # with a bursty/jittery input pipeline)
+    "reader_prefetch_depth": 2,
     # serving/engine.py defaults (overridable per ServingEngine):
     # batch closes at serving_max_batch_size ROWS or after
     # serving_batch_timeout_ms from the first queued request, whichever

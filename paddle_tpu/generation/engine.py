@@ -584,10 +584,16 @@ class GenerationEngine:
                 {"bucket": bucket, "rows": int(num_valid.sum()),
                  **({"flow_from": flow} if flow else {})},
                 parent=reqs[0].ctx)
+        # the prefill lane drives the SAME resolved dispatch object as
+        # every other subsystem (Executor.bind, one BoundStep per seq
+        # bucket) — tagged for spans and the donation audit, with
+        # rows_hint keeping examples/sec honest on the padded lanes
+        bound = self._exe.bind(prog, feed, fetches, scope=self._scope,
+                               tag=f"generation/prefill[{bucket}]")
+        bound.rows_hint = len(reqs)
         try:
             with span_cm:
-                outs = self._exe.run(prog, feed=feed, fetch_list=fetches,
-                                     scope=self._scope, return_numpy=False)
+                outs = bound.run(feed, False)
         except Exception as e:  # noqa: BLE001 — a bad prompt batch must not kill the loop
             for req in reqs:
                 self.cache.release(req.slot)

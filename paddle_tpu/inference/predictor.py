@@ -7,6 +7,7 @@ ZeroCopyRun; Clone() shares weights across threads).
 
 from __future__ import annotations
 
+import collections
 import threading
 from typing import Dict, List, Optional, Sequence
 
@@ -158,6 +159,24 @@ class Predictor:
                               "real_elements": 0, "shapes_seen": set(),
                               "buckets_used": set(), "bucket_hits": {}}
         self._trueshape_cache = {}
+        # resolved runtime.dispatch.BoundStep per (padded) feed
+        # signature — the ONE execution path (ROADMAP item 4): the
+        # Predictor holds the bound dispatch directly instead of
+        # re-assembling Executor.run's bound key per request. SHARED
+        # with clones (same program, same scope, same executor), so a
+        # serving worker pool binds each bucket exactly once. Capped
+        # (oldest-bound evicted) like Executor._bound: without
+        # bucketing every distinct
+        # request shape is a key, and each key includes the flags
+        # generation — unbounded, a long-lived process would strand a
+        # BoundStep (pinning its state refs) per shape per set_flags
+        self._bindings = collections.OrderedDict()
+        self._bindings_cap = 256
+        self._bind_lock = threading.Lock()
+        # call-site label for trace spans / the donation audit;
+        # layered subsystems (serving, generation) override it on
+        # their worker clones
+        self.bind_tag = "predictor/run"
         # feeds whose dim 1 may be sequence-padded under bucketing:
         # declared-dynamic (-1) second dim or a LoD level — a static
         # dim 1 (NCHW channels, [B, F] features) must never be padded
@@ -199,63 +218,81 @@ class Predictor:
         padded dict + (real_elements, padded_elements) for stats.
         Dim 1 buckets only for declared-dynamic/sequence feeds
         (_seq_feed_names) — zero-padding a static channel/feature dim
-        would corrupt non-sequence models."""
+        would corrupt non-sequence models. Uses the BoundStep feed
+        policy (`runtime.dispatch.pad_to`): an already-device-resident
+        jax.Array pads on device (or passes through untouched) instead
+        of round-tripping through numpy and undoing the async H2D."""
+        from ..runtime.dispatch import pad_to
+
         cfg = self._config
         padded = {}
         n_real = n_pad = 0
         for n, a in feed.items():
-            a = np.asarray(a)
-            pads = [(0, 0)] * a.ndim
-            if a.ndim >= 1 and cfg._pad_batch:
-                pads[0] = (0, self._bucket_of(a.shape[0], cfg._batch_buckets)
-                           - a.shape[0])
-            if a.ndim >= 2 and n in self._seq_feed_names:
-                pads[1] = (0, self._bucket_of(a.shape[1], cfg._seq_buckets)
-                           - a.shape[1])
-            padded[n] = (np.pad(a, pads) if any(p != (0, 0) for p in pads)
-                         else a)
-            n_real += int(a.size)
-            n_pad += int(padded[n].size)
+            shape = getattr(a, "shape", None)
+            if shape is None:
+                a = np.asarray(a)
+                shape = a.shape
+            ndim = len(shape)
+            pads = [(0, 0)] * ndim
+            if ndim >= 1 and cfg._pad_batch:
+                pads[0] = (0, self._bucket_of(shape[0], cfg._batch_buckets)
+                           - shape[0])
+            if ndim >= 2 and n in self._seq_feed_names:
+                pads[1] = (0, self._bucket_of(shape[1], cfg._seq_buckets)
+                           - shape[1])
+            padded[n] = pad_to(a, pads)
+            size = 1
+            for d in shape:
+                size *= int(d)
+            psize = 1
+            for d in padded[n].shape:
+                psize *= int(d)
+            n_real += size
+            n_pad += psize
         return padded, (n_real, n_pad)
 
-    def _true_fetch_shapes(self, feed):
+    def _true_fetch_shapes(self, feed, sig=None):
         """Abstract-eval (jax.eval_shape — no compile, no execute) the
         program at the TRUE request shapes: the exact per-fetch output
         shapes to slice the padded run back to. Shape-coincidence
         heuristics are not safe here — a 16-class logits dim is
         indistinguishable from a 16-bucket seq dim by size alone.
-        Cached per request-shape signature."""
+        Cached per request-shape signature (computed ONCE from array
+        metadata — no materializing np.asarray per value — and shared
+        with run()'s bucket accounting via the ``sig`` argument)."""
         import jax
 
-        import paddle_tpu as fluid
         from ..core.executor import build_block_fn
+        from ..runtime.dispatch import feed_signature
 
-        sig = tuple(
-            (n, tuple(np.asarray(a).shape), str(np.asarray(a).dtype))
-            for n, a in sorted(feed.items()))
+        if sig is None:
+            sig = feed_signature(feed)
         hit = self._trueshape_cache.get(sig)
         if hit is not None:
             return hit
+
+        def _spec(v):
+            shp = getattr(v, "shape", None)
+            dt = getattr(v, "dtype", None)
+            if shp is None or dt is None:
+                v = np.asarray(v)
+                shp, dt = v.shape, v.dtype
+            return jax.ShapeDtypeStruct(tuple(shp), dt)
+
         block = self._program.global_block()
-        with fluid.scope_guard(self._scope):
-            feed_vals, _ = self._exe._prepare_feed(block, dict(feed))
-            feed_names = sorted(feed_vals)
-            state_names, written = self._exe._analyze_block(
-                self._program, block, feed_names)
-            fn = build_block_fn(
-                block, feed_names, state_names,
-                [v.name for v in self._fetch_vars], written, None)
-            args = (
-                [jax.random.PRNGKey(0)]
-                + [jax.ShapeDtypeStruct(np.asarray(feed_vals[n]).shape,
-                                        np.asarray(feed_vals[n]).dtype)
-                   for n in feed_names]
-                + [jax.ShapeDtypeStruct(
-                       np.asarray(self._scope.find_var(n)).shape,
-                       np.asarray(self._scope.find_var(n)).dtype)
-                   for n in state_names]
-            )
-            outs = jax.eval_shape(fn, *args)
+        feed_vals, _ = self._exe._prepare_feed(block, dict(feed))
+        feed_names = sorted(feed_vals)
+        state_names, written = self._exe._analyze_block(
+            self._program, block, feed_names)
+        fn = build_block_fn(
+            block, feed_names, state_names,
+            [v.name for v in self._fetch_vars], written, None)
+        args = (
+            [jax.random.PRNGKey(0)]
+            + [_spec(feed_vals[n]) for n in feed_names]
+            + [_spec(self._scope.find_var(n)) for n in state_names]
+        )
+        outs = jax.eval_shape(fn, *args)
         shapes = [tuple(int(d) for d in o.shape)
                   for o in outs[:len(self._fetch_vars)]]
         self._trueshape_cache[sig] = shapes
@@ -263,8 +300,14 @@ class Predictor:
 
     @staticmethod
     def _slice_to(out, shape):
-        out = np.asarray(out)
-        if out.shape == tuple(shape):
+        """Slice one fetched value back to its true (un-padded) shape.
+        Works on numpy AND device arrays — a return_numpy=False caller
+        keeps device residency through the slice."""
+        cur = getattr(out, "shape", None)
+        if cur is None:
+            out = np.asarray(out)
+            cur = out.shape
+        if tuple(cur) == tuple(shape):
             return out
         return out[tuple(slice(0, s) for s in shape)]
 
@@ -293,38 +336,65 @@ class Predictor:
             if st["padded_elements"] else 0.0)
         return st
 
-    def run(self, inputs: Optional[Sequence[np.ndarray]] = None):
-        import paddle_tpu as fluid
+    def _bound_for(self, feed):
+        """The resolved ``runtime.dispatch.BoundStep`` for this exact
+        (padded) feed signature — ``Executor.bind`` on a miss, a plain
+        dict hit thereafter. The binding cache is shared across
+        clones, so a worker pool binds each bucket once."""
+        from .. import flags as _flags
+        from ..runtime.dispatch import feed_signature
 
-        # EVERYTHING touching shared per-Predictor state happens under
-        # the lock: the _inputs/_outputs handles, and the bucketing
-        # work — _true_fetch_shapes enters scope_guard on the
-        # module-global (non-thread-local) scope stack and mutates the
-        # shared _trueshape_cache; concurrent Predictor.run from two
-        # threads used to interleave scope pushes/pops and resolve vars
-        # against the wrong scope (use clone() for lock-free threading)
-        with self._lock, fluid.scope_guard(self._scope):
+        key = (self._program.version, _flags._generation,
+               self._exe.disable_donation, self._exe._force_donation,
+               feed_signature(feed))
+        bound = self._bindings.get(key)
+        if bound is None:
+            with self._bind_lock:
+                bound = self._bindings.get(key)
+                if bound is None:
+                    bound = self._exe.bind(
+                        self._program, feed, self._fetch_vars,
+                        scope=self._scope, tag=self.bind_tag)
+                    self._bindings[key] = bound
+                    while len(self._bindings) > self._bindings_cap:
+                        self._bindings.popitem(last=False)
+        return bound
+
+    def run(self, inputs: Optional[Sequence[np.ndarray]] = None,
+            return_numpy: bool = True):
+        """Execute one request through the unified dispatch path: feed
+        handles -> (optional bucket padding) -> BoundStep.run. No
+        private jit/pad path — the same resolved dispatch object the
+        Executor/Supervisor/GenerationEngine drive, so per-step
+        telemetry (paddle_step_*) and every dispatch optimization
+        cover inference too. ``return_numpy=False`` keeps fetches as
+        device arrays (no host sync) for callers that feed them
+        onward."""
+        # everything touching shared per-Predictor state happens under
+        # the lock: the _inputs/_outputs handles and the bucketing
+        # counters (use clone() for lock-free threading)
+        with self._lock:
             if inputs is not None:
                 for n, a in zip(self._feed_names, inputs):
                     self._inputs[n].copy_from_cpu(a)
             feed = {n: t._value for n, t in self._inputs.items()}
             true_shapes = None
             if self._config._bucketing:
-                req_sig = tuple(np.asarray(a).shape for a in feed.values())
-                true_shapes = self._true_fetch_shapes(feed)
+                from ..runtime.dispatch import feed_signature
+
+                req_sig = feed_signature(feed)
+                true_shapes = self._true_fetch_shapes(feed, req_sig)
                 feed, counts = self._pad_feed(feed)
                 st = self._bucket_stats
                 st["runs"] += 1
                 st["shapes_seen"].add(req_sig)
-                bucket = tuple(a.shape for a in feed.values())
+                bucket = tuple(tuple(a.shape) for a in feed.values())
                 st["buckets_used"].add(bucket)
                 bkey = "|".join(",".join(str(d) for d in s) for s in bucket)
                 st["bucket_hits"][bkey] = st["bucket_hits"].get(bkey, 0) + 1
                 st["real_elements"] += counts[0]
                 st["padded_elements"] += counts[1]
-            outs = self._exe.run(
-                self._program, feed=feed, fetch_list=self._fetch_vars
-            )
+            outs = self._bound_for(feed).run(feed, return_numpy)
             if true_shapes is not None:
                 outs = [self._slice_to(o, s)
                         for o, s in zip(outs, true_shapes)]
@@ -358,6 +428,12 @@ class Predictor:
                            "buckets_used": set(), "bucket_hits": {}}
         p._trueshape_cache = self._trueshape_cache  # same program
         p._seq_feed_names = self._seq_feed_names
+        # same program + scope + executor => clones share the resolved
+        # BoundStep cache (bind once per bucket for the whole pool)
+        p._bindings = self._bindings
+        p._bindings_cap = self._bindings_cap
+        p._bind_lock = self._bind_lock
+        p.bind_tag = self.bind_tag
         return p
 
 

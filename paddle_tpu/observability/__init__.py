@@ -31,9 +31,9 @@ from . import flight, registry, tracing
 from .flight import dump as flight_dump
 from .flight import install_signal_handlers
 from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
-                       step_telemetry, watch_engine, watch_executor,
-                       watch_generation, watch_loader, watch_serving,
-                       watch_supervisor)
+                       overlap_telemetry, step_telemetry, watch_engine,
+                       watch_executor, watch_generation, watch_loader,
+                       watch_serving, watch_supervisor)
 from .registry import registry as get_registry
 from .tracing import SpanContext, attach, current, span, traced
 
@@ -44,6 +44,7 @@ __all__ = [
     "flight_dump", "install_signal_handlers",
     "watch_serving", "watch_engine", "watch_executor", "watch_supervisor",
     "watch_loader", "watch_generation", "step_telemetry",
+    "overlap_telemetry",
     "snapshot", "to_prometheus_text",
 ]
 

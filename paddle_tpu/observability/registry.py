@@ -38,6 +38,7 @@ __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "registry",
     "watch_serving", "watch_engine", "watch_executor", "watch_supervisor",
     "watch_loader", "watch_generation", "step_telemetry",
+    "overlap_telemetry",
 ]
 
 
@@ -413,9 +414,20 @@ def _collect_loaders():
                 depth = q.qsize()
             except Exception:  # noqa: BLE001
                 depth = 0
-        for name, v in (("paddle_reader_queue_depth", depth),
-                        ("paddle_reader_position", loader.position()),
-                        ("paddle_reader_capacity", loader.capacity)):
+        for name, v in (
+                ("paddle_reader_queue_depth", depth),
+                ("paddle_reader_position", loader.position()),
+                ("paddle_reader_capacity", loader.capacity),
+                # feed-starvation visibility: full = producer blocked
+                # (consumer/device is the bottleneck), empty = consumer
+                # blocked (the input pipeline is the bottleneck)
+                ("paddle_reader_buffer_full_stall_total",
+                 getattr(loader, "_stall_full", 0)),
+                ("paddle_reader_buffer_empty_stall_total",
+                 getattr(loader, "_stall_empty", 0)),
+                ("paddle_reader_prefetch_depth",
+                 getattr(loader, "_active_depth", 0)),
+        ):
             merged.setdefault(name, []).append((lbl, v))
     return merged
 
@@ -506,3 +518,61 @@ _REGISTRY.register_collector("step", _step_tel.collect)
 
 def step_telemetry() -> _StepTelemetry:
     return _step_tel
+
+
+class _OverlapTelemetry:
+    """Async-pipeline overlap accounting (BoundStep.run_pipelined).
+
+    Per pipelined step the feeder thread spends ``feed_ms`` of host
+    work (normalize + pad + device_put) and the consumer waits
+    ``wait_ms`` for the prepared feed. Host work that the consumer did
+    NOT wait for ran while the device was busy with the previous step
+    — it was hidden. ``hidden_fraction`` is therefore
+    ``1 - wait_ms_sum / feed_ms_sum`` (clamped to [0, 1]): 1.0 means
+    every host-feed millisecond overlapped the device step, 0.0 means
+    the pipeline is fully feed-bound and the async stage bought
+    nothing."""
+
+    __slots__ = ("_lock", "steps", "feed_ms_sum", "wait_ms_sum")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.steps = 0
+        self.feed_ms_sum = 0.0
+        self.wait_ms_sum = 0.0
+
+    def record(self, feed_ms: float, wait_ms: float) -> None:
+        with self._lock:
+            self.steps += 1
+            self.feed_ms_sum += feed_ms
+            self.wait_ms_sum += wait_ms
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            steps = self.steps
+            feed = self.feed_ms_sum
+            wait = self.wait_ms_sum
+        hidden = 1.0 - (min(wait, feed) / feed) if feed > 0 else 0.0
+        return {
+            "steps": steps,
+            "feed_ms_sum": round(feed, 3),
+            "wait_ms_sum": round(wait, 3),
+            "hidden_fraction": round(hidden, 4),
+        }
+
+    def collect(self) -> Dict[str, float]:
+        s = self.snapshot()
+        return {
+            "paddle_step_overlap_steps_total": s["steps"],
+            "paddle_step_overlap_feed_ms_sum": s["feed_ms_sum"],
+            "paddle_step_overlap_wait_ms_sum": s["wait_ms_sum"],
+            "paddle_step_overlap_hidden_fraction": s["hidden_fraction"],
+        }
+
+
+_overlap_tel = _OverlapTelemetry()
+_REGISTRY.register_collector("step_overlap", _overlap_tel.collect)
+
+
+def overlap_telemetry() -> _OverlapTelemetry:
+    return _overlap_tel

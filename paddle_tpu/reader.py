@@ -35,11 +35,24 @@ class DataLoader:
 
 class GeneratorLoader:
     def __init__(self, feed_list, capacity=64, use_double_buffer=True, iterable=True,
-                 trainer_id=None, num_trainers=None):
+                 trainer_id=None, num_trainers=None, prefetch_depth=None):
         self.feed_list = feed_list or []
         self.capacity = capacity
         self.use_double_buffer = use_double_buffer
         self.iterable = iterable
+        # device prefetch buffer depth: explicit arg wins, else the
+        # live flag `reader_prefetch_depth` (read at iteration start,
+        # so a flag flip applies to the NEXT epoch). Each entry pins
+        # one batch of device memory — this was hard-coded at 2.
+        self._prefetch_depth = (None if prefetch_depth is None
+                                else max(1, int(prefetch_depth)))
+        self._active_depth = 0      # what the current iteration uses
+        # stall counters (scraped as paddle_reader_buffer_*_stall_total):
+        # full = producer blocked, the consumer/device is the
+        # bottleneck; empty = consumer blocked, the input pipeline is
+        # starving the device
+        self._stall_full = 0
+        self._stall_empty = 0
         self._gen: Optional[Callable] = None
         self._places = None
         self._batch_reader = None
@@ -194,10 +207,16 @@ class GeneratorLoader:
                 self._position += 1
                 yield b
             return
-        # depth-2 DEVICE buffer (true double buffering): the queue pins
-        # device memory per entry, so `capacity` host batches would
-        # hold capacity x batch_bytes of HBM for no extra overlap
-        q: "queue.Queue" = queue.Queue(maxsize=2)
+        # bounded DEVICE buffer (depth 2 = true double buffering by
+        # default): the queue pins device memory per entry, so
+        # `capacity` host batches would hold capacity x batch_bytes of
+        # HBM for no extra overlap
+        from .flags import flag
+
+        depth = (self._prefetch_depth if self._prefetch_depth is not None
+                 else max(1, int(flag("reader_prefetch_depth"))))
+        self._active_depth = depth
+        q: "queue.Queue" = queue.Queue(maxsize=depth)
         self._obs_queue = q  # scraped as paddle_reader_queue_depth
         stop = object()
         err: List[BaseException] = []
@@ -205,7 +224,14 @@ class GeneratorLoader:
         def worker():
             try:
                 for b in self._positioned_batches():
-                    q.put(self._to_device(b))
+                    item = self._to_device(b)
+                    try:
+                        q.put_nowait(item)
+                    except queue.Full:
+                        # buffer full: the consumer is the bottleneck
+                        # (device-bound) — counted, then block normally
+                        self._stall_full += 1
+                        q.put(item)
             except BaseException as e:  # surfaced to the consumer
                 # record BEFORE the stop sentinel: the consumer checks
                 # err on every get, so ordering guarantees the error is
@@ -216,8 +242,14 @@ class GeneratorLoader:
 
         t = threading.Thread(target=worker, daemon=True)
         t.start()
+        yielded = False
         while True:
-            b = q.get()
+            try:
+                b = q.get_nowait()
+                waited = False
+            except queue.Empty:
+                waited = True
+                b = q.get()
             if err:
                 # fail fast on the NEXT __next__, even if good batches
                 # are still buffered ahead of the sentinel — silently
@@ -236,7 +268,15 @@ class GeneratorLoader:
                 raise err[0]
             if b is stop:
                 break
+            if waited and yielded:
+                # buffer empty on a mid-stream batch: the input
+                # pipeline is starving the device (feed-bound). The
+                # initial pipeline-fill wait and the end-of-stream
+                # sentinel wait are not starvation and don't count —
+                # they'd otherwise climb ~2/epoch on a healthy pipeline
+                self._stall_empty += 1
             self._position += 1
+            yielded = True
             yield b
 
     # non-iterable (start/reset) mode parity

@@ -42,9 +42,12 @@ import hashlib
 import json
 import logging
 import os
+import queue as _queue_mod
+import threading
 import time
+import weakref
 from collections import OrderedDict
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
 import numpy as np
 
@@ -69,6 +72,19 @@ _GLOBAL_STATS: Dict[str, Any] = {
 
 _PERSISTENT_DIR: Optional[str] = None
 _PERSISTENT_FAILED_PATH: Optional[str] = None
+
+# every live BoundStep in the process — the donation/host-sync audit
+# (tools/donation_audit.py) walks this to prove each subsystem's
+# executables donate their rewritten state and to attribute host-sync
+# points per call site. Weak: a retired bound step drops out on GC.
+_LIVE_BOUND: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def live_bound_steps() -> List["BoundStep"]:
+    """Snapshot of every live BoundStep (any executor, any subsystem).
+    Order is unspecified; callers needing stable reports should sort on
+    ``audit_info()['tag']``."""
+    return list(_LIVE_BOUND)
 
 
 def ensure_persistent_cache() -> Optional[str]:
@@ -285,6 +301,42 @@ def _want_dtype(block, name: str, raw_dtype) -> Optional[str]:
     return None
 
 
+def feed_signature(feed: Dict[str, Any]) -> Tuple:
+    """(name, shape, dtype) per feed, sorted by name — WITHOUT
+    materializing anything: jax.Arrays and numpy arrays answer from
+    their metadata; only values with neither attribute (lists,
+    scalars) pay one np.asarray. This is the signature both the
+    Predictor's bucket cache and the pipelined driver key on, so it
+    must cost attribute reads, not copies."""
+    sig = []
+    for n in sorted(feed):
+        v = feed[n]
+        shp = getattr(v, "shape", None)
+        dt = getattr(v, "dtype", None)
+        if shp is None or dt is None:
+            v = np.asarray(v)
+            shp, dt = v.shape, v.dtype
+        sig.append((n, tuple(shp), str(dt)))
+    return tuple(sig)
+
+
+def pad_to(value, pads) -> Any:
+    """Zero-pad one feed value, honoring the BoundStep feed-normalizer
+    policy: a device-resident jax.Array is padded ON DEVICE (jnp.pad —
+    an np.pad here would round-trip the batch through host memory and
+    undo the loader's async H2D); anything else pads as numpy. No-op
+    (and no copy) when no padding is needed."""
+    if not any(p != (0, 0) for p in pads):
+        return value
+    import jax
+
+    if isinstance(value, jax.Array):
+        import jax.numpy as jnp
+
+        return jnp.pad(value, pads)
+    return np.pad(np.asarray(value), pads)
+
+
 # -- the bound step ---------------------------------------------------------
 
 
@@ -298,6 +350,7 @@ class BoundStep:
         "executor", "compiled", "scope", "block", "base_key",
         "feed_plan", "state_vals", "written_into_state", "scope_gen",
         "n_fetch", "benchmark", "obs_tel", "trace", "rows_hint",
+        "host_sync_calls", "__weakref__",
     )
 
     def __init__(self, executor, compiled, scope, block, raw_dtypes):
@@ -351,6 +404,11 @@ class BoundStep:
         # its first sorted feed is a page pool) set this per step so
         # the paddle_step_* examples/sec telemetry stays honest
         self.rows_hint: Optional[int] = None
+        # host-sync accounting for the donation/host-sync audit: every
+        # return_numpy fetch (and every FLAGS_benchmark forced sync) is
+        # a point where the host blocks on the device
+        self.host_sync_calls = 0
+        _LIVE_BOUND.add(self)
 
     # -- state resolution ---------------------------------------------------
     def _resolve_state(self):
@@ -376,12 +434,20 @@ class BoundStep:
 
     # -- the hot path -------------------------------------------------------
     def run(self, feed: Dict[str, Any], return_numpy: bool):
+        ordered = [norm(feed[n]) for n, norm in self.feed_plan]
+        return self._run_ordered(ordered, return_numpy)
+
+    def _run_ordered(self, ordered: List[Any], return_numpy: bool):
+        """Dispatch one already-normalized arg list. This is THE single
+        execution path: ``run`` (sync callers), ``run_pipelined`` (the
+        async feed stage) and every subsystem above them funnel here, so
+        per-step accounting and every future optimization land in
+        exactly one place."""
         scope = self.scope
         entry_gen = scope_chain_generation(scope)
         if entry_gen != self.scope_gen:
             self._resolve_state()
             entry_gen = self.scope_gen
-        ordered = [norm(feed[n]) for n, norm in self.feed_plan]
         ex = self.executor
         ex._run_counter += 1
         compiled = self.compiled
@@ -441,6 +507,7 @@ class BoundStep:
         if self.benchmark:
             # FLAGS_benchmark (reference operator.cc:1006 adds per-op
             # device syncs): force device sync + report wall time
+            self.host_sync_calls += 1
             for v in fetched + list(new_state[:1]):
                 np.asarray(v)
             _log.info("[benchmark] Executor.run: %.3f ms",
@@ -448,8 +515,164 @@ class BoundStep:
         if return_numpy:
             from ..core.executor import _fetch_to_host
 
+            if fetched:
+                self.host_sync_calls += 1
             fetched = [_fetch_to_host(v) for v in fetched]
         return fetched
+
+    # -- async host/device pipeline -----------------------------------------
+    def run_pipelined(self, feeds: Iterable[Dict[str, Any]],
+                      return_numpy: bool = True, depth: int = 2):
+        """Overlapped driver for a stream of same-signature feeds:
+        yields each step's fetches in order, bit-identical to calling
+        ``run`` per feed.
+
+        A dedicated feeder thread runs the host side of step N+1 —
+        feed normalization/padding/casting plus the ``jax.device_put``
+        H2D start — while step N executes on device, through a bounded
+        (``depth``, default 2 = double buffer) queue. The consumer
+        (this generator, on the caller's thread) does only the
+        dispatch + state write-back, so with a deep enough device
+        queue the hot loop never blocks on host feed work. Values that
+        are ALREADY jax.Arrays (the GeneratorLoader device buffer)
+        pass through untouched — a device-resident batch is never
+        re-materialized on host.
+
+        Semantics:
+          * ordering — results come back in feed order, always;
+          * exceptions — an error raised by the feed iterable or the
+            normalization of feed K surfaces here after step K-1's
+            result, never silently; the feeder thread always exits;
+          * shutdown — closing/abandoning the generator mid-stream
+            stops and joins the feeder thread (no orphan thread, no
+            pinned device batches);
+          * state — scope state flows through the dispatch exactly as
+            in ``run`` (the feeder touches feeds only, never state).
+
+        Overlap efficiency is exported as ``paddle_step_overlap_*``:
+        host feed time spent per step, how much of it the consumer
+        actually waited for (NOT hidden), and the hidden fraction.
+        """
+        import jax
+
+        depth = max(1, int(depth))
+        q: "_queue_mod.Queue" = _queue_mod.Queue(maxsize=depth)
+        stop = threading.Event()
+        _END = object()
+        overlap = None
+        if self.obs_tel is not None:
+            from ..observability.registry import overlap_telemetry
+
+            overlap = overlap_telemetry()
+        plan = self.feed_plan
+        # only single-device targets device_put eagerly: for a mesh
+        # executable the jit call owns placement/sharding, and a
+        # default-device put here would force a resharding copy
+        put_ok = getattr(self.compiled, "mesh", None) is None
+
+        def feeder():
+            err = None
+            try:
+                it = iter(feeds)
+                while True:
+                    if stop.is_set():
+                        return
+                    # the timed span starts BEFORE the next() pull: the
+                    # iterable IS the input pipeline (reader/decode), and
+                    # its production latency is exactly the host work the
+                    # overlap hides — paddle_step_overlap_feed_ms must
+                    # account for it or hidden-fraction under-reports
+                    t0 = time.perf_counter()
+                    try:
+                        feed = next(it)
+                    except StopIteration:
+                        break
+                    if stop.is_set():
+                        # the consumer shut down while next() blocked:
+                        # don't normalize/device_put one more batch
+                        # (pinning device memory) on the way out
+                        return
+                    ordered = [norm(feed[n]) for n, norm in plan]
+                    if put_ok:
+                        ordered = [
+                            v if isinstance(v, jax.Array)
+                            else jax.device_put(v)
+                            for v in ordered
+                        ]
+                    item = (ordered, (time.perf_counter() - t0) * 1e3)
+                    while True:
+                        if stop.is_set():
+                            return
+                        try:
+                            q.put(item, timeout=0.05)
+                            break
+                        except _queue_mod.Full:
+                            continue
+            except BaseException as e:  # noqa: BLE001 — surfaced at the yield
+                err = e
+            while not stop.is_set():
+                try:
+                    q.put((_END, err), timeout=0.05)
+                    return
+                except _queue_mod.Full:
+                    continue
+
+        t = threading.Thread(target=feeder, name="pt-dispatch-feeder",
+                             daemon=True)
+        t.start()
+        try:
+            while True:
+                try:
+                    item = q.get_nowait()
+                    waited_ms = 0.0
+                except _queue_mod.Empty:
+                    t0 = time.perf_counter()
+                    item = q.get()
+                    waited_ms = (time.perf_counter() - t0) * 1e3
+                payload, extra = item
+                if payload is _END:
+                    if extra is not None:
+                        raise extra
+                    return
+                fetched = self._run_ordered(payload, return_numpy)
+                if overlap is not None:
+                    overlap.record(extra, waited_ms)
+                yield fetched
+        finally:
+            stop.set()
+            # unblock a feeder parked in q.put, then reap it
+            try:
+                while True:
+                    q.get_nowait()
+            except _queue_mod.Empty:
+                pass
+            t.join(timeout=5.0)
+
+    # -- audit ---------------------------------------------------------------
+    def audit_info(self) -> Dict[str, Any]:
+        """One report row for tools/donation_audit.py: which rewritten
+        state buffers this executable donates (buffer aliasing) vs
+        should donate, why donation was skipped if it was, how often
+        callers forced a host sync on the fetch path, and the
+        XLA memory/cost analysis captured at compile time (present
+        when ``observability_xla_analysis`` was on)."""
+        c = self.compiled
+        donatable = list(getattr(c, "donatable_names", ()) or ())
+        donated = list(getattr(c, "donated_names", ()) or ())
+        skip = getattr(c, "donation_skip_reason", None)
+        return {
+            "tag": c.tag or "program",
+            "n_feeds": len(c.feed_names),
+            "n_state": len(c.state_names),
+            "n_written": len(c.written_names),
+            "donatable": donatable,
+            "donated": donated,
+            "donation_missed": ([] if skip else
+                                [n for n in donatable if n not in donated]),
+            "donation_skip_reason": skip,
+            "host_sync_calls": self.host_sync_calls,
+            "xla_analysis": dict(getattr(c, "analysis", None) or {}),
+        }
 
     def _first_call(self, fn, counter, ordered):
         """First invocation of a fresh compiled block: this is where

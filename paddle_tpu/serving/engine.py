@@ -213,10 +213,16 @@ class ServingEngine:
         # the (bounded) admission queue until Overloaded fires
         self._batch_q: "_queue_mod.Queue" = _queue_mod.Queue(
             maxsize=self.num_workers)
-        # worker 0 reuses the caller's predictor; the rest are clones
-        # sharing scope + compiled executables via the dispatch cache
-        self._worker_preds = [predictor] + [
-            predictor.clone() for _ in range(self.num_workers - 1)]
+        # every worker is a clone — sharing scope + compiled
+        # executables via the dispatch cache, so the pool still binds
+        # each bucket once. The caller's predictor is left untouched
+        # (its direct runs keep their own bind_tag); the clones are
+        # re-tagged so executables bound by this pool report as
+        # serving's in trace spans and the donation/host-sync audit
+        self._worker_preds = [predictor.clone()
+                              for _ in range(self.num_workers)]
+        for p in self._worker_preds:
+            p.bind_tag = "serving/predict"
         self._batcher: Optional[threading.Thread] = None
         self._workers: List[threading.Thread] = []
         self._started = False

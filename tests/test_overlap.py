@@ -1,0 +1,309 @@
+"""Async host/device pipeline (BoundStep.run_pipelined /
+Executor.run_pipelined) + reader prefetch: ordering and bit-exactness
+vs the sync path under churny shapes, feed-thread exception
+propagation, clean shutdown mid-overlap, prefetch-depth flag + stall
+counters, and Supervisor commit correctness with in-flight prefetched
+batches (the commit must never advance the reader past the step
+counter)."""
+
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import io, observability, resilience
+from paddle_tpu.reader import GeneratorLoader
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(HERE, "tools"))
+
+import chaos_train  # noqa: E402  (deterministic model zoo + feeds)
+
+FEEDER_NAME = "pt-dispatch-feeder"
+
+
+def _feeder_threads():
+    return [t for t in threading.enumerate() if t.name == FEEDER_NAME]
+
+
+def _assert_no_feeder_left(timeout=2.0):
+    """The feeder must exit promptly once its pipeline ends — an
+    orphan would pin device batches for the process lifetime."""
+    deadline = time.time() + timeout
+    while _feeder_threads() and time.time() < deadline:
+        time.sleep(0.01)
+    assert not _feeder_threads(), "orphan feeder thread survived shutdown"
+
+
+def _train_mlp(seed=7):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = seed
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data("x", [8])
+        y = fluid.layers.data("y", [1], dtype="int64")
+        h = fluid.layers.fc(x, 16, act="relu")
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(
+                fluid.layers.fc(h, 4), y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    return main, startup, loss
+
+
+def _batches(sizes):
+    """Deterministic feed per index; batch size pattern drives the
+    signature churn."""
+    for i, b in enumerate(sizes):
+        rng = np.random.RandomState(100 + i)
+        yield {"x": rng.rand(b, 8).astype("float32"),
+               "y": (rng.rand(b, 1) > 0.5).astype("int64")}
+
+
+# churny pattern: three signature segments with a revisit (4 -> 6 -> 4)
+CHURN = [4, 4, 4, 6, 6, 4, 4, 8, 8, 8, 4, 6]
+
+
+def test_pipelined_bit_exact_and_ordered_vs_churny_sync():
+    """The async path must be bit-identical to per-feed `run` even
+    when the feed signature changes mid-stream (segment re-bind). The
+    optimizer state update makes the trajectory order-sensitive, so
+    bitwise equality also proves ordering."""
+    sync_losses = []
+    scope = fluid.Scope()
+    main, startup, loss = _train_mlp()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        for f in _batches(CHURN):
+            out = exe.run(main, feed=f, fetch_list=[loss])
+            sync_losses.append(np.asarray(out[0]))
+
+    async_losses = []
+    scope2 = fluid.Scope()
+    main2, startup2, loss2 = _train_mlp()
+    with fluid.scope_guard(scope2):
+        exe2 = fluid.Executor(fluid.CPUPlace())
+        exe2.run(startup2)
+        for outs in exe2.run_pipelined(main2, _batches(CHURN), [loss2]):
+            async_losses.append(np.asarray(outs[0]))
+
+    assert len(async_losses) == len(CHURN)
+    for i, (a, b) in enumerate(zip(sync_losses, async_losses)):
+        assert a.tobytes() == b.tobytes(), f"step {i} diverged"
+    _assert_no_feeder_left()
+
+
+def test_pipelined_matches_interleaved_plain_run():
+    """run_pipelined and run funnel through the same _run_ordered
+    dispatch: a pipelined prefix then plain-run suffix continues the
+    exact same trajectory (state/PRNG counters flow through)."""
+    ref = []
+    scope = fluid.Scope()
+    main, startup, loss = _train_mlp()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        for f in _batches([4] * 8):
+            ref.append(np.asarray(
+                exe.run(main, feed=f, fetch_list=[loss])[0]))
+
+    got = []
+    scope2 = fluid.Scope()
+    main2, startup2, loss2 = _train_mlp()
+    with fluid.scope_guard(scope2):
+        exe2 = fluid.Executor(fluid.CPUPlace())
+        exe2.run(startup2)
+        for outs in exe2.run_pipelined(main2, _batches([4] * 4), [loss2]):
+            got.append(np.asarray(outs[0]))
+        for f in list(_batches([4] * 8))[4:]:
+            got.append(np.asarray(
+                exe2.run(main2, feed=f, fetch_list=[loss2])[0]))
+    assert [a.tobytes() for a in ref] == [a.tobytes() for a in got]
+
+
+def test_feed_thread_exception_propagates_in_order():
+    """An error raised by the feed iterable surfaces to the consumer
+    AFTER every prior step's result, with the feeder reaped."""
+    main, startup, loss = _train_mlp()
+    scope = fluid.Scope()
+
+    def bad_feeds():
+        yield from _batches([4, 4, 4])
+        raise ValueError("boom at feed 3")
+
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        got = []
+        gen = exe.run_pipelined(main, bad_feeds(), [loss])
+        with pytest.raises(ValueError, match="boom at feed 3"):
+            for outs in gen:
+                got.append(outs)
+        assert len(got) == 3  # every good step delivered first
+    _assert_no_feeder_left()
+
+
+def test_clean_shutdown_mid_overlap():
+    """Abandoning the generator mid-stream (break + close) must stop
+    and join the feeder even while it is parked on a full queue, and
+    the executor must remain usable."""
+    main, startup, loss = _train_mlp()
+    scope = fluid.Scope()
+
+    def endless():
+        i = 0
+        while True:  # pragma: no branch
+            rng = np.random.RandomState(i)
+            yield {"x": rng.rand(4, 8).astype("float32"),
+                   "y": np.zeros((4, 1), "int64")}
+            i += 1
+
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        gen = exe.run_pipelined(main, endless(), [loss], depth=2)
+        for n, _ in enumerate(gen):
+            if n == 2:
+                break
+        gen.close()
+        _assert_no_feeder_left()
+        # still healthy: a fresh pipelined stream over the same binding
+        n = sum(1 for _ in exe.run_pipelined(
+            main, _batches([4] * 3), [loss]))
+        assert n == 3
+    _assert_no_feeder_left()
+
+
+def test_overlap_telemetry_exported():
+    """run_pipelined feeds the paddle_step_overlap_* gauges in the
+    unified registry."""
+    from paddle_tpu.observability.registry import overlap_telemetry
+
+    before = overlap_telemetry().snapshot()
+    main, startup, loss = _train_mlp()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        for _ in exe.run_pipelined(main, _batches([4] * 5), [loss]):
+            pass
+    after = overlap_telemetry().snapshot()
+    assert after["steps"] >= before["steps"] + 5
+    assert after["feed_ms_sum"] > before["feed_ms_sum"]
+    assert 0.0 <= after["hidden_fraction"] <= 1.0
+    flat = " ".join(observability.snapshot()["collected"].keys())
+    assert "paddle_step_overlap_steps_total" in flat
+    assert "paddle_step_overlap_hidden_fraction" in flat
+
+
+def test_reader_prefetch_depth_flag_and_explicit_arg():
+    """The historical hard-coded depth-2 device buffer follows the
+    reader_prefetch_depth live flag, with the explicit ctor arg
+    winning; both are clamped to >= 1."""
+    saved = {"reader_prefetch_depth": fluid.flags.flag(
+        "reader_prefetch_depth")}
+
+    def make(depth_arg=None):
+        loader = GeneratorLoader(feed_list=[], use_double_buffer=True,
+                                 prefetch_depth=depth_arg)
+        loader.set_batch_generator(
+            lambda: ({"x": np.zeros((2, 4), "float32")} for _ in range(6)))
+        return loader
+
+    try:
+        fluid.set_flags({"reader_prefetch_depth": 4})
+        loader = make()
+        assert sum(1 for _ in loader) == 6
+        assert loader._active_depth == 4
+        # explicit arg beats the flag
+        loader = make(depth_arg=1)
+        assert sum(1 for _ in loader) == 6
+        assert loader._active_depth == 1
+        # nonsense flag value clamps instead of a zero-size queue
+        fluid.set_flags({"reader_prefetch_depth": 0})
+        loader = make()
+        assert sum(1 for _ in loader) == 6
+        assert loader._active_depth == 1
+    finally:
+        fluid.set_flags(saved)
+
+
+def test_reader_stall_counters_and_scrape():
+    """A slow consumer trips buffer-full stalls, a slow producer trips
+    buffer-empty stalls, and both export through the unified registry
+    so feed starvation is visible in one scrape."""
+    def make(producer_delay=0.0, n=8):
+        def gen():
+            for _ in range(n):
+                if producer_delay:
+                    time.sleep(producer_delay)
+                yield {"x": np.zeros((2, 4), "float32")}
+
+        loader = GeneratorLoader(feed_list=[], use_double_buffer=True,
+                                 prefetch_depth=2)
+        loader.set_batch_generator(gen)
+        return loader
+
+    # slow consumer: the producer races ahead and parks on a full queue
+    loader = make()
+    for _ in loader:
+        time.sleep(0.02)
+    assert loader._stall_full > 0
+
+    # slow producer: the consumer drains the queue and waits
+    loader2 = make(producer_delay=0.02)
+    for _ in loader2:
+        pass
+    assert loader2._stall_empty > 0
+
+    flat = " ".join(observability.snapshot()["collected"].keys())
+    assert "paddle_reader_buffer_full_stall_total" in flat
+    assert "paddle_reader_buffer_empty_stall_total" in flat
+
+
+def test_supervisor_commit_ignores_prefetch_runahead(tmp_path):
+    """With the device prefetch buffer active the loader's position
+    counter runs AHEAD of the training step (batches are in flight on
+    device). The commit marker must record the step counter, not the
+    loader position — a resumed run replaying from the marker must be
+    bit-exact with an uninterrupted one."""
+    def make_loader():
+        loader = GeneratorLoader(feed_list=[], use_double_buffer=True,
+                                 prefetch_depth=4)
+        loader.set_batch_generator(
+            lambda: (chaos_train.feed_fn(s) for s in range(64)))
+        return loader
+
+    def run(steps, ck, seed=41):
+        main, startup, loss = chaos_train.build_model(seed)
+        losses = {}
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.TPUPlace())
+            exe.run(startup)
+            sup = resilience.Supervisor(
+                exe, main, checkpoint_dir=ck, data=make_loader(),
+                fetch_list=[loss],
+                policy=resilience.CheckpointPolicy(ck, every_steps=3,
+                                                   keep_last=3),
+                on_step=lambda s, f: losses.__setitem__(
+                    s, np.asarray(f[0]).tobytes()))
+            stats = sup.run_loop(steps, final_checkpoint=False)
+        return losses, stats
+
+    # uninterrupted reference over 10 steps
+    ref, _ = run(10, str(tmp_path / "ref"))
+
+    ck = str(tmp_path / "ck")
+    _, stats = run(7, ck)
+    marker = io.read_commit_marker(os.path.join(ck, "6"))
+    # the loader prefetched past step 6 when the commit was cut; the
+    # marker must still say 6
+    assert marker["extra"]["reader_position"] == 6
+    losses2, stats2 = run(10, ck)
+    assert stats2["resumed_from"] == 6
+    assert stats2["steps_completed"] == 4
+    assert {s: ref[s] for s in losses2} == losses2

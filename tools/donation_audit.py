@@ -1,0 +1,300 @@
+#!/usr/bin/env python
+"""Donation / host-sync audit over every bound executable.
+
+The MFU headline (BENCH_r04/r05.json) says the device is ~idle; the
+two silent ways a framework re-creates that state are (a) state
+buffers that stop being donated — every step then materializes a second
+copy of the parameters and pays an HBM round trip the reference's
+in-place ParamOut update never did — and (b) host-sync points creeping
+onto the hot path (`block_until_ready`, implicit `np.asarray` on a
+fetch), which serialize the async pipeline the loader and the
+dispatch feeder exist to fill.
+
+This tool drives every subsystem that owns executables — Executor
+training step, Predictor inference, ServingEngine worker pool,
+GenerationEngine prefill + decode lanes — through a tiny model each,
+then walks the process-wide BoundStep registry
+(`runtime.dispatch.live_bound_steps()`) and reports, per call site:
+
+  * which rewritten state buffers COULD be donated vs which ARE
+    (donation is forced on for the audit run — on CPU the executor
+    deliberately skips it for speed, which would make the check
+    vacuous);
+  * how many times the call site forced a host sync on the fetch path
+    (BoundStep counts every return_numpy conversion and every
+    FLAGS_benchmark forced sync);
+  * the per-executable XLA memory/cost analysis
+    (`observability_xla_analysis` gauges: argument/output/temp bytes,
+    flops) so a donation miss is visible as bytes, not just a name.
+
+The verdict diffs against the checked-in allowlist
+(tools/donation_allowlist.json): a donation miss or a host-syncing
+call site that is not allowlisted fails the run (CI gates on this).
+`--update` rewrites the allowlist from the observed state after a
+deliberate change.
+
+Run:  JAX_PLATFORMS=cpu python tools/donation_audit.py --out audit.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(HERE))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("JAX_PLATFORM_NAME", "cpu")
+
+ALLOWLIST_PATH = os.path.join(HERE, "donation_allowlist.json")
+
+import numpy as np  # noqa: E402
+
+
+# -- subsystem drivers --------------------------------------------------------
+
+
+def _phase_executor(fluid):
+    """Training step: forward + backward + SGD — rewritten params and
+    optimizer state are exactly the buffers donation must alias."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data("x", [16])
+        y = fluid.layers.data("y", [1], dtype="int64")
+        h = fluid.layers.fc(x, 16, act="relu")
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(
+                fluid.layers.fc(h, 10), y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe._force_donation = True  # CPU skips donation; the audit must see it
+        exe.run(startup)
+        feed = {"x": np.random.RandomState(0).rand(8, 16).astype("float32"),
+                "y": np.zeros((8, 1), "int64")}
+        for _ in range(3):
+            exe.run(main, feed=feed, fetch_list=[loss])
+    return [exe, scope]
+
+
+def _export_infer_model(fluid, tmpdir):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data("x", [6])
+        h = fluid.layers.fc(x, 12, act="relu")
+        out = fluid.layers.fc(h, 3, act="softmax")
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        fluid.io.save_inference_model(tmpdir, ["x"], [out], exe, main)
+
+
+def _phase_predictor(fluid, tmpdir):
+    from paddle_tpu.inference import Config, create_predictor
+
+    cfg = Config(tmpdir)
+    cfg.enable_shape_bucketing(seq_buckets=(16, 32), batch_buckets=(4, 8))
+    pred = create_predictor(cfg)
+    pred._exe._force_donation = True
+    rng = np.random.RandomState(1)
+    for b in (2, 4):
+        pred.run([rng.rand(b, 6).astype("float32")])
+    return [pred]
+
+
+def _phase_serving(fluid, tmpdir):
+    from paddle_tpu.inference import Config, create_predictor
+    from paddle_tpu.serving import ServingEngine
+
+    pred = create_predictor(Config(tmpdir))
+    pred._exe._force_donation = True
+    eng = ServingEngine(pred, num_workers=2, max_batch_size=4,
+                        batch_timeout_ms=1.0)
+    rng = np.random.RandomState(2)
+    for _ in range(3):
+        eng.predict({"x": rng.rand(2, 6).astype("float32")}, timeout=60)
+    eng.close(drain=True)
+    return [pred, eng]
+
+
+def _phase_generation(fluid, tmpdir):
+    from paddle_tpu import generation
+    from paddle_tpu.generation.model import GPTConfig, build_lm_program
+    from paddle_tpu.inference import Config, create_predictor
+
+    cfg = GPTConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                    num_heads=2, ffn_size=64, max_position=64,
+                    hidden_dropout=0.0, attention_dropout=0.0)
+    seq = 32
+    lm_dir = os.path.join(tmpdir, "lm")
+    main, startup, _feeds, fetches = build_lm_program(cfg, seq)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        fluid.io.save_inference_model(lm_dir, ["tokens"],
+                                      [fetches["logits"]], exe, main)
+    pred = create_predictor(Config(lm_dir))
+    pred._exe._force_donation = True
+    eng = generation.GenerationEngine(
+        pred, cfg, page_size=8, num_pages=64, max_decode_batch=4,
+        prefill_buckets=(16, seq))
+    rng = np.random.RandomState(3)
+    streams = [eng.submit(rng.randint(1, cfg.vocab_size, 7).astype(np.int64),
+                          max_new_tokens=4) for _ in range(3)]
+    for s in streams:
+        s.result(timeout=300)
+    eng.close(drain=True)
+    return [pred, eng]
+
+
+# -- the audit ----------------------------------------------------------------
+
+
+def run_audit():
+    import paddle_tpu as fluid
+    from paddle_tpu.runtime import dispatch
+
+    # per-executable XLA memory/cost gauges must be captured at compile
+    # time — turn the analysis on BEFORE anything binds
+    fluid.set_flags({"observability_xla_analysis": True})
+
+    tmpdir = tempfile.mkdtemp(prefix="pt_donation_audit_")
+    keep = []  # strong refs: audited bound steps must not be GC'd mid-report
+    sites = {}
+    seen = set()
+
+    def snapshot(site):
+        new = [b for b in dispatch.live_bound_steps() if id(b) not in seen]
+        for b in new:
+            seen.add(id(b))
+        keep.extend(new)
+        sites[site] = new
+
+    try:
+        keep.extend(_phase_executor(fluid))
+        snapshot("executor.train")
+        _export_infer_model(fluid, tmpdir)
+        snapshot("model_export")  # save/load machinery, not a hot path
+        keep.extend(_phase_predictor(fluid, tmpdir))
+        snapshot("predictor.run")
+        keep.extend(_phase_serving(fluid, tmpdir))
+        snapshot("serving.predict")
+        keep.extend(_phase_generation(fluid, tmpdir))
+        snapshot("generation")
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+    report = {"sites": {}, "summary": {
+        "total_executables": 0,
+        "host_sync_sites": {},
+        "donation_missed": [],
+    }}
+    for site, bounds in sites.items():
+        rows = sorted((b.audit_info() for b in bounds),
+                      key=lambda r: r["tag"])
+        report["sites"][site] = rows
+        report["summary"]["total_executables"] += len(rows)
+        syncs = sum(r["host_sync_calls"] for r in rows)
+        if syncs:
+            report["summary"]["host_sync_sites"][site] = syncs
+        for r in rows:
+            for name in r["donation_missed"]:
+                report["summary"]["donation_missed"].append(
+                    {"site": site, "tag": r["tag"], "state": name})
+    return report
+
+
+def load_allowlist():
+    if not os.path.exists(ALLOWLIST_PATH):
+        return {"host_sync": {}, "donation_miss": []}
+    with open(ALLOWLIST_PATH) as f:
+        allow = json.load(f)
+    if isinstance(allow.get("host_sync"), list):
+        # legacy presence-only form: tolerate it, but every listed site
+        # gates at its CURRENT count the next time --update runs
+        allow["host_sync"] = {s: None for s in allow["host_sync"]}
+    return allow
+
+
+def check(report, allow):
+    """Regressions = observed behavior the allowlist does not cover.
+    Host-sync sites gate on COUNT, not just presence: the audit
+    drivers run a fixed step count per phase, so a new forced sync
+    inside an already-allowlisted site shows up as a higher number."""
+    violations = []
+    allowed_sync = allow.get("host_sync", {})
+    allowed_miss = {(m["site"], m["state"])
+                    for m in allow.get("donation_miss", [])}
+    for site, n in report["summary"]["host_sync_sites"].items():
+        if site not in allowed_sync:
+            violations.append(
+                f"host-sync regression: call site {site!r} forced {n} "
+                "host sync(s) on the fetch path and is not allowlisted "
+                "(tools/donation_allowlist.json)")
+        elif allowed_sync[site] is not None and n > allowed_sync[site]:
+            violations.append(
+                f"host-sync regression: call site {site!r} forced {n} "
+                f"host sync(s), up from the allowlisted "
+                f"{allowed_sync[site]} — a new sync crept onto the "
+                "fetch path (rerun with --update only if deliberate)")
+    for m in report["summary"]["donation_missed"]:
+        if (m["site"], m["state"]) not in allowed_miss:
+            violations.append(
+                f"donation regression: {m['site']} / {m['tag']} rewrites "
+                f"state {m['state']!r} without donating its buffer")
+    return violations
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default=None, help="write the report JSON here")
+    ap.add_argument("--update", action="store_true",
+                    help="rewrite the allowlist from the observed state")
+    args = ap.parse_args()
+
+    report = run_audit()
+    allow = load_allowlist()
+    violations = check(report, allow)
+    report["violations"] = violations
+    report["allowlist"] = allow
+
+    out = json.dumps(report, indent=2, sort_keys=True)
+    print(out)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(out + "\n")
+
+    if args.update:
+        new_allow = {
+            "host_sync": dict(sorted(
+                report["summary"]["host_sync_sites"].items())),
+            "donation_miss": [
+                {"site": m["site"], "state": m["state"]}
+                for m in report["summary"]["donation_missed"]],
+        }
+        with open(ALLOWLIST_PATH, "w") as f:
+            json.dump(new_allow, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"[donation_audit] allowlist rewritten: {ALLOWLIST_PATH}",
+              file=sys.stderr)
+        return 0
+
+    if violations:
+        for v in violations:
+            print(f"[donation_audit] {v}", file=sys.stderr)
+        return 1
+    print("[donation_audit] OK: zero non-allowlisted donation misses / "
+          "host-sync points", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
