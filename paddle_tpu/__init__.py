@@ -71,6 +71,7 @@ from . import analysis  # static Program-IR verifier / lint (proglint)
 from . import serving  # dynamic-batching inference serving (engine/server)
 from . import generation  # paged KV-cache + continuous-batching decode
 from . import resilience  # fault-tolerant training supervisor (chaos-tested)
+from . import partition  # logical-axis-rules partitioner (sharded execution)
 from . import observability  # unified telemetry: metrics/tracing/flight
 
 # ``fluid``-style alias so reference user code reads naturally:

@@ -73,6 +73,9 @@ class CompiledProgram:
         self._axis_env = None
         # which with_* strategy built _mesh (chaining guard)
         self._strategy = None
+        # the ResolvedPartition when with_partitioning built the mesh
+        # (report/gauge access; None for the other strategies)
+        self._partition = None
         # cache-key fragment (mesh/device fingerprint, sharding tuples)
         # precomputed once for the executor's hot-path dispatch cache
         # instead of per Executor.run call (runtime/dispatch)
@@ -167,6 +170,44 @@ class CompiledProgram:
         if dp > 1:
             return Mesh(devs[:need].reshape(dp, n), ("dp", axis))
         return Mesh(devs[:n], (axis,))
+
+    def with_partitioning(self, config=None, devices=None,
+                          **kwargs) -> "CompiledProgram":
+        """The logical-axis-rules partitioner (paddle_tpu.partition):
+        resolve a complete sharding assignment — feeds, params,
+        optimizer state — from the config's rules table over its mesh,
+        and attach it to this compile. Unlike the single-form with_*
+        strategies above, one config drives EVERY parallelism the
+        rules express at once (dp batch sharding, tp megatron weights,
+        ZeRO state) and the same rules serve any mesh shape.
+
+        ``config`` is a ``partition.PartitionConfig`` (or None to build
+        one from ``kwargs`` / the ``partition_*`` flags). ``devices``
+        optionally pins the device set (defaults to ``jax.devices()``).
+        The resolve report is kept on ``self.partition`` and exported
+        as ``paddle_partition_*`` gauges."""
+        from ..partition import PartitionConfig
+
+        if config is None:
+            config = PartitionConfig(**kwargs)
+        elif kwargs:
+            raise ValueError(
+                "with_partitioning: pass a PartitionConfig OR keyword "
+                "arguments for one, not both")
+        self._claim_strategy("with_partitioning")
+        resolved = config.resolve(self._program, devices=devices)
+        self._mesh = resolved.mesh
+        self._in_shardings = dict(resolved.in_shardings)
+        self._state_shardings = dict(resolved.state_shardings) or None
+        self._partition = resolved
+        return self
+
+    @property
+    def partition(self):
+        """The ResolvedPartition attached by with_partitioning (None
+        otherwise) — ``.report()`` answers "what sharded and why not
+        the rest"."""
+        return self._partition
 
     def with_sequence_parallel(self, sp: int, dp: int = 1,
                                places=None,

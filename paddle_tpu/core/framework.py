@@ -200,6 +200,12 @@ class Variable:
         # Optional sharding annotation (PartitionSpec-like tuple of
         # axis-name-or-None per dim) consumed by the distributed executor.
         self.sharding: Optional[tuple] = None
+        # Optional LOGICAL axis names per dim ("batch", "embed",
+        # "heads", ...) — what the dims MEAN, not where they live; the
+        # partition subsystem's rules table resolves these to mesh axes
+        # per compile (partition/rules.py), so one tagged model serves
+        # every mesh shape. Stamped via ParamAttr(logical_axes=...).
+        self.logical_axes: Optional[tuple] = None
 
     # -- reference-API surface ------------------------------------------------
     @property
@@ -267,8 +273,8 @@ class Variable:
     # and accumulator/MoE ownership drive re-sharding of a LOADED
     # program (with_expert_parallel, shard_optimizer_states) — losing
     # them would make a deserialized program silently unshardable
-    _SERIALIZED_TAGS = ("sharding", "is_accumulator", "accumulator_owner",
-                        "_moe_expert_param")
+    _SERIALIZED_TAGS = ("sharding", "logical_axes", "is_accumulator",
+                        "accumulator_owner", "_moe_expert_param")
 
     def to_dict(self) -> Dict[str, Any]:
         d = {
@@ -617,6 +623,8 @@ class Program:
                         # entries may themselves be joint-axis tuples
                         val = tuple(tuple(e) if isinstance(e, list) else e
                                     for e in val)
+                    elif t == "logical_axes":
+                        val = tuple(val)
                     setattr(nv, t, val)
             for od in bd["ops"]:
                 attrs = {}

@@ -89,6 +89,17 @@ _FLAG_DEFS: Dict[str, Any] = {
     "resilience_max_rollbacks": 2,
     "resilience_watchdog_timeout_s": 0.0,
     "resilience_fault_spec": "",
+    # partition/ (logical-axis-rules partitioner) defaults, consumed by
+    # PartitionConfig(): partition_mesh is the mesh shape ("dp=4,tp=2";
+    # "" = no default mesh, configs must pass mesh_axes=);
+    # partition_rules overrides the logical->mesh axis rules table
+    # ("batch=dp,heads=tp,embed=", empty right side = replicated; "" =
+    # partition.DEFAULT_RULES); partition_zero is the ZeRO level for
+    # optimizer state (0 = replicated, 1 = shard accumulators over dp,
+    # 3 = shard params too)
+    "partition_mesh": "",
+    "partition_rules": "",
+    "partition_zero": 0,
     # observability/ (unified telemetry): observability_metrics turns
     # on per-step telemetry instruments (wall time, examples/sec) in
     # the dispatch hot path; observability_tracing upgrades span call

@@ -30,6 +30,7 @@ class Config:
         self._seq_buckets = ()
         self._batch_buckets = ()
         self._pad_batch = True
+        self._partition = None
 
     def set_model(self, prog_file_or_dir, params_file=None):
         if params_file is None:
@@ -80,6 +81,27 @@ class Config:
         self._batch_buckets = sorted(batch_buckets or
                                      (1, 2, 4, 8, 16, 32, 64, 128))
         self._pad_batch = pad_batch
+
+    def enable_partitioning(self, config=None, **kwargs):
+        """Shard this predictor over a device mesh via the
+        logical-axis-rules partitioner (paddle_tpu.partition) — the
+        serving analog of ``CompiledProgram.with_partitioning``. With
+        ``mesh_axes={"tp": N}`` the model's tagged weights (heads/mlp/
+        vocab axes) shard tensor-parallel over N devices; clones (the
+        ServingEngine worker pool) share the one mesh and the one set
+        of sharded weight buffers, so N workers serve a model N times
+        larger than one device holds. ``config`` is a PartitionConfig,
+        or pass its keyword arguments (mesh_axes/rules/var_rules/zero)
+        directly; defaults come from the ``partition_*`` flags."""
+        from ..partition import PartitionConfig
+
+        if config is None:
+            config = PartitionConfig(**kwargs)
+        elif kwargs:
+            raise ValueError(
+                "enable_partitioning: pass a PartitionConfig OR keyword "
+                "arguments for one, not both")
+        self._partition = config
 
     def switch_ir_optim(self, flag=True):
         self._aot = flag
@@ -149,6 +171,20 @@ class Predictor:
             from ..contrib.mixed_precision.fp16_lists import AutoMixedPrecisionLists
 
             _insert_cast_ops(self._program.global_block(), AutoMixedPrecisionLists())
+        # the program handed to Executor.bind: plain, or — under
+        # enable_partitioning — a CompiledProgram carrying the resolved
+        # mesh + shardings, so the SAME BoundStep path runs the request
+        # tensor-parallel (logical_axes tags survive save/load via the
+        # serialized var tags, so a loaded GPT is tp-ready untouched)
+        self._run_program = self._program
+        self.partition = None
+        if config._partition is not None:
+            from ..core.compiler import CompiledProgram
+
+            cp = CompiledProgram(self._program).with_partitioning(
+                config._partition)
+            self._run_program = cp
+            self.partition = cp.partition
         block = self._program.global_block()
         self._inputs = {
             n: _Tensor(n, block.var(n).shape if block.has_var(n) else None)
@@ -353,7 +389,7 @@ class Predictor:
                 bound = self._bindings.get(key)
                 if bound is None:
                     bound = self._exe.bind(
-                        self._program, feed, self._fetch_vars,
+                        self._run_program, feed, self._fetch_vars,
                         scope=self._scope, tag=self.bind_tag)
                     self._bindings[key] = bound
                     while len(self._bindings) > self._bindings_cap:
@@ -417,6 +453,9 @@ class Predictor:
         p._scope = self._scope
         p._exe = self._exe
         p._program = self._program
+        # one mesh + one sharding resolve for the whole worker pool
+        p._run_program = self._run_program
+        p.partition = self.partition
         p._feed_names = self._feed_names
         p._fetch_vars = self._fetch_vars
         p._inputs = {n: _Tensor(n, t._static_shape)

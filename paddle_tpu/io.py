@@ -217,6 +217,23 @@ def _checkpoint_manifest(path):
     return out
 
 
+def _is_commit_process():
+    """Mesh-aware commit protocol: every process saves its OWN
+    addressable shards (orbax coordinates the array writes), but
+    exactly one process — process 0 — stamps the commit marker, after
+    the collective save completed. A marker written by a straggler
+    while another process's shards were still in flight would publish
+    a checkpoint the resume path believes complete. Single-process
+    (including the 8-emulated-host-device CI mesh) is trivially
+    process 0."""
+    try:
+        import jax
+
+        return jax.process_index() == 0
+    except Exception:  # noqa: BLE001 — jax not initialized: lone writer
+        return True
+
+
 def write_commit_marker(path, extra=None):
     """Mark a checkpoint directory committed. Written atomically (temp
     + rename) so a crash mid-write leaves no marker — i.e. the dir
@@ -313,7 +330,8 @@ def save_checkpoint(dirname, main_program=None, scope=None, step=None,
         def _commit():
             try:
                 ckptr.wait_until_finished()
-                write_commit_marker(path, extra)
+                if _is_commit_process():
+                    write_commit_marker(path, extra)
             except BaseException as e:  # noqa: BLE001 — re-raised at wait
                 commit_err.append(e)
                 raise
@@ -328,7 +346,8 @@ def save_checkpoint(dirname, main_program=None, scope=None, step=None,
         return _AsyncSaveHandle(ckptr, committer, commit_err)
     ocp.Checkpointer(ocp.StandardCheckpointHandler()).save(
         path, state, force=True)
-    write_commit_marker(path, extra)
+    if _is_commit_process():
+        write_commit_marker(path, extra)
     return None
 
 

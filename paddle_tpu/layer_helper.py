@@ -153,6 +153,13 @@ class LayerHelper:
             optimize_attr={"learning_rate": attr.learning_rate},
             stop_gradient=stop_gradient,
         )
+        if getattr(attr, "logical_axes", None):
+            if len(attr.logical_axes) != len(shape):
+                raise ValueError(
+                    f"param {attr.name!r}: logical_axes "
+                    f"{attr.logical_axes} has {len(attr.logical_axes)} "
+                    f"entries for a rank-{len(shape)} parameter")
+            param.logical_axes = tuple(attr.logical_axes)
         # mirror into startup program + init op
         startup_gb = self.startup_program.global_block()
         sp = startup_gb.create_parameter(

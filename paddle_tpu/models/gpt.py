@@ -59,8 +59,13 @@ class GPTConfig:
                          ffn_size=8192, max_position=1024)
 
 
-def _attr(name, std):
-    return ParamAttr(name=name, initializer=NormalInitializer(0.0, std))
+def _attr(name, std, axes=None):
+    # logical_axes: what each weight dim MEANS — the partition
+    # subsystem's rules table maps them to mesh axes per compile
+    # (partition/), so this one tagging makes GPT tensor-parallel
+    # ready on any mesh with zero further model edits
+    return ParamAttr(name=name, initializer=NormalInitializer(0.0, std),
+                     logical_axes=axes)
 
 
 def _decoder_layer(x, cfg: GPTConfig, idx: int, is_test=False,
@@ -75,8 +80,9 @@ def _decoder_layer(x, cfg: GPTConfig, idx: int, is_test=False,
     )
     qkv = layers.fc(
         ln1, 3 * h, num_flatten_dims=2,
-        param_attr=_attr(f"{pre}_qkv.w", std),
-        bias_attr=ParamAttr(name=f"{pre}_qkv.b"),
+        param_attr=_attr(f"{pre}_qkv.w", std, axes=("embed", "heads")),
+        bias_attr=ParamAttr(name=f"{pre}_qkv.b",
+                            logical_axes=("heads",)),
     )
     q, k, v = layers.split(qkv, 3, dim=2)
     if cfg.use_flash_attention:
@@ -90,7 +96,7 @@ def _decoder_layer(x, cfg: GPTConfig, idx: int, is_test=False,
         )
     proj = layers.fc(
         ctx, h, num_flatten_dims=2,
-        param_attr=_attr(f"{pre}_proj.w", std),
+        param_attr=_attr(f"{pre}_proj.w", std, axes=("heads", "embed")),
         bias_attr=ParamAttr(name=f"{pre}_proj.b"),
     )
     if not is_test and cfg.hidden_dropout:
@@ -113,12 +119,15 @@ def _decoder_layer(x, cfg: GPTConfig, idx: int, is_test=False,
     else:
         ffn1 = layers.fc(
             ln2, cfg.ffn_size, num_flatten_dims=2, act="gelu",
-            param_attr=_attr(f"{pre}_ffn1.w", std),
-            bias_attr=ParamAttr(name=f"{pre}_ffn1.b"),
+            param_attr=_attr(f"{pre}_ffn1.w", std,
+                             axes=("embed", "mlp")),
+            bias_attr=ParamAttr(name=f"{pre}_ffn1.b",
+                                logical_axes=("mlp",)),
         )
         ffn2 = layers.fc(
             ffn1, h, num_flatten_dims=2,
-            param_attr=_attr(f"{pre}_ffn2.w", std),
+            param_attr=_attr(f"{pre}_ffn2.w", std,
+                             axes=("mlp", "embed")),
             bias_attr=ParamAttr(name=f"{pre}_ffn2.b"),
         )
     if not is_test and cfg.hidden_dropout:
@@ -136,12 +145,14 @@ def build_gpt_lm(cfg: GPTConfig, seq_len: int, optimizer=None, is_test=False):
         labels = layers.data("labels", [seq_len], dtype="int64")
         emb = layers.embedding(
             tokens, size=[cfg.vocab_size, cfg.hidden_size],
-            param_attr=_attr("gpt_tok_emb", cfg.initializer_range),
+            param_attr=_attr("gpt_tok_emb", cfg.initializer_range,
+                             axes=("vocab", "embed")),
         )
         pos = layers.embedding(
             layers.assign(np.arange(seq_len, dtype="int64")[None, :]),
             size=[cfg.max_position, cfg.hidden_size],
-            param_attr=_attr("gpt_pos_emb", cfg.initializer_range),
+            param_attr=_attr("gpt_pos_emb", cfg.initializer_range,
+                             axes=("seq", "embed")),
         )
         x = layers.elementwise_add(emb, pos)
         aux_losses = []
@@ -155,8 +166,10 @@ def build_gpt_lm(cfg: GPTConfig, seq_len: int, optimizer=None, is_test=False):
         )
         logits = layers.fc(
             x, cfg.vocab_size, num_flatten_dims=2,
-            param_attr=_attr("gpt_head.w", cfg.initializer_range),
-            bias_attr=ParamAttr(name="gpt_head.b"),
+            param_attr=_attr("gpt_head.w", cfg.initializer_range,
+                             axes=("embed", "vocab")),
+            bias_attr=ParamAttr(name="gpt_head.b",
+                                logical_axes=("vocab",)),
         )
         loss = layers.mean(
             layers.softmax_with_cross_entropy(

@@ -294,6 +294,7 @@ _executors: "weakref.WeakSet" = weakref.WeakSet()
 _supervisors: "weakref.WeakSet" = weakref.WeakSet()
 _loaders: "weakref.WeakSet" = weakref.WeakSet()
 _generation: "weakref.WeakSet" = weakref.WeakSet()
+_partitions: "weakref.WeakSet" = weakref.WeakSet()
 
 
 def watch_serving(metrics) -> None:
@@ -331,6 +332,16 @@ def watch_generation(metrics) -> None:
     the TTFT / inter-token latency quantiles in the one scrape."""
     _obs_id(metrics)
     _generation.add(metrics)
+
+
+def watch_partition(resolved) -> None:
+    """Called by partition.ResolvedPartition.__init__: each live
+    resolve exports as the ``paddle_partition_*{resolve=}`` family —
+    mesh shape (one ``_mesh_<axis>`` gauge per axis), sharded vs
+    replicated state bytes, and per-kind var counts — so "how much of
+    my model actually sharded" is one scrape, not an HLO dump."""
+    _obs_id(resolved)
+    _partitions.add(resolved)
 
 
 def _flatten(prefix: str, d: Dict[str, Any], out: Dict[str, float]) -> None:
@@ -440,6 +451,17 @@ def _collect_generation():
                     lambda e: e.stats_numeric())
 
 
+def _collect_partition():
+    def snap(rp):
+        d = dict(rp.summary)
+        d["mesh_devices"] = int(rp.mesh.devices.size)
+        d["mesh"] = {str(k): int(v) for k, v in rp.mesh_axes().items()}
+        d["zero"] = int(rp.config.zero)
+        return d
+
+    return _labeled(_partitions, "resolve", "paddle_partition", snap)
+
+
 def _collect_build_info():
     from .. import version
 
@@ -455,6 +477,7 @@ for _name, _fn in (
     ("resilience", _collect_supervisors),
     ("reader", _collect_loaders),
     ("generation", _collect_generation),
+    ("partition", _collect_partition),
     ("build_info", _collect_build_info),
 ):
     _REGISTRY.register_collector(_name, _fn)

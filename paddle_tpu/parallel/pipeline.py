@@ -79,7 +79,8 @@ def _legacy_shard_map_kwargs(kwargs, mesh):
     return legacy
 
 
-def _checked_shard_map(per_device, mesh, kwargs):
+def _checked_shard_map(per_device, mesh, kwargs, op="pipeline schedule",
+                       alternative=None):
     """shard_map with replication/varying checks off, across jax
     versions. New API first (check_vma + axis_names); the
     jax.experimental fallback spells partial-manual as auto= and has
@@ -88,7 +89,8 @@ def _checked_shard_map(per_device, mesh, kwargs):
     instead of working (round-5 advisor finding). Where the legacy
     partial-manual path is still broken (its autodiff transpose
     mis-specs scalar outputs), the opaque _SpecError is converted to a
-    clear unsupported-version message."""
+    diagnostic naming the exact op (``op``, from the call site) and
+    the supported alternative (``alternative``)."""
     smap = _shard_map()
     try:
         return smap(per_device, check_vma=False, **kwargs)
@@ -100,23 +102,26 @@ def _checked_shard_map(per_device, mesh, kwargs):
         # mis-specs scalar outputs under autodiff (observed: _SpecError
         # from value_and_grad over the dp>1 schedule) and that error
         # surfaces OUTSIDE this wrapper where it cannot be labeled.
-        # Fail here, clearly, instead.
+        # Fail here, clearly, naming the op the caller was building.
         raise NotImplementedError(
-            f"jax {jax.__version__}: this jax only has the legacy "
-            "jax.experimental.shard_map, whose partial-manual spelling "
-            f"(auto={sorted(legacy_kwargs['auto'])}) cannot run the "
-            f"pipeline schedule (manual axes "
-            f"{sorted(kwargs['axis_names'])}) under autodiff. Run the "
-            "pipeline with dp=1, or upgrade jax to a version with the "
-            "jax.shard_map axis_names API")
+            f"jax {jax.__version__}: {op} needs partial-manual "
+            f"shard_map (manual axes {sorted(kwargs['axis_names'])}, "
+            f"GSPMD-auto axes {sorted(legacy_kwargs['auto'])}), and "
+            "this jax only has the legacy jax.experimental.shard_map, "
+            "whose auto= spelling mis-specs scalar outputs under "
+            "autodiff. "
+            + (alternative or "Run the pipeline with dp=1 (full-manual "
+               "mesh, which the legacy API runs)")
+            + ", or upgrade jax to a version with the jax.shard_map "
+            "axis_names API.")
     try:
         return smap(per_device, check_rep=False, **legacy_kwargs)
     except TypeError as e:
         raise RuntimeError(
-            f"jax {jax.__version__}: shard_map accepts neither the "
-            "axis_names/check_vma API nor the legacy auto=/check_rep "
-            "one — this jax version is unsupported for pipeline "
-            "parallelism; upgrade jax"
+            f"jax {jax.__version__}: {op}: shard_map accepts neither "
+            "the axis_names/check_vma API nor the legacy "
+            "auto=/check_rep one — this jax version is unsupported for "
+            "pipeline parallelism; upgrade jax"
         ) from e
 
 
@@ -310,7 +315,10 @@ def pipeline_schedule(
     # dropped.
     kwargs = _manual_axis_kwargs(mesh, axis_name, {
         "mesh": mesh, "in_specs": (P(), P()), "out_specs": P()})
-    wrapped = _checked_shard_map(per_device, mesh, kwargs)
+    wrapped = _checked_shard_map(
+        per_device, mesh, kwargs,
+        op="pipeline_apply (GPipe forward schedule)",
+        alternative="Run pipeline_apply with a pp-only mesh (dp=1)")
     return wrapped(params, feeds_mb)
 
 
@@ -465,7 +473,11 @@ def pipeline_schedule_1f1b(
     kwargs = _manual_axis_kwargs(mesh, axis_name, {
         "mesh": mesh, "in_specs": (P(), P(), P(), P()),
         "out_specs": (P(), P())})
-    wrapped = _checked_shard_map(per_device, mesh, kwargs)
+    wrapped = _checked_shard_map(
+        per_device, mesh, kwargs,
+        op="pipeline_schedule_1f1b (1F1B forward/backward schedule)",
+        alternative="Run the 1F1B schedule with a pp-only mesh (dp=1), "
+        "or use the GPipe path (CompiledProgram.with_pipeline)")
     return wrapped(diff_params, tuple(rest_params), feeds_mb,
                    jnp.asarray(grad_scale, jnp.float32))
 
@@ -711,7 +723,9 @@ def pipeline_train_step_1f1b(
             "in_specs": (pspec, P(), P()),
             "out_specs": (P(), pspec),
         }
-        wrapped = _checked_shard_map(per_device, mesh, kwargs)
+        wrapped = _checked_shard_map(
+            per_device, mesh, kwargs,
+            op="pipeline_train_step (stacked-stage train step)")
         return wrapped(stage_params, microbatches, targets)
 
     return step

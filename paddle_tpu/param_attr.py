@@ -20,6 +20,7 @@ class ParamAttr:
         trainable: bool = True,
         gradient_clip=None,
         do_model_average: bool = False,
+        logical_axes=None,
     ):
         self.name = name
         self.initializer = initializer
@@ -28,6 +29,11 @@ class ParamAttr:
         self.trainable = trainable
         self.gradient_clip = gradient_clip
         self.do_model_average = do_model_average
+        # logical axis names per dim ("embed", "mlp", ...) — the
+        # partition subsystem's rules table maps them to mesh axes
+        # (partition/rules.py); None = untagged (replicated unless a
+        # PartitionConfig var_rules pattern matches the name)
+        self.logical_axes = tuple(logical_axes) if logical_axes else None
 
     @staticmethod
     def _to_attr(arg) -> "ParamAttr":

@@ -1,0 +1,41 @@
+"""paddle_tpu.partition — logical-axis-rules partitioner.
+
+The first-class sharded execution path: a rules table maps logical
+tensor axes (``batch``, ``embed``, ``heads``, ``mlp``, ``kv_pages``,
+…) onto mesh axes (``dp``, ``tp``); ``PartitionConfig`` resolves
+NamedShardings for params, optimizer state (ZeRO via the structural
+accumulator tags) and activations from it; and
+``CompiledProgram.with_partitioning`` hands the assignment to the one
+jitted ``runtime.dispatch.BoundStep`` every subsystem already drives —
+so data-parallel ``Executor.run``/``run_pipelined`` training,
+tensor-parallel ``Predictor``/``ServingEngine`` workers and the
+mesh-aware ``Supervisor`` checkpoint protocol all share one config
+surface.
+
+Minimal usage::
+
+    from paddle_tpu import partition
+
+    cfg = partition.PartitionConfig(mesh_axes={"dp": 8}, zero=1)
+    compiled = fluid.CompiledProgram(main).with_partitioning(cfg)
+    exe.run(compiled, feed=batch, fetch_list=[loss])   # sharded step
+
+Tensor parallelism needs logical axes on the weights — tag them at
+layer build time (``ParamAttr(logical_axes=("embed", "mlp"))``; the
+in-repo GPT already is) or supply name-pattern rules::
+
+    cfg = partition.PartitionConfig(
+        mesh_axes={"tp": 4},
+        var_rules=((r"_ffn1\\.w", ("embed", "mlp")),
+                   (r"_ffn2\\.w", ("mlp", "embed"))))
+"""
+
+from .config import PartitionConfig, ResolvedPartition
+from .rules import (DEFAULT_RULES, LogicalAxisRules, parse_mesh, parse_rules,
+                    resolve_spec, rules_to_str)
+
+__all__ = [
+    "PartitionConfig", "ResolvedPartition", "DEFAULT_RULES",
+    "LogicalAxisRules", "parse_mesh", "parse_rules", "resolve_spec",
+    "rules_to_str",
+]

@@ -296,6 +296,14 @@ class Supervisor:
             # the rolled-back pulls)
             "reader_position": int(completed_steps),
         }
+        # mesh-bound runs stamp the mesh shape into the commit marker:
+        # resume on ANY topology stays supported (arrays land as host
+        # values and the next compile re-places them), but the marker
+        # records which mesh produced the trajectory being resumed
+        mesh = getattr(self.program, "_mesh", None)
+        if mesh is not None and hasattr(mesh, "shape"):
+            extra["mesh"] = {str(k): int(v)
+                             for k, v in dict(mesh.shape).items()}
         with tracing.span(
                 "resilience/checkpoint",
                 {"step": completed_steps, "reason": reason}):

@@ -660,8 +660,17 @@ class BoundStep:
         donatable = list(getattr(c, "donatable_names", ()) or ())
         donated = list(getattr(c, "donated_names", ()) or ())
         skip = getattr(c, "donation_skip_reason", None)
+        # mesh-bound executables are first-class audit subjects — a
+        # sharded train state that stops being donated doubles the
+        # per-device HBM exactly like a single-device one; the mesh
+        # shape is reported so the allowlist diff can tell the sharded
+        # and unsharded variants of one program apart
+        mesh = getattr(c, "mesh", None)
+        if mesh is not None and hasattr(mesh, "shape"):
+            mesh = {str(k): int(v) for k, v in dict(mesh.shape).items()}
         return {
             "tag": c.tag or "program",
+            "mesh": mesh,
             "n_feeds": len(c.feed_names),
             "n_state": len(c.state_names),
             "n_written": len(c.written_names),

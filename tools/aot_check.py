@@ -261,6 +261,8 @@ def _child():
         rng = np.random.RandomState(0)
 
         def mc(name, cp_fn, prog_pack, feed, **meta):
+            if only and only not in name:
+                return
             main_prog, startup, loss = prog_pack
             t0 = time.time()
             try:
@@ -359,6 +361,38 @@ def _child():
            lambda m: fluid.CompiledProgram(m).with_sequence_parallel(
                sp=4, places=[fluid.TPUPlace(i) for i in range(4)]),
            (lmain, lstart, lf["loss"]), lfeed, mesh="sp4", seq=8192)
+
+        # (f) PARTITIONER: the logical-axis-rules path (paddle_tpu.
+        # partition) — the same GPT whose ParamAttr tags drive the CPU
+        # dp/tp parity tests compiles its SHARDED TRAIN step for real
+        # v5e silicon through one rules table (dp2 x tp2 + ZeRO-1
+        # optimizer state), proving the config surface reaches the
+        # target SPMD partitioner, not just the CPU emulation
+        pt = fluid.partition
+        pcfg = GPTConfig.tiny()
+        pmain2, pstart2, _, pf2 = build_gpt_lm(
+            pcfg, 128, optimizer=fluid.optimizer.Adam(1e-3))
+        pfeed2 = {"tokens": rng.randint(0, pcfg.vocab_size,
+                                        (8, 128)).astype("int64"),
+                  "labels": rng.randint(0, pcfg.vocab_size,
+                                        (8, 128)).astype("int64")}
+        mc("multichip_partition_dp2xtp2_zero1_gpt_train",
+           lambda m: fluid.CompiledProgram(m).with_partitioning(
+               pt.PartitionConfig(mesh_axes={"dp": 2, "tp": 2}, zero=1)),
+           (pmain2, pstart2, pf2["loss"]), pfeed2,
+           mesh="dp2 x tp2 zero1")
+
+        # (g) the TP-predict executable (the ServingEngine worker form):
+        # forward-only logits over a tp4 mesh from the same tags
+        imain, istart, _, if_ = build_gpt_lm(pcfg, 128, is_test=True)
+        ifeed = {"tokens": rng.randint(0, pcfg.vocab_size,
+                                       (4, 128)).astype("int64"),
+                 "labels": rng.randint(0, pcfg.vocab_size,
+                                       (4, 128)).astype("int64")}
+        mc("multichip_partition_tp4_gpt_predict",
+           lambda m: fluid.CompiledProgram(m).with_partitioning(
+               pt.PartitionConfig(mesh_axes={"tp": 4})),
+           (imain, istart, if_["logits"]), ifeed, mesh="tp4")
 
     # merge-by-name into the existing archive: different env
     # selections (kernels-only / stages / multichip) must accumulate,

@@ -50,6 +50,13 @@ sys.path.insert(0, os.path.dirname(HERE))
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 os.environ.setdefault("JAX_PLATFORM_NAME", "cpu")
+# the partition phase audits MESH-bound executables (sharded train
+# state must donate exactly like unsharded) — force 8 host devices so
+# a dp4 x tp2 mesh exists on the CPU CI runner
+_xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        _xla_flags + " --xla_force_host_platform_device_count=8").strip()
 
 ALLOWLIST_PATH = os.path.join(HERE, "donation_allowlist.json")
 
@@ -155,6 +162,57 @@ def _phase_generation(fluid, tmpdir):
     return [pred, eng]
 
 
+def _phase_partition(fluid, tmpdir):
+    """Mesh-bound executables: a dp4(+ZeRO-1) sharded training step and
+    a tp2 predictor over one partitioned model. The audit must treat
+    these exactly like single-device executables — sharded train state
+    still rewrites in place, so every rewritten buffer must donate —
+    and the report rows carry the mesh shape to prove none were
+    skipped."""
+    import numpy as np
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data("x", [16])
+        y = fluid.layers.data("y", [1], dtype="int64")
+        h = fluid.layers.fc(
+            x, 32, act="relu",
+            param_attr=fluid.ParamAttr(name="pt_w1",
+                                       logical_axes=("embed", "mlp")),
+            bias_attr=fluid.ParamAttr(name="pt_b1", logical_axes=("mlp",)))
+        logits = fluid.layers.fc(
+            h, 4, param_attr=fluid.ParamAttr(name="pt_w2",
+                                             logical_axes=("mlp", "embed")))
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, y))
+        fluid.optimizer.Adam(0.01).minimize(loss)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe._force_donation = True  # CPU mesh skips donation; audit must see it
+        exe.run(startup)
+        cfg = fluid.partition.PartitionConfig(mesh_axes={"dp": 4}, zero=1)
+        compiled = fluid.CompiledProgram(main).with_partitioning(cfg)
+        feed = {"x": np.random.RandomState(4).rand(8, 16).astype("float32"),
+                "y": np.zeros((8, 1), "int64")}
+        for _ in range(3):
+            exe.run(compiled, feed=feed, fetch_list=[loss])
+
+    from paddle_tpu.inference import Config, create_predictor
+
+    icfg = Config(tmpdir)
+    # the exported model carries no logical_axes tags — the name-pattern
+    # var_rules path is what untouched third-party models use
+    icfg.enable_partitioning(
+        mesh_axes={"tp": 2}, zero=0,
+        var_rules=((r"fc_0\.w_0", ("embed", "mlp")),
+                   (r"fc_1\.w_0", ("mlp", "embed"))))
+    pred = create_predictor(icfg)
+    pred._exe._force_donation = True
+    pred.run([np.random.RandomState(5).rand(4, 6).astype("float32")])
+    return [exe, scope, compiled, pred]
+
+
 # -- the audit ----------------------------------------------------------------
 
 
@@ -189,8 +247,18 @@ def run_audit():
         snapshot("serving.predict")
         keep.extend(_phase_generation(fluid, tmpdir))
         snapshot("generation")
+        keep.extend(_phase_partition(fluid, tmpdir))
+        snapshot("partition")
     finally:
         shutil.rmtree(tmpdir, ignore_errors=True)
+
+    # the partition phase exists to prove mesh-bound executables are
+    # audited, not skipped — an empty mesh column there means the audit
+    # silently lost its sharded coverage
+    if not any(b.audit_info().get("mesh") for b in sites.get("partition", [])):
+        raise RuntimeError(
+            "donation audit: the partition phase produced no mesh-bound "
+            "executables — sharded coverage was silently lost")
 
     report = {"sites": {}, "summary": {
         "total_executables": 0,

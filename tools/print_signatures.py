@@ -16,6 +16,7 @@ MODULES = [
     "paddle_tpu.generation",
     "paddle_tpu.resilience",
     "paddle_tpu.observability",
+    "paddle_tpu.partition",
     "paddle_tpu.layers",
     "paddle_tpu.optimizer",
     "paddle_tpu.nets",
