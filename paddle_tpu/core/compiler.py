@@ -195,6 +195,17 @@ class CompiledProgram:
                 "with_partitioning: pass a PartitionConfig OR keyword "
                 "arguments for one, not both")
         self._claim_strategy("with_partitioning")
+        if config.collectives_active():
+            # bucketed / quantized DP gradient all-reduce: rewrite the
+            # program (idempotent) BEFORE resolving shardings so the
+            # resolve pass and the executor both see the final op list
+            from ..parallel.collectives import ensure_planned
+
+            ensure_planned(
+                self._program,
+                bucket_mb=config.collective_bucket_mb,
+                quantization=config.collective_quantization,
+                quant_block=config.collective_quant_block)
         resolved = config.resolve(self._program, devices=devices)
         self._mesh = resolved.mesh
         self._in_shardings = dict(resolved.in_shardings)

@@ -223,6 +223,8 @@ def build_block_fn(
     written_names: Sequence[str],
     mesh=None,
     axis_env=None,
+    in_shardings=None,
+    state_shardings=None,
 ):
     """Build the pure function f(step_key, *feeds, *state) ->
     (*fetches, *new_state) for a block. This is the object XLA
@@ -248,6 +250,23 @@ def build_block_fn(
             block, feed_names, state_names, fetch_names, written_names, mesh, k,
             bool(getattr(block.program, "_gradient_merge_avg", True)),
             axis_env=axis_env,
+        )
+
+    # collective-planned programs (parallel/collectives.py) over a mesh
+    # with a real dp axis: forward+backward+bucket-reduces run inside a
+    # shard_map manual over dp, so each gradient bucket's all-reduce is
+    # an explicit, overlappable collective instead of one GSPMD blob
+    # after the whole backward. Without a dp>1 mesh (or under the
+    # pipeline/gradient-merge paths above) the bucket ops lower as
+    # identity and the program behaves exactly monolithic.
+    plan = getattr(block.program, "_collective_plan", None)
+    if (plan is not None and mesh is not None
+            and int(dict(mesh.shape).get(plan.axis, 0)) > 1):
+        from ..parallel.collectives import build_collective_fn
+
+        return build_collective_fn(
+            block, feed_names, state_names, fetch_names, written_names,
+            mesh, axis_env, plan, in_shardings, state_shardings,
         )
 
     def fn(step_key, *args):
@@ -826,6 +845,13 @@ class Executor:
             if use_program_cache:
                 self._cache[key] = compiled
 
+        # the collective plan's wire-byte gauges need the mesh degree
+        # even when the executable came out of a (shared) cache and
+        # build_collective_fn never ran for this instance
+        plan = getattr(program, "_collective_plan", None)
+        if plan is not None and mesh is not None:
+            plan.attach(mesh)
+
         # pre-flight: sharded feeds must divide over their mesh axes —
         # fail HERE with the strategy named, not inside GSPMD
         if mesh is not None and in_shardings:
@@ -974,7 +1000,9 @@ class Executor:
                     "with_data_parallel()"
                 )
         raw_fn = build_block_fn(block, feed_names, state_names, fetch_names,
-                                written_names, mesh, axis_env=axis_env)
+                                written_names, mesh, axis_env=axis_env,
+                                in_shardings=in_shardings,
+                                state_shardings=state_shardings)
 
         # fold the per-step PRNG key INSIDE the executable: the hot
         # path passes (base_key, step_index) and pays ONE dispatch per

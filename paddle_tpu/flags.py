@@ -100,6 +100,22 @@ _FLAG_DEFS: Dict[str, Any] = {
     "partition_mesh": "",
     "partition_rules": "",
     "partition_zero": 0,
+    # parallel/collectives.py (gradient-collective planner): when
+    # collective_bucket_mb > 0 OR collective_quantization != "none",
+    # Optimizer.apply_gradients / CompiledProgram.with_partitioning
+    # rewrite the train program so the DP gradient all-reduce runs as
+    # size-capped per-bucket collectives issued as each bucket's grads
+    # are produced (shard_map/psum inside the one jitted step —
+    # overlappable with the rest of backward), instead of one
+    # monolithic end-of-backward GSPMD blob. collective_bucket_mb caps
+    # a bucket's payload (0 = planner off unless quantization asks for
+    # it); collective_quantization="int8" swaps each bucket's psum for
+    # the EQuARX-style two-shot blockwise-int8 exchange (~3.9x fewer
+    # wire bytes at block 256, bench-gated accuracy);
+    # collective_quant_block is the per-scale block size in elements
+    "collective_bucket_mb": 0.0,
+    "collective_quantization": "none",
+    "collective_quant_block": 256,
     # observability/ (unified telemetry): observability_metrics turns
     # on per-step telemetry instruments (wall time, examples/sec) in
     # the dispatch hot path; observability_tracing upgrades span call

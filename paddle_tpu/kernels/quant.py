@@ -1,0 +1,136 @@
+"""Blockwise int8 quantization helpers for quantized collectives.
+
+The EQuARX observation (arXiv:2506.17615): on a comm-bound mesh the
+gradient all-reduce's wire bytes, not its flops, set the step time —
+so quantize the payload *around* the exchange and keep the arithmetic
+in fp32. The unit here is a BLOCK of ``block`` consecutive elements
+sharing one fp32 scale (max-abs / 127): small enough that one outlier
+only poisons its own block, large enough that the scale overhead is
+~4/block of the payload (1.6% at block=256).
+
+These are pure-JAX functions (no Pallas): the quantize/dequantize math
+is elementwise + a per-block reduction, which XLA fuses into the
+surrounding collective schedule on every backend — the win is wire
+bytes, not kernel time. Used by the ``collective_bucket_reduce`` op
+lowering (ops/collective.py) inside its shard_map region, and directly
+by tests/benches to measure round-trip error against the per-block
+bound (|err| <= scale/2 per stage).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "blockwise_quantize", "blockwise_dequantize", "quantized_mean",
+    "blockwise_error_bound", "quantized_payload_bytes",
+]
+
+_QMAX = 127.0
+
+
+def blockwise_quantize(blocks):
+    """[nb, block] fp32 -> (int8 [nb, block], fp32 scales [nb]).
+
+    scale = max|x| / 127 per block (1.0 for all-zero blocks so the
+    dequantize never divides by zero); values quantize symmetrically to
+    [-127, 127]."""
+    amax = jnp.max(jnp.abs(blocks), axis=-1)
+    scale = jnp.where(amax > 0, amax / _QMAX, 1.0).astype(jnp.float32)
+    q = jnp.clip(jnp.round(blocks / scale[:, None]), -_QMAX, _QMAX)
+    return q.astype(jnp.int8), scale
+
+
+def blockwise_dequantize(q, scale):
+    """Inverse of blockwise_quantize: int8 [..., nb, block] * fp32
+    scales [..., nb] -> fp32 [..., nb, block]."""
+    return q.astype(jnp.float32) * scale[..., None]
+
+
+def blockwise_error_bound(x, block: int) -> float:
+    """The per-element round-trip error bound for one quantize stage:
+    half a quantization step of the worst block, i.e.
+    max_b(scale_b) / 2. Host-side (numpy) — used by tests/benches to
+    gate measured error."""
+    flat = np.asarray(x, dtype=np.float32).reshape(-1)
+    m = flat.shape[0]
+    nb = -(-m // block)
+    flat = np.pad(flat, (0, nb * block - m))
+    amax = np.abs(flat.reshape(nb, block)).max(axis=-1)
+    scale = np.where(amax > 0, amax / _QMAX, 1.0)
+    return float(scale.max() / 2.0)
+
+
+def quantized_mean(x, axis_name: str, axis_size: int, block: int,
+                   exchange: bool = True):
+    """Two-shot blockwise-int8 mean-all-reduce of ``x`` over the manual
+    mesh axis ``axis_name`` (must run inside shard_map with that axis
+    manual). The EQuARX recipe, shaped like XLA's two-shot all-reduce:
+
+      1. reduce-scatter phase: every rank quantizes its LOCAL value
+         blockwise and an all-to-all delivers rank r exactly chunk r of
+         every peer's int8 payload (+ its fp32 scales); rank r
+         dequantizes and averages ITS chunk in fp32;
+      2. all-gather phase: the reduced chunk is re-quantized and an
+         all-gather distributes it; every rank dequantizes ALL chunks
+         — including its own, so the result is bit-identical on every
+         rank (replicated by construction).
+
+    Wire bytes per rank  ~= 2*(n-1)/n * (numel + 4*numel/block), vs
+    2*(n-1)/n * 4*numel for the fp32 ring — ~3.9x fewer at block=256.
+    Error: one quantization step per phase, |err| <= scale_1/2 +
+    scale_2/2 with per-block scales.
+
+    ``exchange=False`` runs the numerics-equivalent psum form — the
+    same quantize -> mean -> requantize pipeline, but the exchange
+    itself is a psum of the dequantized payload. Used inside
+    PARTIAL-manual shard_map regions (a dp x tp mesh), where XLA's
+    manual-subgroup partitioner hard-aborts on all_to_all/all_gather
+    (only psum lowers); there the int8 accuracy model is preserved and
+    the wire saving is modeled rather than emulated.
+    """
+    shape, dtype = x.shape, x.dtype
+    flat = x.astype(jnp.float32).reshape(-1)
+    m = flat.shape[0]
+    # block count padded to a multiple of axis_size so the all_to_all
+    # chunks evenly
+    nb = -(-m // block)
+    nb = -(-nb // axis_size) * axis_size
+    pad = nb * block - m
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    blocks = flat.reshape(nb, block)
+    q, s = blockwise_quantize(blocks)
+
+    if exchange:
+        # phase 1: all-to-all the int8 chunks; rank r owns blocks
+        # [r*nb/n, (r+1)*nb/n)
+        qx = jax.lax.all_to_all(q, axis_name, 0, 0, tiled=True)
+        sx = jax.lax.all_to_all(s, axis_name, 0, 0, tiled=True)
+        chunk = nb // axis_size
+        qx = qx.reshape(axis_size, chunk, block)
+        sx = sx.reshape(axis_size, chunk)
+        reduced = blockwise_dequantize(qx, sx).sum(axis=0) / axis_size
+        # phase 2: requantize the reduced chunk, all-gather, dequantize
+        q2, s2 = blockwise_quantize(reduced)
+        qg = jax.lax.all_gather(q2, axis_name)
+        sg = jax.lax.all_gather(s2, axis_name)
+        out = blockwise_dequantize(qg, sg).reshape(nb * block)
+    else:
+        reduced = jax.lax.psum(
+            blockwise_dequantize(q, s), axis_name) / axis_size
+        q2, s2 = blockwise_quantize(reduced)
+        out = blockwise_dequantize(q2, s2).reshape(nb * block)
+    if pad:
+        out = out[:m]
+    return out.reshape(shape).astype(dtype)
+
+
+def quantized_payload_bytes(numel: int, block: int) -> int:
+    """Wire payload of one quantized exchange direction for a tensor of
+    ``numel`` elements: int8 body + one fp32 scale per block (padding
+    counted — it crosses the wire too)."""
+    nb = -(-numel // block)
+    return nb * block + 4 * nb

@@ -31,9 +31,10 @@ from . import flight, registry, tracing
 from .flight import dump as flight_dump
 from .flight import install_signal_handlers
 from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
-                       overlap_telemetry, step_telemetry, watch_engine,
-                       watch_executor, watch_generation, watch_loader,
-                       watch_partition, watch_serving, watch_supervisor)
+                       overlap_telemetry, step_telemetry,
+                       watch_collectives, watch_engine, watch_executor,
+                       watch_generation, watch_loader, watch_partition,
+                       watch_serving, watch_supervisor)
 from .registry import registry as get_registry
 from .tracing import SpanContext, attach, current, span, traced
 
@@ -43,8 +44,8 @@ __all__ = [
     "span", "traced", "attach", "current", "SpanContext",
     "flight_dump", "install_signal_handlers",
     "watch_serving", "watch_engine", "watch_executor", "watch_supervisor",
-    "watch_loader", "watch_generation", "watch_partition", "step_telemetry",
-    "overlap_telemetry",
+    "watch_loader", "watch_generation", "watch_partition",
+    "watch_collectives", "step_telemetry", "overlap_telemetry",
     "snapshot", "to_prometheus_text",
 ]
 

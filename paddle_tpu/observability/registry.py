@@ -295,6 +295,7 @@ _supervisors: "weakref.WeakSet" = weakref.WeakSet()
 _loaders: "weakref.WeakSet" = weakref.WeakSet()
 _generation: "weakref.WeakSet" = weakref.WeakSet()
 _partitions: "weakref.WeakSet" = weakref.WeakSet()
+_collectives: "weakref.WeakSet" = weakref.WeakSet()
 
 
 def watch_serving(metrics) -> None:
@@ -342,6 +343,17 @@ def watch_partition(resolved) -> None:
     my model actually sharded" is one scrape, not an HLO dump."""
     _obs_id(resolved)
     _partitions.add(resolved)
+
+
+def watch_collectives(plan) -> None:
+    """Called by parallel.collectives.CollectivePlan.__init__: each
+    live plan exports as the ``paddle_collective_*{plan=}`` family —
+    bucket count/size, the wire-byte model (fp32 vs quantized, bytes
+    saved per step) and the bench-measured overlap hidden fraction and
+    max quantization error — so "is the all-reduce actually cheaper"
+    is one scrape."""
+    _obs_id(plan)
+    _collectives.add(plan)
 
 
 def _flatten(prefix: str, d: Dict[str, Any], out: Dict[str, float]) -> None:
@@ -462,6 +474,11 @@ def _collect_partition():
     return _labeled(_partitions, "resolve", "paddle_partition", snap)
 
 
+def _collect_collectives():
+    return _labeled(_collectives, "plan", "paddle_collective",
+                    lambda p: p.snapshot())
+
+
 def _collect_build_info():
     from .. import version
 
@@ -478,6 +495,7 @@ for _name, _fn in (
     ("reader", _collect_loaders),
     ("generation", _collect_generation),
     ("partition", _collect_partition),
+    ("collective", _collect_collectives),
     ("build_info", _collect_build_info),
 ):
     _REGISTRY.register_collector(_name, _fn)

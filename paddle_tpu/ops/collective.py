@@ -14,6 +14,8 @@ jax.distributed.initialize and XLA orders collectives itself.
 
 from __future__ import annotations
 
+from typing import Any, Dict, List
+
 import jax
 import jax.numpy as jnp
 
@@ -87,6 +89,80 @@ def _c_reducescatter(ctx, op, ins):
     if axis is None:
         return {"Out": [x]}
     return {"Out": [jax.lax.psum_scatter(x, axis, scatter_dimension=0, tiled=True)]}
+
+
+@register_op("collective_bucket_reduce", inputs=("X",), outputs=("Out",),
+             stop_gradient=True)
+def _collective_bucket_reduce(ctx, op, ins):
+    """One gradient bucket's all-reduce (parallel/collectives.py).
+
+    Inside the planner's manual shard_map region (the lowering context
+    carries ``collective_axis``/``collective_axis_size``) each input is
+    a per-shard PARTIAL gradient; the op emits the cross-replica mean —
+    a plain psum/size in fp32 mode, or the EQuARX-style two-shot
+    blockwise-int8 exchange when the planner asked for
+    ``quantization="int8"``. Because the op sits in program order right
+    after the bucket's last producer, its collective is data-ready the
+    moment that slice of backward finishes — XLA's latency-hiding
+    scheduler can run it under the remaining backward compute instead
+    of serializing every gradient behind the last one.
+
+    Anywhere else — no mesh, a GSPMD-auto compile, the gradient-merge
+    or pipeline paths — the inputs are already LOGICAL (fully reduced)
+    gradients and the op is identity, so a planned program degrades to
+    exactly the monolithic PR-8 semantics.
+    """
+    xs = ins["X"]
+    env = ctx.axis_env or {}
+    axis = env.get("collective_axis")
+    if axis is None or env.get("collective_skip_reduce"):
+        # collective_skip_reduce: the bench's compute-only timing
+        # variant — same program shape, collectives elided
+        return {"Out": list(xs)}
+    size = int(env.get("collective_axis_size", 1))
+    quantized = op.attrs.get("quantization", "none") == "int8"
+    block = int(op.attrs.get("quant_block", 256))
+    # the real int8 all-to-all/all-gather exchange requires a
+    # FULLY-manual region; inside a partial-manual one (dp x tp mesh)
+    # XLA's manual-subgroup partitioner only lowers psum, so the
+    # numerics-equivalent psum form runs there
+    exchange = bool(env.get("collective_exchange_ok", True))
+
+    if not quantized:
+        # fp32: one psum per gradient, grouped at the bucket point.
+        # Deliberately NOT flattened into one payload: the psum is
+        # elementwise either way, but slicing grads back out of a flat
+        # buffer reshapes the tensors downstream consumers reduce over
+        # (clip-by-global-norm's sum of squares), changing summation
+        # order — and the bucketed fp32 path is contractually
+        # BIT-identical to the monolithic one. XLA combines adjacent
+        # same-ready all-reduces itself where profitable.
+        inv = 1.0 / size
+        return {"Out": [jax.lax.psum(x, axis) * jnp.asarray(inv, x.dtype)
+                        for x in xs]}
+
+    # int8: the bucket reduces as ONE flat payload (per dtype): one
+    # quantized exchange per bucket instead of one per gradient, so
+    # block + dp-chunk padding amortize over the whole bucket (a
+    # 4-element bias grad would otherwise pad to a full block times a
+    # dp multiple and cost MORE wire than fp32)
+    from ..kernels.quant import quantized_mean
+
+    out: List[Any] = [None] * len(xs)
+    by_dtype: Dict[Any, List[int]] = {}
+    for i, x in enumerate(xs):
+        by_dtype.setdefault(jnp.dtype(x.dtype), []).append(i)
+    for dt, idxs in by_dtype.items():
+        flat = (xs[idxs[0]].reshape(-1) if len(idxs) == 1 else
+                jnp.concatenate([xs[i].reshape(-1) for i in idxs]))
+        red = quantized_mean(flat, axis, size, block, exchange=exchange)
+        off = 0
+        for i in idxs:
+            n = xs[i].size
+            out[i] = jax.lax.dynamic_slice_in_dim(
+                red, off, n).reshape(xs[i].shape)
+            off += n
+    return {"Out": out}
 
 
 def _register_noop(name, slots=("X",)):

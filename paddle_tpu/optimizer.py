@@ -157,6 +157,11 @@ class Optimizer:
 
     def apply_gradients(self, params_grads) -> List:
         params_grads = sorted(params_grads, key=lambda pg: pg[0].name)
+        # the raw backward grads, BEFORE clip/regularization rewrite
+        # them: the collective planner buckets exactly these, so the
+        # cross-replica reduce happens first and clip-by-global-norm
+        # sees the true (global) gradient, matching the monolithic path
+        raw_params_grads = list(params_grads)
         # gradient clipping (global set or per-param attr)
         params_grads = clip_mod.append_gradient_clip_ops(params_grads, self._grad_clip)
         # weight decay
@@ -171,6 +176,13 @@ class Optimizer:
                 op.attrs["op_role"] = OpRole.Optimize
                 opt_ops.append(op)
         self._finish_update(block, params_grads)
+        # flag-gated (collective_bucket_mb / collective_quantization):
+        # bucket the DP gradient all-reduce and repoint clip/reg/opt at
+        # the reduced values — a no-op when the flags are off
+        from .parallel.collectives import ensure_planned
+
+        ensure_planned(default_main_program(),
+                       params_grads=raw_params_grads)
         default_main_program()._bump()
         return opt_ops
 
@@ -1044,9 +1056,17 @@ class GradientMergeOptimizer:
         self.avg = bool(avg)
 
     def minimize(self, loss, startup_program=None, parameter_list=None, no_grad_set=None):
-        out = self.inner_optimizer.minimize(
-            loss, startup_program, parameter_list, no_grad_set
-        )
+        # the scan-based merge path owns its gradient flow (running-mean
+        # accumulator inside lax.scan) and build_block_fn routes there
+        # before the collective branch — a plan stamped by the inner
+        # minimize's flag seam would lower its bucket ops as identity
+        # while the gauges claim wire savings that never happen
+        from .parallel.collectives import suppress_planning
+
+        with suppress_planning():
+            out = self.inner_optimizer.minimize(
+                loss, startup_program, parameter_list, no_grad_set
+            )
         program = loss.block.program
         program._gradient_merge_k = self.k_steps
         program._gradient_merge_avg = self.avg
@@ -1084,7 +1104,14 @@ class PipelineOptimizer:
         self._schedule = schedule
 
     def minimize(self, loss, startup_program=None, parameter_list=None, no_grad_set=None):
-        out = self._optimizer.minimize(loss, startup_program, parameter_list, no_grad_set)
+        # the pipeline schedule owns its gradient flow (per-stage
+        # grads merged by the schedule itself) — the collective
+        # planner's flag seam must not rewrite a program whose cuts
+        # are stamped only after this inner minimize returns
+        from .parallel.collectives import suppress_planning
+
+        with suppress_planning():
+            out = self._optimizer.minimize(loss, startup_program, parameter_list, no_grad_set)
         cuts = []
         for c in self._cut_list or []:
             cs = c if isinstance(c, (list, tuple)) else [c]

@@ -8,4 +8,4 @@ jax.distributed.initialize.
 
 from .env import ParallelEnv, get_rank, get_world_size, init_parallel_env
 from .mesh import MeshContext, get_mesh, mesh_guard, ring_registry
-from . import fleet
+from . import collectives, fleet

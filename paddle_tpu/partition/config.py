@@ -139,11 +139,21 @@ class PartitionConfig:
     ``zero``       — ZeRO level: 0 = replicated optimizer state,
         1 = shard accumulators over dp, 3 = shard params too; defaults
         to the ``partition_zero`` flag.
+    ``collective_bucket_mb`` / ``collective_quantization`` /
+    ``collective_quant_block`` — the gradient-collective planner
+        (parallel/collectives.py): bucket the DP gradient all-reduce
+        (size cap in MB; 0 = off) and optionally blockwise-int8
+        quantize the wire payload; default to the ``collective_*``
+        flags. ``with_partitioning`` plans the program when these ask
+        for it.
     """
 
     def __init__(self, mesh_axes=None, rules: Optional[LogicalAxisRules] = None,
                  var_rules: Optional[Sequence[Tuple[str, Sequence[Optional[str]]]]] = None,
-                 zero: Optional[int] = None):
+                 zero: Optional[int] = None,
+                 collective_bucket_mb: Optional[float] = None,
+                 collective_quantization: Optional[str] = None,
+                 collective_quant_block: Optional[int] = None):
         from ..flags import flag
 
         self.mesh_axes = parse_mesh(
@@ -153,6 +163,21 @@ class PartitionConfig:
         self.var_rules = tuple(
             (re.compile(pat), tuple(axes)) for pat, axes in (var_rules or ()))
         self.zero = int(flag("partition_zero") if zero is None else zero)
+        self.collective_bucket_mb = float(
+            flag("collective_bucket_mb") if collective_bucket_mb is None
+            else collective_bucket_mb)
+        self.collective_quantization = str(
+            flag("collective_quantization") if collective_quantization is None
+            else collective_quantization) or "none"
+        self.collective_quant_block = int(
+            flag("collective_quant_block") if collective_quant_block is None
+            else collective_quant_block)
+
+    def collectives_active(self) -> bool:
+        """True when this config asks for the gradient-collective
+        planner (bucketed and/or quantized DP all-reduce)."""
+        return (self.collective_bucket_mb > 0
+                or self.collective_quantization != "none")
 
     def build_mesh(self, devices=None):
         """The jax Mesh for ``mesh_axes`` (over ``devices`` or the

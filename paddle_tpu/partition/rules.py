@@ -65,16 +65,27 @@ def parse_mesh(spec) -> Dict[str, int]:
     if isinstance(spec, dict):
         return {str(k): int(v) for k, v in spec.items()}
     out: Dict[str, int] = {}
-    for part in str(spec).replace(";", ",").split(","):
+    for pos, part in enumerate(str(spec).replace(";", ",").split(","), 1):
         part = part.strip()
         if not part:
             continue
         if "=" not in part:
             raise ValueError(
-                f"partition mesh entry {part!r}: expected axis=size "
-                "(e.g. 'dp=4,tp=2')")
+                f"partition mesh: entry {pos} ({part!r}) of {spec!r}: "
+                "expected axis=size (e.g. 'dp=4,tp=2')")
         k, v = part.split("=", 1)
-        out[k.strip()] = int(v)
+        if not k.strip():
+            raise ValueError(
+                f"partition mesh: entry {pos} ({part!r}) of {spec!r}: "
+                "the axis name is empty — expected axis=size "
+                "(e.g. 'dp=4,tp=2')")
+        try:
+            out[k.strip()] = int(v)
+        except ValueError:
+            raise ValueError(
+                f"partition mesh: entry {pos} ({part!r}) of {spec!r}: "
+                f"size {v.strip()!r} is not an integer — expected "
+                "axis=size (e.g. 'dp=4,tp=2')") from None
     return out
 
 
@@ -86,15 +97,21 @@ def parse_rules(spec) -> Tuple[Tuple[str, Optional[str]], ...]:
     if not isinstance(spec, str):
         return tuple((str(l), m if m else None) for l, m in spec)
     out: List[Tuple[str, Optional[str]]] = []
-    for part in spec.replace(";", ",").split(","):
+    for pos, part in enumerate(spec.replace(";", ",").split(","), 1):
         part = part.strip()
         if not part:
             continue
         if "=" not in part:
             raise ValueError(
-                f"partition rule {part!r}: expected logical=mesh "
-                "(e.g. 'heads=tp') or logical= for replicated")
+                f"partition rules: entry {pos} ({part!r}) of {spec!r}: "
+                "expected logical=mesh (e.g. 'heads=tp') or logical= "
+                "for replicated")
         l, m = part.split("=", 1)
+        if not l.strip():
+            raise ValueError(
+                f"partition rules: entry {pos} ({part!r}) of {spec!r}: "
+                "the logical axis name is empty — expected logical=mesh "
+                "(e.g. 'heads=tp')")
         out.append((l.strip(), m.strip() or None))
     return tuple(out)
 

@@ -169,6 +169,13 @@ def program_fingerprint(program) -> str:
             "gm_k": getattr(program, "_gradient_merge_k", None),
             "gm_avg": getattr(program, "_gradient_merge_avg", None),
             "dist_plan": getattr(program, "_dist_plan", None),
+            # bucketed/quantized collectives (parallel/collectives.py):
+            # two content-identical programs whose plans differ (quant
+            # mode, skip_reduce timing variant) lower differently
+            "collective": (
+                program._collective_plan.fingerprint()
+                if getattr(program, "_collective_plan", None) is not None
+                else None),
         }
         digest = hashlib.sha256(
             json.dumps([d, extra], sort_keys=True, default=str).encode()

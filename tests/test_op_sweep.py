@@ -537,6 +537,11 @@ COVERED_ELSEWHERE = {
     # kv_cache_write scatter vs oracle + junk-page isolation; both
     # driven end-to-end by the continuous==naive greedy equivalence)
     'paged_attention', 'kv_cache_write',
+    # PR-9 gradient-collective planner (tests/test_collectives.py:
+    # bucketed fp32 bit-identity vs monolithic x4 trajectories, int8
+    # quant round-trip bound, exchange==psum-form equivalence, and
+    # tools/collective_bench.py loss-trajectory accuracy gate)
+    'collective_bucket_reduce',
     # round-4 MoE (tests/test_moe.py: dense training, ep parity,
     # capacity drops, gpt integration)
     'switch_moe',

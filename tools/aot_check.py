@@ -279,6 +279,7 @@ def _child():
                     collective_permute=txt.count("collective-permute"),
                     all_reduce=txt.count("all-reduce"),
                     all_gather=txt.count("all-gather"),
+                    all_to_all=txt.count("all-to-all"),
                     per_dev_bytes=int(ma.argument_size_in_bytes
                                       + ma.output_size_in_bytes
                                       + ma.temp_size_in_bytes), **meta)
@@ -381,6 +382,30 @@ def _child():
                pt.PartitionConfig(mesh_axes={"dp": 2, "tp": 2}, zero=1)),
            (pmain2, pstart2, pf2["loss"]), pfeed2,
            mesh="dp2 x tp2 zero1")
+
+        # (h) COLLECTIVES: the bucketed and int8-quantized DP gradient
+        # all-reduce (parallel/collectives.py) — the planner's
+        # shard_map step with explicit per-bucket collectives compiles
+        # through the real TPU SPMD partitioner for v5e, so a live
+        # window never burns on a partial-manual lowering the CPU
+        # emulation can't see. The HLO collective counts prove the
+        # bucket reduces are real ops: >= 2 all-reduces for the
+        # bucketed row, all-to-all + all-gather for the int8 exchange.
+        for ctag, cquant in (("bucketed", "none"), ("int8", "int8")):
+            ccfg = GPTConfig.tiny()
+            cmain, cstart, _, cf = build_gpt_lm(
+                ccfg, 128, optimizer=fluid.optimizer.Adam(1e-3))
+            cfeed = {"tokens": rng.randint(0, ccfg.vocab_size,
+                                           (8, 128)).astype("int64"),
+                     "labels": rng.randint(0, ccfg.vocab_size,
+                                           (8, 128)).astype("int64")}
+            mc(f"multichip_collective_dp4_{ctag}_gpt_train",
+               lambda m, q=cquant: fluid.CompiledProgram(m)
+               .with_partitioning(pt.PartitionConfig(
+                   mesh_axes={"dp": 4}, collective_bucket_mb=0.25,
+                   collective_quantization=q)),
+               (cmain, cstart, cf["loss"]), cfeed,
+               mesh=f"dp4 collective {ctag}")
 
         # (g) the TP-predict executable (the ServingEngine worker form):
         # forward-only logits over a tp4 mesh from the same tags

@@ -213,6 +213,46 @@ def _phase_partition(fluid, tmpdir):
     return [exe, scope, compiled, pred]
 
 
+def _phase_collectives(fluid):
+    """Quantized-collective DP training (parallel/collectives.py): the
+    rewritten program's forward+backward runs inside the planner's
+    shard_map with int8 bucket reduces, and the contract is unchanged —
+    every rewritten sharded state buffer (params + ZeRO-1 moments)
+    still donates, and the bucket collectives add ZERO new hot-path
+    host syncs (the only sync stays the caller's loss fetch)."""
+    import numpy as np
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data("x", [16])
+        y = fluid.layers.data("y", [1], dtype="int64")
+        h = fluid.layers.fc(
+            x, 32, act="relu",
+            param_attr=fluid.ParamAttr(name="qc_w1",
+                                       logical_axes=("embed", "mlp")),
+            bias_attr=fluid.ParamAttr(name="qc_b1", logical_axes=("mlp",)))
+        logits = fluid.layers.fc(
+            h, 4, param_attr=fluid.ParamAttr(name="qc_w2",
+                                             logical_axes=("mlp", "embed")))
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, y))
+        fluid.optimizer.Adam(0.01).minimize(loss)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe._force_donation = True  # CPU mesh skips donation; audit must see it
+        exe.run(startup)
+        cfg = fluid.partition.PartitionConfig(
+            mesh_axes={"dp": 4}, zero=1,
+            collective_bucket_mb=0.001, collective_quantization="int8")
+        compiled = fluid.CompiledProgram(main).with_partitioning(cfg)
+        feed = {"x": np.random.RandomState(6).rand(8, 16).astype("float32"),
+                "y": np.zeros((8, 1), "int64")}
+        for _ in range(3):
+            exe.run(compiled, feed=feed, fetch_list=[loss])
+    return [exe, scope, compiled]
+
+
 # -- the audit ----------------------------------------------------------------
 
 
@@ -249,16 +289,21 @@ def run_audit():
         snapshot("generation")
         keep.extend(_phase_partition(fluid, tmpdir))
         snapshot("partition")
+        keep.extend(_phase_collectives(fluid))
+        snapshot("collectives")
     finally:
         shutil.rmtree(tmpdir, ignore_errors=True)
 
-    # the partition phase exists to prove mesh-bound executables are
-    # audited, not skipped — an empty mesh column there means the audit
-    # silently lost its sharded coverage
-    if not any(b.audit_info().get("mesh") for b in sites.get("partition", [])):
-        raise RuntimeError(
-            "donation audit: the partition phase produced no mesh-bound "
-            "executables — sharded coverage was silently lost")
+    # the partition/collectives phases exist to prove mesh-bound
+    # executables are audited, not skipped — an empty mesh column there
+    # means the audit silently lost its sharded coverage
+    for site in ("partition", "collectives"):
+        if not any(b.audit_info().get("mesh")
+                   for b in sites.get(site, [])):
+            raise RuntimeError(
+                f"donation audit: the {site} phase produced no "
+                "mesh-bound executables — sharded coverage was "
+                "silently lost")
 
     report = {"sites": {}, "summary": {
         "total_executables": 0,
