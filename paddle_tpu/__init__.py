@@ -73,6 +73,7 @@ from . import generation  # paged KV-cache + continuous-batching decode
 from . import resilience  # fault-tolerant training supervisor (chaos-tested)
 from . import partition  # logical-axis-rules partitioner (sharded execution)
 from . import observability  # unified telemetry: metrics/tracing/flight
+from . import traffic  # SLO-aware admission + multi-tenant scheduling
 
 # ``fluid``-style alias so reference user code reads naturally:
 #   import paddle_tpu as fluid
@@ -119,6 +120,7 @@ __all__ = [
     "generation",
     "resilience",
     "observability",
+    "traffic",
 ]
 
 

@@ -957,6 +957,15 @@ class Executor:
         axis_env=None,
     ) -> _CompiledBlock:
         from ..flags import flag
+        from ..runtime import dispatch as _dispatch
+
+        # level-2 on disk: EVERY compile path routes XLA through the
+        # persistent compilation cache, including aot_compile — the
+        # shape-bucketing warmup compiles its buckets through there
+        # before any bind ever runs, and those executables were
+        # silently skipping the cache (a bucketed serving worker
+        # re-compiled from scratch on every rolling restart)
+        _dispatch.ensure_persistent_cache()
 
         # static Program-IR verification (analysis/) BEFORE any lowering:
         # "warn" runs the structural passes and logs findings; "strict"

@@ -116,6 +116,35 @@ _FLAG_DEFS: Dict[str, Any] = {
     "collective_bucket_mb": 0.0,
     "collective_quantization": "none",
     "collective_quant_block": 256,
+    # traffic/ (SLO-aware admission + multi-tenant scheduling) defaults,
+    # consumed by TrafficConfig.from_flags(): traffic_queue_capacity is
+    # the per-PRIORITY-CLASS bounded queue depth (a full class queue
+    # sheds with Retry-After instead of queueing into a latency cliff);
+    # traffic_tenants declares per-tenant token-bucket quotas
+    # ("alice=100:200,bob=50" = name=rate_rps[:burst]); unknown tenants
+    # get traffic_default_rate/traffic_default_burst (rate 0 =
+    # unlimited); a queued batch/best_effort request older than
+    # traffic_aging_ms is promoted one class per interval so strict
+    # priority cannot starve it; traffic_shed_headroom scales the
+    # service-time estimate when deciding a deadline is provably
+    # unmeetable (shed BEFORE a batch slot is spent);
+    # traffic_max_inflight bounds requests handed to the engine at once
+    # (0 = auto from the engine's batch geometry, keeps ordering in the
+    # traffic layer); sustained deadline-miss ratio above
+    # traffic_slo_miss_threshold for traffic_slo_window_s dumps the
+    # flight recorder; traffic_stream_write_timeout_s cancels a
+    # streamed /v1/generate whose client stopped reading (frees its KV
+    # pages; 0 disables)
+    "traffic_queue_capacity": 64,
+    "traffic_tenants": "",
+    "traffic_default_rate": 0.0,
+    "traffic_default_burst": 0.0,
+    "traffic_aging_ms": 500.0,
+    "traffic_shed_headroom": 1.2,
+    "traffic_max_inflight": 0,
+    "traffic_slo_miss_threshold": 0.5,
+    "traffic_slo_window_s": 5.0,
+    "traffic_stream_write_timeout_s": 30.0,
     # observability/ (unified telemetry): observability_metrics turns
     # on per-step telemetry instruments (wall time, examples/sec) in
     # the dispatch hot path; observability_tracing upgrades span call

@@ -80,6 +80,7 @@ class GenerationStream:
         self.error: Optional[BaseException] = None
         self._cancelled = False
         self.first_token_at: Optional[float] = None
+        self._callbacks: List = []
 
     # -- engine side ---------------------------------------------------------
     def _push(self, token: int) -> None:
@@ -104,6 +105,25 @@ class GenerationStream:
         with self._cond:
             self._q.append(_DONE)
             self._cond.notify_all()
+            callbacks, self._callbacks = self._callbacks, []
+        for cb in callbacks:
+            try:
+                cb(self)
+            except Exception:  # noqa: BLE001 — a bad callback is the caller's bug
+                pass
+
+    def add_done_callback(self, fn) -> None:
+        """``fn(self)`` once the stream reaches a terminal state
+        (immediately if it already has) — the traffic layer's
+        completion accounting, no waiter thread per request."""
+        with self._cond:
+            if not self._done.is_set():
+                self._callbacks.append(fn)
+                return
+        try:
+            fn(self)
+        except Exception:  # noqa: BLE001
+            pass
 
     # -- caller side ---------------------------------------------------------
     def __iter__(self):
@@ -428,6 +448,17 @@ class GenerationEngine:
                            deadline_ms).result(timeout)
 
     # -- introspection -------------------------------------------------------
+    def queue_depth(self) -> int:
+        """Requests admitted but not yet prefilled (the traffic
+        layer's backend-room check before dispatching a prompt).
+        LOCKLESS on purpose: the traffic dispatcher calls this while
+        holding its own condition variable, and this engine invokes
+        stream done-callbacks (which re-enter the traffic layer) while
+        holding ``self._cond`` — taking the engine lock here would be
+        an ABBA deadlock. ``len`` of a deque is atomic under the GIL;
+        an off-by-a-few readout only shifts one dispatch decision."""
+        return len(self._queue)
+
     def stats(self) -> Dict[str, Any]:
         out = self.metrics.snapshot()
         out["cache"] = self.cache.stats()

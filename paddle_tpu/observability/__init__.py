@@ -34,7 +34,7 @@ from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
                        overlap_telemetry, step_telemetry,
                        watch_collectives, watch_engine, watch_executor,
                        watch_generation, watch_loader, watch_partition,
-                       watch_serving, watch_supervisor)
+                       watch_serving, watch_supervisor, watch_traffic)
 from .registry import registry as get_registry
 from .tracing import SpanContext, attach, current, span, traced
 
@@ -45,8 +45,8 @@ __all__ = [
     "flight_dump", "install_signal_handlers",
     "watch_serving", "watch_engine", "watch_executor", "watch_supervisor",
     "watch_loader", "watch_generation", "watch_partition",
-    "watch_collectives", "step_telemetry", "overlap_telemetry",
-    "snapshot", "to_prometheus_text",
+    "watch_collectives", "watch_traffic", "step_telemetry",
+    "overlap_telemetry", "snapshot", "to_prometheus_text",
 ]
 
 

@@ -37,7 +37,7 @@ from . import flight
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "registry",
     "watch_serving", "watch_engine", "watch_executor", "watch_supervisor",
-    "watch_loader", "watch_generation", "step_telemetry",
+    "watch_loader", "watch_generation", "watch_traffic", "step_telemetry",
     "overlap_telemetry",
 ]
 
@@ -296,6 +296,7 @@ _loaders: "weakref.WeakSet" = weakref.WeakSet()
 _generation: "weakref.WeakSet" = weakref.WeakSet()
 _partitions: "weakref.WeakSet" = weakref.WeakSet()
 _collectives: "weakref.WeakSet" = weakref.WeakSet()
+_traffic: "weakref.WeakSet" = weakref.WeakSet()
 
 
 def watch_serving(metrics) -> None:
@@ -354,6 +355,17 @@ def watch_collectives(plan) -> None:
     is one scrape."""
     _obs_id(plan)
     _collectives.add(plan)
+
+
+def watch_traffic(controller) -> None:
+    """Called by traffic.TrafficController.__init__: per-class/
+    per-tenant admit/shed/goodput counters, queue depths, the
+    deadline-miss ratio and the shed-before-batch counter become the
+    ``paddle_traffic_*{ctrl=}`` family group — the admission story of
+    every live controller in the one scrape a router/autoscaler
+    already reads."""
+    _obs_id(controller)
+    _traffic.add(controller)
 
 
 def _flatten(prefix: str, d: Dict[str, Any], out: Dict[str, float]) -> None:
@@ -479,6 +491,24 @@ def _collect_collectives():
                     lambda p: p.snapshot())
 
 
+def _collect_traffic():
+    """TrafficMetrics.collect() already emits labeled series (cls=,
+    tenant=, reason=); this just stamps each with the controller's
+    ctrl= id so two controllers in one process stay distinguishable."""
+    merged: Dict[str, List] = {}
+    for ctl in list(_traffic):
+        try:
+            series = ctl.metrics.collect()
+        except Exception:  # noqa: BLE001 — a closing controller mid-scrape
+            continue
+        cid = getattr(ctl, "_obs_id", "?")
+        for name, items in series.items():
+            out = merged.setdefault(name, [])
+            for labels, val in items:
+                out.append(({**{"ctrl": cid}, **(labels or {})}, val))
+    return merged
+
+
 def _collect_build_info():
     from .. import version
 
@@ -496,6 +526,7 @@ for _name, _fn in (
     ("generation", _collect_generation),
     ("partition", _collect_partition),
     ("collective", _collect_collectives),
+    ("traffic", _collect_traffic),
     ("build_info", _collect_build_info),
 ):
     _REGISTRY.register_collector(_name, _fn)

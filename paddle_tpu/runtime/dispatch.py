@@ -107,16 +107,19 @@ def ensure_persistent_cache() -> Optional[str]:
     try:
         os.makedirs(path, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", path)
-        if _PERSISTENT_DIR is not None:
-            # jax pins its cache singleton to the first directory it
-            # initialized with; a flag change mid-process needs a reset
-            # (private API — best-effort on future jax)
-            try:
-                from jax._src import compilation_cache as _cc
+        # jax latches its cache singleton at the FIRST compile in the
+        # process: if anything jitted before this flag was applied
+        # (e.g. the weight-dtype convert during model load), the
+        # singleton initialized with no directory and silently ignores
+        # the config forever. Reset unconditionally so the next
+        # compile re-initializes against the directory just applied
+        # (private API — best-effort on future jax).
+        try:
+            from jax._src import compilation_cache as _cc
 
-                _cc.reset_cache()
-            except Exception:  # noqa: BLE001
-                pass
+            _cc.reset_cache()
+        except Exception:  # noqa: BLE001
+            pass
         # default thresholds skip small/fast compiles — a framework
         # whose unit of compilation is the WHOLE train step wants
         # every executable persisted, including the tiny eval/infer

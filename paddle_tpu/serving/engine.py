@@ -89,7 +89,8 @@ class ServingFuture:
     the per-fetch output list (predictor order) or raises the serving
     error the request was completed with."""
 
-    __slots__ = ("_ev", "_lock", "_result", "_error", "_engine")
+    __slots__ = ("_ev", "_lock", "_result", "_error", "_engine",
+                 "_callbacks")
 
     def __init__(self, engine: "ServingEngine"):
         self._ev = threading.Event()
@@ -97,6 +98,7 @@ class ServingFuture:
         self._result: Optional[List[np.ndarray]] = None
         self._error: Optional[BaseException] = None
         self._engine = engine
+        self._callbacks: List = []
 
     def _complete(self, result=None, error=None) -> bool:
         """First completion wins (batcher expiry vs caller cancel vs
@@ -106,7 +108,27 @@ class ServingFuture:
                 return False
             self._result, self._error = result, error
             self._ev.set()
-            return True
+            callbacks, self._callbacks = self._callbacks, []
+        for cb in callbacks:
+            try:
+                cb(self)
+            except Exception:  # noqa: BLE001 — a bad callback is the caller's bug
+                pass
+        return True
+
+    def add_done_callback(self, fn) -> None:
+        """``fn(self)`` on completion (whichever thread completes it);
+        immediately if already done. The traffic layer's completion
+        accounting rides this instead of burning a waiter thread per
+        request."""
+        with self._lock:
+            if not self._ev.is_set():
+                self._callbacks.append(fn)
+                return
+        try:
+            fn(self)
+        except Exception:  # noqa: BLE001
+            pass
 
     def cancel(self) -> bool:
         """Cancel if not yet completed/batched. True if the request

@@ -22,9 +22,26 @@ three endpoints a serving deployment actually needs:
                           Requires a GenerationEngine
                           (ServingServer(..., generation_engine=)).
     GET  /healthz      -> 200 while serving, 503 once closed (a load
-                          balancer drains on this flip)
+                          balancer drains on this flip); with a traffic
+                          controller attached, also per-class queue
+                          depths + drain state + deadline-miss ratio —
+                          the one endpoint a router/autoscaler needs
     GET  /metrics      -> Prometheus text: serving counters/quantiles +
                           aggregated predictor bucket stats
+
+With ``ServingServer(engine, traffic=TrafficController(...))`` both
+POST endpoints route through the traffic tier: tenant and priority
+class come from the ``X-Tenant`` / ``X-Priority`` headers (or payload
+fields ``tenant`` / ``priority``), and every shed maps to 503 (429
+for tenant-quota sheds) carrying a ``Retry-After`` header computed
+from the measured queue-drain rate. Without a controller, bare-engine
+``Overloaded`` 503s still carry a coarse Retry-After estimate.
+
+Slow clients: a streamed ``/v1/generate`` whose client stops reading
+hits the socket write timeout (``traffic_stream_write_timeout_s``),
+which CANCELS the sequence — its KV pages free at the next step
+boundary and the handler thread is reaped, instead of the writer
+blocking forever while the engine decodes tokens nobody will read.
 
 Each request handler thread just blocks in `engine.predict` — the
 coalescing into dense TPU batches happens in the engine's batcher, so
@@ -37,6 +54,8 @@ traces right next to the executor's compile/step events.
 from __future__ import annotations
 
 import json
+import math
+import socket
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -57,10 +76,21 @@ def _json_default(o):
     raise TypeError(f"not JSON serializable: {type(o)}")
 
 
+def _retry_after_header(seconds: float) -> str:
+    # Retry-After is integer seconds on the wire; the JSON body keeps
+    # the sub-second value for clients that can use it
+    return str(max(1, int(math.ceil(seconds))))
+
+
 class _Handler(BaseHTTPRequestHandler):
     engine: ServingEngine = None  # set by the subclass ServingServer makes
     gen_engine = None             # generation.GenerationEngine (optional)
+    traffic = None                # traffic.TrafficController (optional)
     started_at: float = 0.0       # time.monotonic() at server start
+    stream_timeout_s: float = 0.0  # /v1/generate write stall budget
+    sndbuf: int = 0               # test hook: shrink SO_SNDBUF
+    active = None                 # {"n": int} shared with ServingServer
+    active_lock = None
     server_version = "paddle_tpu_serving/1.0"
     protocol_version = "HTTP/1.1"
 
@@ -68,24 +98,50 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # noqa: A003 — quiet by default
         pass
 
-    def _reply(self, code: int, body: bytes, ctype: str):
+    def setup(self):
+        super().setup()
+        if self.sndbuf:
+            self.connection.setsockopt(
+                socket.SOL_SOCKET, socket.SO_SNDBUF, int(self.sndbuf))
+
+    def _reply(self, code: int, body: bytes, ctype: str, headers=None):
         self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
 
-    def _reply_json(self, code: int, obj):
+    def _reply_json(self, code: int, obj, headers=None):
         self._reply(code, json.dumps(obj, default=_json_default).encode(),
-                    "application/json")
+                    "application/json", headers=headers)
+
+    def _reply_shed(self, e) -> None:
+        """A traffic-layer shed: 503 (429 for quota) + Retry-After
+        from the measured drain rate / token-bucket refill."""
+        code = 429 if e.kind == "quota" else 503
+        self._reply_json(code, {
+            "error": str(e), "kind": f"shed:{e.kind}",
+            "retry_after_s": round(e.retry_after_s, 3),
+        }, headers={"Retry-After": _retry_after_header(e.retry_after_s)})
+
+    def _meta(self, payload) -> tuple:
+        """(tenant, priority) from headers first, payload second —
+        a proxy can stamp headers without touching the body."""
+        tenant = self.headers.get("X-Tenant") or payload.get("tenant")
+        priority = self.headers.get("X-Priority") or payload.get("priority")
+        return tenant, priority
 
     # -- endpoints -----------------------------------------------------------
     def do_GET(self):  # noqa: N802 — http.server contract
         if self.path == "/healthz":
             from .. import version
 
+            draining = self.engine.closed or (
+                self.traffic is not None and self.traffic.draining)
             body = {
-                "status": "draining" if self.engine.closed else "ok",
+                "status": "draining" if draining else "ok",
                 # uptime + build info: a load balancer's drain check and
                 # a fleet-rollout "which build is this" probe share one
                 # endpoint
@@ -93,7 +149,12 @@ class _Handler(BaseHTTPRequestHandler):
                 "version": version.full_version,
                 "tpu": version.tpu(),
             }
-            self._reply_json(503 if self.engine.closed else 200, body)
+            if self.traffic is not None:
+                # per-class queue depths + drain state + miss ratio:
+                # the router/autoscaler decides from THIS endpoint,
+                # not from scraping and joining three metric families
+                body["traffic"] = self.traffic.health()
+            self._reply_json(503 if draining else 200, body)
         elif self.path == "/metrics":
             # the UNIFIED registry: serving counters (this engine and
             # any sibling, labeled), dispatch/compile caches, executor,
@@ -107,12 +168,24 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply_json(404, {"error": f"no such endpoint {self.path}"})
 
     def do_POST(self):  # noqa: N802
-        if self.path == "/v1/generate":
-            self._generate()
-            return
-        if self.path != "/v1/predict":
-            self._reply_json(404, {"error": f"no such endpoint {self.path}"})
-            return
+        # in-flight accounting: the rolling-restart drain waits for
+        # this to hit zero before the process exits, so no accepted
+        # request ever dies with its response half-written
+        with self.active_lock:
+            self.active["n"] += 1
+        try:
+            if self.path == "/v1/generate":
+                self._generate()
+            elif self.path == "/v1/predict":
+                self._predict()
+            else:
+                self._reply_json(404,
+                                 {"error": f"no such endpoint {self.path}"})
+        finally:
+            with self.active_lock:
+                self.active["n"] -= 1
+
+    def _predict(self):
         from ..observability import tracing
 
         try:
@@ -133,15 +206,30 @@ class _Handler(BaseHTTPRequestHandler):
                 self._reply_json(
                     400, {"error": f"{name} must be a number, got {v!r}"})
                 return
+        from ..traffic import TrafficShed, engine_retry_after
+
         try:
             # span (record_event when tracing is off): the HTTP handler
             # thread is the trace root; engine.submit's span nests under
             # it via the ambient thread-local context
             with tracing.span("serving/http_predict"):
-                outs = self.engine.predict(inputs, deadline_ms=deadline_ms,
-                                           timeout=timeout)
+                if self.traffic is not None:
+                    tenant, priority = self._meta(payload)
+                    outs = self.traffic.predict(
+                        inputs, tenant=tenant, priority=priority,
+                        deadline_ms=deadline_ms, timeout=timeout)
+                else:
+                    outs = self.engine.predict(inputs,
+                                               deadline_ms=deadline_ms,
+                                               timeout=timeout)
+        except TrafficShed as e:
+            self._reply_shed(e)
         except Overloaded as e:
-            self._reply_json(503, {"error": str(e), "kind": "overloaded"})
+            ra = engine_retry_after(self.engine)
+            self._reply_json(
+                503, {"error": str(e), "kind": "overloaded",
+                      "retry_after_s": round(ra, 3)},
+                headers={"Retry-After": _retry_after_header(ra)})
         except (DeadlineExceeded, TimeoutError) as e:
             self._reply_json(504, {"error": str(e), "kind": "deadline"})
         except EngineClosed as e:
@@ -191,18 +279,47 @@ class _Handler(BaseHTTPRequestHandler):
         from .engine import DeadlineExceeded as _DE
         from .engine import EngineClosed as _EC
         from .engine import Overloaded as _OV
+        from ..traffic import TrafficShed, generation_retry_after
 
+        ticket = None
         try:
             with tracing.span("serving/http_generate"):
-                stream = self.gen_engine.submit(
-                    tokens, max_new_tokens=max_new,
-                    eos_id=eos_id if eos_id is not None else "default",
-                    deadline_ms=deadline_ms)
+                if self.traffic is not None:
+                    tenant, priority = self._meta(payload)
+                    ticket = self.traffic.submit_generation(
+                        tokens, tenant=tenant, priority=priority,
+                        deadline_ms=deadline_ms, max_new_tokens=max_new,
+                        eos_id=eos_id if eos_id is not None else "default")
+                    # blocks until the dispatcher admits the prompt
+                    # into the continuous batch (or sheds it)
+                    stream = ticket.stream(
+                        timeout=(deadline_ms / 1e3 + 5.0
+                                 if deadline_ms is not None else 600.0))
+                else:
+                    stream = self.gen_engine.submit(
+                        tokens, max_new_tokens=max_new,
+                        eos_id=eos_id if eos_id is not None else "default",
+                        deadline_ms=deadline_ms)
+        except TrafficShed as e:
+            self._reply_shed(e)
+            return
         except _OV as e:
-            self._reply_json(503, {"error": str(e), "kind": "overloaded"})
+            ra = generation_retry_after(self.gen_engine)
+            self._reply_json(
+                503, {"error": str(e), "kind": "overloaded",
+                      "retry_after_s": round(ra, 3)},
+                headers={"Retry-After": _retry_after_header(ra)})
             return
         except _EC as e:
             self._reply_json(503, {"error": str(e), "kind": "closed"})
+            return
+        except (_DE, TimeoutError) as e:
+            if ticket is not None:
+                # the client is gone after this 504: withdraw the
+                # still-queued request so it never spends decode lanes
+                # and KV pages on a stream nobody will read
+                ticket.cancel()
+            self._reply_json(504, {"error": str(e), "kind": "deadline"})
             return
         except ValueError as e:
             self._reply_json(400, {"error": str(e)})
@@ -226,6 +343,15 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Type", "application/x-ndjson")
         self.send_header("Transfer-Encoding", "chunked")
         self.end_headers()
+        # slow-client budget: a client that stops READING eventually
+        # fills the socket buffers and blocks our next write; the
+        # timeout turns that permanent stall into a cancel — the
+        # sequence retires at the next step boundary (KV pages freed),
+        # the engine stops decoding tokens nobody will read, and this
+        # handler thread is reaped instead of leaking. Routine
+        # hangups (RST/EPIPE) take the same path.
+        if self.stream_timeout_s and self.stream_timeout_s > 0:
+            self.connection.settimeout(float(self.stream_timeout_s))
         n = 0
         try:
             for tok in stream:
@@ -234,6 +360,10 @@ class _Handler(BaseHTTPRequestHandler):
                 n += 1
             tail = {"done": True, "finish_reason": stream.finish_reason,
                     "n_tokens": n}
+        except OSError:   # stalled (socket.timeout) or hung-up client
+            stream.cancel()
+            self.close_connection = True
+            return
         except Exception as e:  # noqa: BLE001 — deadline/cancel mid-stream
             tail = {"done": True, "finish_reason": stream.finish_reason
                     or "error", "n_tokens": n, "error": str(e)}
@@ -241,8 +371,9 @@ class _Handler(BaseHTTPRequestHandler):
             self._write_chunk(json.dumps(tail).encode() + b"\n")
             self.wfile.write(b"0\r\n\r\n")
             self.wfile.flush()
-        except (ConnectionError, BrokenPipeError):
+        except OSError:
             stream.cancel()   # client hung up: stop wasting decode lanes
+            self.close_connection = True
 
 
 class _QuietThreadingServer(ThreadingHTTPServer):
@@ -257,23 +388,70 @@ class _QuietThreadingServer(ThreadingHTTPServer):
         super().handle_error(request, client_address)
 
 
+class _ReuseportThreadingServer(_QuietThreadingServer):
+    """SO_REUSEPORT listener: N worker PROCESSES bind the same
+    host:port and the kernel load-balances new connections across
+    them — the traffic.WorkerPool scale-out front."""
+
+    def server_bind(self):
+        if not hasattr(socket, "SO_REUSEPORT"):
+            raise OSError(
+                "SO_REUSEPORT is not supported on this platform; use "
+                "traffic.ThinRouter / WorkerPool(use_reuseport=False)")
+        self.socket.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+        super().server_bind()
+
+
 class ServingServer:
     """Own the HTTP listener; the engine's lifecycle stays the
     caller's. `port=0` binds an ephemeral port (tests, examples);
-    `.port` reports the bound one."""
+    `.port` reports the bound one.
+
+    ``traffic=`` routes both POST endpoints through a
+    ``traffic.TrafficController`` (priority/tenant admission, deadline
+    sheds with Retry-After). ``reuse_port=True`` binds with
+    SO_REUSEPORT so sibling worker processes share the port.
+    ``stream_write_timeout_s`` overrides the
+    ``traffic_stream_write_timeout_s`` flag (slow-reader cancel);
+    ``sndbuf`` shrinks the per-connection send buffer (test hook for
+    the slow-client regression test)."""
 
     def __init__(self, engine: ServingEngine, host: str = "127.0.0.1",
-                 port: int = 0, start: bool = True, generation_engine=None):
+                 port: int = 0, start: bool = True, generation_engine=None,
+                 traffic=None, reuse_port: bool = False,
+                 stream_write_timeout_s: Optional[float] = None,
+                 sndbuf: int = 0):
+        from ..flags import flag
+
         self.engine = engine
         self.generation_engine = generation_engine
+        self.traffic = traffic
+        if stream_write_timeout_s is None:
+            stream_write_timeout_s = float(
+                flag("traffic_stream_write_timeout_s"))
+        self._active = {"n": 0}
+        self._active_lock = threading.Lock()
         handler = type("_BoundHandler", (_Handler,),
                        {"engine": engine, "gen_engine": generation_engine,
+                        "traffic": traffic,
+                        "stream_timeout_s": float(stream_write_timeout_s),
+                        "sndbuf": int(sndbuf),
+                        "active": self._active,
+                        "active_lock": self._active_lock,
                         "started_at": time.monotonic()})
-        self._httpd = _QuietThreadingServer((host, port), handler)
+        server_cls = (_ReuseportThreadingServer if reuse_port
+                      else _QuietThreadingServer)
+        self._httpd = server_cls((host, port), handler)
         self.host, self.port = self._httpd.server_address[:2]
         self._thread: Optional[threading.Thread] = None
         if start:
             self.start()
+
+    def active_requests(self) -> int:
+        """POST requests currently inside a handler (the drain
+        protocol's exit condition)."""
+        with self._active_lock:
+            return self._active["n"]
 
     @property
     def address(self) -> str:

@@ -1,0 +1,62 @@
+"""paddle_tpu.traffic — the production traffic tier.
+
+ROADMAP item 5: the serving stack's overload story used to be one
+bounded FIFO that rejected when full. This package is the layer
+between the HTTP front end and the engines that a multi-tenant,
+SLO-bound deployment actually needs:
+
+* ``admission`` — priority classes (``interactive``/``batch``/
+  ``best_effort``), per-tenant token-bucket quotas, per-class/
+  per-tenant bounded queues.
+* ``controller`` — ``TrafficController``: deadline-aware scheduling
+  (service-time estimates from the live ``paddle_step_*`` quantiles;
+  provably-unmeetable deadlines shed BEFORE costing a batch slot, with
+  a measured-drain-rate Retry-After), strict-priority dispatch with
+  aging, sustained-SLO-breach flight-recorder dumps.
+* ``frontend`` — ``WorkerPool``: multi-process scale-out behind
+  SO_REUSEPORT (or the ``ThinRouter`` fallback), persistent-compile-
+  cache warm starts, zero-drop rolling restart.
+
+Everything exports ``paddle_traffic_*`` series into the unified
+observability registry; ``tools/traffic_replay.py`` is the
+scenario-diversity proof harness (bursty arrivals, heavy-tail mixes,
+mixed tenants, slow clients), gated in CI at smoke scale.
+
+    from paddle_tpu.serving import ServingEngine, ServingServer
+    from paddle_tpu import traffic
+
+    ctl = traffic.TrafficController(engine, generation_engine=gen)
+    srv = ServingServer(engine, traffic=ctl)     # headers pick
+    ctl.stats()                                  # tenant + class
+"""
+
+from .admission import (
+    BATCH,
+    BEST_EFFORT,
+    CLASSES,
+    INTERACTIVE,
+    ClassQueues,
+    TenantSpec,
+    TokenBucket,
+    TrafficConfig,
+    parse_tenants,
+)
+from .controller import (
+    ServiceTimeEstimator,
+    TrafficController,
+    TrafficShed,
+    TrafficTicket,
+    engine_retry_after,
+    generation_retry_after,
+)
+from .frontend import ThinRouter, WorkerPool, reuseport_supported
+from .metrics import TrafficMetrics
+
+__all__ = [
+    "CLASSES", "INTERACTIVE", "BATCH", "BEST_EFFORT",
+    "TokenBucket", "TenantSpec", "parse_tenants", "TrafficConfig",
+    "ClassQueues", "TrafficMetrics",
+    "TrafficController", "TrafficTicket", "TrafficShed",
+    "ServiceTimeEstimator", "engine_retry_after", "generation_retry_after",
+    "WorkerPool", "ThinRouter", "reuseport_supported",
+]

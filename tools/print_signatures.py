@@ -17,6 +17,7 @@ MODULES = [
     "paddle_tpu.resilience",
     "paddle_tpu.observability",
     "paddle_tpu.partition",
+    "paddle_tpu.traffic",
     "paddle_tpu.layers",
     "paddle_tpu.optimizer",
     "paddle_tpu.nets",

@@ -17,6 +17,12 @@ the numbers track coalescing + queueing + dispatch overhead. Reported:
                 awaited: the throughput-bounded regime, where full
                 batches amortize per-call dispatch (this is the number
                 that must beat `direct`)
+  fifo_vs_slo — the SAME bursty deadline-bound overload through the
+                bare FIFO engine and through the traffic tier
+                (paddle_tpu.traffic): deadline-goodput both ways plus
+                the gain (tools/traffic_replay.py owns the full
+                scenario suite; this is its headline number riding the
+                serving trajectory artifact)
 
 Prints one JSON object (same contract as tools/dispatch_bench.py);
 --out FILE also writes it to disk; --smoke shrinks the load for CI
@@ -178,6 +184,30 @@ def main():
     result["burst_speedup_vs_direct"] = round(
         result["burst_req_per_sec"] / result["direct_req_per_sec"], 2)
     result["burst_batch_occupancy"] = burst_snap["batch_occupancy"]
+
+    # FIFO vs SLO-aware goodput under deadline-bound overload: the
+    # traffic tier must convert the same offered load into MORE
+    # responses that meet their deadlines (sheds are free, late
+    # completions are not)
+    sys.path.insert(0, HERE)
+    import traffic_replay
+
+    overload_spec = {
+        "rate": result["burst_req_per_sec"] * 1.5,
+        "burst_rate": result["burst_req_per_sec"] * 4.0,
+        "duration_s": 2.0 if args.smoke else 5.0,
+        "max_batch": args.max_batch, "workers": args.workers,
+        "queue_capacity": 512,
+        "deadline_ms": {"interactive": 80.0, "batch": 300.0,
+                        "best_effort": 300.0},
+    }
+    cmp_r = traffic_replay.run_overload_comparison(pred, overload_spec)
+    result["fifo_vs_slo"] = {
+        "fifo_goodput": cmp_r["fifo"]["goodput"],
+        "slo_goodput": cmp_r["slo"]["goodput"],
+        "goodput_gain": cmp_r["goodput_gain"],
+        "shed_before_batch_ok": cmp_r["slo"].get("shed_before_batch_ok"),
+    }
 
     result["errors"] = len(errors) + hung
     if errors:
