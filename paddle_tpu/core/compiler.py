@@ -195,18 +195,23 @@ class CompiledProgram:
                 "with_partitioning: pass a PartitionConfig OR keyword "
                 "arguments for one, not both")
         self._claim_strategy("with_partitioning")
+        mesh = config.build_mesh(devices)
         if config.collectives_active():
             # bucketed / quantized DP gradient all-reduce: rewrite the
             # program (idempotent) BEFORE resolving shardings so the
-            # resolve pass and the executor both see the final op list
+            # resolve pass and the executor both see the final op list.
+            # The bucket cap resolves against THIS mesh: a dp axis that
+            # spans hosts picks the per-axis form's dcn bucket (bigger
+            # buckets amortize DCN latency), an ICI-local one its dp
+            # bucket
             from ..parallel.collectives import ensure_planned
 
             ensure_planned(
                 self._program,
-                bucket_mb=config.collective_bucket_mb,
+                bucket_mb=config.effective_bucket_mb(mesh),
                 quantization=config.collective_quantization,
                 quant_block=config.collective_quant_block)
-        resolved = config.resolve(self._program, devices=devices)
+        resolved = config.resolve(self._program, mesh=mesh)
         self._mesh = resolved.mesh
         self._in_shardings = dict(resolved.in_shardings)
         self._state_shardings = dict(resolved.state_shardings) or None

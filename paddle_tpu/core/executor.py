@@ -151,6 +151,14 @@ class _CompiledBlock:
         self.donated_names: List[str] = []
         self.donation_skip_reason: Optional[str] = None
         self.mesh = None
+        # multi-host (mesh spanning processes): the per-arg shardings
+        # the dispatch layer needs to assemble GLOBAL jax.Arrays from
+        # each process's LOCAL feed batch / host-value state
+        # (jax.make_array_from_process_local_data) — host numpy cannot
+        # be passed straight into a jit whose in_shardings are
+        # non-addressable
+        self.feed_shardings: Optional[Dict[str, Any]] = None
+        self.state_sharding_by_name: Optional[Dict[str, Any]] = None
 
 
 def _lower_block(
@@ -1084,6 +1092,16 @@ class Executor:
         blk.donated_names = donatable if donate else []
         blk.donation_skip_reason = skip_reason
         blk.mesh = mesh
+        if mesh is not None:
+            # dispatch needs the per-arg shardings when this mesh spans
+            # processes: host feeds/state must be assembled into global
+            # jax.Arrays (BoundStep._globalize) before the jit call
+            shardings = jit_kwargs["in_shardings"]
+            blk.feed_shardings = {
+                n: shardings[2 + i] for i, n in enumerate(feed_names)}
+            blk.state_sharding_by_name = {
+                n: shardings[2 + len(feed_names) + i]
+                for i, n in enumerate(state_names)}
         return blk
 
     def _compile_multiprocess(

@@ -2,6 +2,23 @@
 
 Reference: python/paddle/distributed/ (launch.py:175,353 multi-proc GPU
 launcher; launch_ps.py pserver launcher).
+
+Beyond the reference: ``launch.py`` is an ELASTIC launcher (heartbeat
+failure detector, SIGTERM->SIGKILL teardown, world restart with fresh
+rendezvous) and ``coordinator.py`` is the in-process coordination
+fabric (jax.distributed rendezvous, hybrid DCN+ICI mesh construction,
+barriers with restartable-exit timeouts, per-rank heartbeats, the
+``paddle_dist_*`` gauges). ``tools/chaos_multihost.py`` proves the
+kill-one-of-N -> restart -> bit-exact-resume loop end to end.
 """
 
-from ..parallel.env import ParallelEnv, get_rank, get_world_size, init_parallel_env
+from ..parallel.env import (ParallelEnv, get_rank, get_world_size,
+                            init_parallel_env)
+from .coordinator import (RESTART_EXIT_CODE, BarrierTimeout, Coordinator,
+                          get_coordinator, initialize, spans_processes)
+
+__all__ = [
+    "ParallelEnv", "get_rank", "get_world_size", "init_parallel_env",
+    "Coordinator", "BarrierTimeout", "RESTART_EXIT_CODE",
+    "initialize", "get_coordinator", "spans_processes",
+]

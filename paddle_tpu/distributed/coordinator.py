@@ -1,0 +1,520 @@
+"""Multi-host coordination: rendezvous, hybrid mesh, heartbeats, and
+barriers with restartable-exit semantics.
+
+The reference framework's multi-host story is a static NCCL ring wired
+at launch; a dead trainer wedges every peer in a collective until the
+operator notices. Here the coordination fabric is explicit:
+
+* ``initialize()`` wraps ``jax.distributed.initialize`` rendezvous
+  through the ``PADDLE_*`` env contract the elastic launcher
+  (``distributed/launch.py``) exports, and starts the per-rank
+  heartbeat the launcher's failure detector watches;
+* ``build_mesh()`` arranges the *global* device set process-major so a
+  mesh axis spanning hosts groups each host's ICI-local chips
+  contiguously — the hybrid DCN+ICI layout
+  ``partition.PartitionConfig.resolve`` and the collective planner
+  consume unchanged (``spans_processes(mesh)`` is how the planner
+  detects that a reduce crosses DCN and picks the bigger
+  ``collective_bucket_mb`` bucket for it);
+* ``barrier()`` is a named barrier over the jax coordination service
+  with a TIMEOUT: a peer that died (or wedged) turns the stall into a
+  ``BarrierTimeout`` instead of an unbounded hang, and
+  ``restartable_exit()`` converts that into a clean
+  ``RESTART_EXIT_CODE`` exit the launcher interprets as "restart the
+  world" — the same escalation the PR-4 watchdog applies to hung
+  steps;
+* ``make_global_array()`` assembles one global jax.Array from this
+  process's LOCAL batch (what a rank-sharded ``GeneratorLoader``
+  yields), the feed-side contract of multi-host GSPMD execution.
+
+Everything degrades to a no-op in a single-process world, so the same
+training script runs unmodified under ``launch.py --nproc_per_node=N``
+or bare ``python``.
+
+Note on backends: cross-process GSPMD jit (mesh spanning processes)
+requires a real TPU/GPU backend — XLA's CPU backend refuses
+multiprocess computations. On CPU the cross-process path is the pmap
+collective seam (``GradAllReduce`` transpile +
+``Executor._compile_multiprocess``), which is what the chaos harness
+(``tools/chaos_multihost.py``) drives in CI; the mesh/feed helpers
+here are the TPU-pod path.
+
+Exported gauges (observability registry, ``paddle_dist_*``): world
+size, rank, restart count, live ranks + max heartbeat age (scanned
+from the heartbeat directory), barrier counters and cumulative barrier
+wait.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+__all__ = [
+    "Coordinator", "BarrierTimeout", "RESTART_EXIT_CODE",
+    "initialize", "get_coordinator", "spans_processes",
+]
+
+_log = logging.getLogger("paddle_tpu.distributed")
+
+# Exit status meaning "this failure is restartable: re-rendezvous and
+# resume from the last committed checkpoint" (EX_TEMPFAIL). The elastic
+# launcher restarts the world on ANY nonzero child exit while restarts
+# remain; this code documents intent (vs. 43 = injected kill, other =
+# crash) in logs and chaos reports.
+RESTART_EXIT_CODE = 75
+
+_HB_PREFIX = "hb.rank"
+
+
+class BarrierTimeout(RuntimeError):
+    """A coordination barrier timed out — some rank died or wedged.
+
+    The clean recovery is a world restart: callers in a multi-process
+    world should exit with ``RESTART_EXIT_CODE`` (the Supervisor does
+    this automatically when the timeout escapes its loop)."""
+
+
+class Coordinator:
+    """One process's view of the multi-host world.
+
+    Built by ``initialize()``; holds rank/world/restart-count, runs the
+    heartbeat thread the launcher's failure detector reads, and scopes
+    the barrier sequence numbers (barrier names must be unique per use
+    on the coordination service — every rank executes the same barrier
+    call sequence, so a per-name counter keeps them aligned)."""
+
+    def __init__(self, rank: int, world_size: int,
+                 heartbeat_dir: Optional[str] = None,
+                 heartbeat_interval_s: float = 2.5):
+        self.rank = int(rank)
+        self.world_size = int(world_size)
+        self.restart_count = int(os.environ.get("PADDLE_RESTART_COUNT", "0"))
+        self.heartbeat_dir = heartbeat_dir
+        self.heartbeat_interval_s = float(heartbeat_interval_s)
+        self._hb_thread: Optional[threading.Thread] = None
+        self._hb_stop = threading.Event()
+        self._progress_fn = None
+        self._progress_stall_s = 0.0
+        self._progress_last: Any = None
+        self._progress_changed = time.time()
+        self._barrier_seq: Dict[str, int] = {}
+        self._stats_lock = threading.Lock()
+        self._stats: Dict[str, float] = {
+            "barriers_total": 0,
+            "barrier_wait_ms_total": 0.0,
+            "barrier_timeouts_total": 0,
+            "heartbeats_total": 0,
+        }
+        from ..observability import watch_coordinator
+
+        watch_coordinator(self)
+
+    # -- identity ------------------------------------------------------------
+    @property
+    def is_distributed(self) -> bool:
+        return self.world_size > 1
+
+    def __repr__(self):
+        return (f"Coordinator(rank={self.rank}/{self.world_size}, "
+                f"restarts={self.restart_count})")
+
+    # -- heartbeats ----------------------------------------------------------
+    def _hb_path(self, rank: Optional[int] = None) -> Optional[str]:
+        if not self.heartbeat_dir:
+            return None
+        return os.path.join(self.heartbeat_dir,
+                            f"{_HB_PREFIX}{self.rank if rank is None else rank}")
+
+    def start_heartbeat(self) -> bool:
+        """Begin touching this rank's heartbeat file every
+        ``heartbeat_interval_s``. The launcher's failure detector
+        treats a heartbeat older than its ``--heartbeat_timeout_s`` as
+        a hung host and restarts the world — the liveness signal a
+        plain ``proc.poll()`` cannot give (a wedged collective keeps
+        the process alive forever). No-op without a heartbeat dir."""
+        if self._hb_thread is not None or not self.heartbeat_dir:
+            return self._hb_thread is not None
+        os.makedirs(self.heartbeat_dir, exist_ok=True)
+        # a FRESH stop event: after stop_heartbeat() the old (set)
+        # event would make the new loop's first wait() return True and
+        # silently never beat — the launcher would then kill a healthy
+        # rank for staleness. The old thread still holds the old event
+        # and exits on it.
+        self._hb_stop = stop = threading.Event()
+        self._beat()  # first beat lands before the thread is scheduled
+
+        def loop():
+            while not stop.wait(self.heartbeat_interval_s):
+                if self._progress_stalled():
+                    # the heartbeat thread is alive but the WORK is not
+                    # — stop beating so the launcher's staleness check
+                    # reads this rank as hung (a thread-based beat
+                    # would otherwise vouch for a wedged step loop
+                    # forever)
+                    continue
+                try:
+                    self._beat()
+                except OSError:  # run dir reclaimed mid-shutdown
+                    return
+
+        self._hb_thread = threading.Thread(
+            target=loop, daemon=True, name=f"paddle-dist-hb-{self.rank}")
+        self._hb_thread.start()
+        return True
+
+    def attach_progress(self, fn, stall_after_s: float = 60.0):
+        """Make the heartbeat PROGRESS-based: ``fn()`` returns any
+        value that changes while real work happens (e.g. the
+        Supervisor's ``steps_completed``); once it stops changing for
+        ``stall_after_s`` the heartbeat goes silent and the launcher
+        declares the rank hung. Without this, a process wedged in a
+        dead-peer collective keeps its daemon heartbeat alive forever.
+        Size the window above the longest legitimate gap between
+        progress ticks (first-compile, checkpoint save)."""
+        self._progress_fn = fn
+        self._progress_stall_s = float(stall_after_s)
+        self._progress_last = None
+        self._progress_changed = time.time()
+
+    def _progress_stalled(self) -> bool:
+        fn = self._progress_fn
+        if fn is None or self._progress_stall_s <= 0:
+            return False
+        try:
+            v = fn()
+        except Exception:  # noqa: BLE001 — the probe must never kill the beat
+            return False
+        if v != self._progress_last:
+            self._progress_last = v
+            self._progress_changed = time.time()
+            return False
+        return time.time() - self._progress_changed > self._progress_stall_s
+
+    def _beat(self):
+        path = self._hb_path()
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(str(time.time()))
+        os.replace(tmp, path)  # atomic: the detector never reads a torn file
+        with self._stats_lock:
+            self._stats["heartbeats_total"] += 1
+
+    def stop_heartbeat(self):
+        self._hb_stop.set()
+        self._hb_thread = None
+
+    def heartbeat_ages(self) -> Dict[int, float]:
+        """rank -> seconds since that rank's last heartbeat, for every
+        rank that has ever beaten (the launcher-side failure-detector
+        view, also readable by any rank for the gauges)."""
+        out: Dict[int, float] = {}
+        if not self.heartbeat_dir or not os.path.isdir(self.heartbeat_dir):
+            return out
+        now = time.time()
+        for entry in os.listdir(self.heartbeat_dir):
+            if not entry.startswith(_HB_PREFIX):
+                continue
+            try:
+                rank = int(entry[len(_HB_PREFIX):])
+                out[rank] = max(
+                    0.0,
+                    now - os.path.getmtime(
+                        os.path.join(self.heartbeat_dir, entry)))
+            except (ValueError, OSError):
+                continue
+        return out
+
+    def live_ranks(self, stale_after_s: Optional[float] = None) -> int:
+        """Ranks whose heartbeat is fresher than ``stale_after_s``
+        (default 4x the beat interval). Without a heartbeat dir the
+        only honest answer is this process itself."""
+        ages = self.heartbeat_ages()
+        if not ages:
+            return 1
+        cutoff = (4.0 * self.heartbeat_interval_s
+                  if stale_after_s is None else float(stale_after_s))
+        return sum(1 for a in ages.values() if a <= cutoff)
+
+    # -- barrier -------------------------------------------------------------
+    def barrier(self, name: str, timeout_s: Optional[float] = None) -> float:
+        """Named barrier across every process, with a timeout.
+
+        Returns the seconds spent waiting. A stall past ``timeout_s``
+        (default: the ``dist_barrier_timeout_s`` flag) raises
+        ``BarrierTimeout`` instead of hanging — a dead peer costs one
+        bounded wait, after which the caller exits restartably and the
+        launcher re-forms the world. Single-process: no-op."""
+        if self.world_size <= 1:
+            return 0.0
+        from ..flags import flag
+
+        timeout_s = (float(flag("dist_barrier_timeout_s"))
+                     if timeout_s is None else float(timeout_s))
+        seq = self._barrier_seq.get(name, 0)
+        self._barrier_seq[name] = seq + 1
+        key = f"paddle:{name}:{seq}"
+        t0 = time.perf_counter()
+        try:
+            client = _coordination_client()
+            if client is None:
+                raise BarrierTimeout(
+                    f"barrier {name!r}: jax.distributed is not initialized "
+                    "in this process — call distributed.initialize() first")
+            client.wait_at_barrier(key, int(timeout_s * 1000))
+        except BarrierTimeout:
+            raise
+        except Exception as e:  # noqa: BLE001 — service errors → timeout
+            with self._stats_lock:
+                self._stats["barrier_timeouts_total"] += 1
+            raise BarrierTimeout(
+                f"barrier {name!r} (key {key}) did not complete within "
+                f"{timeout_s:.0f}s — a peer rank likely died or wedged; "
+                f"exit with RESTART_EXIT_CODE ({RESTART_EXIT_CODE}) so the "
+                f"launcher restarts the world ({type(e).__name__}: {e})"
+            ) from e
+        waited = time.perf_counter() - t0
+        with self._stats_lock:
+            self._stats["barriers_total"] += 1
+            self._stats["barrier_wait_ms_total"] += waited * 1e3
+        return waited
+
+    # -- host-side collective -------------------------------------------------
+    def host_allreduce(self, arrays: Dict[str, Any], tag: str,
+                       timeout_s: Optional[float] = None,
+                       op: str = "mean") -> Dict[str, Any]:
+        """Average (or sum) small named float arrays across every
+        process THROUGH the coordination service's key-value store.
+
+        This is the host-level wire: it needs nothing but the gRPC
+        coordination channel, so it works on backends whose device
+        runtime cannot lower cross-process collectives (XLA's CPU
+        backend — the CI/chaos-harness path) and for small optimizer-
+        state syncs not worth a device executable. TPU-pod gradient
+        traffic belongs in-graph (the PR-9 planner over a
+        ``build_mesh`` mesh), not here — this path serializes through
+        the rank-0 coordinator process, so use it for KBs, not GBs.
+
+        Dead-peer semantics match ``barrier()``: a rank that never
+        publishes its ``tag`` payload turns the wait into a
+        ``BarrierTimeout`` after ``timeout_s`` (default
+        ``dist_barrier_timeout_s``), which the Supervisor converts to a
+        clean restartable exit."""
+        if self.world_size <= 1:
+            return {k: np.asarray(v) for k, v in arrays.items()}
+        if op not in ("mean", "sum"):
+            raise ValueError(f"host_allreduce: op must be 'mean' or "
+                             f"'sum', got {op!r}")
+        from ..flags import flag
+
+        timeout_s = (float(flag("dist_barrier_timeout_s"))
+                     if timeout_s is None else float(timeout_s))
+        client = _coordination_client()
+        if client is None:
+            raise BarrierTimeout(
+                f"host_allreduce {tag!r}: jax.distributed is not "
+                "initialized in this process")
+        import io as _io
+
+        buf = _io.BytesIO()
+        np.savez(buf, **{k: np.asarray(v) for k, v in arrays.items()})
+        client.key_value_set_bytes(f"paddle:ar:{tag}:{self.rank}",
+                                   buf.getvalue())
+        total: Dict[str, Any] = {}
+        t0 = time.perf_counter()
+        for rank in range(self.world_size):
+            try:
+                payload = client.blocking_key_value_get_bytes(
+                    f"paddle:ar:{tag}:{rank}", int(timeout_s * 1000))
+            except Exception as e:  # noqa: BLE001 — service timeout/error
+                with self._stats_lock:
+                    self._stats["barrier_timeouts_total"] += 1
+                raise BarrierTimeout(
+                    f"host_allreduce {tag!r}: rank {rank} never "
+                    f"published its payload within {timeout_s:.0f}s — "
+                    "a peer likely died; exit restartably so the "
+                    f"launcher re-forms the world ({type(e).__name__})"
+                ) from e
+            with np.load(_io.BytesIO(payload)) as z:
+                for k in z.files:
+                    if z[k].dtype.kind != "f":
+                        # non-float state is replicated by contract:
+                        # keep the first rank's copy, don't sum it
+                        total.setdefault(k, z[k])
+                        continue
+                    # accumulate in f64, in rank order, so every rank
+                    # computes the bit-identical reduction
+                    v = z[k].astype(np.float64)
+                    total[k] = v if k not in total else total[k] + v
+        with self._stats_lock:
+            self._stats["barriers_total"] += 1
+            self._stats["barrier_wait_ms_total"] += \
+                (time.perf_counter() - t0) * 1e3
+        out = {}
+        for k, v in total.items():
+            ref = np.asarray(arrays[k])
+            if ref.dtype.kind == "f":
+                if op == "mean":
+                    v = v / self.world_size
+                v = v.astype(ref.dtype)
+            out[k] = v
+        return out
+
+    # -- mesh ----------------------------------------------------------------
+    def build_mesh(self, mesh_axes, devices=None):
+        """A Mesh over the GLOBAL device set, process-major.
+
+        ``mesh_axes`` is the ``parse_mesh`` dict/str form ("dp=8" or
+        "dcn=2,ici=4"). Devices sort by (process_index, id), so an axis
+        spanning hosts places each host's chips contiguously — DCN hops
+        happen between blocks, ICI within them (the hybrid layout; with
+        explicit ``dcn``/``ici`` axes the dcn axis should come first).
+        The result drops straight into ``PartitionConfig.resolve
+        (mesh=...)`` and ``CompiledProgram.with_partitioning`` — rules
+        and planner are mesh-shape-agnostic."""
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+
+        from ..partition.rules import parse_mesh
+
+        axes = parse_mesh(mesh_axes)
+        if not axes:
+            raise ValueError(
+                "build_mesh needs at least one axis, e.g. 'dp=8' or "
+                "'dcn=2,ici=4'")
+        devs = (list(devices) if devices is not None
+                else sorted(jax.devices(),
+                            key=lambda d: (d.process_index, d.id)))
+        names = tuple(axes)
+        shape = tuple(axes[n] for n in names)
+        total = int(np.prod(shape))
+        if len(devs) < total:
+            raise ValueError(
+                f"mesh {dict(axes)} needs {total} devices, the world has "
+                f"{len(devs)} ({self.world_size} process(es) x "
+                f"{len(devs) // max(self.world_size, 1)} local)")
+        return Mesh(np.array(devs[:total]).reshape(shape), names)
+
+    # -- feeds ---------------------------------------------------------------
+    def make_global_array(self, sharding, local_batch):
+        """One global jax.Array from this process's LOCAL batch.
+
+        ``sharding`` is a NamedSharding (or (mesh, spec) pair); the
+        local batch is what a rank-sharded GeneratorLoader yields —
+        this process's rows only. Every process calls this with its own
+        shard and the results line up into one global array the
+        jit/partitioned step consumes. Single-process shardings fall
+        through to a plain device_put."""
+        import jax
+        import numpy as np
+
+        if isinstance(sharding, tuple):
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            mesh, spec = sharding
+            sharding = NamedSharding(
+                mesh, spec if not isinstance(spec, (tuple, list))
+                else P(*spec))
+        arr = np.asarray(local_batch)
+        if getattr(sharding, "is_fully_addressable", True):
+            return jax.device_put(arr, sharding)
+        return jax.make_array_from_process_local_data(sharding, arr)
+
+    # -- exits ---------------------------------------------------------------
+    def restartable_exit(self, reason: str) -> "SystemExit":
+        """Log + flight-note ``reason`` and return a SystemExit carrying
+        ``RESTART_EXIT_CODE`` for the caller to raise — the clean way
+        out of a stalled world (the launcher restarts it)."""
+        _log.error("restartable exit (rank %d): %s", self.rank, reason)
+        try:
+            from ..observability import flight
+
+            flight.note("event", what="restartable_exit", rank=self.rank,
+                        reason=reason)
+        except Exception:  # noqa: BLE001 — exiting anyway
+            pass
+        return SystemExit(RESTART_EXIT_CODE)
+
+    # -- telemetry ------------------------------------------------------------
+    def stats_numeric(self) -> Dict[str, float]:
+        ages = self.heartbeat_ages()
+        with self._stats_lock:
+            out = dict(self._stats)
+        out.update(
+            world_size=self.world_size,
+            rank=self.rank,
+            restarts=self.restart_count,
+            live_ranks=self.live_ranks() if ages else self.world_size,
+            heartbeat_age_s=round(max(ages.values()), 3) if ages else 0.0,
+        )
+        return out
+
+
+def _coordination_client():
+    """The jax coordination-service client, or None when
+    jax.distributed was never initialized (single process)."""
+    try:
+        from jax._src import distributed as _jd
+
+        return _jd.global_state.client
+    except Exception:  # noqa: BLE001 — layout changed / not initialized
+        return None
+
+
+_COORD: Optional[Coordinator] = None
+_COORD_LOCK = threading.Lock()
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               heartbeat: bool = True) -> Coordinator:
+    """Rendezvous + heartbeat, from the launcher's env contract.
+
+    Wraps ``parallel.env.init_parallel_env`` (PADDLE_TRAINER_ID /
+    PADDLE_TRAINERS_NUM / PADDLE_TRAINER_ENDPOINTS ->
+    ``jax.distributed.initialize`` at the rank-0 endpoint), then starts
+    the heartbeat thread when the launcher exported
+    ``PADDLE_HEARTBEAT_DIR``. Idempotent — the second call returns the
+    live Coordinator."""
+    global _COORD
+    with _COORD_LOCK:
+        if _COORD is not None:
+            return _COORD
+        from ..parallel.env import init_parallel_env
+
+        env = init_parallel_env(coordinator_address)
+        coord = Coordinator(
+            env.rank, env.world_size,
+            heartbeat_dir=os.environ.get("PADDLE_HEARTBEAT_DIR") or None,
+            heartbeat_interval_s=float(
+                os.environ.get("PADDLE_HEARTBEAT_INTERVAL_S", "2.5")))
+        if heartbeat:
+            coord.start_heartbeat()
+        _COORD = coord
+        _log.info("coordinator up: rank %d/%d restart=%d heartbeat=%s",
+                  coord.rank, coord.world_size, coord.restart_count,
+                  coord.heartbeat_dir or "off")
+        return coord
+
+
+def get_coordinator() -> Optional[Coordinator]:
+    """The live Coordinator, or None before ``initialize()``."""
+    return _COORD
+
+
+def spans_processes(mesh) -> bool:
+    """True when ``mesh`` places devices from more than one process —
+    i.e. its collectives cross DCN. The collective planner keys the
+    per-axis ``collective_bucket_mb`` choice on this."""
+    if mesh is None or not hasattr(mesh, "devices"):
+        return False
+    try:
+        procs = {d.process_index for d in mesh.devices.flat}
+    except Exception:  # noqa: BLE001 — emulated/stub device objects
+        return False
+    return len(procs) > 1

@@ -112,8 +112,13 @@ _FLAG_DEFS: Dict[str, Any] = {
     # it); collective_quantization="int8" swaps each bucket's psum for
     # the EQuARX-style two-shot blockwise-int8 exchange (~3.9x fewer
     # wire bytes at block 256, bench-gated accuracy);
-    # collective_quant_block is the per-scale block size in elements
-    "collective_bucket_mb": 0.0,
+    # collective_quant_block is the per-scale block size in elements.
+    # collective_bucket_mb also takes a PER-MESH-AXIS form
+    # ("dp=32,dcn=8"... sizes in MB): a reduce whose mesh axis crosses
+    # hosts (DCN) picks its own — typically bigger — bucket than one
+    # staying on ICI; the single-value form applies everywhere
+    # (parallel.collectives.parse_bucket_mb)
+    "collective_bucket_mb": "0",
     "collective_quantization": "none",
     "collective_quant_block": 256,
     # traffic/ (SLO-aware admission + multi-tenant scheduling) defaults,
@@ -145,6 +150,19 @@ _FLAG_DEFS: Dict[str, Any] = {
     "traffic_slo_miss_threshold": 0.5,
     "traffic_slo_window_s": 5.0,
     "traffic_stream_write_timeout_s": 30.0,
+    # distributed/ (multi-host coordination, distributed/coordinator.py
+    # + the two-phase cross-host checkpoint commit in io.py):
+    # dist_commit_timeout_s bounds every phase of a multi-host save —
+    # the stage-ready handshake, process 0's wait for all shard-done
+    # files, and the other ranks' wait for the commit marker; a rank
+    # that dies mid-save turns into ONE bounded CheckpointCommitTimeout
+    # (never a torn committed checkpoint, never an unbounded hang).
+    # dist_barrier_timeout_s is the default Coordinator.barrier()
+    # timeout — a coordination-service stall (dead peer) becomes a
+    # BarrierTimeout the Supervisor converts to a clean restartable
+    # exit (RESTART_EXIT_CODE) for the elastic launcher
+    "dist_commit_timeout_s": 120.0,
+    "dist_barrier_timeout_s": 300.0,
     # observability/ (unified telemetry): observability_metrics turns
     # on per-step telemetry instruments (wall time, examples/sec) in
     # the dispatch hot path; observability_tracing upgrades span call

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import json
 import os
+import time
 from typing import List, Optional
 
 import numpy as np
@@ -194,7 +195,7 @@ def load_inference_model(
     return program, meta["feed_names"], fetch_vars
 
 
-# -- sharded / async checkpointing (orbax) ----------------------------------
+# -- sharded / async checkpointing (orbax + multi-host) ----------------------
 
 # Commit protocol (resilience/): a checkpoint directory is COMMITTED
 # only once it contains this marker, written AFTER every array file has
@@ -203,7 +204,55 @@ def load_inference_model(
 # fault injection, partial copy) is detected, plus caller `extra`
 # metadata — the supervisor stores step counter, RNG state and reader
 # position here, alongside the persistables.
+#
+# Multi-host (jax.process_count() > 1) extends this to a TWO-PHASE
+# commit over a shared filesystem: every rank writes its own shard file
+# plus a shard-done file (phase 1), and process 0 stamps the one commit
+# marker only after every rank's done-file — with a matching save nonce
+# — is present (phase 2). A host that dies mid-save leaves its
+# done-file missing, so the marker is never written and resume falls
+# back to the previous committed checkpoint; a torn multi-host
+# checkpoint is unobservable by construction.
 _COMMIT_MARKER = "_PT_COMMIT.json"
+_SHARD_DONE_PREFIX = "_PT_SHARD_DONE."
+_STAGE_READY = "_PT_STAGE_READY"
+_SHARD_FILE = "__shards__.rank{rank}.npz"
+_SHARD_META = "__shards__.meta.json"
+
+# test hook: (rank, world) override so the two-phase protocol is unit-
+# testable without spawning a jax.distributed world
+_FORCE_DIST = None
+
+# per-process save sequence number, part of the save nonce. Every rank
+# executes the same sequence of saves (SPMD), so the counter stays
+# aligned across ranks while making each save ATTEMPT's nonce unique —
+# a crashed attempt's leftover done-files can never satisfy a later
+# attempt's phase-2 wait.
+_SAVE_SEQ = [0]
+
+
+class CheckpointCommitTimeout(RuntimeError):
+    """Phase 2 of a multi-host checkpoint commit timed out — some
+    rank's shard-done file (or process 0's commit marker) never
+    arrived. The save FAILED; no marker was (or will be) written for
+    it. In a supervised run the step-level retry / the elastic
+    launcher's world restart owns recovery."""
+
+
+def _dist_info():
+    """(process_rank, world_size) — the multi-host checkpoint layout
+    switch. ``_FORCE_DIST`` lets tests exercise the protocol without a
+    real jax.distributed world."""
+    if _FORCE_DIST is not None:
+        return _FORCE_DIST
+    try:
+        import jax
+
+        if jax.process_count() > 1:
+            return jax.process_index(), jax.process_count()
+    except Exception:  # noqa: BLE001 — jax absent/uninitialized: lone writer
+        pass
+    return 0, 1
 
 
 def _checkpoint_manifest(path):
@@ -238,8 +287,6 @@ def write_commit_marker(path, extra=None):
     """Mark a checkpoint directory committed. Written atomically (temp
     + rename) so a crash mid-write leaves no marker — i.e. the dir
     stays uncommitted — never a truncated JSON that half-parses."""
-    import time
-
     marker = {
         "manifest": _checkpoint_manifest(path),
         "commit_time": time.time(),
@@ -289,8 +336,288 @@ def is_committed_checkpoint(path):
     return os.path.isfile(os.path.join(path, "_CHECKPOINT_METADATA"))
 
 
+# -- two-phase cross-host commit ---------------------------------------------
+
+
+def _atomic_json(path, payload):
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def write_shard_done(path, rank, nonce):
+    """Phase 1, per rank: mark this rank's shards durable for the save
+    attempt identified by ``nonce``. Atomic (temp + rename) — a crash
+    mid-write leaves no done-file, i.e. the rank counts as NOT done."""
+    _atomic_json(os.path.join(path, f"{_SHARD_DONE_PREFIX}{rank}"),
+                 {"rank": int(rank), "nonce": str(nonce)})
+
+
+def done_shard_ranks(path, world, nonce):
+    """Ranks whose phase-1 done-file for THIS save attempt is present.
+    Done-files from a crashed earlier attempt carry a different nonce
+    and never count — process 0 can't be tricked into committing a
+    directory whose shard data is part-old, part-new."""
+    done = []
+    for rank in range(int(world)):
+        try:
+            with open(os.path.join(
+                    path, f"{_SHARD_DONE_PREFIX}{rank}")) as f:
+                if str(json.load(f).get("nonce")) == str(nonce):
+                    done.append(rank)
+        except (OSError, ValueError):
+            continue
+    return done
+
+
+def finalize_two_phase_commit(path, world, extra=None, nonce=None,
+                              timeout_s=None, poll_s=0.05):
+    """Phase 2, process 0 only: wait until EVERY rank's shard-done file
+    for this save attempt is present, then stamp the one commit marker
+    (its manifest covers every rank's files). A rank that died mid-save
+    keeps its done-file missing, the wait times out, and the directory
+    stays uncommitted forever — ``latest_checkpoint`` will never select
+    it. Raises ``CheckpointCommitTimeout`` naming the missing ranks."""
+    from .flags import flag
+
+    world = int(world)
+    timeout_s = (float(flag("dist_commit_timeout_s"))
+                 if timeout_s is None else float(timeout_s))
+    deadline = time.time() + timeout_s
+    while True:
+        done = done_shard_ranks(path, world, nonce)
+        if len(done) >= world:
+            break
+        if time.time() >= deadline:
+            missing = sorted(set(range(world)) - set(done))
+            raise CheckpointCommitTimeout(
+                f"two-phase commit of {path!r}: rank(s) {missing} never "
+                f"wrote their shard-done file within {timeout_s:.0f}s "
+                f"(save nonce {nonce!r}) — a host likely died mid-save; "
+                "the checkpoint stays UNCOMMITTED and resume will use "
+                "the previous committed one")
+        time.sleep(poll_s)
+    marker_extra = dict(extra or {})
+    marker_extra.setdefault("world", world)
+    marker_extra["commit_nonce"] = str(nonce)
+    return write_commit_marker(path, marker_extra)
+
+
+def _wait_for_marker(paths, nonce, timeout_s, poll_s=0.05):
+    """Non-zero ranks' phase-2 wait: block until process 0's commit
+    marker for THIS attempt appears at any of ``paths`` (staging or its
+    published location — the rename can land between polls)."""
+    deadline = time.time() + timeout_s
+    while True:
+        for p in paths:
+            marker = read_commit_marker(p)
+            if marker is not None and \
+                    str(marker.get("extra", {}).get("commit_nonce")) \
+                    == str(nonce):
+                return p
+        if time.time() >= deadline:
+            raise CheckpointCommitTimeout(
+                f"two-phase commit of {paths[0]!r}: process 0 never "
+                f"stamped the commit marker within {timeout_s:.0f}s "
+                f"(save nonce {nonce!r}) — process 0 likely died "
+                "mid-commit; the save FAILED on this rank too")
+        time.sleep(poll_s)
+
+
+def _index_key(name, index, shape):
+    """``name@start-stop;start-stop...`` — one npz key per owned shard,
+    reversible by ``_parse_index_key``."""
+    parts = []
+    for sl, dim in zip(index, shape):
+        start, stop, _ = sl.indices(int(dim))
+        parts.append(f"{start}-{stop}")
+    return f"{name}@{';'.join(parts)}" if parts else name
+
+
+def _parse_index_key(key):
+    """Inverse of ``_index_key``: (name, [(start, stop), ...]) — or
+    (key, None) for an unsharded full-value entry."""
+    name, _, idx = key.rpartition("@")
+    if name and all(
+            p.count("-") == 1
+            and all(x.isdigit() for x in p.split("-"))
+            for p in idx.split(";")):
+        return name, [tuple(int(x) for x in p.split("-"))
+                      for p in idx.split(";")]
+    return key, None
+
+
+def _save_checkpoint_multihost(path, state, extra, rank, world,
+                               publish_path=None, timeout_s=None,
+                               nonce=None):
+    """The multi-host save: every rank writes the shards it OWNS into
+    its own ``__shards__.rank<k>.npz`` (genuinely non-addressable
+    jax.Arrays contribute each replica-0 addressable shard under an
+    offset key; replicated/host values are round-robined over ranks so
+    write bandwidth scales with the pod), then the two-phase commit
+    publishes the marker. Requires ``path`` on a filesystem all hosts
+    share — the same contract every multi-host checkpoint format has."""
+    import jax
+
+    from .flags import flag
+    from .resilience.faults import check_save_kill
+
+    timeout_s = (float(flag("dist_commit_timeout_s"))
+                 if timeout_s is None else float(timeout_s))
+    if nonce is None:
+        # unique per save ATTEMPT yet identical across ranks: every
+        # rank executes the same SPMD sequence of saves, so the
+        # per-process counter stays aligned; the restart generation
+        # keeps a resumed world's nonces distinct from the crashed one
+        _SAVE_SEQ[0] += 1
+        nonce = (f"{extra.get('step', '')}:{extra.get('run_counter', '')}:"
+                 f"g{os.environ.get('PADDLE_RESTART_COUNT', '0')}:"
+                 f"s{_SAVE_SEQ[0]}")
+
+    # stage-ready handshake: rank 0 clears debris a crashed earlier
+    # attempt left in this directory (stale done-files/shards from a
+    # possibly DIFFERENT world size would otherwise leak into the
+    # manifest and the restore), then posts the ready token; other
+    # ranks write nothing until they see THIS attempt's token.
+    ready = os.path.join(path, _STAGE_READY)
+    if rank == 0:
+        os.makedirs(path, exist_ok=True)
+        for entry in os.listdir(path):
+            if entry.startswith((_SHARD_DONE_PREFIX, "__shards__.",
+                                 _COMMIT_MARKER, _STAGE_READY)):
+                try:
+                    os.remove(os.path.join(path, entry))
+                except OSError:
+                    pass
+        _atomic_json(ready, {"nonce": nonce, "world": world})
+    else:
+        deadline = time.time() + timeout_s
+        while True:
+            try:
+                with open(ready) as f:
+                    if str(json.load(f).get("nonce")) == nonce:
+                        break
+            except (OSError, ValueError):
+                pass
+            if time.time() >= deadline:
+                raise CheckpointCommitTimeout(
+                    f"two-phase commit of {path!r}: process 0 never "
+                    f"posted the stage-ready token within "
+                    f"{timeout_s:.0f}s (nonce {nonce!r})")
+            time.sleep(0.05)
+
+    arrays = {}
+    meta_vars = {}
+    for i, name in enumerate(sorted(state)):
+        val = state[name]
+        if isinstance(val, jax.Array) and not val.is_fully_addressable:
+            # genuinely non-addressable: this process can only see its
+            # local shards — write each replica-0 shard it holds
+            for sh in val.addressable_shards:
+                if sh.replica_id != 0:
+                    continue
+                arrays[_index_key(name, sh.index, val.shape)] = \
+                    np.asarray(sh.data)
+            meta_vars[name] = {"shape": [int(d) for d in val.shape],
+                               "dtype": str(np.dtype(val.dtype)),
+                               "sharded": True}
+        else:
+            # replicated / host value: identical on every rank (the
+            # deterministic-replay contract), so exactly one rank —
+            # round-robin by position — writes it
+            if i % world == rank:
+                arrays[name] = np.asarray(val)
+            meta_vars[name] = {"sharded": False, "owner": i % world}
+    shard_path = os.path.join(path, _SHARD_FILE.format(rank=rank))
+    tmp = f"{shard_path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        np.savez(f, **arrays)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, shard_path)
+    if rank == 0:
+        _atomic_json(os.path.join(path, _SHARD_META),
+                     {"format": 1, "world": world, "nonce": nonce,
+                      "vars": meta_vars})
+
+    # deterministic fault injection point: a `killsave@N` fault dies
+    # HERE — shards durable, done-file missing — the exact torn-save
+    # scenario phase 2 exists to absorb
+    check_save_kill("before_shard_done")
+    write_shard_done(path, rank, nonce)
+
+    if rank == 0:
+        finalize_two_phase_commit(path, world, extra=extra, nonce=nonce,
+                                  timeout_s=timeout_s)
+    else:
+        candidates = [path] + ([publish_path] if publish_path else [])
+        _wait_for_marker(candidates, nonce, timeout_s)
+    return None
+
+
+def _is_multihost_checkpoint(path):
+    return os.path.isfile(os.path.join(path, _SHARD_META))
+
+
+def load_checkpoint_arrays(path):
+    """Read a committed checkpoint directory into {var_name: np.array}
+    without touching any scope — both formats (orbax single-host,
+    multi-host ``__shards__`` rank files). Sharded vars are assembled
+    from every rank's offset-keyed entries; missing coverage raises."""
+    if _is_multihost_checkpoint(path):
+        with open(os.path.join(path, _SHARD_META)) as f:
+            meta = json.load(f)
+        state = {}
+        filled = {}
+        for entry in sorted(os.listdir(path)):
+            if not (entry.startswith("__shards__.rank")
+                    and entry.endswith(".npz")):
+                continue
+            with np.load(os.path.join(path, entry)) as z:
+                for key in z.files:
+                    name, idx = _parse_index_key(key)
+                    if idx is None:
+                        state[name] = z[key]
+                        continue
+                    info = meta["vars"].get(name)
+                    if info is None or not info.get("sharded"):
+                        state[name] = z[key]
+                        continue
+                    if name not in state:
+                        state[name] = np.zeros(
+                            tuple(info["shape"]),
+                            dtype=np.dtype(info["dtype"]))
+                        filled[name] = 0
+                    sel = tuple(slice(a, b) for a, b in idx)
+                    state[name][sel] = z[key]
+                    filled[name] += int(
+                        np.prod([b - a for a, b in idx]))
+        short = {n: (filled[n], int(np.prod(meta["vars"][n]["shape"])))
+                 for n in filled
+                 if filled[n] < np.prod(meta["vars"][n]["shape"])}
+        if short:
+            raise ValueError(
+                f"multi-host checkpoint {path!r} is missing shard "
+                f"coverage for {sorted(short)} (filled/total elements "
+                f"{short}) — a rank's shard file is absent or truncated")
+        missing = sorted(set(meta["vars"]) - set(state))
+        if missing:
+            raise ValueError(
+                f"multi-host checkpoint {path!r} is missing vars "
+                f"{missing[:5]}{'...' if len(missing) > 5 else ''} — "
+                "an owning rank's shard file never landed")
+        return state
+    import orbax.checkpoint as ocp
+
+    ckptr = ocp.Checkpointer(ocp.StandardCheckpointHandler())
+    return {k: np.asarray(v) for k, v in ckptr.restore(path).items()}
+
+
 def save_checkpoint(dirname, main_program=None, scope=None, step=None,
-                    async_save=False, extra=None):
+                    async_save=False, extra=None, publish_path=None):
     """Sharded checkpoint of all persistables via orbax (SURVEY §5's
     checkpoint/resume target; reference io.py save_persistables +
     fleet util checkpoints, but TPU-native: device/GSPMD-sharded
@@ -302,7 +629,17 @@ def save_checkpoint(dirname, main_program=None, scope=None, step=None,
     caller `extra` metadata); `latest_checkpoint` only ever selects
     committed directories, so a crash mid-save can never be resumed
     from. Async saves commit from a background thread once the write
-    lands."""
+    lands.
+
+    Multi-host (jax.process_count() > 1): every rank writes its OWN
+    shards (non-addressable arrays contribute their local replica-0
+    shards; replicated values round-robin across ranks) into a shared
+    directory, and the TWO-PHASE protocol — per-rank shard-done files,
+    then the process-0 marker — guarantees a host killed mid-save never
+    yields a committed checkpoint. ``publish_path`` names where the
+    directory will be renamed after commit (CheckpointPolicy's staging
+    flow) so non-zero ranks can find the marker either place; async
+    saves degrade to sync in this mode (the commit IS the sync point)."""
     import orbax.checkpoint as ocp
 
     main_program = main_program or framework.default_main_program()
@@ -315,6 +652,11 @@ def save_checkpoint(dirname, main_program=None, scope=None, step=None,
     path = os.path.abspath(dirname)
     if step is not None:
         path = os.path.join(path, str(int(step)))
+    rank, world = _dist_info()
+    if world > 1:
+        return _save_checkpoint_multihost(
+            path, state, dict(extra or {}), rank, world,
+            publish_path=publish_path)
     if async_save:
         import threading
 
@@ -390,15 +732,24 @@ def _async_checkpointer():
     return _ASYNC_CKPTR
 
 
-def load_checkpoint(dirname, main_program=None, scope=None, step=None):
+def load_checkpoint(dirname, main_program=None, scope=None, step=None,
+                    mesh=None):
     """Restore persistables saved by save_checkpoint. Arrays land as
     UNCOMMITTED host values: a checkpoint written on one device
     topology (say dp4) must resume on another (dp2, single chip) — the
     next compile re-places them per ITS mesh, so sharding is a property
     of the compile, not of the checkpoint (elastic resume; the
-    reference only restarts on the same topology)."""
+    reference only restarts on the same topology).
+
+    ``mesh`` (optional) asks for a STRICT topology check: when the
+    commit marker records the mesh shape that produced this trajectory
+    (the Supervisor stamps it) and it differs from ``mesh``'s, the load
+    refuses with an error naming both shapes — instead of the cryptic
+    shard-count mismatch the assembly would otherwise die with deep in
+    the restore. Multi-host resumes (the Supervisor passes its mesh
+    automatically when jax.process_count() > 1) get this check by
+    default; single-host elastic resume stays unrestricted."""
     import numpy as np
-    import orbax.checkpoint as ocp
 
     main_program = main_program or framework.default_main_program()
     scope = scope or global_scope()
@@ -412,8 +763,20 @@ def load_checkpoint(dirname, main_program=None, scope=None, step=None):
             "was likely interrupted mid-save; resume from "
             "latest_checkpoint(), which skips such directories"
         )
-    ckptr = ocp.Checkpointer(ocp.StandardCheckpointHandler())
-    state = ckptr.restore(path)
+    extra = (read_commit_marker(path) or {}).get("extra", {})
+    if mesh is not None and extra.get("mesh"):
+        want = {str(k): int(v) for k, v in dict(mesh.shape).items()} \
+            if hasattr(mesh, "shape") else \
+            {str(k): int(v) for k, v in dict(mesh).items()}
+        have = {str(k): int(v) for k, v in dict(extra["mesh"]).items()}
+        if want != have:
+            raise ValueError(
+                f"checkpoint {path!r} was committed on mesh {have} but "
+                f"the current mesh is {want} — refusing the strict "
+                "(mesh=...) restore. Resume on the matching topology, or "
+                "load without mesh= for an elastic restore that re-places "
+                "arrays under the next compile")
+    state = load_checkpoint_arrays(path)
     for name, val in state.items():
         scope.set_var(name, np.asarray(val))
     return sorted(state)

@@ -297,6 +297,7 @@ _generation: "weakref.WeakSet" = weakref.WeakSet()
 _partitions: "weakref.WeakSet" = weakref.WeakSet()
 _collectives: "weakref.WeakSet" = weakref.WeakSet()
 _traffic: "weakref.WeakSet" = weakref.WeakSet()
+_coordinators: "weakref.WeakSet" = weakref.WeakSet()
 
 
 def watch_serving(metrics) -> None:
@@ -366,6 +367,17 @@ def watch_traffic(controller) -> None:
     already reads."""
     _obs_id(controller)
     _traffic.add(controller)
+
+
+def watch_coordinator(coord) -> None:
+    """Called by distributed.Coordinator.__init__: the multi-host
+    world's health becomes the ``paddle_dist_*{coord=}`` family —
+    world size / rank / restart count, live ranks + max heartbeat age
+    (scanned from the heartbeat dir), and barrier counters with
+    cumulative wait — so "is the pod whole and is anyone stalling" is
+    one scrape on every rank."""
+    _obs_id(coord)
+    _coordinators.add(coord)
 
 
 def _flatten(prefix: str, d: Dict[str, Any], out: Dict[str, float]) -> None:
@@ -462,6 +474,12 @@ def _collect_loaders():
                  getattr(loader, "_stall_empty", 0)),
                 ("paddle_reader_prefetch_depth",
                  getattr(loader, "_active_depth", 0)),
+                # multi-host: which slice of the sample stream this
+                # loader feeds (rank sharding from the launcher env)
+                ("paddle_reader_trainer_id",
+                 getattr(loader, "trainer_id", 0)),
+                ("paddle_reader_num_trainers",
+                 getattr(loader, "num_trainers", 1)),
         ):
             merged.setdefault(name, []).append((lbl, v))
     return merged
@@ -509,6 +527,11 @@ def _collect_traffic():
     return merged
 
 
+def _collect_dist():
+    return _labeled(_coordinators, "coord", "paddle_dist",
+                    lambda c: c.stats_numeric())
+
+
 def _collect_build_info():
     from .. import version
 
@@ -527,6 +550,7 @@ for _name, _fn in (
     ("partition", _collect_partition),
     ("collective", _collect_collectives),
     ("traffic", _collect_traffic),
+    ("dist", _collect_dist),
     ("build_info", _collect_build_info),
 ):
     _REGISTRY.register_collector(_name, _fn)

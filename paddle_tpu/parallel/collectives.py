@@ -56,7 +56,86 @@ OP_TYPE = "collective_bucket_reduce"
 REDUCED_SUFFIX = "@BUCKETREDUCED"
 
 __all__ = ["CollectivePlan", "ensure_planned", "build_collective_fn",
-           "OP_TYPE"]
+           "OP_TYPE", "parse_bucket_mb", "effective_bucket_mb"]
+
+
+def parse_bucket_mb(spec):
+    """``collective_bucket_mb`` in either form: a single size
+    (number / numeric string — today's behavior, applied to every
+    axis) or per-mesh-axis ``"dp=32,dcn=8"`` (sizes in MB), so a
+    reduce crossing DCN can amortize its far-higher per-collective
+    latency with bigger buckets than an ICI-local one. Returns a float
+    or an {axis: mb} dict; malformed entries are named by position
+    (the PR-9 diagnostic style)."""
+    if spec is None:
+        return 0.0
+    if isinstance(spec, (int, float)):
+        return float(spec)
+    if isinstance(spec, dict):
+        return {str(k): float(v) for k, v in spec.items()}
+    s = str(spec).strip()
+    if not s:
+        return 0.0
+    if "=" not in s:
+        try:
+            return float(s)
+        except ValueError:
+            raise ValueError(
+                f"collective_bucket_mb: {s!r} is neither a bucket size "
+                "in MB nor the per-axis form axis=mb[,axis=mb...] "
+                "(e.g. '32' or 'dp=32,dcn=8')") from None
+    out: Dict[str, float] = {}
+    for pos, part in enumerate(s.replace(";", ",").split(","), 1):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(
+                f"collective_bucket_mb: entry {pos} ({part!r}) of "
+                f"{spec!r}: expected axis=mb (e.g. 'dp=32,dcn=8')")
+        k, v = part.split("=", 1)
+        if not k.strip():
+            raise ValueError(
+                f"collective_bucket_mb: entry {pos} ({part!r}) of "
+                f"{spec!r}: the axis name is empty — expected axis=mb "
+                "(e.g. 'dp=32,dcn=8')")
+        try:
+            out[k.strip()] = float(v)
+        except ValueError:
+            raise ValueError(
+                f"collective_bucket_mb: entry {pos} ({part!r}) of "
+                f"{spec!r}: size {v.strip()!r} is not a number (MB) — "
+                "expected axis=mb (e.g. 'dp=32,dcn=8')") from None
+    return out
+
+
+def effective_bucket_mb(spec, mesh=None, crosses_hosts=None) -> float:
+    """The bucket cap the planner should use for the DP gradient
+    reduce under ``spec``. Scalar form: applies everywhere. Per-axis
+    form: a reduce that crosses hosts (the mesh places devices from
+    more than one process, or — with no mesh to inspect — the world
+    has more than one process) picks the ``dcn`` entry first, an
+    ICI-local one picks ``dp`` first; either falls back to the other,
+    and no matching entry means 0 (planner off)."""
+    parsed = parse_bucket_mb(spec)
+    if not isinstance(parsed, dict):
+        return parsed
+    if crosses_hosts is None:
+        if mesh is not None:
+            from ..distributed.coordinator import spans_processes
+
+            crosses_hosts = spans_processes(mesh)
+        else:
+            try:
+                import jax
+
+                crosses_hosts = jax.process_count() > 1
+            except Exception:  # noqa: BLE001 — jax not initialized
+                crosses_hosts = False
+    for axis in (("dcn", "dp") if crosses_hosts else ("dp", "dcn")):
+        if axis in parsed:
+            return float(parsed[axis])
+    return 0.0
 
 
 def _numel(shape) -> int:
@@ -257,8 +336,11 @@ def ensure_planned(program=None, params_grads=None, bucket_mb=None,
 
     program = program if program is not None else default_main_program()
 
-    mb = float(flag("collective_bucket_mb") if bucket_mb is None
-               else bucket_mb)
+    # bucket_mb accepts the per-axis form too ("dp=32,dcn=8"); at this
+    # seam the reduce axis is dp, crossing hosts exactly when the world
+    # does (a multi-process dp reduce IS a DCN reduce)
+    mb = effective_bucket_mb(
+        flag("collective_bucket_mb") if bucket_mb is None else bucket_mb)
     quant = str(flag("collective_quantization") if quantization is None
                 else quantization) or "none"
     qblock = int(flag("collective_quant_block") if quant_block is None

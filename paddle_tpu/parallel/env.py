@@ -51,6 +51,29 @@ class ParallelEnv:
     def dev_id(self):
         return int(os.environ.get("FLAGS_selected_tpus", os.environ.get("FLAGS_selected_gpus", "0")).split(",")[0])
 
+    @property
+    def restart_count(self):
+        """How many times the elastic launcher has restarted this
+        world (PADDLE_RESTART_COUNT; 0 on the first incarnation). A
+        training script can key one-shot behavior — chaos faults,
+        cold-start profiling — on generation 0."""
+        return int(os.environ.get("PADDLE_RESTART_COUNT", "0"))
+
+
+def free_port(host: str = "127.0.0.1") -> int:
+    """An OS-assigned free TCP port (bind to 0, read, release). The
+    canonical copy — the elastic launcher, the traffic WorkerPool and
+    the chaos harnesses all need one; keep the (inherently racy)
+    assign-then-release pattern in exactly one place."""
+    import socket
+
+    s = socket.socket()
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    s.bind((host, 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
 
 _initialized = False
 

@@ -163,7 +163,12 @@ class PartitionConfig:
         self.var_rules = tuple(
             (re.compile(pat), tuple(axes)) for pat, axes in (var_rules or ()))
         self.zero = int(flag("partition_zero") if zero is None else zero)
-        self.collective_bucket_mb = float(
+        from ..parallel.collectives import parse_bucket_mb
+
+        # a float for the single-value form, an {axis: mb} dict for the
+        # per-mesh-axis "dp=32,dcn=8" form (DCN reduces pick bigger
+        # buckets) — effective_bucket_mb(mesh) resolves either
+        self.collective_bucket_mb = parse_bucket_mb(
             flag("collective_bucket_mb") if collective_bucket_mb is None
             else collective_bucket_mb)
         self.collective_quantization = str(
@@ -173,11 +178,21 @@ class PartitionConfig:
             flag("collective_quant_block") if collective_quant_block is None
             else collective_quant_block)
 
+    def effective_bucket_mb(self, mesh=None) -> float:
+        """The bucket cap for a gradient reduce on ``mesh`` — the
+        per-axis form resolves against whether the mesh's collectives
+        cross hosts (``coordinator.spans_processes``)."""
+        from ..parallel.collectives import effective_bucket_mb
+
+        return effective_bucket_mb(self.collective_bucket_mb, mesh=mesh)
+
     def collectives_active(self) -> bool:
         """True when this config asks for the gradient-collective
         planner (bucketed and/or quantized DP all-reduce)."""
-        return (self.collective_bucket_mb > 0
-                or self.collective_quantization != "none")
+        mb = self.collective_bucket_mb
+        any_bucket = (any(v > 0 for v in mb.values())
+                      if isinstance(mb, dict) else mb > 0)
+        return any_bucket or self.collective_quantization != "none"
 
     def build_mesh(self, devices=None):
         """The jax Mesh for ``mesh_axes`` (over ``devices`` or the

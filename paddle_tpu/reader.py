@@ -167,6 +167,14 @@ class GeneratorLoader:
             out[k] = jax.device_put(arr, dev)
         return out
 
+    def shard_info(self) -> dict:
+        """This loader's slice of the multi-host world: which rank it
+        feeds and how many trainers carve the sample stream (scraped
+        as paddle_reader_trainer_id / paddle_reader_num_trainers — the
+        first thing to check when two ranks train on the same data)."""
+        return {"trainer_id": self.trainer_id,
+                "num_trainers": self.num_trainers}
+
     # -- resumable position (checkpoint/restore contract) -------------------
     def position(self) -> int:
         """Batches handed to the consumer since iteration started (==

@@ -24,7 +24,8 @@ Chaos-drive it: ``python tools/chaos_train.py --smoke``.
 """
 
 from .checkpoint import CheckpointPolicy
-from .faults import KILL_EXIT_CODE, FaultInjector, FaultSpec, InjectedFault
+from .faults import (KILL_EXIT_CODE, FaultInjector, FaultSpec,
+                     InjectedFault, check_save_kill)
 from .supervisor import NonFiniteLossError, Supervisor, WatchdogTimeout
 
 __all__ = [
@@ -36,4 +37,5 @@ __all__ = [
     "WatchdogTimeout",
     "NonFiniteLossError",
     "KILL_EXIT_CODE",
+    "check_save_kill",
 ]

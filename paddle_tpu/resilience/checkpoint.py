@@ -75,10 +75,21 @@ class CheckpointPolicy:
     def save(self, completed_steps: int, main_program=None, scope=None,
              extra: Optional[Dict[str, Any]] = None) -> str:
         """Atomically commit a checkpoint for ``completed_steps`` and
-        run retention GC. Returns the committed directory."""
+        run retention GC. Returns the committed directory.
+
+        Multi-host: every rank saves into ONE shared staging directory
+        (``.staging.<step>.shared`` — the pid suffix would split the
+        world across directories); io.save_checkpoint runs the
+        two-phase shard-done/marker protocol inside it, and process 0
+        alone publishes (atomic_rename) and GCs. Non-zero ranks return
+        once they have SEEN the commit marker — a collective save, like
+        every multi-host checkpoint format's."""
         step = int(completed_steps)
+        rank, world = io._dist_info()
         staging = os.path.join(
-            self.dirname, f"{_STAGING_PREFIX}{step}.{os.getpid()}")
+            self.dirname,
+            f"{_STAGING_PREFIX}{step}."
+            f"{'shared' if world > 1 else os.getpid()}")
         final = os.path.join(self.dirname, str(step))
         meta = {"step": step}
         meta.update(extra or {})
@@ -90,21 +101,31 @@ class CheckpointPolicy:
             # Skipping avoids moving a live committed checkpoint aside.
             # A mismatching commit is a FOREIGN run's (reused dir):
             # fall through and replace it with this run's state.
+            # (Multi-host: the metadata is deterministic-identical
+            # across ranks, so every rank takes this branch together.)
             self._last_save_time = time.time()
             self._last_saved_step = step
-            self.gc()
+            if rank == 0:
+                self.gc()
             return final
         self._fs.mkdirs(self.dirname)
-        self._fs.delete(staging)
+        if world == 1:
+            self._fs.delete(staging)
+        # multi-host: deleting the SHARED staging here would race the
+        # other ranks' writes — io's stage-ready handshake (rank 0
+        # clears debris, then posts the attempt token) owns cleanup
         io.save_checkpoint(staging, main_program=main_program, scope=scope,
-                           extra=meta)
-        # dst, if present, is an uncommitted leftover or a foreign
-        # run's commit (checked above) — atomic_rename's aside protocol
-        # replaces it with the narrowest possible destruction window
-        self._fs.atomic_rename(staging, final)
+                           extra=meta, publish_path=final)
+        if rank == 0:
+            # dst, if present, is an uncommitted leftover or a foreign
+            # run's commit (checked above) — atomic_rename's aside
+            # protocol replaces it with the narrowest possible
+            # destruction window
+            self._fs.atomic_rename(staging, final)
         self._last_save_time = time.time()
         self._last_saved_step = step
-        self.gc()
+        if rank == 0:
+            self.gc()
         return final
 
     @staticmethod
@@ -127,18 +148,20 @@ class CheckpointPolicy:
         return io.committed_checkpoint_steps(self.dirname)
 
     def restore(self, main_program=None, scope=None,
-                step: Optional[int] = None
+                step: Optional[int] = None, mesh=None
                 ) -> Optional[Tuple[int, Dict[str, Any]]]:
         """Load the latest (or a specific) committed checkpoint into
         ``scope``; returns (completed_steps, marker extra) or None when
-        no committed checkpoint exists."""
+        no committed checkpoint exists. ``mesh`` forwards to
+        ``io.load_checkpoint``'s strict topology check (multi-host
+        resume refuses a foreign-mesh trajectory by name)."""
         if step is None:
             step = self.latest()
             if step is None:
                 return None
         path = os.path.join(self.dirname, str(int(step)))
         io.load_checkpoint(self.dirname, main_program=main_program,
-                           scope=scope, step=step)
+                           scope=scope, step=step, mesh=mesh)
         marker = io.read_commit_marker(path) or {}
         return int(step), dict(marker.get("extra", {}))
 

@@ -9,7 +9,7 @@ recovers (retry or rollback) the re-run of the same step proceeds
 clean, which is what makes the recovered loss trajectory comparable
 bitwise against an uninterrupted run.
 
-Spec grammar (comma-separated, ``kind@step`` with an optional
+Spec grammar (comma-separated, ``[rR:]kind@step`` with an optional
 ``:arg``)::
 
     raise@12            step 12 raises InjectedFault before running
@@ -17,23 +17,35 @@ Spec grammar (comma-separated, ``kind@step`` with an optional
     hang@30:2.5         step 30 sleeps 2.5s before running (watchdog bait)
     kill@40             step 40 hard-kills the process (os._exit) —
                         simulates preemption without a signal
+    killsave@8          the checkpoint save following step 8 dies AFTER
+                        this rank's shards are written but BEFORE its
+                        shard-done file — the torn-commit scenario the
+                        two-phase cross-host protocol must absorb
+    r2:kill@40          rank-scoped: fires only on the process whose
+                        PADDLE_TRAINER_ID is 2 — "kill exactly one
+                        host of N", the dominant real failure mode
+                        (entries without a rank prefix fire everywhere)
 """
 
 from __future__ import annotations
 
 import os
+import re
 import time
 from typing import Any, List, Optional, Tuple
 
 import numpy as np
 
-__all__ = ["FaultSpec", "FaultInjector", "InjectedFault", "KILL_EXIT_CODE"]
+__all__ = ["FaultSpec", "FaultInjector", "InjectedFault", "KILL_EXIT_CODE",
+           "check_save_kill"]
 
 # distinctive exit status so a test/driver can tell an injected kill
 # from a genuine crash of the child process
 KILL_EXIT_CODE = 43
 
-_KINDS = ("raise", "nan", "hang", "kill")
+_KINDS = ("raise", "nan", "hang", "kill", "killsave")
+
+_RANK_RE = re.compile(r"^r(\d+):(.+)$")
 
 
 class InjectedFault(RuntimeError):
@@ -41,35 +53,46 @@ class InjectedFault(RuntimeError):
 
 
 class FaultSpec:
-    """Parsed fault plan: a list of (kind, step, arg) actions."""
+    """Parsed fault plan: a list of (kind, step, arg, rank) actions
+    (rank None = every rank)."""
 
-    def __init__(self, actions: List[Tuple[str, int, Optional[float]]]):
-        for kind, step, _ in actions:
+    def __init__(self, actions: List[Tuple]):
+        norm = []
+        for act in actions:
+            kind, step, arg = act[0], act[1], act[2]
+            rank = act[3] if len(act) > 3 else None
             if kind not in _KINDS:
                 raise ValueError(
                     f"unknown fault kind {kind!r} (expected one of {_KINDS})")
             if step < 0:
                 raise ValueError(f"fault step must be >= 0, got {step}")
-        self.actions = list(actions)
+            if rank is not None and rank < 0:
+                raise ValueError(f"fault rank must be >= 0, got {rank}")
+            norm.append((kind, step, arg, rank))
+        self.actions = norm
 
     @classmethod
     def parse(cls, spec: str) -> "FaultSpec":
-        """Parse ``"raise@12,nan@20,hang@30:2.5,kill@40"``."""
-        actions: List[Tuple[str, int, Optional[float]]] = []
+        """Parse ``"raise@12,nan@20,hang@30:2.5,r1:kill@40"``."""
+        actions: List[Tuple[str, int, Optional[float], Optional[int]]] = []
         for part in (spec or "").split(","):
             part = part.strip()
             if not part:
                 continue
+            rank: Optional[int] = None
+            m = _RANK_RE.match(part)
+            if m:
+                rank, part = int(m.group(1)), m.group(2)
             try:
                 kind, rest = part.split("@", 1)
                 arg: Optional[float] = None
                 if ":" in rest:
                     rest, arg_s = rest.split(":", 1)
                     arg = float(arg_s)
-                actions.append((kind.strip(), int(rest), arg))
+                actions.append((kind.strip(), int(rest), arg, rank))
             except ValueError as e:
                 raise ValueError(
-                    f"bad fault spec entry {part!r} (grammar: kind@step"
+                    f"bad fault spec entry {part!r} (grammar: [rN:]kind@step"
                     f"[:arg], kinds {_KINDS}): {e}"
                 ) from None
         return cls(actions)
@@ -78,15 +101,44 @@ class FaultSpec:
         return bool(self.actions)
 
 
+# one-shot flag set by an armed ``killsave`` fault and consumed by the
+# checkpoint writer (io.py) at its pre-done-file injection point — this
+# is how "a host dies mid-save, after its data but before its
+# done-file" is simulated deterministically
+_SAVE_KILL_ARMED = {"on": False}
+
+
+def check_save_kill(point: str = "before_shard_done") -> None:
+    """Called by the checkpoint writer at its injection points; a
+    pending ``killsave`` fault hard-kills the process here (after the
+    shard data landed, before the done-file), leaving a torn save the
+    two-phase commit must never publish."""
+    if _SAVE_KILL_ARMED["on"] and point == "before_shard_done":
+        _SAVE_KILL_ARMED["on"] = False
+        os._exit(KILL_EXIT_CODE)
+
+
 class FaultInjector:
     """Applies a FaultSpec around each supervised step, one shot per
     action. ``before_step`` runs where the step would (raise / hang /
-    kill); ``after_step`` poisons the fetched loss (nan)."""
+    kill, and arms a pending killsave); ``after_step`` poisons the
+    fetched loss (nan). Rank-scoped entries (``rN:``) only fire on the
+    process whose rank (``PADDLE_TRAINER_ID``, or the ``rank=``
+    argument) matches — on every other rank they are dropped at
+    construction and never reported by ``fired()``."""
 
-    def __init__(self, spec: Optional[FaultSpec] = None):
+    def __init__(self, spec: Optional[FaultSpec] = None,
+                 rank: Optional[int] = None):
         if isinstance(spec, str):
             spec = FaultSpec.parse(spec)
+        self.rank = int(os.environ.get("PADDLE_TRAINER_ID", "0")
+                        if rank is None else rank)
         self.spec = spec or FaultSpec([])
+        # rank filter applied once: foreign-rank entries are not "ours"
+        self.spec = FaultSpec([
+            a for a in self.spec.actions
+            if a[3] is None or a[3] == self.rank
+        ])
         self._fired: List[Tuple[str, int]] = []
 
     @classmethod
@@ -102,7 +154,7 @@ class FaultInjector:
         (None when the spec gave no ``:arg``) — one-shot. Returns the
         ``_NOT_PENDING`` sentinel when no such action is pending, so an
         explicit ``:0`` arg stays distinguishable from "absent"."""
-        for i, (k, s, arg) in enumerate(self.spec.actions):
+        for i, (k, s, arg, _rank) in enumerate(self.spec.actions):
             if k == kind and s == step:
                 del self.spec.actions[i]
                 self._fired.append((kind, step))
@@ -122,6 +174,8 @@ class FaultInjector:
             # hard preemption: no cleanup, no atexit, no signal handler
             # — exactly what a spot-VM reclaim looks like to the child
             os._exit(KILL_EXIT_CODE)
+        if self._take("killsave", step) is not self._NOT_PENDING:
+            _SAVE_KILL_ARMED["on"] = True
         if self._take("raise", step) is not self._NOT_PENDING:
             raise InjectedFault(f"injected transient fault at step {step}")
 

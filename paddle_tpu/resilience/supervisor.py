@@ -257,7 +257,8 @@ class Supervisor:
         with tracing.span("resilience/restore",
                           {"dir": self.policy.dirname}):
             restored = self.policy.restore(main_program=self._main,
-                                           scope=self.scope)
+                                           scope=self.scope,
+                                           mesh=self._strict_mesh())
         if restored is None:
             return 0
         step, extra = restored
@@ -282,8 +283,42 @@ class Supervisor:
                         break
         return start
 
+    def _strict_mesh(self):
+        """The mesh to hold a restore to. Multi-host resume is strict —
+        a trajectory committed on a foreign mesh shape must be refused
+        by name, not die as a shard-count mismatch mid-assembly —
+        while single-host resume stays elastic (PR-8: sharding is a
+        property of the compile, any topology restores)."""
+        from .. import io
+
+        _, world = io._dist_info()
+        if world <= 1:
+            return None
+        mesh = getattr(self.program, "_mesh", None)
+        return mesh if hasattr(mesh, "shape") else None
+
     # -- checkpointing ------------------------------------------------------
     def _save(self, completed_steps: int, reason: str) -> str:
+        # multi-host: all ranks must REACH this save point before any
+        # shard write starts — a peer that died mid-step turns into one
+        # bounded BarrierTimeout here (escalated below to a clean
+        # restartable exit) instead of a phase-2 commit timeout minutes
+        # later. The SIGTERM preemption flush gets a SHORT bound: in a
+        # coordinated preemption every live rank reaches its step
+        # boundary within a step time, and when a peer is already dead
+        # the flush must fail before the launcher's SIGKILL grace —
+        # stalling the full dist_barrier_timeout_s would turn the
+        # graceful flush into a guaranteed SIGKILL.
+        from ..distributed.coordinator import get_coordinator
+
+        coord = get_coordinator()
+        if coord is not None and coord.is_distributed:
+            from ..flags import flag
+
+            timeout = float(flag("dist_barrier_timeout_s"))
+            if reason == "preempt":
+                timeout = min(timeout, 5.0)
+            coord.barrier("resilience/pre_save", timeout_s=timeout)
         extra = {
             "run_counter": int(self.exe._run_counter),
             "random_seed": int(getattr(self._main, "random_seed", 0) or 0),
@@ -304,6 +339,16 @@ class Supervisor:
         if mesh is not None and hasattr(mesh, "shape"):
             extra["mesh"] = {str(k): int(v)
                              for k, v in dict(mesh.shape).items()}
+        from .. import io as _io
+
+        _, world = _io._dist_info()
+        if world > 1:
+            # the marker records which world committed this trajectory
+            # (and how many restarts deep the run was) — the restore
+            # side's strict check and the chaos report both read it
+            extra["world"] = world
+            extra["restart_count"] = int(
+                os.environ.get("PADDLE_RESTART_COUNT", "0"))
         with tracing.span(
                 "resilience/checkpoint",
                 {"step": completed_steps, "reason": reason}):
@@ -331,7 +376,8 @@ class Supervisor:
                           {"dir": self.policy.dirname}):
             restored = self.policy.restore(main_program=self._main,
                                           scope=self.scope,
-                                          step=self._last_commit_step)
+                                          step=self._last_commit_step,
+                                          mesh=self._strict_mesh())
         if restored is None:
             return None
         step, extra = restored
@@ -505,7 +551,26 @@ class Supervisor:
                         # reclaim landed, captured BEFORE the flush
                         self._flight_dump("sigterm", step=step)
                     if final_checkpoint:
-                        self._save(step, reason="preempt")
+                        # best-effort in a multi-host teardown: when a
+                        # peer is already dead the flush CANNOT commit
+                        # (two-phase needs every rank) — exit cleanly
+                        # on the last committed checkpoint instead of
+                        # stalling into the launcher's SIGKILL
+                        try:
+                            self._save(step, reason="preempt")
+                        except BaseException as e:  # noqa: BLE001
+                            from .. import io as _io
+                            from ..distributed.coordinator import \
+                                BarrierTimeout
+
+                            if not isinstance(
+                                    e, (BarrierTimeout,
+                                        _io.CheckpointCommitTimeout)):
+                                raise
+                            self._stats["preempt_flush_failed"] = True
+                            flight.note(
+                                "event", what="preempt_flush_failed",
+                                step=step, error=repr(e))
                     break
                 feed = self._feed_for(step)
                 if feed is None:
@@ -560,6 +625,21 @@ class Supervisor:
             # them — so this fires once per terminal failure)
             self._flight_dump(f"exception:{type(e).__name__}",
                               error=repr(e))
+            # multi-host: a stall (hung step under the watchdog, or a
+            # coordination barrier that timed out because a peer died)
+            # is not a crash to debug, it is a world to restart — exit
+            # with the code the elastic launcher treats as "re-form the
+            # world and auto-resume" instead of an arbitrary traceback
+            # status
+            from .. import io as _io
+            from ..distributed.coordinator import (BarrierTimeout,
+                                                   RESTART_EXIT_CODE)
+
+            _, world = _io._dist_info()
+            if world > 1 and isinstance(
+                    e, (WatchdogTimeout, BarrierTimeout,
+                        _io.CheckpointCommitTimeout)):
+                raise SystemExit(RESTART_EXIT_CODE) from e
             raise
         finally:
             if in_main and old_handler is not None:

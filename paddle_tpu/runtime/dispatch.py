@@ -347,6 +347,27 @@ def pad_to(value, pads) -> Any:
     return np.pad(np.asarray(value), pads)
 
 
+def _globalizing_normalizer(norm, sharding):
+    """Compose a feed normalizer with local->global assembly for a
+    mesh spanning processes: every process passes its LOCAL rows and
+    ``jax.make_array_from_process_local_data`` lines them up into one
+    global array per the feed's sharding. Values that are already
+    jax.Arrays (a coordinator-aware loader built them globally) pass
+    through untouched."""
+    import jax
+
+    def globalize(v):
+        v = norm(v)
+        if isinstance(v, jax.Array) or sharding is None:
+            return v
+        arr = np.asarray(v)
+        if getattr(sharding, "is_fully_addressable", True):
+            return jax.device_put(arr, sharding)
+        return jax.make_array_from_process_local_data(sharding, arr)
+
+    return globalize
+
+
 # -- the bound step ---------------------------------------------------------
 
 
@@ -360,7 +381,7 @@ class BoundStep:
         "executor", "compiled", "scope", "block", "base_key",
         "feed_plan", "state_vals", "written_into_state", "scope_gen",
         "n_fetch", "benchmark", "obs_tel", "trace", "rows_hint",
-        "host_sync_calls", "__weakref__",
+        "host_sync_calls", "state_globalize", "__weakref__",
     )
 
     def __init__(self, executor, compiled, scope, block, raw_dtypes):
@@ -394,6 +415,26 @@ class BoundStep:
             (n, _feed_normalizer(_want_dtype(block, n, raw_dtypes.get(n))))
             for n in compiled.feed_names
         ]
+        # multi-host mesh (devices from >1 process): host feeds are
+        # each process's LOCAL batch (a rank-sharded GeneratorLoader's
+        # yield) and must be assembled into GLOBAL jax.Arrays before
+        # the jit call — numpy cannot cross a non-addressable
+        # in_sharding. Resolved once here; single-process meshes keep
+        # the zero-overhead plan above.
+        from ..distributed.coordinator import spans_processes
+
+        self.state_globalize = None
+        if spans_processes(compiled.mesh):
+            if compiled.feed_shardings:
+                self.feed_plan = [
+                    (n, _globalizing_normalizer(
+                        norm, compiled.feed_shardings.get(n)))
+                    for n, norm in self.feed_plan
+                ]
+            # host-value state (startup init, a restored checkpoint)
+            # is identical on every process; assemble it onto the
+            # global mesh per each var's sharding at resolve time
+            self.state_globalize = compiled.state_sharding_by_name
         self.n_fetch = len(compiled.fetch_names)
         # positions of written state inside the state arg list (for the
         # in-place cached-ref update after each step); written names
@@ -438,9 +479,35 @@ class BoundStep:
                     f"persistable var {n!r} not found in scope — run the "
                     "startup program first"
                 )
+            if self.state_globalize is not None:
+                v = self._globalize_state(n, v)
             vals.append(v)
         self.state_vals = vals
         self.scope_gen = gen
+
+    def _globalize_state(self, name, v):
+        """Multi-host mesh only: a host-value state var (startup init
+        or a restored checkpoint — identical on every process by the
+        deterministic-replay contract) becomes one global jax.Array
+        per its compiled sharding. Already-global arrays (the previous
+        step's outputs) pass through."""
+        import jax
+
+        if isinstance(v, jax.Array):
+            return v
+        sharding = self.state_globalize.get(name)
+        if sharding is None:
+            return v
+        arr = np.asarray(v)
+        if getattr(sharding, "is_fully_addressable", True):
+            return jax.device_put(arr, sharding)
+        # global_shape == local shape selects full-value semantics:
+        # every process holds the whole array (identical by the
+        # deterministic-replay contract) and each device takes its
+        # slice of it — the host-restore case, vs. the per-process
+        # LOCAL-batch semantics feeds use
+        return jax.make_array_from_process_local_data(
+            sharding, arr, global_shape=arr.shape)
 
     # -- the hot path -------------------------------------------------------
     def run(self, feed: Dict[str, Any], return_numpy: bool):

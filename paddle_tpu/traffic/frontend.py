@@ -50,12 +50,9 @@ def reuseport_supported() -> bool:
 
 
 def _free_port(host: str = "127.0.0.1") -> int:
-    s = socket.socket()
-    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-    s.bind((host, 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+    from ..parallel.env import free_port
+
+    return free_port(host)
 
 
 # -- the worker process ------------------------------------------------------

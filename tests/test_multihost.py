@@ -1,0 +1,542 @@
+"""Multi-host execution + fault tolerance: the two-phase cross-host
+checkpoint commit, the strict mesh-resume check, rank-scoped fault
+injection, per-mesh-axis collective buckets, and the elastic launcher
+(failure detection, SIGTERM->SIGKILL escalation, exit-code propagation,
+world restart).
+
+The full N-process kill-one-rank -> world-restart -> bitwise-resume
+round trip lives in ``tools/chaos_multihost.py --smoke`` (the CI
+``chaos-multihost`` job); here the protocol pieces are exercised
+directly (fast) plus a 2-process CPU parity run (slow-marked).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import io
+from paddle_tpu.resilience import FaultInjector, FaultSpec
+from paddle_tpu.resilience import faults as faults_mod
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- two-phase cross-host commit --------------------------------------------
+
+
+def _state():
+    return {"w": np.arange(12.0, dtype=np.float32).reshape(3, 4),
+            "b": np.ones(3, np.float32),
+            "step_i": np.asarray([7], np.int32)}
+
+
+def test_two_phase_commit_all_ranks(tmp_path):
+    """Both ranks save concurrently; the marker lands only after every
+    shard-done file, and the assembled restore round-trips bitwise."""
+    path = str(tmp_path / "ck" / "7")
+    state = _state()
+    errs = []
+
+    def rank_save(rank):
+        try:
+            io._save_checkpoint_multihost(
+                path, dict(state), {"step": 7, "run_counter": 3},
+                rank, 2, timeout_s=20, nonce="attempt1")
+        except Exception as e:  # noqa: BLE001
+            errs.append((rank, e))
+
+    threads = [threading.Thread(target=rank_save, args=(r,))
+               for r in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs, errs
+    assert io.is_committed_checkpoint(path)
+    marker = io.read_commit_marker(path)
+    assert marker["extra"]["world"] == 2
+    assert marker["extra"]["step"] == 7
+    got = io.load_checkpoint_arrays(path)
+    for k, v in state.items():
+        np.testing.assert_array_equal(got[k], v)
+    # both ranks' shard files + done files are in the manifest
+    rels = set(marker["manifest"])
+    assert {"__shards__.rank0.npz", "__shards__.rank1.npz",
+            "_PT_SHARD_DONE.0", "_PT_SHARD_DONE.1"} <= rels
+
+
+def test_two_phase_commit_missing_rank_never_commits(tmp_path):
+    """Phase 2 with one rank's done-file absent times out and leaves
+    the directory UNCOMMITTED — the kill-mid-save guarantee."""
+    path = str(tmp_path / "ck" / "3")
+    # rank 0 saves alone; rank 1 "died" before its done-file
+    with pytest.raises(io.CheckpointCommitTimeout) as ei:
+        io._save_checkpoint_multihost(
+            path, _state(), {"step": 3}, 0, 2, timeout_s=0.3,
+            nonce="attempt1")
+    assert "rank(s) [1]" in str(ei.value)
+    assert not io.is_committed_checkpoint(path)
+    assert io.read_commit_marker(path) is None
+    # rank 1's data landing LATER (with its done-file) completes the
+    # attempt: finalize re-run by rank 0 now commits
+    io.write_shard_done(path, 1, "attempt1")
+    io.finalize_two_phase_commit(path, 2, extra={"step": 3},
+                                 nonce="attempt1", timeout_s=1.0)
+    assert io.is_committed_checkpoint(path)
+
+
+def test_stale_done_files_do_not_satisfy_new_attempt(tmp_path):
+    """Done-files from a crashed earlier attempt carry the old nonce
+    and never count toward a new save's phase 2."""
+    path = str(tmp_path / "ck" / "5")
+    os.makedirs(path)
+    io.write_shard_done(path, 0, "old")
+    io.write_shard_done(path, 1, "old")
+    assert io.done_shard_ranks(path, 2, "new") == []
+    with pytest.raises(io.CheckpointCommitTimeout):
+        io.finalize_two_phase_commit(path, 2, nonce="new", timeout_s=0.2)
+
+
+def test_multihost_restore_detects_missing_shard_file(tmp_path):
+    path = str(tmp_path / "ck" / "9")
+    errs = []
+
+    def rank_save(rank):
+        try:
+            io._save_checkpoint_multihost(
+                path, _state(), {"step": 9}, rank, 2, timeout_s=20,
+                nonce="a1")
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    ts = [threading.Thread(target=rank_save, args=(r,)) for r in (0, 1)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errs, errs
+    # truncate one rank's shard file away: assembly must refuse loudly
+    os.remove(os.path.join(path, "__shards__.rank1.npz"))
+    with pytest.raises(ValueError, match="missing"):
+        io.load_checkpoint_arrays(path)
+
+
+# -- strict mesh-resume check -----------------------------------------------
+
+
+def _committed_single(tmp_path, extra):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data("x", [4])
+        fluid.layers.fc(x, 3)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        io.save_checkpoint(str(tmp_path / "ck"), main_program=main,
+                           scope=scope, step=4, extra=extra)
+    return main, str(tmp_path / "ck")
+
+
+def test_load_checkpoint_refuses_foreign_mesh(tmp_path):
+    """A checkpoint whose commit marker records the mesh that produced
+    it refuses a strict (mesh=...) restore onto a different shape, with
+    an error naming BOTH shapes — not a shard-count crash later."""
+    from paddle_tpu.parallel.mesh import make_mesh
+
+    main, ck = _committed_single(tmp_path,
+                                 {"step": 4, "mesh": {"dp": 4}})
+    mesh2 = make_mesh({"dp": 2})
+    with pytest.raises(ValueError) as ei:
+        io.load_checkpoint(ck, main_program=main, scope=fluid.Scope(),
+                           step=4, mesh=mesh2)
+    msg = str(ei.value)
+    assert "'dp': 4" in msg and "'dp': 2" in msg, msg
+    # same shape passes; no mesh arg stays elastic (PR-8 behavior)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        io.load_checkpoint(ck, main_program=main, scope=scope, step=4,
+                           mesh=make_mesh({"dp": 4}))
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        io.load_checkpoint(ck, main_program=main, scope=scope, step=4)
+
+
+# -- rank-scoped fault injection --------------------------------------------
+
+
+def test_fault_spec_rank_scoping():
+    spec = FaultSpec.parse("r2:kill@7,nan@3,r0:raise@5")
+    assert spec.actions == [("kill", 7, None, 2), ("nan", 3, None, None),
+                            ("raise", 5, None, 0)]
+    # rank 1 keeps only the unscoped entry
+    fi = FaultInjector("r2:kill@7,nan@3,r0:raise@5", rank=1)
+    assert [a[:2] for a in fi.spec.actions] == [("nan", 3)]
+    # rank 2 keeps kill + nan
+    fi2 = FaultInjector("r2:kill@7,nan@3,r0:raise@5", rank=2)
+    assert sorted(a[0] for a in fi2.spec.actions) == ["kill", "nan"]
+
+
+def test_fault_spec_bad_entries():
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        FaultSpec.parse("explode@3")
+    with pytest.raises(ValueError, match="bad fault spec entry"):
+        FaultSpec.parse("kill@x")
+
+
+def test_killsave_arms_save_kill_hook():
+    fi = FaultInjector("killsave@2", rank=0)
+    fi.before_step(1)
+    assert not faults_mod._SAVE_KILL_ARMED["on"]
+    fi.before_step(2)
+    assert faults_mod._SAVE_KILL_ARMED["on"]
+    # disarm without dying (the real check would os._exit)
+    faults_mod._SAVE_KILL_ARMED["on"] = False
+    assert ("killsave", 2) in fi.fired()
+
+
+# -- per-mesh-axis collective buckets ---------------------------------------
+
+
+def test_parse_bucket_mb_forms():
+    from paddle_tpu.parallel.collectives import (effective_bucket_mb,
+                                                 parse_bucket_mb)
+
+    assert parse_bucket_mb("25") == 25.0
+    assert parse_bucket_mb(2.5) == 2.5
+    assert parse_bucket_mb("") == 0.0
+    assert parse_bucket_mb("dp=32,dcn=8") == {"dp": 32.0, "dcn": 8.0}
+    # positional diagnostics, PR-9 style
+    with pytest.raises(ValueError, match="entry 2"):
+        parse_bucket_mb("dp=32,bogus")
+    with pytest.raises(ValueError, match="axis name is empty"):
+        parse_bucket_mb("=8")
+    with pytest.raises(ValueError, match="not a number"):
+        parse_bucket_mb("dp=big")
+    with pytest.raises(ValueError, match="neither"):
+        parse_bucket_mb("large")
+    # selection: DCN-crossing reduces take the dcn entry, local the dp
+    spec = {"dp": 32.0, "dcn": 8.0}
+    assert effective_bucket_mb(spec, crosses_hosts=True) == 8.0
+    assert effective_bucket_mb(spec, crosses_hosts=False) == 32.0
+    assert effective_bucket_mb({"dcn": 8.0}, crosses_hosts=False) == 8.0
+    assert effective_bucket_mb({"tp": 4.0}, crosses_hosts=True) == 0.0
+    assert effective_bucket_mb("12", crosses_hosts=True) == 12.0
+
+
+def test_partition_config_per_axis_bucket():
+    from paddle_tpu import partition
+
+    cfg = partition.PartitionConfig(mesh_axes="dp=2",
+                                    collective_bucket_mb="dp=1,dcn=4")
+    assert cfg.collective_bucket_mb == {"dp": 1.0, "dcn": 4.0}
+    assert cfg.collectives_active()
+    # a local (single-process) mesh resolves to the dp entry
+    assert cfg.effective_bucket_mb(cfg.build_mesh()) == 1.0
+    # single-value form keeps today's behavior (float passthrough)
+    cfg2 = partition.PartitionConfig(mesh_axes="dp=2",
+                                     collective_bucket_mb=2.5)
+    assert cfg2.collective_bucket_mb == 2.5
+    assert cfg2.effective_bucket_mb() == 2.5
+    cfg3 = partition.PartitionConfig(mesh_axes="dp=2",
+                                     collective_bucket_mb="0")
+    assert not cfg3.collectives_active()
+
+
+# -- elastic launcher --------------------------------------------------------
+
+LAUNCH = [sys.executable, "-m", "paddle_tpu.distributed.launch"]
+
+
+def _plain_env():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    return env
+
+
+def _write(tmp_path, name, body):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(body))
+    return str(p)
+
+
+def test_launcher_propagates_first_nonzero_exit(tmp_path):
+    """One rank dies with a distinctive code while its sibling would
+    happily run forever — the launcher must kill the sibling and exit
+    with the FIRST failure's code (the old launcher could exit 0)."""
+    worker = _write(tmp_path, "w.py", """
+        import os, sys, time
+        if os.environ["PADDLE_TRAINER_ID"] == "1":
+            sys.exit(7)
+        time.sleep(120)
+    """)
+    t0 = time.time()
+    proc = subprocess.run(
+        LAUNCH + ["--nproc_per_node=2", "--started_port=0",
+                  "--kill_grace_s=5",
+                  f"--run_dir={tmp_path / 'run'}", worker],
+        capture_output=True, text=True, timeout=60, env=_plain_env())
+    assert proc.returncode == 7, (proc.returncode, proc.stderr[-1000:])
+    assert time.time() - t0 < 45, "sibling was not torn down promptly"
+    assert "rank 1 exited with code 7" in proc.stderr
+
+
+def test_launcher_escalates_sigterm_to_sigkill(tmp_path):
+    """A survivor that swallows SIGTERM (wedged in a dead peer's
+    collective, or just rude) is SIGKILLed after the grace period."""
+    worker = _write(tmp_path, "w.py", """
+        import os, signal, sys, time
+        if os.environ["PADDLE_TRAINER_ID"] == "1":
+            time.sleep(0.5)
+            sys.exit(9)
+        signal.signal(signal.SIGTERM, signal.SIG_IGN)
+        print("armored", flush=True)
+        time.sleep(300)
+    """)
+    t0 = time.time()
+    proc = subprocess.run(
+        LAUNCH + ["--nproc_per_node=2", "--started_port=0",
+                  "--kill_grace_s=1.5",
+                  f"--run_dir={tmp_path / 'run'}", worker],
+        capture_output=True, text=True, timeout=60, env=_plain_env())
+    assert proc.returncode == 9, (proc.returncode, proc.stderr[-1000:])
+    assert "escalating to SIGKILL" in proc.stderr, proc.stderr[-1000:]
+    assert time.time() - t0 < 40
+
+
+def test_launcher_rank_prefixed_logs(tmp_path):
+    worker = _write(tmp_path, "w.py", """
+        import os
+        print("hello from", os.environ["PADDLE_TRAINER_ID"], flush=True)
+    """)
+    proc = subprocess.run(
+        LAUNCH + ["--nproc_per_node=2", "--started_port=0",
+                  f"--run_dir={tmp_path / 'run'}", worker],
+        capture_output=True, text=True, timeout=60, env=_plain_env())
+    assert proc.returncode == 0, proc.stderr[-1000:]
+    assert "[rank 0] hello from 0" in proc.stderr
+    assert "[rank 1] hello from 1" in proc.stderr
+
+
+def test_launcher_elastic_restart_resumes_world(tmp_path):
+    """Generation 0 fails; the launcher re-rendezvouses (fresh env,
+    bumped PADDLE_RESTART_COUNT) and generation 1 succeeds -> exit 0."""
+    worker = _write(tmp_path, "w.py", """
+        import json, os, sys
+        gen = int(os.environ["PADDLE_RESTART_COUNT"])
+        rank = os.environ["PADDLE_TRAINER_ID"]
+        with open(os.environ["OUT_DIR"] + f"/g{gen}.r{rank}", "w") as f:
+            json.dump({"endpoints":
+                       os.environ["PADDLE_TRAINER_ENDPOINTS"]}, f)
+        if gen == 0 and rank == "1":
+            sys.exit(43)
+    """)
+    out_dir = tmp_path / "out"
+    out_dir.mkdir()
+    env = _plain_env()
+    env["OUT_DIR"] = str(out_dir)
+    proc = subprocess.run(
+        LAUNCH + ["--nproc_per_node=2", "--started_port=0",
+                  "--max_restarts=2", "--kill_grace_s=2",
+                  f"--run_dir={tmp_path / 'run'}", worker],
+        capture_output=True, text=True, timeout=60, env=env)
+    assert proc.returncode == 0, proc.stderr[-1500:]
+    assert "restarting world (restart 1/2)" in proc.stderr
+    assert "world completed after 1 restart(s)" in proc.stderr
+    seen = sorted(p.name for p in out_dir.iterdir())
+    assert "g0.r1" in seen and "g1.r0" in seen and "g1.r1" in seen
+    # fresh rendezvous: the endpoint list changed between generations
+    g0 = json.loads((out_dir / "g0.r0").read_text())["endpoints"]
+    g1 = json.loads((out_dir / "g1.r0").read_text())["endpoints"]
+    assert g0 != g1
+
+
+def test_launcher_detects_stale_heartbeat(tmp_path):
+    """A rank that beat once and then froze (process alive, no
+    progress) is declared hung and the world is torn down — the
+    failure mode proc.poll() can never see."""
+    worker = _write(tmp_path, "w.py", """
+        import os, time
+        hb = os.environ["PADDLE_HEARTBEAT_DIR"]
+        os.makedirs(hb, exist_ok=True)
+        rank = os.environ["PADDLE_TRAINER_ID"]
+        with open(os.path.join(hb, "hb.rank" + rank), "w") as f:
+            f.write(str(time.time()))
+        time.sleep(300)  # frozen: never beats again
+    """)
+    t0 = time.time()
+    proc = subprocess.run(
+        LAUNCH + ["--nproc_per_node=2", "--started_port=0",
+                  "--heartbeat_timeout_s=2", "--kill_grace_s=1",
+                  f"--run_dir={tmp_path / 'run'}", worker],
+        capture_output=True, text=True, timeout=60, env=_plain_env())
+    assert proc.returncode == 75, (proc.returncode, proc.stderr[-1000:])
+    assert "heartbeat stale" in proc.stderr
+    assert time.time() - t0 < 45
+
+
+# -- coordinator (single-process surface) ------------------------------------
+
+
+def test_coordinator_heartbeat_and_gauges(tmp_path):
+    from paddle_tpu.distributed.coordinator import Coordinator
+
+    c = Coordinator(0, 1, heartbeat_dir=str(tmp_path / "hb"),
+                    heartbeat_interval_s=0.05)
+    assert c.start_heartbeat()
+    time.sleep(0.2)
+    ages = c.heartbeat_ages()
+    assert 0 in ages and ages[0] < 5.0
+    assert c.live_ranks() == 1
+    s = c.stats_numeric()
+    assert s["world_size"] == 1 and s["heartbeats_total"] >= 1
+    # progress stall silences the beat
+    c.attach_progress(lambda: 1, stall_after_s=0.05)
+    time.sleep(0.3)
+    before = c.stats_numeric()["heartbeats_total"]
+    time.sleep(0.3)
+    assert c.stats_numeric()["heartbeats_total"] == before, \
+        "heartbeat kept beating for a stalled progress probe"
+    c.stop_heartbeat()
+    # single-process barrier and host_allreduce are no-ops
+    assert c.barrier("x") == 0.0
+    out = c.host_allreduce({"a": np.ones(3)}, tag="t")
+    np.testing.assert_array_equal(out["a"], np.ones(3))
+    # the paddle_dist_* gauges are in the unified scrape
+    from paddle_tpu import observability
+
+    text = observability.to_prometheus_text()
+    assert "paddle_dist_world_size" in text
+    assert "paddle_dist_barriers_total" in text
+
+
+def test_coordinator_build_mesh_process_major():
+    from paddle_tpu.distributed.coordinator import (Coordinator,
+                                                    spans_processes)
+
+    c = Coordinator(0, 1)
+    mesh = c.build_mesh("dp=4")
+    assert dict(mesh.shape) == {"dp": 4}
+    assert not spans_processes(mesh)
+    mesh2 = c.build_mesh({"dcn": 2, "ici": 2})
+    assert dict(mesh2.shape) == {"dcn": 2, "ici": 2}
+    # consumed unchanged by the partitioner
+    from paddle_tpu import partition
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data("x", [8])
+        y = fluid.layers.data("y", [1])
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(
+            fluid.layers.fc(x, 4), y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    cfg = partition.PartitionConfig(mesh_axes={"dp": 4})
+    resolved = cfg.resolve(main, mesh=mesh)
+    assert dict(resolved.mesh.shape) == {"dp": 4}
+    assert any(r["kind"] == "data" and r["spec"]
+               and r["spec"][0] == "dp" for r in resolved.rows)
+    with pytest.raises(ValueError, match="needs"):
+        c.build_mesh("dp=1024")
+
+
+# -- 2-process CPU parity (slow: spawns jax subprocesses) --------------------
+
+
+@pytest.mark.slow
+def test_two_process_parity_vs_single_process_dp2(tmp_path):
+    """The 2-process CPU path (local batches + per-step host-allreduce
+    state averaging, momentum optimizer) matches a single-process
+    PARTITIONED dp2 run of the same global batches allclose — the
+    multi-host wire reproduces the in-graph dp trajectory.
+
+    Kill/restart/bitwise-resume at N>=4 is covered by
+    ``tools/chaos_multihost.py --smoke`` in the chaos-multihost CI job.
+    """
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import chaos_multihost as mh
+
+    steps, world = 5, 2
+    # -- 2-process run through the elastic launcher ---------------------
+    ck = tmp_path / "ck"
+    st = tmp_path / "st"
+    env = mh._scrubbed_env()
+    proc = subprocess.run(
+        LAUNCH + ["--nproc_per_node=2",
+                  f"--started_port={mh._free_port()}",
+                  f"--run_dir={tmp_path / 'run'}",
+                  os.path.join(REPO, "tools", "chaos_multihost.py"),
+                  "--worker", "--steps", str(steps), "--every", "0",
+                  "--no-dropout",
+                  "--ckpt-dir", str(ck), "--stats-dir", str(st)],
+        capture_output=True, text=True, timeout=300, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    ranks = []
+    for r in range(world):
+        with open(st / f"stats.rank{r}.gen0.json") as f:
+            ranks.append(json.load(f))
+    multi = [np.mean([float(rk["losses"][str(s)]) for rk in ranks])
+             for s in range(steps)]
+
+    # -- single-process dp2 partitioned run on the same global batches --
+    main, startup, loss = mh.build_model(dropout=False)
+    reader = mh._sample_reader(steps * mh.BATCH * world)
+    samples = list(reader())
+    scope = fluid.Scope()
+    single = []
+    from paddle_tpu import partition
+
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        prog = fluid.CompiledProgram(main).with_partitioning(
+            partition.PartitionConfig(mesh_axes={"dp": 2}))
+        for s in range(steps):
+            # the global batch of step s: rank r's loader yields
+            # samples with index % world == r, batch b of rank r =
+            # its b'th chunk — concatenated in rank order
+            rows = []
+            for r in range(world):
+                mine = [smp for i, smp in enumerate(samples)
+                        if i % world == r]
+                rows += mine[s * mh.BATCH:(s + 1) * mh.BATCH]
+            feed = {
+                "x": np.stack([row[0] for row in rows]),
+                "y": np.stack([row[1] for row in rows]),
+            }
+            (l,) = exe.run(prog, feed=feed, fetch_list=[loss])
+            single.append(float(np.asarray(l).reshape(())))
+    np.testing.assert_allclose(multi, single, rtol=3e-5, atol=3e-6)
+
+
+@pytest.mark.slow
+def test_two_process_parity_worker_uses_dropout_model(tmp_path):
+    """The chaos worker's dropout model stays deterministic across a
+    2-process run: both ranks' losses at every step are finite and the
+    final checkpoint's params are identical on re-read."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import chaos_multihost as mh
+
+    ck, st = tmp_path / "ck", tmp_path / "st"
+    proc = subprocess.run(
+        LAUNCH + ["--nproc_per_node=2",
+                  f"--started_port={mh._free_port()}",
+                  f"--run_dir={tmp_path / 'run'}",
+                  os.path.join(REPO, "tools", "chaos_multihost.py"),
+                  "--worker", "--steps", "4", "--every", "2",
+                  "--ckpt-dir", str(ck), "--stats-dir", str(st)],
+        capture_output=True, text=True, timeout=300,
+        env=mh._scrubbed_env(), cwd=REPO)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    arrays = io.load_checkpoint_arrays(str(ck / "4"))
+    assert arrays and all(np.isfinite(v).all() for v in arrays.values()
+                          if np.asarray(v).dtype.kind == "f")
+    marker = io.read_commit_marker(str(ck / "4"))
+    assert marker["extra"]["world"] == 2

@@ -107,8 +107,10 @@ def test_atomic_rename_local_and_hdfs_stub(tmp_path):
 
 def test_fault_spec_parse_and_one_shot():
     spec = resilience.FaultSpec.parse("raise@3, nan@5, hang@7:0.01, kill@9")
-    assert [(k, s) for k, s, _ in spec.actions] == [
+    # actions are (kind, step, arg, rank) — rank None = every rank
+    assert [(a[0], a[1]) for a in spec.actions] == [
         ("raise", 3), ("nan", 5), ("hang", 7), ("kill", 9)]
+    assert all(a[3] is None for a in spec.actions)
     inj = resilience.FaultInjector(
         resilience.FaultSpec([("raise", 3, None)]))
     with pytest.raises(resilience.InjectedFault):
