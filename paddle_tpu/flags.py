@@ -71,6 +71,24 @@ _FLAG_DEFS: Dict[str, Any] = {
     "generation_queue_capacity": 64,
     "generation_max_new_tokens": 64,
     "generation_prefill_buckets": "16,32,64,128,256,512",
+    # ragged decode (generation/engine.py "ragged" mode, the default):
+    # ONE [lanes, generation_chunk_tokens] mixed prefill+decode
+    # executable replaces the two-lane prefill/decode pair — a prompt
+    # longer than generation_chunk_tokens prefills in chunks across
+    # steps (chunked prefill: a fat prompt never stalls decode ITL);
+    # "two_lane" selects the PR-6 engine (the token-identity oracle).
+    # generation_spec_tokens > 0 turns on speculative decoding: a
+    # draft model (GenerationEngine(draft=...)) proposes up to k
+    # tokens per sequence per step and the target verifies them in
+    # the same ragged call — greedy-identical by construction.
+    # generation_kv_dtype="int8" stores KV pages blockwise-int8
+    # quantized (kernels/quant.py scales, one per head x token slot),
+    # ~3.6x fewer pool bytes -> ~2x+ resident sequences at a byte
+    # budget (accuracy bench-gated; ragged mode only)
+    "generation_engine_mode": "ragged",
+    "generation_chunk_tokens": 16,
+    "generation_spec_tokens": 0,
+    "generation_kv_dtype": "float32",
     # resilience/supervisor.py defaults (overridable per Supervisor /
     # CheckpointPolicy): checkpoint cadence is every-N-steps OR
     # every-T-seconds, whichever fires first (0 disables that trigger);

@@ -5,9 +5,17 @@ The serving subsystem (PR 3) coalesces stateless predict calls; this
 package serves the workload that made TPU serving hard: autoregressive
 decode under heavy concurrent traffic. K/V lives in fixed-size pages
 behind per-sequence block tables (Ragged Paged Attention,
-arXiv:2604.15464); prefill and decode run as separate micro-batch
-lanes; sequences join/leave the running decode batch every step; every
-token streams to its caller the moment it is sampled.
+arXiv:2604.15464); ONE ragged [lanes, chunk] executable serves mixed
+prefill chunks, decode rows and speculative-verify rows side by side
+(mode="ragged", the default — "two_lane" retains the PR-6
+prefill/decode lane pair as the token-identity oracle); sequences
+join/leave the running batch every step; every token streams to its
+caller the moment it is sampled. Long prompts prefill in chunks
+across steps (decode ITL never stalls on a fat prompt); a draft model
+(draft.HostDraft or any DraftModel) + spec_tokens turns on
+speculative decoding (greedy-identical by construction); and
+kv_dtype="int8" quantizes the page pools for ~2x+ resident sequences
+per byte budget.
 
     from paddle_tpu.inference import Config, create_predictor
     from paddle_tpu import generation
@@ -28,10 +36,12 @@ the streamed `POST /v1/generate` HTTP endpoint. Flags: the
 reference on CPU CI).
 """
 
+from .draft import DraftModel, HostDraft
 from .engine import GenerationEngine, GenerationMetrics, GenerationStream
 from .kvcache import PagedKVCache, PagePoolExhausted
 from .model import (CacheGeometry, GPTConfig, build_decode_program,
-                    build_lm_program, build_prefill_program)
+                    build_lm_program, build_prefill_program,
+                    build_ragged_step_program)
 
 __all__ = [
     "GenerationEngine",
@@ -41,7 +51,10 @@ __all__ = [
     "PagePoolExhausted",
     "CacheGeometry",
     "GPTConfig",
+    "DraftModel",
+    "HostDraft",
     "build_lm_program",
     "build_prefill_program",
     "build_decode_program",
+    "build_ragged_step_program",
 ]

@@ -11,19 +11,33 @@ does what modern LLM serving does instead:
 
 * **Paged KV cache** (kvcache.py): each sequence's K/V lives in
   fixed-size pages behind a block table; join/leave never copies or
-  reallocates.
-* **Two lanes, one loop.** Prefill (the prompt's full forward, batched
-  by seq bucket) and decode (ONE token for every running sequence, a
-  fixed-lane batch) are separate executables; a single step loop
-  interleaves them, so sequences join the running decode batch the
-  step after their prefill and leave the moment they finish — classic
-  continuous batching.
-* **One jitted call per token.** The decode program's batch dim is the
-  fixed lane count, so the whole engine life is ONE executable; the
-  loop holds its ``runtime.dispatch.BoundStep`` (``Executor.bind``)
-  directly — the per-token hot path is a feed-dict assembly and one
-  jitted call, nothing else. Page pools ride feeds/fetches as jax
-  arrays (zero-copy through the dispatch normalizers).
+  reallocates. ``kv_dtype="int8"`` stores pages blockwise-quantized
+  (kernels/quant.py scales) for ~2x+ resident sequences per byte.
+* **ONE ragged executable** (mode="ragged", the default — Ragged
+  Paged Attention, arXiv:2604.15464): every step runs a single
+  [lanes, chunk] mixed batch where each row is whatever its sequence
+  needs — a prefill chunk, one decode token, a decode token plus k
+  speculative draft tokens, or nothing (idle lane). Prompts longer
+  than ``chunk_tokens`` prefill in chunks ACROSS steps (chunked
+  prefill), so a fat prompt arriving mid-traffic costs every running
+  sequence a bounded slice per step instead of a whole-prompt stall —
+  the decode-ITL interference gate in tools/generation_bench.py.
+* **Speculative decoding** (``spec_tokens`` + a ``generation.draft``
+  model): the draft proposes k tokens per sequence, the target
+  verifies all of them in the SAME ragged call (its argmax at every
+  chunk position IS the greedy continuation), and the accepted prefix
+  + one correction token emit together — greedy-identical by
+  construction, whatever the draft proposed.
+* **mode="two_lane"**: the PR-6 engine — separate prefill-bucket and
+  decode executables — retained as the token-identity oracle the
+  ragged collapse is proven against (and for A/B perf archaeology).
+* **One jitted call per step.** Either mode's program has fixed
+  shapes, so the whole engine life is ONE executable (plus the
+  prefill-bucket ladder in two_lane); the loop holds its
+  ``runtime.dispatch.BoundStep`` (``Executor.bind``) directly — the
+  per-step hot path is a feed-dict assembly and one jitted call,
+  nothing else. Page pools ride feeds/fetches as jax arrays
+  (zero-copy through the dispatch normalizers).
 * **Streaming.** ``submit()`` returns a ``GenerationStream`` —
   iterate it for tokens as they are sampled (time-to-first-token is a
   prefill, not a whole generation), or ``result()`` for the full list.
@@ -55,7 +69,8 @@ from ..serving.engine import (DeadlineExceeded, EngineClosed, Overloaded,
                               RequestCancelled, ServingError)
 from ..serving.metrics import StreamingHistogram
 from .kvcache import PagedKVCache, PagePoolExhausted
-from .model import CacheGeometry, build_decode_program, build_prefill_program
+from .model import (CacheGeometry, build_decode_program,
+                    build_prefill_program, build_ragged_step_program)
 
 __all__ = ["GenerationEngine", "GenerationStream", "GenerationMetrics"]
 
@@ -81,6 +96,19 @@ class GenerationStream:
         self._cancelled = False
         self.first_token_at: Optional[float] = None
         self._callbacks: List = []
+        # per-request speculative-decoding accounting (the /v1/generate
+        # usage fragment): every emitted token is target-VERIFIED;
+        # accepted_draft_tokens counts how many of them the draft
+        # proposed (0 with speculation off)
+        self.verified_tokens = 0
+        self.accepted_draft_tokens = 0
+
+    def usage(self) -> Dict[str, int]:
+        """The response ``usage`` fragment: spec-decode behavior is
+        visible per request, not just in fleet-wide gauges."""
+        return {"completion_tokens": len(self._tokens),
+                "verified_tokens": int(self.verified_tokens),
+                "accepted_draft_tokens": int(self.accepted_draft_tokens)}
 
     # -- engine side ---------------------------------------------------------
     def _push(self, token: int) -> None:
@@ -169,7 +197,7 @@ class GenerationStream:
 class _GenRequest:
     __slots__ = ("prompt", "orig_prompt", "max_new", "eos_id", "deadline",
                  "stream", "enqueue_t", "slot", "pending", "n_generated",
-                 "ctx", "admit_seq", "last_tok_t")
+                 "ctx", "admit_seq", "last_tok_t", "prefill_off", "drafts")
 
     def __init__(self, prompt, max_new, eos_id, deadline, stream, ctx):
         self.prompt = prompt            # context to prefill (grows on resume)
@@ -185,6 +213,8 @@ class _GenRequest:
         self.ctx = ctx                       # tracing ctx of the submit span
         self.admit_seq = 0                   # admission order (evict victim)
         self.last_tok_t: Optional[float] = None
+        self.prefill_off = 0            # prompt tokens already written
+        self.drafts = None              # this step's speculative proposals
 
 
 class GenerationMetrics:
@@ -200,7 +230,13 @@ class GenerationMetrics:
                  "prefill_tokens_total", "decode_tokens_total",
                  "prefill_rows_total", "prefill_capacity_rows_total",
                  "decode_active_lane_steps_total",
-                 "decode_capacity_lane_steps_total")
+                 "decode_capacity_lane_steps_total",
+                 # ragged mode: every step is one mixed executable run
+                 "ragged_steps_total", "prefill_chunks_total",
+                 # speculative decoding (exported as the
+                 # paddle_generation_spec_* gauge family)
+                 "spec_rounds_total", "spec_proposed_total",
+                 "spec_accepted_total")
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -222,12 +258,17 @@ class GenerationMetrics:
         with self._lock:
             getattr(self, hist).record(v)
 
-    def observe_decode_step(self, ms: float, active: int, lanes: int) -> None:
+    def observe_decode_step(self, ms: float, active: int, lanes: int,
+                            tokens: Optional[int] = None) -> None:
+        """One decode/ragged step: ``active`` lanes did real work out
+        of ``lanes``; ``tokens`` overrides the emitted-token count
+        (speculative steps emit more than one per lane)."""
         with self._lock:
             self.decode_step_ms.record(ms)
             self._decode_wall_s += ms / 1e3
             self._c["decode_steps_total"] += 1
-            self._c["decode_tokens_total"] += active
+            self._c["decode_tokens_total"] += (
+                active if tokens is None else tokens)
             self._c["decode_active_lane_steps_total"] += active
             self._c["decode_capacity_lane_steps_total"] += lanes
 
@@ -257,6 +298,17 @@ class GenerationMetrics:
             out["decode_tokens_per_s"] = (
                 round(self._c["decode_tokens_total"] / self._decode_wall_s, 2)
                 if self._decode_wall_s > 0 else 0.0)
+            # spec-decode health as ratios (the satellite gauges:
+            # draft acceptance rate + accepted tokens per step) —
+            # flattened by the registry into paddle_generation_spec_*
+            prop = self._c["spec_proposed_total"]
+            out["spec_acceptance_rate"] = (
+                round(self._c["spec_accepted_total"] / prop, 4)
+                if prop else 0.0)
+            rounds = self._c["spec_rounds_total"]
+            out["spec_accepted_tokens_per_step"] = (
+                round(self._c["spec_accepted_total"] / rounds, 4)
+                if rounds else 0.0)
             return out
 
 
@@ -283,6 +335,11 @@ class GenerationEngine:
                  prefill_buckets: Optional[Sequence[int]] = None,
                  eos_id: Optional[int] = None,
                  dtype: str = "float32",
+                 mode: Optional[str] = None,
+                 chunk_tokens: Optional[int] = None,
+                 spec_tokens: Optional[int] = None,
+                 draft=None,
+                 kv_dtype: Optional[str] = None,
                  warmup: bool = False, start: bool = True):
         from ..flags import flag
 
@@ -301,6 +358,37 @@ class GenerationEngine:
                                   or flag("generation_queue_capacity"))
         self.default_max_new = int(flag("generation_max_new_tokens"))
         self.default_eos = eos_id
+        self.mode = str(mode or flag("generation_engine_mode"))
+        if self.mode not in ("ragged", "two_lane"):
+            raise ValueError(
+                f"generation_engine_mode must be 'ragged' or 'two_lane', "
+                f"got {self.mode!r}")
+        self.spec_tokens = int(spec_tokens if spec_tokens is not None
+                               else flag("generation_spec_tokens"))
+        self._draft = draft
+        if self._draft is None:
+            self.spec_tokens = 0
+        elif hasattr(self._draft, "min_rows"):
+            # pin the draft's row bucket to the lane count: one draft
+            # executable per length bucket for the engine's whole life
+            self._draft.min_rows = max(int(self._draft.min_rows or 1),
+                                       self.lanes)
+        self.chunk_tokens = int(chunk_tokens
+                                or flag("generation_chunk_tokens"))
+        # a speculative row is [pending + k drafts] wide; the chunk
+        # must hold it
+        self.chunk_tokens = max(2, self.chunk_tokens, self.spec_tokens + 1)
+        # precedence: kv_dtype param > legacy dtype param > flag
+        if kv_dtype is None:
+            kv_dtype = (dtype if dtype != "float32"
+                        else flag("generation_kv_dtype"))
+        self.kv_dtype = str(kv_dtype)
+        if self.kv_dtype == "int8" and self.mode != "ragged":
+            raise ValueError("int8 KV pages require the ragged engine "
+                             "(generation_engine_mode='ragged')")
+        if self.mode != "ragged" and self.spec_tokens:
+            raise ValueError("speculative decoding requires the ragged "
+                             "engine (generation_engine_mode='ragged')")
         if prefill_buckets is None:
             prefill_buckets = tuple(
                 int(x) for x in
@@ -316,7 +404,8 @@ class GenerationEngine:
             config.num_layers, config.num_heads,
             config.hidden_size // config.num_heads,
             num_pages=self.num_pages, page_size=self.page_size,
-            max_seqs=self.lanes, max_pages_per_seq=maxp, dtype=dtype)
+            max_seqs=self.lanes, max_pages_per_seq=maxp,
+            dtype=self.kv_dtype)
         self.metrics = GenerationMetrics()
         # unified telemetry: this engine's counters + page-pool stats
         # join the scrape as paddle_generation_*{engine=} series
@@ -324,10 +413,18 @@ class GenerationEngine:
 
         watch_generation(self)
 
-        self._decode_prog, self._decode_fetches = build_decode_program(
-            config, self.geom)
-        self._decode_bound = None       # resolved on first decode step
+        self._ragged_bound = None       # resolved on the first step
+        self._decode_bound = None       # two_lane: first decode step
         self._prefill_progs: Dict[int, Any] = {}    # seq bucket -> (prog, fetches)
+        if self.mode == "ragged":
+            # THE executable: one mixed prefill+decode program for the
+            # engine's whole life, one BoundStep per step
+            self._ragged_prog, self._ragged_fetches = \
+                build_ragged_step_program(config, self.geom,
+                                          self.chunk_tokens, self.kv_dtype)
+        else:
+            self._decode_prog, self._decode_fetches = build_decode_program(
+                config, self.geom)
 
         self._cond = threading.Condition()
         self._queue: "collections.deque[_GenRequest]" = collections.deque()
@@ -480,9 +577,14 @@ class GenerationEngine:
                     if self._stop or (self._closed and not self._queue
                                       and not self._by_slot):
                         break
-                self._admit_and_prefill()
-                if self._by_slot:
-                    self._decode_step()
+                if self.mode == "ragged":
+                    self._admit_ragged()
+                    if self._by_slot:
+                        self._ragged_step()
+                else:
+                    self._admit_and_prefill()
+                    if self._by_slot:
+                        self._decode_step()
                 self.metrics.set_gauges(len(self._queue), len(self._by_slot))
         finally:
             # loop exit — normal drain leaves nothing live; anything
@@ -644,6 +746,226 @@ class GenerationEngine:
             self._by_slot[req.slot] = req
             self._emit(req, int(next_tok[i]), now)
 
+    # -- the ragged lane (mode="ragged") -------------------------------------
+    def _admit_ragged(self):
+        """Admission without a prefill executable: an admitted request
+        takes a lane + pages for its whole prompt (the same FIFO
+        head-of-line discipline as two_lane) and starts CHUNKED
+        prefill on the next ragged step."""
+        for req in self._pop_admissible():
+            req.prefill_off = 0
+            req.pending = None
+            req.drafts = None
+            self._by_slot[req.slot] = req
+
+    def _bind_ragged(self, feed):
+        if self._ragged_bound is None:
+            self._ragged_bound = self._exe.bind(
+                self._ragged_prog, feed, self._ragged_fetches,
+                scope=self._scope, tag="generation/ragged_step")
+        return self._ragged_bound
+
+    def _retire_dead_rows(self, now: float) -> None:
+        """Retire cancelled/expired sequences before spending a step
+        on them (shared by the ragged and two-lane step loops — the
+        two engines must never diverge on retirement policy)."""
+        for slot, req in list(self._by_slot.items()):
+            if req.stream._cancelled:
+                self._retire(slot, "cancelled")
+                self.metrics.inc("cancelled_total")
+            elif req.deadline is not None and now > req.deadline:
+                self._retire(slot, "deadline")
+                self.metrics.inc("expired_total")
+
+    def _grow_or_evict(self, slot: int) -> bool:
+        """Grow slot's page chain by one token; a dry pool evicts
+        (youngest first) and a truly stuck row finishes early
+        ("capacity"). False when the slot was retired. Shared eviction
+        policy for both engine modes."""
+        while True:
+            try:
+                self.cache.ensure_capacity(
+                    slot, int(self.cache.lengths[slot]) + 1)
+                return True
+            except PagePoolExhausted:
+                if not self._make_room(slot):
+                    self._retire(slot, "capacity")
+                    return False
+
+    def _spec_budget(self, slot: int, req: _GenRequest) -> int:
+        """Draft tokens this row could verify this step: bounded by
+        the spec window, the chunk width, the request's remaining
+        token budget and the position window."""
+        if self._draft is None or self.spec_tokens <= 0:
+            return 0
+        L = int(self.cache.lengths[slot])
+        return max(0, min(self.spec_tokens,
+                          self.chunk_tokens - 1,
+                          req.max_new - req.n_generated - 1,
+                          self.config.max_position - L - 2))
+
+    def _ragged_step(self):
+        """ONE mixed executable run: every active lane contributes
+        whatever its sequence needs this step — a prefill chunk, a
+        decode token, or a decode token plus speculative drafts — and
+        the whole batch attends raggedly over the shared page pool."""
+        from ..observability import tracing
+
+        R, C, L = self.lanes, self.chunk_tokens, self.config.num_layers
+        now = time.monotonic()
+        self._retire_dead_rows(now)
+        # page growth for decode rows (+ the speculative window);
+        # prefill rows were fully reserved at admission. A dry pool
+        # first degrades speculation to plain decode, then evicts
+        # (youngest first), then finishes the stuck row early.
+        spec_rows: List = []
+        for slot, req in list(self._by_slot.items()):
+            if slot not in self._by_slot:
+                continue
+            if req.prefill_off < int(req.prompt.size):
+                continue
+            req.drafts = None
+            k = self._spec_budget(slot, req)
+            if k > 0:
+                try:
+                    self.cache.ensure_capacity(
+                        slot, int(self.cache.lengths[slot]) + 1 + k)
+                    spec_rows.append((slot, req, k))
+                    continue
+                except PagePoolExhausted:
+                    pass
+            self._grow_or_evict(slot)
+        if not self._by_slot:
+            return
+        # batched drafting: ONE propose() call covers every
+        # speculative row, so draft cost amortizes over the batch
+        spec_rows = [(s, r, k) for s, r, k in spec_rows
+                     if s in self._by_slot]
+        if spec_rows:
+            ctxs = [np.concatenate([r.orig_prompt,
+                                    np.asarray(r.stream._tokens, np.int64)])
+                    for _, r, _ in spec_rows]
+            # always propose the FULL spec window and trim per row:
+            # a shrinking k near a request's token budget would mint a
+            # fresh draft executable per distinct k (warmup compiled
+            # exactly the spec_tokens buckets)
+            try:
+                props = self._draft.propose(ctxs, self.spec_tokens)
+            except Exception:  # noqa: BLE001 — a broken draft must never kill decode
+                props = [np.zeros(0, np.int64)] * len(spec_rows)
+            self.metrics.inc("spec_rounds_total")
+            for (slot, req, k), dr in zip(spec_rows, props):
+                dr = np.asarray(dr, np.int64).reshape(-1)[:k]
+                req.drafts = dr
+                self.metrics.inc("spec_proposed_total", int(dr.size))
+        # assemble the mixed batch
+        tokens = np.zeros((R, C), np.int64)
+        pos_ids = np.zeros((R, C), np.int64)
+        positions = np.zeros(R, np.int64)
+        num_valid = np.zeros(R, np.int32)
+        for slot, req in self._by_slot.items():
+            if req.prefill_off < int(req.prompt.size):
+                off = req.prefill_off
+                c = min(C, int(req.prompt.size) - off)
+                tokens[slot, :c] = req.prompt[off:off + c]
+                pos_ids[slot, :c] = np.arange(off, off + c)
+                positions[slot] = off
+                num_valid[slot] = c
+            else:
+                dr = (req.drafts if req.drafts is not None
+                      else np.zeros(0, np.int64))
+                row = np.concatenate(
+                    [np.asarray([req.pending], np.int64), dr])
+                L0 = int(self.cache.lengths[slot])
+                tokens[slot, :row.size] = row
+                pos_ids[slot, :row.size] = np.arange(L0, L0 + row.size)
+                positions[slot] = L0
+                num_valid[slot] = row.size
+        feed = {
+            "gen_tokens": tokens,
+            "gen_pos_ids": pos_ids,
+            "gen_positions": positions,
+            "gen_num_valid": num_valid,
+            "gen_block_tables": np.ascontiguousarray(
+                self.cache.block_tables),
+        }
+        for li in range(L):
+            feed[f"gen_k_pages_{li}"] = self.cache.k_pages[li]
+            feed[f"gen_v_pages_{li}"] = self.cache.v_pages[li]
+        if self.cache.quantized:
+            for li in range(L):
+                feed[f"gen_k_scales_{li}"] = self.cache.k_scales[li]
+                feed[f"gen_v_scales_{li}"] = self.cache.v_scales[li]
+        bound = self._bind_ragged(feed)
+        active = list(self._by_slot.items())
+        bound.rows_hint = len(active)
+        span_cm = contextlib.nullcontext()
+        if tracing.enabled():
+            flow = [r.ctx.span_id for _, r in active if r.ctx is not None]
+            span_cm = tracing.span(
+                f"generation/ragged_step[n={len(active)}]",
+                {"lanes": R, "chunk": C,
+                 "new_tokens": int(num_valid.sum()),
+                 **({"flow_from": flow} if flow else {})})
+        t0 = time.monotonic()
+        try:
+            with span_cm:
+                outs = bound.run(feed, False)
+        except Exception as e:  # noqa: BLE001 — a bad batch must not kill the loop
+            for slot, req in active:
+                self._retire(slot, "error", ServingError(
+                    f"ragged step execution failed: {e!r}"))
+            return
+        next_all = np.asarray(outs[0]).reshape(R, C)
+        if self.cache.quantized:
+            self.cache.set_buffers(
+                list(outs[1:1 + L]), list(outs[1 + L:1 + 2 * L]),
+                list(outs[1 + 2 * L:1 + 3 * L]), list(outs[1 + 3 * L:]))
+        else:
+            self.cache.set_buffers(list(outs[1:1 + L]),
+                                   list(outs[1 + L:]))
+        now = time.monotonic()
+        self.metrics.inc("ragged_steps_total")
+        emitted_total = 0
+        for slot, req in active:
+            if slot not in self._by_slot:
+                continue
+            nv = int(num_valid[slot])
+            if nv <= 0:
+                continue
+            if req.prefill_off < int(req.prompt.size):
+                # a prefill chunk: its K/V is cached now; the FINAL
+                # chunk additionally samples the first token (TTFT)
+                self.cache.advance(slot, nv)
+                req.prefill_off += nv
+                self.metrics.inc("prefill_chunks_total")
+                self.metrics.inc("prefill_tokens_total", nv)
+                if req.prefill_off >= int(req.prompt.size):
+                    self.metrics.inc("prefill_batches_total")
+                    self._emit(req, int(next_all[slot, nv - 1]), now)
+                    emitted_total += 1
+            else:
+                # decode / speculative verify: next_all[slot, j] IS
+                # the greedy token after position start+j, so draft j
+                # is accepted iff it equals the target's token at its
+                # own offset — the emitted stream is greedy-identical
+                # by construction, whatever the draft proposed
+                dr = req.drafts if req.drafts is not None else ()
+                for j in range(nv):
+                    if j > 0:
+                        if int(dr[j - 1]) != int(next_all[slot, j - 1]):
+                            break       # rejected: the tail is dead
+                        self.metrics.inc("spec_accepted_total")
+                        req.stream.accepted_draft_tokens += 1
+                    self.cache.advance(slot)
+                    emitted_total += 1
+                    self._emit(req, int(next_all[slot, j]), now)
+                    if slot not in self._by_slot:
+                        break           # retired (eos/length/deadline)
+        n_active = sum(1 for s, _ in active if num_valid[s] > 0)
+        self.metrics.observe_decode_step(
+            (now - t0) * 1e3, n_active, R, tokens=emitted_total)
+
     # -- decode lane ---------------------------------------------------------
     def _bind_decode(self, feed):
         if self._decode_bound is None:
@@ -677,6 +999,8 @@ class GenerationEngine:
              np.asarray(victim.stream._tokens, np.int64)])
         victim.slot = None
         victim.pending = None
+        victim.prefill_off = 0
+        victim.drafts = None
         with self._cond:
             self._queue.appendleft(victim)
             self._cond.notify_all()
@@ -687,14 +1011,7 @@ class GenerationEngine:
 
         Bd, L = self.lanes, self.config.num_layers
         now = time.monotonic()
-        # retire cancelled/expired before spending a step on them
-        for slot, req in list(self._by_slot.items()):
-            if req.stream._cancelled:
-                self._retire(slot, "cancelled")
-                self.metrics.inc("cancelled_total")
-            elif req.deadline is not None and now > req.deadline:
-                self._retire(slot, "deadline")
-                self.metrics.inc("expired_total")
+        self._retire_dead_rows(now)
         if not self._by_slot:
             return
         # grow page chains for the rows about to be written; evict on
@@ -702,15 +1019,7 @@ class GenerationEngine:
         for slot, req in list(self._by_slot.items()):
             if slot not in self._by_slot:   # evicted by an earlier row
                 continue
-            while True:
-                try:
-                    self.cache.ensure_capacity(
-                        slot, int(self.cache.lengths[slot]) + 1)
-                    break
-                except PagePoolExhausted:
-                    if not self._make_room(slot):
-                        self._retire(slot, "capacity")
-                        break
+            self._grow_or_evict(slot)
         if not self._by_slot:
             return
         tokens = np.zeros((Bd, 1), np.int64)
@@ -767,6 +1076,7 @@ class GenerationEngine:
         first = req.stream.first_token_at is None
         if req.last_tok_t is not None:
             self.metrics.observe("itl_ms", (now - req.last_tok_t) * 1e3)
+        req.stream.verified_tokens += 1
         req.stream._push(token)
         req.last_tok_t = now
         if first:
@@ -797,10 +1107,33 @@ class GenerationEngine:
 
     # -- warmup --------------------------------------------------------------
     def _warmup(self):
-        """Compile EVERY prefill-bucket executable plus the decode
-        executable before serving traffic, so no request ever pays an
-        XLA compile mid-generation (the first prefill of a cold bucket
-        would otherwise stall every running sequence's next token)."""
+        """Compile every executable before serving traffic, so no
+        request ever pays an XLA compile mid-generation. Ragged mode
+        has exactly ONE executable to warm (a two-token request driven
+        through prefill-chunk + decode phases of the same program);
+        two_lane warms the whole prefill-bucket ladder + decode."""
+        if self.mode == "ragged":
+            if self.spec_tokens > 0 and hasattr(self._draft, "warmup"):
+                # the draft's jitted length-bucket ladder is part of
+                # the no-compile-mid-generation contract too
+                self._draft.warmup(self.spec_tokens)
+            slot = self.cache.allocate_slot(2)
+            req = _GenRequest(np.asarray([0, 0], np.int64), 1, None,
+                              None, GenerationStream(self), None)
+            req.slot = slot
+            self._by_slot[slot] = req
+            try:
+                for _ in range(4):
+                    if slot not in self._by_slot:
+                        break
+                    self._ragged_step()
+            finally:
+                if slot in self._by_slot:
+                    self._retire(slot, "length")
+                elif self.cache.is_active(slot):
+                    self.cache.release(slot)
+            self.metrics.__init__()
+            return
         for bucket in self._seq_buckets:
             slot = self.cache.allocate_slot(2)
             try:

@@ -24,6 +24,14 @@ Page 0 is permanently reserved as the JUNK page: idle decode lanes and
 batch-padding rows point their tables at it, so their (discarded)
 writes can never corrupt a live sequence.
 
+``dtype="int8"`` selects the QUANTIZED pool (ragged engine only): K/V
+pages store blockwise-int8 values plus one fp32 scale per
+(head, token slot) — the kernels/quant.py block unit with
+block = head_dim. A page then costs ~1/3.6 the fp32 bytes
+(``page_bytes``), so the same HBM budget holds ~3.6x the pages and
+~2x+ the resident sequences — the capacity multiplier
+tools/generation_bench.py --int8 gates.
+
 Exhaustion is backpressure, not corruption: ``allocate_slot`` /
 ``ensure_capacity`` raise ``PagePoolExhausted``; the engine responds
 by delaying admission (queued requests wait for pages) or by evicting
@@ -62,11 +70,15 @@ class PagedKVCache:
         self.max_seqs = int(max_seqs)
         self.max_pages_per_seq = int(max_pages_per_seq)
         self.dtype = dtype
+        self.quantized = dtype == "int8"
         self._lock = threading.Lock()
         # device pools, one K + one V per layer (lazy: first access
-        # allocates, so constructing a cache in a test costs nothing)
+        # allocates, so constructing a cache in a test costs nothing);
+        # int8 pools carry fp32 scale planes [KVH, P, ps] alongside
         self._k_pages: Optional[List[Any]] = None
         self._v_pages: Optional[List[Any]] = None
+        self._k_scales: Optional[List[Any]] = None
+        self._v_scales: Optional[List[Any]] = None
         # host bookkeeping
         self.block_tables = np.zeros((max_seqs, max_pages_per_seq), np.int32)
         self.lengths = np.zeros(max_seqs, np.int32)
@@ -88,6 +100,14 @@ class PagedKVCache:
                              for _ in range(self.num_layers)]
             self._v_pages = [jnp.zeros(shape, self.dtype)
                              for _ in range(self.num_layers)]
+            if self.quantized:
+                # scale 1.0 everywhere: a junk/unwritten slot
+                # dequantizes to 0.0, never to NaN/garbage
+                sshape = shape[:3]
+                self._k_scales = [jnp.ones(sshape, "float32")
+                                  for _ in range(self.num_layers)]
+                self._v_scales = [jnp.ones(sshape, "float32")
+                                  for _ in range(self.num_layers)]
 
     @property
     def k_pages(self) -> List[Any]:
@@ -99,13 +119,51 @@ class PagedKVCache:
         self._ensure_buffers()
         return self._v_pages
 
-    def set_buffers(self, k_pages: List[Any], v_pages: List[Any]) -> None:
+    @property
+    def k_scales(self) -> List[Any]:
+        self._ensure_buffers()
+        return self._k_scales
+
+    @property
+    def v_scales(self) -> List[Any]:
+        self._ensure_buffers()
+        return self._v_scales
+
+    def set_buffers(self, k_pages: List[Any], v_pages: List[Any],
+                    k_scales: Optional[List[Any]] = None,
+                    v_scales: Optional[List[Any]] = None) -> None:
         """Swap in the functionally-updated pools fetched from a
-        prefill/decode step."""
+        prefill/decode/ragged step (scale planes too for the int8
+        pool)."""
         if len(k_pages) != self.num_layers or len(v_pages) != self.num_layers:
             raise ValueError("set_buffers: wrong layer count")
         self._k_pages = list(k_pages)
         self._v_pages = list(v_pages)
+        if self.quantized:
+            if k_scales is None or v_scales is None:
+                raise ValueError("set_buffers: int8 pool needs scale planes")
+            self._k_scales = list(k_scales)
+            self._v_scales = list(v_scales)
+
+    @staticmethod
+    def page_bytes(num_kv_heads: int, head_dim: int, page_size: int,
+                   dtype: str) -> int:
+        """HBM bytes ONE page costs per layer (K + V, scale planes
+        included for int8) — the capacity arithmetic the int8 bench
+        gates its ~2x-resident-sequences claim on."""
+        slots = num_kv_heads * page_size
+        if dtype == "int8":
+            return 2 * (slots * head_dim + 4 * slots)   # int8 body + scales
+        import numpy as np
+
+        item = 2 if dtype == "bfloat16" else np.dtype(dtype).itemsize
+        return 2 * slots * head_dim * item
+
+    def pool_bytes(self) -> int:
+        """Total device bytes of the page pools across layers."""
+        return (self.num_layers * self.num_pages
+                * self.page_bytes(self.num_kv_heads, self.head_dim,
+                                  self.page_size, self.dtype))
 
     # -- capacity accounting -------------------------------------------------
     def pages_needed(self, n_tokens: int) -> int:
@@ -221,6 +279,7 @@ class PagedKVCache:
                 "max_seqs": self.max_seqs,
                 "evictions_total": self.evictions_total,
                 "page_allocations_total": self.allocations_total,
+                "pool_bytes": self.pool_bytes(),
             }
 
     def check_integrity(self) -> None:

@@ -20,19 +20,33 @@ weights a saved LM was trained/exported with serve both lanes):
 an inference model for the Predictor (build_gpt_lm always wires a CE
 loss, which would drag a labels feed into serving).
 
+``build_ragged_step_program(cfg, geom, chunk, kv_dtype)`` is the
+tentpole successor to the pair above: ONE [lanes, chunk] executable
+whose rows are whatever each sequence needs this step — a prefill
+chunk, a decode token, a decode token + speculative drafts, or an
+idle lane — through ``kernels/ragged_paged_attention``. The engine's
+"ragged" mode (the default) runs its whole life through it; the
+prefill/decode pair remains for mode="two_lane" (the identity
+oracle).
+
 Feed-name contract (the engine assembles these every step):
-  gen_tokens       [B, S] / [B, 1] int64
+  gen_tokens       [B, S] / [B, 1] / [B, chunk] int64
+  gen_pos_ids      [B, chunk] int64  ragged only: absolute position
+                               ids of each chunk token (row start + j)
   gen_positions    [B] int64   absolute position of each new row
-                               (prefill: 0; decode: current length)
+                               (prefill: 0; decode: current length;
+                               ragged: the row's chunk start)
   gen_num_valid    [B] int32   real rows in this window (prefill: the
                                true prompt length; decode: 1 active /
-                               0 idle lane)
+                               0 idle lane; ragged: chunk tokens)
   gen_attend_lens  [B] int32   decode only: tokens to attend over
                                (= position + 1)
   gen_last_index   [B] int64   prefill only: index of the true last
                                prompt token (length - 1)
   gen_block_tables [B, max_pages_per_seq] int32
   gen_k_pages_{l} / gen_v_pages_{l}   the per-layer page pools
+  gen_k_scales_{l} / gen_v_scales_{l} int8 pools only: fp32 scale
+                               planes [kv_heads, pages, page_size]
 """
 
 from __future__ import annotations
@@ -47,7 +61,7 @@ from ..models.gpt import GPTConfig, _attr
 from ..param_attr import ParamAttr
 
 __all__ = ["CacheGeometry", "build_lm_program", "build_prefill_program",
-           "build_decode_program", "GPTConfig"]
+           "build_decode_program", "build_ragged_step_program", "GPTConfig"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -62,15 +76,27 @@ class CacheGeometry:
         return self.max_pages_per_seq * self.page_size
 
 
-def _page_feeds(cfg: GPTConfig, geom: CacheGeometry):
+def _page_feeds(cfg: GPTConfig, geom: CacheGeometry, dtype: str = "float32"):
     kvh = cfg.num_heads
     d = cfg.hidden_size // cfg.num_heads
     shape = [kvh, geom.num_pages, geom.page_size, d]
-    kps = [layers.data(f"gen_k_pages_{i}", shape, append_batch_size=False)
+    kps = [layers.data(f"gen_k_pages_{i}", shape, append_batch_size=False,
+                       dtype=dtype)
            for i in range(cfg.num_layers)]
-    vps = [layers.data(f"gen_v_pages_{i}", shape, append_batch_size=False)
+    vps = [layers.data(f"gen_v_pages_{i}", shape, append_batch_size=False,
+                       dtype=dtype)
            for i in range(cfg.num_layers)]
     return kps, vps
+
+
+def _scale_feeds(cfg: GPTConfig, geom: CacheGeometry):
+    kvh = cfg.num_heads
+    shape = [kvh, geom.num_pages, geom.page_size]
+    kss = [layers.data(f"gen_k_scales_{i}", shape, append_batch_size=False)
+           for i in range(cfg.num_layers)]
+    vss = [layers.data(f"gen_v_scales_{i}", shape, append_batch_size=False)
+           for i in range(cfg.num_layers)]
+    return kss, vss
 
 
 def _ln(x, name):
@@ -206,6 +232,76 @@ def build_prefill_program(cfg: GPTConfig, seq_len: int, geom: CacheGeometry):
         next_tok = layers.argmax(last_logits, axis=-1)   # [B]
     fetches = [next_tok] + [p[0] for p in out_pages] + \
         [p[1] for p in out_pages]
+    return main, fetches
+
+
+def build_ragged_step_program(cfg: GPTConfig, geom: CacheGeometry,
+                              chunk: int, kv_dtype: str = "float32"):
+    """THE ragged executable: one [lanes, chunk] mixed batch serves
+    prefill chunks, decode rows and speculative-verify rows side by
+    side — the whole GenerationEngine life is this ONE program bound
+    to ONE BoundStep.
+
+    Per row r the engine feeds up to ``chunk`` NEW tokens starting at
+    absolute position gen_positions[r] (gen_num_valid[r] of them are
+    real; 0 = idle lane). Each layer scatters the chunk's K/V into the
+    page pool (int8-quantized when ``kv_dtype == "int8"``), then
+    ragged_paged_attention attends every chunk token over its
+    sequence's full prefix through the block tables. The head runs
+    over ALL chunk positions and argmax is fetched for every position
+    — the engine reads the last valid column for plain rows and every
+    column for speculative verification (greedy target tokens at each
+    draft offset).
+
+    Returns (program, fetches) with fetch order
+    [next_tokens(R*C), k_pages.., v_pages.. (, k_scales.., v_scales..)].
+    """
+    quantized = kv_dtype == "int8"
+    main, startup = Program(), Program()
+    with program_guard(main, startup), unique_name.guard():
+        tokens = layers.data("gen_tokens", [chunk], dtype="int64")
+        pos_ids = layers.data("gen_pos_ids", [chunk], dtype="int64")
+        positions = layers.data("gen_positions", [], dtype="int64")
+        num_valid = layers.data("gen_num_valid", [], dtype="int32")
+        tables = layers.data("gen_block_tables", [geom.max_pages_per_seq],
+                             dtype="int32")
+        kps, vps = _page_feeds(cfg, geom,
+                               "int8" if quantized else "float32")
+        kss = vss = [None] * cfg.num_layers
+        if quantized:
+            kss, vss = _scale_feeds(cfg, geom)
+        from ..kernels import (kv_cache_write_layer,
+                               quantized_kv_cache_write_layer,
+                               ragged_paged_attention_layer)
+
+        x = layers.elementwise_add(_embed(tokens, cfg),
+                                   _pos_embed(pos_ids, cfg))   # [R, C, H]
+        out_pages = []
+        for i in range(cfg.num_layers):
+            pre = f"dec{i}"
+            ln1 = _ln(x, f"{pre}_ln1")
+            q, k, v = _qkv_split(ln1, cfg, pre)
+            if quantized:
+                ko, vo, kso, vso = quantized_kv_cache_write_layer(
+                    kps[i], vps[i], kss[i], vss[i], k, v, tables,
+                    positions, num_valid, cfg.num_heads)
+            else:
+                ko, vo = kv_cache_write_layer(
+                    kps[i], vps[i], k, v, tables, positions, num_valid,
+                    cfg.num_heads)
+                kso = vso = None
+            out_pages.append((ko, vo, kso, vso))
+            ctx = ragged_paged_attention_layer(
+                q, ko, vo, tables, positions, num_valid, cfg.num_heads,
+                k_scales_var=kso, v_scales_var=vso)
+            x = _proj_ffn(x, ctx, cfg, pre)
+        logits = _head(x, cfg)                      # [R, C, V]
+        next_tok = layers.argmax(
+            layers.reshape(logits, [-1, cfg.vocab_size]), axis=-1)  # [R*C]
+    fetches = ([next_tok] + [p[0] for p in out_pages]
+               + [p[1] for p in out_pages])
+    if quantized:
+        fetches += [p[2] for p in out_pages] + [p[3] for p in out_pages]
     return main, fetches
 
 

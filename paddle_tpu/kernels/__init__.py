@@ -13,7 +13,12 @@ attention/ffn/layer_norm/adam/softmax-ce):
   * paged attention + kv_cache_write — decode-step attention over
     paged K/V with block tables (kernels/paged_attention.py, wrapping
     jax.experimental.pallas.ops.tpu.paged_attention on TPU), the
-    kernel layer under paddle_tpu.generation's continuous batching
+    kernel layer under paddle_tpu.generation's two-lane engine
+  * ragged paged attention + quantized KV write — ONE kernel serving
+    mixed prefill chunks and decode rows side by side over the paged
+    pool (kernels/ragged_paged_attention.py, custom Pallas lowering),
+    with an int8-page variant reusing the kernels/quant.py blockwise
+    machinery — the kernel under the ragged GenerationEngine
   * adam — deliberately NOT a kernel: a pure elementwise chain that
     XLA already fuses into one loop (verified in lowered HLO)
 
@@ -26,4 +31,8 @@ from .flash_attention import flash_attention, flash_attention_layer
 from .layer_norm import fused_layer_norm, layer_norm_pallas
 from .paged_attention import (kv_cache_write, kv_cache_write_layer,
                               paged_attention, paged_attention_layer)
+from .ragged_paged_attention import (quantized_kv_cache_write,
+                                     quantized_kv_cache_write_layer,
+                                     ragged_paged_attention,
+                                     ragged_paged_attention_layer)
 from .softmax_xent import fused_softmax_xent

@@ -1,0 +1,441 @@
+"""Ragged paged attention: ONE kernel for mixed prefill + decode rows.
+
+The two-lane GenerationEngine paid padding waste twice — a prefill
+executable padded to the seq bucket and a decode executable whose
+fixed lanes idle — and a two-executable step loop. Ragged Paged
+Attention (arXiv:2604.15464, PAPERS.md [1]) collapses both into one
+batch: each row of the ragged batch is a CHUNK of new tokens for one
+sequence — a prefill chunk of up to `chunk` tokens, a single decode
+token, a decode token plus k speculative draft tokens, or nothing at
+all (an idle lane, num_valid = 0) — and one kernel attends every
+chunk over its sequence's paged K/V through the block tables.
+
+Semantics (the contract tests/test_ragged.py diffs against a dense
+oracle): query j of row b sits at absolute position start_pos[b] + j
+and attends keys 0 .. start_pos[b] + j of its sequence — full prefix
+out of the page pool plus causal attention within the chunk (whose
+K/V the step's kv_cache_write has already scattered into the pool
+before this op runs). Rows j >= num_valid[b] and whole rows with
+num_valid[b] == 0 are DEFINED as zeros — never NaN, so idle lanes and
+batch padding can ride the same executable for free.
+
+Three ops, all registered (proglint PTL030/PTL020-022 first-class,
+no lint_suppress anywhere):
+
+  ragged_paged_attention    Q [B, C, H*D] x pages -> Out [B, C, H*D]
+  ragged_paged_attention_q  same, over int8 pages + per-(head, slot)
+                            fp32 scales (the quantized-KV serving path)
+  kv_cache_write_q          quantized twin of kv_cache_write: new K/V
+                            rows are blockwise-int8 quantized (one
+                            scale per [head_dim] row — the
+                            kernels/quant.py EQuARX machinery) on the
+                            way into the pool, roughly quadrupling the
+                            tokens a byte budget holds (junk-page
+                            routing for invalid rows preserved)
+
+Routing matches every other fused kernel: a Pallas/Mosaic lowering on
+real TPU or under PADDLE_TPU_FORCE_PALLAS=1 (tools/aot_check.py
+validates it against the v5e compiler: rows ragged_attention_{f32,
+bf16,int8kv} + ragged_kv_write_int8, runnable under
+PT_AOT_ONLY=ragged), the pure-JAX reference below everywhere else —
+including PADDLE_TPU_KERNEL_INTERPRET=1, which runs the real kernel
+body in interpreter mode. The reference is the numerics oracle AND the
+CPU-CI execution path.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .quant import blockwise_dequantize, blockwise_quantize
+
+_logger = logging.getLogger("paddle_tpu.ragged_paged_attention")
+
+NEG_INF = -1e30
+LANES = 128  # TPU minor tile; m/l scratch is lane-replicated
+
+
+def _pallas_mode() -> Optional[str]:
+    # same routing contract as flash/paged attention: interpret env
+    # wins, then real TPU / forced-Pallas AOT validation, else None
+    from .flash_attention import _pallas_mode as _fa_mode
+
+    return _fa_mode()
+
+
+# -- reference (the oracle + the CPU-CI path) --------------------------------
+
+
+def _gather_kv(pages, scales, page_indices):
+    """[KVH, P, ps, D] pages -> [B, KVH, maxp*ps, D] fp32 windows per
+    the block tables, dequantizing int8 pages against their
+    per-(head, slot) scales on the way out."""
+    B, maxp = page_indices.shape
+    KVH, _P, ps, D = pages.shape
+    win = jnp.transpose(pages[:, page_indices], (1, 0, 2, 3, 4))
+    win = win.astype(jnp.float32).reshape(B, KVH, maxp * ps, D)
+    if scales is not None:
+        s = jnp.transpose(scales[:, page_indices], (1, 0, 2, 3))
+        win = blockwise_dequantize(win, s.reshape(B, KVH, maxp * ps))
+    return win
+
+
+def _reference_ragged(q, k_pages, v_pages, start_pos, num_valid,
+                      page_indices, sm_scale: float, k_scales, v_scales):
+    """Pure-JAX oracle: gather each row's pages into a contiguous
+    window, apply the ragged causal mask (key_pos <= start + j), plain
+    fp32 softmax. O(B * C * maxp * ps) HBM — exactly right for CPU CI
+    and the correctness tests."""
+    B, C, H, D = q.shape
+    KVH = k_pages.shape[0]
+    maxp, ps = page_indices.shape[1], k_pages.shape[2]
+    K = maxp * ps
+    k = _gather_kv(k_pages, k_scales, page_indices)
+    v = _gather_kv(v_pages, v_scales, page_indices)
+    if KVH != H:  # grouped-query: repeat KV heads over the query groups
+        k = jnp.repeat(k, H // KVH, axis=1)
+        v = jnp.repeat(v, H // KVH, axis=1)
+    s = jnp.einsum("bchd,bhkd->bhck", q.astype(jnp.float32) * sm_scale, k)
+    kpos = jnp.arange(K, dtype=jnp.int32)
+    qpos = start_pos[:, None] + jnp.arange(C, dtype=jnp.int32)[None, :]
+    mask = kpos[None, None, :] <= qpos[:, :, None]           # [B, C, K]
+    s = jnp.where(mask[:, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhck,bhkd->bchd", p, v)
+    # invalid rows (j >= num_valid, idle lanes with num_valid == 0)
+    # are DEFINED zero — all-masked softmax NaN must never escape
+    row_ok = (jnp.arange(C, dtype=jnp.int32)[None, :]
+              < num_valid[:, None])                          # [B, C]
+    return jnp.where(row_ok[..., None, None], o, 0.0).astype(q.dtype)
+
+
+# -- Pallas lowering ---------------------------------------------------------
+
+
+def _make_ragged_kernel(C: int, ps: int, maxp: int, sm_scale: float,
+                        quantized: bool):
+    from jax.experimental import pallas as pl
+
+    def kernel(*refs):
+        it = iter(refs)
+        tables_ref, starts_ref, nvalid_ref = next(it), next(it), next(it)
+        q_ref, k_ref, v_ref = next(it), next(it), next(it)
+        ks_ref = next(it) if quantized else None
+        vs_ref = next(it) if quantized else None
+        o_ref = next(it)
+        acc_ref, m_ref, l_ref = next(it), next(it), next(it)
+
+        b, p = pl.program_id(0), pl.program_id(2)
+        start = starts_ref[b]
+        total = start + nvalid_ref[b]    # keys written for this row
+        del tables_ref                   # consumed by the index maps
+
+        @pl.when(p == 0)
+        def init():  # noqa: ANN202
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+            m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+            l_ref[...] = jnp.zeros_like(l_ref)
+
+        @pl.when(p * ps < total)
+        def body():  # noqa: ANN202
+            q = q_ref[0, 0].astype(jnp.float32) * sm_scale     # [C, D]
+            k = k_ref[0, 0].astype(jnp.float32)                # [ps, D]
+            v = v_ref[0, 0].astype(jnp.float32)
+            if quantized:
+                # scale planes ride as [KVH, P, ps, 1] blocks (Mosaic
+                # wants the trailing dims tile-aligned or exact)
+                k = k * ks_ref[0, 0].astype(jnp.float32)
+                v = v * vs_ref[0, 0].astype(jnp.float32)
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)            # [C, ps]
+            kpos = p * ps + jax.lax.broadcasted_iota(
+                jnp.int32, (C, ps), 1)
+            qpos = start + jax.lax.broadcasted_iota(
+                jnp.int32, (C, ps), 0)
+            s = jnp.where(kpos <= qpos, s, NEG_INF)
+            m_prev = m_ref[:, 0]
+            m_curr = s.max(axis=-1)
+            m_next = jnp.maximum(m_prev, m_curr)
+            alpha = jnp.exp(m_prev - m_next)
+            pexp = jnp.exp(s - m_next[:, None])
+            l_ref[...] = jnp.broadcast_to(
+                (alpha * l_ref[:, 0] + pexp.sum(axis=-1))[:, None],
+                l_ref.shape)
+            m_ref[...] = jnp.broadcast_to(m_next[:, None], m_ref.shape)
+            acc_ref[...] = acc_ref[...] * alpha[:, None] + jnp.dot(
+                pexp, v, preferred_element_type=jnp.float32)
+
+        @pl.when(p == maxp - 1)
+        def finish():  # noqa: ANN202
+            denom = l_ref[:, 0]
+            denom = jnp.where(denom == 0.0, 1.0, denom)   # len-0 row -> 0
+            o_ref[0, 0] = (acc_ref[...] / denom[:, None]).astype(o_ref.dtype)
+
+    return kernel
+
+
+def _ragged_pallas(q, k_pages, v_pages, start_pos, num_valid, page_indices,
+                   sm_scale: float, k_scales, v_scales, interpret: bool):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, C, H, D = q.shape
+    KVH, _P, ps, _ = k_pages.shape
+    maxp = page_indices.shape[1]
+    quantized = k_scales is not None
+    # sublane-align the chunk so the [C, D] scratch tiles cleanly
+    Cp = -(-C // 8) * 8
+    qt = jnp.transpose(q, (0, 2, 1, 3))                   # [B, H, C, D]
+    if Cp != C:
+        qt = jnp.pad(qt, ((0, 0), (0, 0), (0, Cp - C), (0, 0)))
+    group = H // KVH
+
+    def kv_idx(b, h, p, tables, starts, nvalid):
+        del starts, nvalid
+        return (h // group, tables[b, p], 0, 0)
+
+    def scale_idx(b, h, p, tables, starts, nvalid):
+        del starts, nvalid
+        return (h // group, tables[b, p], 0, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, 1, Cp, D),
+                     lambda b, h, p, *refs: (b, h, 0, 0)),    # q
+        pl.BlockSpec((1, 1, ps, D), kv_idx),                  # k page
+        pl.BlockSpec((1, 1, ps, D), kv_idx),                  # v page
+    ]
+    args = [qt, k_pages, v_pages]
+    if quantized:
+        in_specs += [pl.BlockSpec((1, 1, ps, 1), scale_idx),
+                     pl.BlockSpec((1, 1, ps, 1), scale_idx)]
+        args += [k_scales[..., None], v_scales[..., None]]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(B, H, maxp),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, Cp, D),
+                               lambda b, h, p, *refs: (b, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((Cp, D), jnp.float32),       # acc
+            pltpu.VMEM((Cp, LANES), jnp.float32),   # m
+            pltpu.VMEM((Cp, LANES), jnp.float32),   # l
+        ],
+    )
+    kernel = _make_ragged_kernel(Cp, ps, maxp, sm_scale, quantized)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, Cp, D), q.dtype),
+        interpret=interpret,
+    )(page_indices, start_pos, num_valid, *args)
+    out = jnp.transpose(out[:, :, :C], (0, 2, 1, 3))      # [B, C, H, D]
+    row_ok = (jnp.arange(C, dtype=jnp.int32)[None, :]
+              < num_valid[:, None])
+    return jnp.where(row_ok[..., None, None], out, 0.0)
+
+
+# -- public entry ------------------------------------------------------------
+
+
+def ragged_paged_attention(q, k_pages, v_pages, start_pos, num_valid,
+                           page_indices, *, sm_scale: Optional[float] = None,
+                           k_scales=None, v_scales=None):
+    """Attend a ragged batch of new-token chunks over paged K/V.
+
+    q:            [B, C, H, D] — up to C new tokens per sequence
+                  (prefill chunk / decode row / decode + draft tokens)
+    k_pages/v_pages: [KVH, P, ps, D]; int8 with ``k_scales/v_scales``
+                  [KVH, P, ps] fp32 for the quantized-KV pool
+    start_pos:    [B] int32 — absolute position of q[:, 0]
+    num_valid:    [B] int32 — real rows in each chunk (0 = idle lane)
+    page_indices: [B, maxp] int32 block tables
+
+    Returns [B, C, H, D]; rows j >= num_valid[b] are zeros. Query j
+    attends keys 0 .. start_pos[b] + j (the chunk's own K/V has been
+    written by kv_cache_write before this op in every program). The
+    softmax scale (default 1/sqrt(D)) applies to q identically on both
+    paths — CPU CI numerics ARE the TPU numerics.
+    """
+    B, C, H, D = q.shape
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(D)
+    start_pos = start_pos.astype(jnp.int32)
+    num_valid = num_valid.astype(jnp.int32)
+    page_indices = page_indices.astype(jnp.int32)
+    mode = _pallas_mode()
+    if mode is not None:
+        try:
+            return _ragged_pallas(q, k_pages, v_pages, start_pos, num_valid,
+                                  page_indices, scale, k_scales, v_scales,
+                                  interpret=(mode == "interpret"))
+        except Exception:  # noqa: BLE001 — a kernel regression must be loud
+            import os
+
+            if os.environ.get("PADDLE_TPU_FORCE_PALLAS") == "1":
+                # the AOT-validation contract: never record ok=true for
+                # a kernel that silently fell back
+                raise
+            _logger.warning(
+                "ragged_paged_attention Pallas kernel failed; falling back "
+                "to the reference gather implementation", exc_info=True)
+    return _reference_ragged(q, k_pages, v_pages, start_pos, num_valid,
+                             page_indices, scale, k_scales, v_scales)
+
+
+# -- quantized KV page write -------------------------------------------------
+
+
+def quantized_kv_cache_write(k_pages, v_pages, k_scales, v_scales,
+                             k_new, v_new, page_indices, positions,
+                             num_valid):
+    """int8 twin of paged_attention.kv_cache_write: each new [D] row
+    quantizes to int8 with one fp32 max-abs/127 scale (the
+    kernels/quant.py block unit with block = head_dim), then scatters
+    into the int8 pool + the [KVH, P, ps] scale planes. Invalid rows
+    route to junk page 0 exactly like the fp32 write. Pure functional;
+    XLA fuses quantize + scatter into the surrounding step."""
+    B, S, KVH, D = k_new.shape
+    ps = int(k_pages.shape[2])
+    page_indices = page_indices.astype(jnp.int32)
+    positions = positions.astype(jnp.int32)
+    num_valid = num_valid.astype(jnp.int32)
+    offs = positions[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]
+    valid = jnp.arange(S, dtype=jnp.int32)[None, :] < num_valid[:, None]
+    table_col = jnp.clip(offs // ps, 0, page_indices.shape[1] - 1)
+    page = jnp.take_along_axis(page_indices, table_col, axis=1)   # [B, S]
+    page = jnp.where(valid, page, 0)        # invalid rows -> junk page 0
+    slot = jnp.where(valid, offs % ps, 0)
+    # [KVH, B, S, D] rows -> blockwise int8 (one scale per [D] row)
+    kq, ks = blockwise_quantize(
+        jnp.transpose(k_new, (2, 0, 1, 3)).astype(jnp.float32)
+        .reshape(KVH * B * S, D))
+    vq, vs = blockwise_quantize(
+        jnp.transpose(v_new, (2, 0, 1, 3)).astype(jnp.float32)
+        .reshape(KVH * B * S, D))
+    kq = kq.reshape(KVH, B, S, D)
+    vq = vq.reshape(KVH, B, S, D)
+    k_pages = k_pages.at[:, page, slot, :].set(kq)
+    v_pages = v_pages.at[:, page, slot, :].set(vq)
+    k_scales = k_scales.at[:, page, slot].set(ks.reshape(KVH, B, S))
+    v_scales = v_scales.at[:, page, slot].set(vs.reshape(KVH, B, S))
+    return k_pages, v_pages, k_scales, v_scales
+
+
+# -- program-level layers ----------------------------------------------------
+
+
+def ragged_paged_attention_layer(q_var, k_pages_var, v_pages_var,
+                                 tables_var, positions_var, num_valid_var,
+                                 num_heads: int, k_scales_var=None,
+                                 v_scales_var=None):
+    """Emit the ragged attention op: Q [B, C, H*D] over the page pool.
+    One op per decoder layer — the whole mixed prefill+decode step
+    stays a single XLA executable. Passing the scale Variables selects
+    the int8-pool variant."""
+    from ..layer_helper import LayerHelper
+    from ..layers.nn import _out
+
+    quantized = k_scales_var is not None
+    op = "ragged_paged_attention_q" if quantized else "ragged_paged_attention"
+    helper = LayerHelper(op)
+    out = _out(helper, q_var, shape=q_var.shape)
+    inputs = {"Q": [q_var], "KPages": [k_pages_var], "VPages": [v_pages_var],
+              "BlockTables": [tables_var], "Positions": [positions_var],
+              "NumValid": [num_valid_var]}
+    if quantized:
+        inputs["KScales"] = [k_scales_var]
+        inputs["VScales"] = [v_scales_var]
+    helper.append_op(type=op, inputs=inputs, outputs={"Out": [out]},
+                     attrs={"num_heads": num_heads})
+    return out
+
+
+def quantized_kv_cache_write_layer(k_pages_var, v_pages_var, k_scales_var,
+                                   v_scales_var, k_var, v_var, tables_var,
+                                   positions_var, num_valid_var,
+                                   num_heads: int):
+    """Emit ``kv_cache_write_q``; returns the functionally updated
+    (k_pages, v_pages, k_scales, v_scales) Variables the downstream
+    ragged attention reads and the engine fetches back."""
+    from ..layer_helper import LayerHelper
+    from ..layers.nn import _out
+
+    helper = LayerHelper("kv_cache_write_q")
+    out_k = _out(helper, k_pages_var, shape=k_pages_var.shape)
+    out_v = _out(helper, v_pages_var, shape=v_pages_var.shape)
+    out_ks = _out(helper, k_scales_var, shape=k_scales_var.shape)
+    out_vs = _out(helper, v_scales_var, shape=v_scales_var.shape)
+    helper.append_op(
+        type="kv_cache_write_q",
+        inputs={"KPages": [k_pages_var], "VPages": [v_pages_var],
+                "KScales": [k_scales_var], "VScales": [v_scales_var],
+                "K": [k_var], "V": [v_var], "BlockTables": [tables_var],
+                "Positions": [positions_var], "NumValid": [num_valid_var]},
+        outputs={"OutKPages": [out_k], "OutVPages": [out_v],
+                 "OutKScales": [out_ks], "OutVScales": [out_vs]},
+        attrs={"num_heads": num_heads},
+    )
+    return out_k, out_v, out_ks, out_vs
+
+
+# -- op registration ---------------------------------------------------------
+from ..core.registry import register_op  # noqa: E402
+
+
+def _lower_ragged(ins, op, quantized: bool):
+    q = ins["Q"][0]                       # [B, C, H*D] layer layout
+    h = int(op.attrs["num_heads"])
+    B, C, HD = q.shape
+    D = HD // h
+    o = ragged_paged_attention(
+        q.reshape(B, C, h, D), ins["KPages"][0], ins["VPages"][0],
+        ins["Positions"][0], ins["NumValid"][0], ins["BlockTables"][0],
+        k_scales=ins["KScales"][0] if quantized else None,
+        v_scales=ins["VScales"][0] if quantized else None)
+    return {"Out": [o.reshape(B, C, HD)]}
+
+
+@register_op("ragged_paged_attention",
+             inputs=("Q", "KPages", "VPages", "BlockTables", "Positions",
+                     "NumValid"),
+             outputs=("Out",),
+             no_grad=("BlockTables", "Positions", "NumValid"),
+             stop_gradient=True)
+def _ragged_paged_attention_op(ctx, op, ins):
+    return _lower_ragged(ins, op, quantized=False)
+
+
+@register_op("ragged_paged_attention_q",
+             inputs=("Q", "KPages", "VPages", "KScales", "VScales",
+                     "BlockTables", "Positions", "NumValid"),
+             outputs=("Out",),
+             no_grad=("KScales", "VScales", "BlockTables", "Positions",
+                      "NumValid"),
+             stop_gradient=True)
+def _ragged_paged_attention_q_op(ctx, op, ins):
+    return _lower_ragged(ins, op, quantized=True)
+
+
+@register_op("kv_cache_write_q",
+             inputs=("KPages", "VPages", "KScales", "VScales", "K", "V",
+                     "BlockTables", "Positions", "NumValid"),
+             outputs=("OutKPages", "OutVPages", "OutKScales", "OutVScales"),
+             no_grad=("BlockTables", "Positions", "NumValid"),
+             stop_gradient=True)
+def _kv_cache_write_q_op(ctx, op, ins):
+    k, v = ins["K"][0], ins["V"][0]       # [B, S, H*D] layer layout
+    h = int(op.attrs["num_heads"])
+    B, S, HD = k.shape
+    D = HD // h
+    kp, vp, ks, vs = quantized_kv_cache_write(
+        ins["KPages"][0], ins["VPages"][0], ins["KScales"][0],
+        ins["VScales"][0], k.reshape(B, S, h, D), v.reshape(B, S, h, D),
+        ins["BlockTables"][0], ins["Positions"][0], ins["NumValid"][0])
+    return {"OutKPages": [kp], "OutVPages": [vp],
+            "OutKScales": [ks], "OutVScales": [vs]}

@@ -331,8 +331,11 @@ def watch_generation(metrics) -> None:
     """Called by generation.GenerationMetrics.__init__: the engine's
     counters/histograms + page-pool stats become the
     ``paddle_generation_*{engine=}`` family group — per-phase
-    prefill/decode occupancy, page-pool utilization, tokens/sec and
-    the TTFT / inter-token latency quantiles in the one scrape."""
+    prefill/decode occupancy, page-pool utilization, tokens/sec, the
+    TTFT / inter-token latency quantiles, and the speculative-decoding
+    health series (``paddle_generation_spec_proposed_total`` /
+    ``_spec_accepted_total`` / ``_spec_acceptance_rate`` /
+    ``_spec_accepted_tokens_per_step``) in the one scrape."""
     _obs_id(metrics)
     _generation.add(metrics)
 

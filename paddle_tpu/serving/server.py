@@ -17,8 +17,13 @@ three endpoints a serving deployment actually needs:
                           IS SAMPLED (first line lands at
                           time-to-first-token, long before the
                           generation completes), then a final
-                          {"done": true, "finish_reason": ..} line.
-                          stream=false buffers into one JSON object.
+                          {"done": true, "finish_reason": ..,
+                          "usage": {prompt/completion/verified/
+                          accepted_draft token counts}} line — the
+                          usage fragment makes speculative-decoding
+                          behavior visible per request.
+                          stream=false buffers into one JSON object
+                          (same usage fragment).
                           Requires a GenerationEngine
                           (ServingServer(..., generation_engine=)).
     GET  /healthz      -> 200 while serving, 503 once closed (a load
@@ -324,6 +329,15 @@ class _Handler(BaseHTTPRequestHandler):
         except ValueError as e:
             self._reply_json(400, {"error": str(e)})
             return
+        def usage_fragment():
+            # per-request spec-decode visibility: how many tokens the
+            # draft proposed AND the target accepted vs the total the
+            # target verified — an operator can see speculative
+            # behavior per response, not just in fleet-wide gauges
+            u = stream.usage()
+            u["prompt_tokens"] = len(tokens)
+            return u
+
         if not do_stream:
             try:
                 out = stream.result()
@@ -334,7 +348,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._reply_json(500, {"error": repr(e)})
                 return
             self._reply_json(200, {"tokens": out,
-                                   "finish_reason": stream.finish_reason})
+                                   "finish_reason": stream.finish_reason,
+                                   "usage": usage_fragment()})
             return
         # streamed: chunked NDJSON, one line per token the moment the
         # engine samples it — the whole point of continuous batching is
@@ -359,14 +374,15 @@ class _Handler(BaseHTTPRequestHandler):
                     {"index": n, "token": int(tok)}).encode() + b"\n")
                 n += 1
             tail = {"done": True, "finish_reason": stream.finish_reason,
-                    "n_tokens": n}
+                    "n_tokens": n, "usage": usage_fragment()}
         except OSError:   # stalled (socket.timeout) or hung-up client
             stream.cancel()
             self.close_connection = True
             return
         except Exception as e:  # noqa: BLE001 — deadline/cancel mid-stream
             tail = {"done": True, "finish_reason": stream.finish_reason
-                    or "error", "n_tokens": n, "error": str(e)}
+                    or "error", "n_tokens": n, "error": str(e),
+                    "usage": usage_fragment()}
         try:
             self._write_chunk(json.dumps(tail).encode() + b"\n")
             self.wfile.write(b"0\r\n\r\n")

@@ -258,25 +258,52 @@ class ServiceTimeEstimator:
                 return float(lat["p50"])
         return None
 
-    def generate_service_ms(self, max_new: Optional[int]) -> Optional[float]:
-        """TTFT p50 + max_new x inter-token p50 (both measured by the
-        generation engine; medians for the same shed-must-be-provable
-        reason); None until the engine has served."""
+    def generate_service_ms(self, max_new: Optional[int],
+                            prompt_tokens: Optional[int] = None
+                            ) -> Optional[float]:
+        """TTFT estimate + max_new x inter-token p50; None until the
+        engine has served (medians for the same shed-must-be-provable
+        reason).
+
+        TTFT accounts for CHUNKED prefill: on the ragged engine a
+        prompt of P tokens takes ceil(P / chunk_tokens) steps to reach
+        its first token, so the estimate is chunks x step median — a
+        fat prompt is priced as the several bounded slices it actually
+        costs, not as one monolithic prefill at the global TTFT
+        median (which a mixed workload would badly under/over-state
+        for the tails of the prompt-length distribution)."""
         if self._gen is None:
             return None
         snap = self._gen.metrics.snapshot()
         if not snap["ttft_ms"]["count"]:
             return None
-        ttft = float(snap["ttft_ms"]["p50"] or 0.0)
         itl = float(snap["itl_ms"]["p50"] or 0.0)
         n = int(max_new if max_new is not None
                 else getattr(self._gen, "default_max_new", 16))
+        ttft = float(snap["ttft_ms"]["p50"] or 0.0)
+        # chunk pricing only for the ragged engine: a two_lane engine
+        # prefills in ONE monolithic executable, and pricing it as
+        # chunks x step-median would shed requests it can serve
+        chunk = (int(getattr(self._gen, "chunk_tokens", 0) or 0)
+                 if getattr(self._gen, "mode", "") == "ragged" else 0)
+        step_p50 = float(snap["decode_step_ms"]["p50"] or 0.0)
+        if prompt_tokens and chunk and step_p50 > 0:
+            chunks = -(-int(prompt_tokens) // chunk)
+            # queue-to-lane wait is already in the measured TTFT; keep
+            # its single-chunk share and add the extra chunk steps
+            ttft = max(ttft, chunks * step_p50)
         return ttft + itl * max(0, n - 1)
 
     def service_ms(self, req: _TReq) -> Optional[float]:
         if req.kind == "generate":
+            prompt_tokens = None
+            try:
+                prompt_tokens = len(req.feed)
+            except TypeError:
+                pass
             return self.generate_service_ms(
-                req.gen_args.get("max_new_tokens"))
+                req.gen_args.get("max_new_tokens"),
+                prompt_tokens=prompt_tokens)
         return self.predict_service_ms()
 
 

@@ -390,18 +390,21 @@ def test_drain_close(predictor):
 
 
 def test_decode_is_one_bound_dispatch(predictor):
-    """The per-token hot path: after the first decode step the engine
-    holds ONE BoundStep and every further step reuses it — no new
-    executables, no new bound entries."""
+    """The per-step hot path (tentpole acceptance): the RAGGED engine
+    holds exactly ONE BoundStep for its whole life — prefill chunks,
+    decode rows and mixed batches all reuse it; no new executables,
+    no new bound entries, no prefill-bucket ladder."""
     with _engine(predictor) as eng:
+        assert eng.mode == "ragged"
         eng.generate(_prompts(1)[0], max_new_tokens=4, timeout=300)
-        bound = eng._decode_bound
+        bound = eng._ragged_bound
         assert bound is not None
+        assert eng._decode_bound is None and not eng._prefill_progs
         compiles_before = eng._exe.cache_stats()["jit_compiles"]
         eng.generate(_prompts(1, seed=5)[0], max_new_tokens=6, timeout=300)
-        assert eng._decode_bound is bound
+        assert eng._ragged_bound is bound
         compiles_after = eng._exe.cache_stats()["jit_compiles"]
-        # same seq bucket + same decode program: zero new executables
+        # prefill AND decode of a fresh request: zero new executables
         assert compiles_after == compiles_before
 
 
@@ -421,9 +424,9 @@ def test_metrics_join_unified_registry(predictor):
 
 
 def test_decode_steps_join_request_trace(predictor):
-    """Tentpole contract: with tracing on, decode steps carry
-    flow_from arrows back to the request's submit span, and the decode
-    executable's compile event is tagged generation/decode."""
+    """Tentpole contract: with tracing on, ragged steps carry
+    flow_from arrows back to the request's submit span (prefill
+    chunks, decode and verify rows all live in the SAME step spans)."""
     from paddle_tpu.observability import flight
 
     fluid.set_flags({"observability_tracing": True})
@@ -435,13 +438,12 @@ def test_decode_steps_join_request_trace(predictor):
         evs = [e for e in flight.entries()
                if "generation" in str(e.get("name", ""))]
         names = {e["name"] for e in evs}
-        assert any(n.startswith("generation/prefill") for n in names)
-        assert any(n.startswith("generation/decode_step") for n in names)
+        assert any(n.startswith("generation/ragged_step") for n in names)
         subs = [e for e in evs if e["name"] == "generation/submit"]
-        decs = [e for e in evs if "decode_step" in e["name"]]
-        assert subs and decs
+        steps = [e for e in evs if "ragged_step" in e["name"]]
+        assert subs and steps
         sub_ids = {s["span_id"] for s in subs}
-        assert any(set(e.get("flow_from") or []) & sub_ids for e in decs)
+        assert any(set(e.get("flow_from") or []) & sub_ids for e in steps)
     finally:
         fluid.set_flags({"observability_tracing": False})
 

@@ -537,6 +537,13 @@ COVERED_ELSEWHERE = {
     # kv_cache_write scatter vs oracle + junk-page isolation; both
     # driven end-to-end by the continuous==naive greedy equivalence)
     'paged_attention', 'kv_cache_write',
+    # PR-12 ragged decode ops (tests/test_ragged.py: ragged attention
+    # vs dense oracle f32+bf16 over mixed chunk/decode/len-0 rows,
+    # interpret-mode == reference, int8 variant within the blockwise
+    # quant bound + junk isolation; driven end-to-end by the
+    # ragged==two_lane==oracle equivalence through churn/eviction)
+    'ragged_paged_attention', 'ragged_paged_attention_q',
+    'kv_cache_write_q',
     # PR-9 gradient-collective planner (tests/test_collectives.py:
     # bucketed fp32 bit-identity vs monolithic x4 trajectories, int8
     # quant round-trip bound, exchange==psum-form equivalence, and

@@ -192,6 +192,44 @@ def _child():
             (kpg, kpg, knew, knew, pidx, lens, lens),
             B=Bd, heads=Hh, head_dim=Dd, pages=Pp, page_size=psz)
 
+    # -- ragged paged attention (the ONE mixed prefill+decode kernel) --
+    # generation's ragged engine runs its whole life through this op:
+    # prefill chunks, decode rows and speculative-verify rows in one
+    # [lanes, chunk] batch. Rows compile the custom Pallas kernel for
+    # v5e in f32, bf16 AND the int8-quantized-KV variant (pages int8 +
+    # fp32 scale planes), plus the quantized page write. Run just
+    # these with PT_AOT_ONLY=ragged.
+    from paddle_tpu.kernels.ragged_paged_attention import (
+        quantized_kv_cache_write, ragged_paged_attention as ragged)
+
+    Rl, Ck, Hh, Dd, Pp, psz, maxp = 8, 32, 8, 128, 128, 16, 16
+    ivec = jax.ShapeDtypeStruct((Rl,), jnp.int32)
+    pidx = jax.ShapeDtypeStruct((Rl, maxp), jnp.int32)
+    for tag, dt in (("f32", jnp.float32), ("bf16", bf)):
+        qa = jax.ShapeDtypeStruct((Rl, Ck, Hh, Dd), dt)
+        kpg = jax.ShapeDtypeStruct((Hh, Pp, psz, Dd), dt)
+        aot(f"ragged_attention_{tag}",
+            lambda q, k, v, st, nv, pi: ragged(q, k, v, st, nv, pi),
+            (qa, kpg, kpg, ivec, ivec, pidx),
+            lanes=Rl, chunk=Ck, heads=Hh, head_dim=Dd, pages=Pp,
+            page_size=psz)
+    qbf = jax.ShapeDtypeStruct((Rl, Ck, Hh, Dd), bf)
+    kq8 = jax.ShapeDtypeStruct((Hh, Pp, psz, Dd), jnp.int8)
+    scl = jax.ShapeDtypeStruct((Hh, Pp, psz), jnp.float32)
+    aot("ragged_attention_int8kv",
+        lambda q, k, v, ks, vs, st, nv, pi: ragged(
+            q, k, v, st, nv, pi, k_scales=ks, v_scales=vs),
+        (qbf, kq8, kq8, scl, scl, ivec, ivec, pidx),
+        lanes=Rl, chunk=Ck, heads=Hh, head_dim=Dd, pages=Pp,
+        page_size=psz)
+    knew = jax.ShapeDtypeStruct((Rl, Ck, Hh, Dd), jnp.float32)
+    aot("ragged_kv_write_int8",
+        lambda kp, vp, ks, vs, k, v, pi, pos, nv: quantized_kv_cache_write(
+            kp, vp, ks, vs, k, v, pi, pos, nv),
+        (kq8, kq8, scl, scl, knew, knew, pidx, ivec, ivec),
+        lanes=Rl, chunk=Ck, heads=Hh, head_dim=Dd, pages=Pp,
+        page_size=psz)
+
     # -- the bench stages: full train steps at their REAL shapes -------
     # the exact (kind, model, batch, seq) of bench.py's stage ladder,
     # params + adam state as abstract args, full fwd+bwd+update. This

@@ -530,7 +530,7 @@ def run_mixed_tenant(pred, spec):
 # -- scenario: slow client over HTTP ----------------------------------------
 
 
-def _build_lm_stack(tmp_dir):
+def _build_lm_stack(tmp_dir, kv_dtype="float32"):
     import paddle_tpu as fluid
     from paddle_tpu.generation import GenerationEngine
     from paddle_tpu.generation.model import GPTConfig, build_lm_program
@@ -550,7 +550,7 @@ def _build_lm_stack(tmp_dir):
     pred = create_predictor(Config(d))
     gen = GenerationEngine(pred, cfg, page_size=16, num_pages=192,
                            max_decode_batch=4, prefill_buckets=(16,),
-                           warmup=False)
+                           kv_dtype=kv_dtype, warmup=False)
     return pred, gen
 
 
@@ -558,10 +558,14 @@ def run_slow_client(tmp_dir, spec):
     """One client streams /v1/generate and stops reading; one healthy
     client streams alongside. Gates: the stalled sequence is CANCELLED
     early (decode work saved, KV pages freed), and the healthy stream
-    finishes normally — the batcher never stalled."""
+    finishes normally — the batcher never stalled. ``spec["kv_dtype"]
+    = "int8"`` runs the same regression over QUANTIZED pages — a
+    stalled socket must free int8 pages + scale planes at the next
+    step boundary exactly like fp32 ones."""
     from paddle_tpu.serving import ServingEngine, ServingServer
 
-    pred, gen = _build_lm_stack(tmp_dir)
+    pred, gen = _build_lm_stack(tmp_dir,
+                                kv_dtype=spec.get("kv_dtype", "float32"))
     engine = ServingEngine(pred, num_workers=1)
     server = ServingServer(engine, generation_engine=gen,
                            stream_write_timeout_s=spec["stall_timeout_s"],
