@@ -40,13 +40,15 @@ class GradientClipByGlobalNorm(BaseGradientClipAttr):
         self.clip_norm = float(clip_norm)
         self.group_name = group_name
 
-    def _append_clip_op(self, params_grads):
+    def _append_scale_op(self, params_grads):
+        """Emit ONLY the global-norm scale factor (a scalar var) —
+        the fused-optimizer path (kernels/fused_optim.py) consumes it
+        as the ops' ``ClipScale`` operand so the per-grad multiply
+        happens inside the one-pass update instead of materializing a
+        clipped copy of every gradient."""
         from .layers.nn import (
             elementwise_div,
             elementwise_max,
-            elementwise_min,
-            elementwise_mul,
-            scale,
             sqrt,
             square,
             reduce_sum,
@@ -58,7 +60,12 @@ class GradientClipByGlobalNorm(BaseGradientClipAttr):
         global_norm = sqrt(total)
         max_norm = fill_constant([], "float32", self.clip_norm)
         denom = elementwise_max(global_norm, max_norm)
-        factor = elementwise_div(max_norm, denom)
+        return elementwise_div(max_norm, denom)
+
+    def _append_clip_op(self, params_grads):
+        from .layers.nn import elementwise_mul
+
+        factor = self._append_scale_op(params_grads)
         return [(p, elementwise_mul(g, factor, axis=-1)) for p, g in params_grads]
 
 

@@ -287,6 +287,11 @@ def build_block_fn(
             env[n] = args[len(feed_names) + i]
         ctx = LoweringContext(step_key=step_key, mesh=mesh, axis_env=axis_env)
         ctx.check_nan_inf = flag("check_nan_inf")
+        # state-var partition specs, for lowerings that must wrap a
+        # Pallas kernel in a shard_map over the mesh (fused_optim:
+        # Mosaic cannot be GSPMD-auto-partitioned, and the wrap wants
+        # the ZeRO moment specs so the local update stays local)
+        ctx.state_shardings = state_shardings or {}
         _lower_block(block, env, ctx)
         fetched = []
         for n in fetch_names:
@@ -638,13 +643,17 @@ class Executor:
             program = framework.default_main_program()
         scope = scope or global_scope()
         fetch_list = list(fetch_list) if fetch_list is not None else []
-        if depth is None:
-            depth = int(flag("dispatch_pipeline_depth"))
         it = iter(feeds if feeds is not None else ())
         _END = object()
         pending = next(it, _END)
         while pending is not _END:
             bound = self.bind(program, pending, fetch_list, scope=scope)
+            # depth resolves AFTER the bind: the first bind may apply
+            # an autotune profile that tunes dispatch_pipeline_depth —
+            # reading the flag up front would run the whole stream at
+            # the default (an explicit depth= argument still wins)
+            seg_depth = (depth if depth is not None
+                         else int(flag("dispatch_pipeline_depth")))
             sig = feed_signature(pending)
 
             def _segment():
@@ -667,7 +676,8 @@ class Executor:
                     yield f
 
             for outs in bound.run_pipelined(
-                    _segment(), return_numpy=return_numpy, depth=depth):
+                    _segment(), return_numpy=return_numpy,
+                    depth=seg_depth):
                 yield outs
 
     def _bound_key(self, program, feed, fetch_list, scope):
@@ -758,6 +768,18 @@ class Executor:
         # an in-memory cache hit can still be a fresh jit in a process
         # whose flag changed)
         _dispatch.ensure_persistent_cache()
+
+        # autotune seam (runtime.dispatch.autotune_for_program): a
+        # profile recorded for this program's fingerprint pre-tunes the
+        # runtime knobs (pipeline depth, prefetch, serving buckets...)
+        # before the step binds — once per fingerprint, explicit
+        # user-set flags always win, absence is free (one set probe).
+        # A non-empty apply bumped the flags generation AFTER the
+        # caller computed bkey: recompute it, or this bind would be
+        # cached under a dead key and the next run would re-lower and
+        # re-compile the whole program
+        if _dispatch.autotune_for_program(program) and bkey is not None:
+            bkey = self._bound_key(program, feed, fetch_list, scope)
 
         mesh = None
         in_shardings = None

@@ -139,6 +139,22 @@ _FLAG_DEFS: Dict[str, Any] = {
     "collective_bucket_mb": "0",
     "collective_quantization": "none",
     "collective_quant_block": 256,
+    # kernels/fused_optim.py: replace the unfused XLA m/v/param chain
+    # of Adam/Momentum with the one-pass Pallas update over donated
+    # buffers. "auto" (default) fuses on real TPU targets (and under
+    # PADDLE_TPU_FORCE_PALLAS=1); "on"/"off" force. On non-TPU
+    # backends the fused ops lower to the pure-JAX reference, which is
+    # op-for-op the unfused chain (bitwise-identical trajectories)
+    "optimizer_fuse": "auto",
+    # tools/autotune.py cost-model autotuner: profiles keyed by
+    # executable fingerprint live under autotune_dir; when
+    # autotune_apply is on, Executor._compile (and the serving/
+    # generation engine constructors) look up the program's profile
+    # and apply its tuned flags — EXCEPT flags the user set explicitly
+    # (set_flags / FLAGS_ env always win). apply_autotune_profile()
+    # is the same seam invoked by hand.
+    "autotune_dir": os.path.join("~", ".cache", "paddle_tpu", "autotune"),
+    "autotune_apply": True,
     # traffic/ (SLO-aware admission + multi-tenant scheduling) defaults,
     # consumed by TrafficConfig.from_flags(): traffic_queue_capacity is
     # the per-PRIORITY-CLASS bounded queue depth (a full class queue
@@ -225,6 +241,11 @@ _flags: Dict[str, Any] = {}
 # counter instead of re-reading flags every step
 _generation = 0
 
+# flags the USER pinned — via FLAGS_<name> env or set_flags — as
+# opposed to defaults: an autotune profile never overrides these
+# (explicit configuration outranks a recorded sweep)
+_explicit: set = set()
+
 
 def _coerce(default, raw: str):
     if isinstance(default, bool):
@@ -239,7 +260,11 @@ def _coerce(default, raw: str):
 def _init():
     for name, default in _FLAG_DEFS.items():
         env = os.environ.get(f"FLAGS_{name}")
-        _flags[name] = _coerce(default, env) if env is not None else default
+        if env is not None:
+            _flags[name] = _coerce(default, env)
+            _explicit.add(name)
+        else:
+            _flags[name] = default
 
 
 _init()
@@ -264,6 +289,7 @@ def set_flags(flag_dict: Dict[str, Any]):
         if key not in _flags:
             raise ValueError(f"unknown flag {n!r}")
         _flags[key] = v
+        _explicit.add(key)
     _generation += 1
 
 
@@ -273,3 +299,177 @@ def generation() -> int:
 
 def flag(name: str):
     return _flags[name]
+
+
+# -- autotune profiles -------------------------------------------------------
+# tools/autotune.py sweeps the performance knobs for one workload and
+# writes the winners as a JSON profile keyed by the workload's
+# executable fingerprint. This seam is the consumer: a later process
+# running the same workload calls apply_autotune_profile(fingerprint)
+# — Executor._compile and the serving/generation engine constructors
+# do it automatically under the `autotune_apply` flag — and comes up
+# pre-tuned with zero hand-set flags. Precedence: a flag the user set
+# explicitly (set_flags / FLAGS_ env) is never overridden.
+
+AUTOTUNE_PROFILE_VERSION = 1
+
+_logger = None
+
+
+def _log():
+    global _logger
+    if _logger is None:
+        import logging
+
+        _logger = logging.getLogger("paddle_tpu.autotune")
+    return _logger
+
+
+class AutotuneProfileMismatch(ValueError):
+    """The profile on disk records a different executable fingerprint
+    than the one requested — a stale/copied profile is refused rather
+    than silently mis-tuning a different workload."""
+
+
+def autotune_dir() -> str:
+    return os.path.expanduser(str(flag("autotune_dir")))
+
+
+def autotune_profile_path(fingerprint: str, dir: str = None) -> str:
+    base = os.path.expanduser(dir) if dir else autotune_dir()
+    # fingerprints are hex digests / identifier-safe keys; sanitize
+    # anything else so a weird key cannot escape the profile dir
+    safe = "".join(c if (c.isalnum() or c in "._-") else "_"
+                   for c in str(fingerprint))
+    return os.path.join(base, f"{safe}.json")
+
+
+def save_autotune_profile(fingerprint: str, flag_updates: Dict[str, Any],
+                          evidence: Dict[str, Any] = None,
+                          dir: str = None) -> str:
+    """Write a tuned-flags profile for one executable fingerprint.
+    Unknown flag names are rejected here (at tuner time) so the apply
+    side only ever has to warn about cross-version drift."""
+    import json
+
+    for n in flag_updates:
+        if n not in _FLAG_DEFS:
+            raise ValueError(f"save_autotune_profile: unknown flag {n!r}")
+    path = autotune_profile_path(fingerprint, dir)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    payload = {
+        "version": AUTOTUNE_PROFILE_VERSION,
+        "fingerprint": str(fingerprint),
+        "flags": dict(flag_updates),
+        "evidence": dict(evidence or {}),
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f, indent=1, sort_keys=True)
+    os.replace(tmp, path)
+    return path
+
+
+def apply_autotune_profile(fingerprint: str, dir: str = None,
+                           missing_ok: bool = False) -> Dict[str, Any]:
+    """Load the profile for ``fingerprint`` and apply its flags —
+    skipping any flag the user set explicitly — returning the dict of
+    flags actually applied. A malformed or wrong-version profile
+    degrades to the defaults with a warning (never an exception: a
+    corrupt cache file must not take down training); a profile whose
+    RECORDED fingerprint disagrees with the requested one raises
+    AutotuneProfileMismatch (stale profiles are refused, not guessed
+    at)."""
+    import json
+
+    global _generation
+    path = autotune_profile_path(fingerprint, dir)
+    if not os.path.exists(path):
+        if missing_ok:
+            return {}
+        raise FileNotFoundError(
+            f"no autotune profile for fingerprint {fingerprint!r} "
+            f"(looked at {path}); run tools/autotune.py first")
+    try:
+        with open(path) as f:
+            payload = json.load(f)
+        if not isinstance(payload, dict):
+            raise ValueError("profile root is not an object")
+        version = payload.get("version")
+        profile_flags = payload.get("flags")
+        recorded = payload.get("fingerprint")
+        if version != AUTOTUNE_PROFILE_VERSION:
+            raise ValueError(
+                f"profile version {version!r} != "
+                f"{AUTOTUNE_PROFILE_VERSION}")
+        if not isinstance(profile_flags, dict):
+            raise ValueError("profile has no 'flags' object")
+    except (json.JSONDecodeError, ValueError, OSError) as e:
+        _log().warning(
+            "autotune profile %s is malformed (%s); ignoring it and "
+            "running with default flags", path, e)
+        return {}
+    if recorded != str(fingerprint):
+        raise AutotuneProfileMismatch(
+            f"autotune profile {path} records fingerprint {recorded!r} "
+            f"but {fingerprint!r} was requested — the profile is stale "
+            "(re-run tools/autotune.py for this workload)")
+    applied: Dict[str, Any] = {}
+    for n, v in profile_flags.items():
+        if n not in _FLAG_DEFS:
+            _log().warning(
+                "autotune profile %s names unknown flag %r; skipping",
+                path, n)
+            continue
+        if n in _explicit:
+            continue  # explicit configuration outranks the sweep
+        # coerce to the flag's declared type — a value-corrupt profile
+        # must degrade HERE with a warning, not crash later at bind
+        # time when the runtime consumes the flag
+        default = _FLAG_DEFS[n]
+        try:
+            if isinstance(v, str):
+                v = _coerce(default, v)
+            elif isinstance(default, bool):
+                v = bool(v)
+            elif isinstance(default, int):
+                v = int(v)
+            elif isinstance(default, float):
+                v = float(v)
+            elif isinstance(default, str):
+                v = str(v)
+        except (TypeError, ValueError):
+            _log().warning(
+                "autotune profile %s: flag %r value %r does not coerce "
+                "to %s; skipping", path, n, v, type(default).__name__)
+            continue
+        _flags[n] = v
+        applied[n] = v
+    if applied:
+        _generation += 1
+        _log().info("autotune profile applied for %s: %s",
+                    fingerprint, applied)
+    return applied
+
+
+# fingerprints already auto-probed this process — the Executor seam
+# must cost one set lookup per program, not a disk stat per bind
+_autotune_probed: set = set()
+
+
+def autotune_apply_for(fingerprint: str) -> Dict[str, Any]:
+    """The automatic seam (Executor._compile / engine construction):
+    best-effort apply of a matching profile under the
+    ``autotune_apply`` flag — once per fingerprint per process, and
+    never an exception on the construction path."""
+    if not flag("autotune_apply") or not fingerprint:
+        return {}
+    if fingerprint in _autotune_probed:
+        return {}
+    _autotune_probed.add(fingerprint)
+    try:
+        return apply_autotune_profile(fingerprint, missing_ok=True)
+    except Exception as e:  # noqa: BLE001 — construction must survive
+        _log().warning("autotune profile for %s not applied: %s",
+                       fingerprint, e)
+        return {}

@@ -343,6 +343,13 @@ class GenerationEngine:
                  warmup: bool = False, start: bool = True):
         from ..flags import flag
 
+        # autotune seam: a profile recorded for this model pre-tunes
+        # the generation_* knobs (chunk tokens, lane count, pages)
+        # BEFORE they are read below (explicit flags/ctor args win)
+        from ..runtime.dispatch import autotune_for_program
+
+        autotune_for_program(getattr(predictor, "_program", None))
+
         self.config = config
         # the clone shares scope + executor + compiled executables with
         # the caller's predictor but owns its own lock/IO handles — the

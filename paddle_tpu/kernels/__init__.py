@@ -19,8 +19,14 @@ attention/ffn/layer_norm/adam/softmax-ce):
     pool (kernels/ragged_paged_attention.py, custom Pallas lowering),
     with an int8-page variant reusing the kernels/quant.py blockwise
     machinery — the kernel under the ragged GenerationEngine
-  * adam — deliberately NOT a kernel: a pure elementwise chain that
-    XLA already fuses into one loop (verified in lowered HLO)
+  * fused optimizer — one-pass Adam/AdamW/Momentum over donated
+    buffers (kernels/fused_optim.py): the whole m/v/param update is a
+    single Pallas pass per parameter with the global-norm-clip scale
+    folded in as a scalar operand, wired into optimizer.Adam/Momentum
+    under the ``optimizer_fuse`` flag (this supersedes the seed's
+    "adam is deliberately not a kernel" stance — the lowered HLO of a
+    ZeRO-sharded step showed the optimizer tail as a CHAIN of fusions
+    re-reading state, not one)
 
 Kernels degrade gracefully: on non-TPU backends (CPU tests) they fall
 back to the pure-XLA implementation with identical numerics
@@ -28,6 +34,8 @@ back to the pure-XLA implementation with identical numerics
 """
 
 from .flash_attention import flash_attention, flash_attention_layer
+from .fused_optim import (fused_adam_update, fused_momentum_update,
+                          optimizer_fuse_enabled)
 from .layer_norm import fused_layer_norm, layer_norm_pallas
 from .paged_attention import (kv_cache_write, kv_cache_write_layer,
                               paged_attention, paged_attention_layer)

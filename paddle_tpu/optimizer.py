@@ -155,6 +155,17 @@ class Optimizer:
     ):
         return append_backward(loss, parameter_list, no_grad_set)
 
+    def _fusion_active(self, params_grads) -> bool:
+        # exact optimizer classes whose update the fused one-pass
+        # Pallas ops (kernels/fused_optim.py) can replace — exact, not
+        # isinstance: subclasses (Lamb, DGC) append their own ops and
+        # must stay unfused
+        if type(self).__name__ not in ("AdamOptimizer", "MomentumOptimizer"):
+            return False
+        from .kernels.fused_optim import optimizer_fuse_enabled
+
+        return optimizer_fuse_enabled()
+
     def apply_gradients(self, params_grads) -> List:
         params_grads = sorted(params_grads, key=lambda pg: pg[0].name)
         # the raw backward grads, BEFORE clip/regularization rewrite
@@ -162,8 +173,33 @@ class Optimizer:
         # cross-replica reduce happens first and clip-by-global-norm
         # sees the true (global) gradient, matching the monolithic path
         raw_params_grads = list(params_grads)
-        # gradient clipping (global set or per-param attr)
-        params_grads = clip_mod.append_gradient_clip_ops(params_grads, self._grad_clip)
+        # fused one-pass optimizer (optimizer_fuse flag): when the clip
+        # is ByGlobalNorm and nothing else rewrites the grads, fold the
+        # clip into the fused ops' ClipScale scalar operand — the norm
+        # reduction stays in-graph, the per-grad multiply moves inside
+        # the one-pass update (no clipped gradient copies). Any other
+        # grad rewrite (per-param clip attrs, regularizers) keeps the
+        # standard clip/reg chain; the fused op then consumes the
+        # rewritten grads exactly like the unfused one did.
+        self._fuse_active = self._fusion_active(params_grads)
+        self._fused_clip_scale = None
+        effective_clip = self._grad_clip or clip_mod._global_clip
+        can_fold_clip = (
+            self._fuse_active
+            and isinstance(effective_clip, clip_mod.GradientClipByGlobalNorm)
+            and not any(getattr(p, "gradient_clip_attr", None)
+                        for p, _ in params_grads)
+            and self.regularization is None
+            and not any(getattr(p, "regularizer", None)
+                        for p, _ in params_grads)
+        )
+        if can_fold_clip:
+            self._fused_clip_scale = effective_clip._append_scale_op(
+                params_grads)
+        else:
+            # gradient clipping (global set or per-param attr)
+            params_grads = clip_mod.append_gradient_clip_ops(
+                params_grads, self._grad_clip)
         # weight decay
         params_grads = append_regularization_ops(params_grads, self.regularization)
 
@@ -300,6 +336,22 @@ class MomentumOptimizer(Optimizer):
     def _append_optimize_op(self, block, pg):
         p, g = pg
         v = self._get_accumulator("velocity", p)
+        if getattr(self, "_fuse_active", False):
+            inputs = {
+                "Param": [p],
+                "Grad": [g],
+                "Velocity": [v],
+                "LearningRate": [self._create_param_lr(p)],
+            }
+            if getattr(self, "_fused_clip_scale", None) is not None:
+                inputs["ClipScale"] = [self._fused_clip_scale]
+            return block.append_op(
+                type="fused_momentum",
+                inputs=inputs,
+                outputs={"ParamOut": [p], "VelocityOut": [v]},
+                attrs={"mu": self._momentum,
+                       "use_nesterov": self._use_nesterov},
+            )
         return block.append_op(
             type="momentum",
             inputs={
@@ -490,6 +542,34 @@ class AdamOptimizer(Optimizer):
         m2 = self._get_accumulator("moment2", p)
         b1p = self._get_accumulator("beta1_pow_acc", p)
         b2p = self._get_accumulator("beta2_pow_acc", p)
+        if getattr(self, "_fuse_active", False):
+            # one-pass fused update (kernels/fused_optim.py) over the
+            # SAME accumulator vars — ZeRO/partition specs, checkpoints
+            # and the donation audit see an identical state surface
+            inputs = {
+                "Param": [p],
+                "Grad": [g],
+                "LearningRate": [self._create_param_lr(p)],
+                "Moment1": [m1],
+                "Moment2": [m2],
+                "Beta1Pow": [b1p],
+                "Beta2Pow": [b2p],
+            }
+            if getattr(self, "_fused_clip_scale", None) is not None:
+                inputs["ClipScale"] = [self._fused_clip_scale]
+            return block.append_op(
+                type="fused_adam",
+                inputs=inputs,
+                outputs={
+                    "ParamOut": [p],
+                    "Moment1Out": [m1],
+                    "Moment2Out": [m2],
+                    "Beta1PowOut": [b1p],
+                    "Beta2PowOut": [b2p],
+                },
+                attrs={"beta1": self._beta1, "beta2": self._beta2,
+                       "epsilon": self._epsilon},
+            )
         return block.append_op(
             type="adam",
             inputs={

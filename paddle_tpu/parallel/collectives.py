@@ -868,6 +868,9 @@ def build_collective_fn(block, feed_names, state_names, fetch_names,
         ctx2 = LoweringContext(step_key=step_key, mesh=mesh,
                                axis_env=axis_env)
         ctx2.check_nan_inf = check
+        # the optimizer tail runs at GSPMD level: fused_optim lowerings
+        # need the ZeRO state specs to wrap their Pallas pass correctly
+        ctx2.state_shardings = state_shardings
         _lower_block(block, env, ctx2, ops=seg2)
 
         fetched = []

@@ -189,6 +189,25 @@ def program_fingerprint(program) -> str:
     return digest
 
 
+def autotune_for_program(program) -> Dict[str, Any]:
+    """THE autotune-profile construction seam (Executor bind, the
+    serving/generation engine constructors): unwrap a CompiledProgram,
+    fingerprint, and best-effort apply a matching tuned-flags profile
+    (flags.autotune_apply_for — once per fingerprint per process,
+    explicit user flags always win, absence costs one set probe).
+    Returns the flags actually applied so callers can react to a
+    flags-generation bump (e.g. recompute a bound key)."""
+    if program is None:
+        return {}
+    from .. import flags as _flags
+
+    prog = getattr(program, "_program", None) or program
+    try:
+        return _flags.autotune_apply_for(program_fingerprint(prog))
+    except Exception:  # noqa: BLE001 — construction must survive
+        return {}
+
+
 def shared_cache_get(key):
     hit = _SHARED_CACHE.get(key)
     if hit is not None:

@@ -194,6 +194,13 @@ class ServingEngine:
                  num_workers: Optional[int] = None, start: bool = True):
         from ..flags import flag
 
+        # autotune seam: a profile recorded for this model pre-tunes
+        # the serving_* knobs BEFORE they are read below (explicit
+        # user-set flags / ctor args still win)
+        from ..runtime.dispatch import autotune_for_program
+
+        autotune_for_program(getattr(predictor, "_program", None))
+
         self._predictor = predictor
         self._feed_names: List[str] = list(predictor.get_input_names())
         self._fetch_names: List[str] = list(predictor.get_output_names())

@@ -1,0 +1,262 @@
+"""Autotune profile seam (flags.apply_autotune_profile +
+tools/autotune.py): round trip, stale-fingerprint refusal, malformed
+degradation, explicit-flag precedence, the Executor-construction
+auto-apply, and the cost-model derivations."""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import flags as pflags
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+FP = "deadbeef" * 8
+
+
+@pytest.fixture()
+def adir(tmp_path):
+    old = fluid.get_flags(["autotune_dir", "autotune_apply",
+                           "dispatch_pipeline_depth",
+                           "collective_bucket_mb",
+                           "serving_max_batch_size"])
+    old_explicit = set(pflags._explicit)
+    old_probed = set(pflags._autotune_probed)
+    fluid.set_flags({"autotune_dir": str(tmp_path)})
+    pflags._autotune_probed.clear()
+    yield str(tmp_path)
+    fluid.set_flags(old)
+    pflags._explicit.clear()
+    pflags._explicit.update(old_explicit)
+    pflags._autotune_probed.clear()
+    pflags._autotune_probed.update(old_probed)
+
+
+def test_profile_round_trip(adir):
+    path = pflags.save_autotune_profile(
+        FP, {"dispatch_pipeline_depth": 3, "collective_bucket_mb": "8"},
+        evidence={"why": "test"})
+    assert os.path.exists(path)
+    # simulate a fresh process: nothing explicit, defaults in place
+    pflags._explicit.discard("dispatch_pipeline_depth")
+    pflags._explicit.discard("collective_bucket_mb")
+    applied = pflags.apply_autotune_profile(FP)
+    assert applied == {"dispatch_pipeline_depth": 3,
+                       "collective_bucket_mb": "8"}
+    assert pflags.flag("dispatch_pipeline_depth") == 3
+    assert pflags.flag("collective_bucket_mb") == "8"
+
+
+def test_explicit_flags_win(adir):
+    pflags.save_autotune_profile(FP, {"dispatch_pipeline_depth": 7})
+    fluid.set_flags({"dispatch_pipeline_depth": 2})  # user pinned it
+    applied = pflags.apply_autotune_profile(FP)
+    assert "dispatch_pipeline_depth" not in applied
+    assert pflags.flag("dispatch_pipeline_depth") == 2
+
+
+def test_fingerprint_mismatch_refuses_stale_profile(adir):
+    """A profile copied/renamed to another fingerprint's slot is
+    refused loudly, never applied to the wrong workload."""
+    path = pflags.save_autotune_profile(FP, {"dispatch_pipeline_depth": 3})
+    other = pflags.autotune_profile_path("cafebabe" * 8)
+    os.rename(path, other)
+    with pytest.raises(pflags.AutotuneProfileMismatch,
+                       match="stale"):
+        pflags.apply_autotune_profile("cafebabe" * 8)
+
+
+def test_missing_profile(adir):
+    with pytest.raises(FileNotFoundError):
+        pflags.apply_autotune_profile("0" * 16)
+    assert pflags.apply_autotune_profile("0" * 16, missing_ok=True) == {}
+
+
+def test_malformed_profile_degrades_with_warning(adir, caplog):
+    import logging
+
+    path = pflags.autotune_profile_path(FP)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    before = pflags.flag("dispatch_pipeline_depth")
+    cases = ["{not json", json.dumps([1, 2]),
+             json.dumps({"version": 99, "fingerprint": FP, "flags": {}}),
+             json.dumps({"version": 1, "fingerprint": FP})]
+    for raw in cases:
+        with open(path, "w") as f:
+            f.write(raw)
+        with caplog.at_level(logging.WARNING, "paddle_tpu.autotune"):
+            caplog.clear()
+            assert pflags.apply_autotune_profile(FP) == {}
+            assert any("malformed" in r.message for r in caplog.records)
+    assert pflags.flag("dispatch_pipeline_depth") == before
+
+
+def test_unknown_flag_in_profile_skipped(adir, caplog):
+    import logging
+
+    path = pflags.autotune_profile_path(FP)
+    with open(path, "w") as f:
+        json.dump({"version": pflags.AUTOTUNE_PROFILE_VERSION,
+                   "fingerprint": FP,
+                   "flags": {"no_such_flag": 1,
+                             "dispatch_pipeline_depth": 4}}, f)
+    pflags._explicit.discard("dispatch_pipeline_depth")
+    with caplog.at_level(logging.WARNING, "paddle_tpu.autotune"):
+        applied = pflags.apply_autotune_profile(FP)
+    assert applied == {"dispatch_pipeline_depth": 4}
+    assert any("unknown flag" in r.message for r in caplog.records)
+
+
+def test_profile_values_coerced_to_flag_types(adir, caplog):
+    """Type-corrupt values degrade per-flag with a warning instead of
+    crashing later at bind time; string forms coerce to the flag's
+    declared type."""
+    import logging
+
+    path = pflags.autotune_profile_path(FP)
+    with open(path, "w") as f:
+        json.dump({"version": pflags.AUTOTUNE_PROFILE_VERSION,
+                   "fingerprint": FP,
+                   "flags": {"dispatch_pipeline_depth": "3",
+                             "serving_max_batch_size": [1, 2]}}, f)
+    pflags._explicit.discard("dispatch_pipeline_depth")
+    pflags._explicit.discard("serving_max_batch_size")
+    with caplog.at_level(logging.WARNING, "paddle_tpu.autotune"):
+        applied = pflags.apply_autotune_profile(FP)
+    assert applied == {"dispatch_pipeline_depth": 3}
+    assert pflags.flag("dispatch_pipeline_depth") == 3
+    assert any("does not coerce" in r.message for r in caplog.records)
+
+
+def test_xla_gauges_pick_the_train_executable(adir):
+    """Several executables register compile-time gauges in a process
+    (startup compiles first); the cost model must read every family
+    from the max-flops (train) executable, never mix labels."""
+    import autotune as at
+
+    from paddle_tpu.observability.registry import registry
+
+    reg = registry()
+    for tag, flops, nbytes in (("exe=startup", 1e3, 1e6),
+                               ("exe=train", 1e9, 2e6)):
+        reg.gauge("paddle_xla_flops", "t").labels(executable=tag).set(flops)
+        reg.gauge("paddle_xla_bytes_accessed", "t").labels(
+            executable=tag).set(nbytes)
+    g = at._xla_gauges()
+    assert g["paddle_xla_flops"] == 1e9
+    assert g["paddle_xla_bytes_accessed"] == 2e6
+    assert "train" in g["executable_label"]
+
+
+def test_save_rejects_unknown_flags(adir):
+    with pytest.raises(ValueError, match="unknown flag"):
+        pflags.save_autotune_profile(FP, {"bogus": 1})
+
+
+def test_executor_compile_auto_applies_profile(adir):
+    """The construction seam: a profile recorded for a program's
+    fingerprint is applied at first compile — no hand-set flags."""
+    from paddle_tpu.runtime.dispatch import program_fingerprint
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data("x", [4])
+        out = fluid.layers.fc(x, 3)
+    fp = program_fingerprint(main)
+    pflags.save_autotune_profile(fp, {"dispatch_pipeline_depth": 5})
+    pflags._explicit.discard("dispatch_pipeline_depth")
+    fluid.set_flags({"autotune_apply": True})
+    pflags._autotune_probed.clear()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        exe.run(main, feed={"x": np.zeros((2, 4), "float32")},
+                fetch_list=[out])
+    assert pflags.flag("dispatch_pipeline_depth") == 5
+
+
+def test_run_pipelined_first_touch_honors_profile_depth(adir):
+    """run_pipelined must resolve dispatch_pipeline_depth AFTER its
+    first bind — the bind is what auto-applies the profile, and a
+    depth read up front would run the whole stream at the default."""
+    from paddle_tpu.runtime.dispatch import program_fingerprint
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data("x", [4])
+        out = fluid.layers.fc(x, 3)
+    fp = program_fingerprint(main)
+    pflags.save_autotune_profile(fp, {"dispatch_pipeline_depth": 4})
+    pflags._explicit.discard("dispatch_pipeline_depth")
+    pflags._autotune_probed.discard(fp)
+    fluid.set_flags({"autotune_apply": True})
+    seen = {}
+    from paddle_tpu.runtime.dispatch import BoundStep
+
+    orig = BoundStep.run_pipelined
+
+    def spy(self, feeds, return_numpy=True, depth=2):
+        seen["depth"] = depth
+        return orig(self, feeds, return_numpy=return_numpy, depth=depth)
+
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        feeds = [{"x": np.zeros((2, 4), "float32")}] * 3
+        BoundStep.run_pipelined = spy
+        try:
+            # first-ever touch of this program IS the pipelined run
+            list(exe.run_pipelined(main, feeds=feeds, fetch_list=[out],
+                                   scope=scope))
+        finally:
+            BoundStep.run_pipelined = orig
+    assert seen["depth"] == 4
+
+
+def test_cost_model_derivations(adir):
+    import autotune as at
+
+    main, _, _ = at.build_workload(fluid)
+    # bandwidth-bound gauges -> bigger serving batch, fatter chunks
+    flags_bw, rat = at.derive_cost_model_flags(
+        main, {"paddle_xla_flops": 1e6,
+               "paddle_xla_bytes_accessed": 1e6}, batch=32)
+    assert rat["bandwidth_bound"] is True
+    assert flags_bw["serving_max_batch_size"] == 64
+    assert flags_bw["generation_chunk_tokens"] == 32
+    # compute-bound -> latency-tight defaults
+    flags_cb, rat = at.derive_cost_model_flags(
+        main, {"paddle_xla_flops": 1e9,
+               "paddle_xla_bytes_accessed": 1e6}, batch=32)
+    assert rat["bandwidth_bound"] is False
+    assert flags_cb["serving_max_batch_size"] == 32
+    # the bucket cap tracks the gradient bytes, never zero
+    assert float(flags_bw["collective_bucket_mb"]) > 0
+    # every derived name is a real flag (save would throw otherwise)
+    pflags.save_autotune_profile(FP, flags_bw)
+
+
+def test_workload_fingerprint_stable_across_processes(adir):
+    """The whole scheme hinges on a fresh process recomputing the same
+    fingerprint for the same workload."""
+    import subprocess
+
+    code = ("import sys; sys.path.insert(0, %r); sys.path.insert(0, %r); "
+            "import autotune, paddle_tpu; "
+            "from paddle_tpu.runtime.dispatch import program_fingerprint; "
+            "m, _, _ = autotune.build_workload(paddle_tpu); "
+            "print(program_fingerprint(m))"
+            % (REPO, os.path.join(REPO, "tools")))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    outs = {subprocess.run([sys.executable, "-c", code], env=env,
+                           capture_output=True, text=True, timeout=300,
+                           check=True).stdout.strip().splitlines()[-1]
+            for _ in range(2)}
+    assert len(outs) == 1 and len(next(iter(outs))) == 64

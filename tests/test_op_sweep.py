@@ -587,6 +587,10 @@ COVERED_ELSEWHERE = {
     # driven by dedicated tests in THIS file (below)
     'adadelta', 'adagrad', 'adamax', 'adamw', 'decayed_adagrad', 'dpsgd',
     'ftrl', 'lamb', 'lars_momentum', 'rmsprop',
+    # PR-13 fused one-pass optimizer (test_fused_optimizer_op_lowerings
+    # below: bitwise vs the unfused counterparts incl. the ClipScale
+    # fold; kernel + trajectory tiers in tests/test_fused_optim.py)
+    'fused_adam', 'fused_adamw', 'fused_momentum',
     'merge_selected_rows', 'get_tensor_from_selected_rows',
     'dgc',  # tests/test_dgc.py
     'local_sgd_select',  # tests/test_zero_localsgd.py
@@ -2309,6 +2313,84 @@ def test_adamw_op_lowering():
         {"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8, "coeff": 0.01},
     )
     _run_spec("adamw", sp)
+
+
+def _run_one_op(op_type, inputs, attrs, out_slots):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        block = main.global_block()
+        in_vars, feed = {}, {}
+        for slot, arr in inputs.items():
+            arr = np.asarray(arr)
+            name = f"{op_type}_{slot}"
+            in_vars[slot] = [block.create_var(
+                name=name, shape=arr.shape, dtype=str(arr.dtype),
+                is_data=True, stop_gradient=True)]
+            feed[name] = arr
+        out_vars = {s: [block.create_var(name=f"{op_type}_{s}_o",
+                                         stop_gradient=True)]
+                    for s in out_slots}
+        block.append_op(type=op_type, inputs=in_vars, outputs=out_vars,
+                        attrs=dict(attrs))
+        fetch = [out_vars[s][0] for s in out_slots]
+    exe = fluid.Executor(fluid.CPUPlace())
+    return [np.asarray(v) for v in exe.run(main, feed=feed,
+                                           fetch_list=fetch)]
+
+
+def test_fused_optimizer_op_lowerings():
+    """PR-13 one-pass fused optimizer ops (kernels/fused_optim.py):
+    each fused op — including the folded ClipScale operand — must
+    reproduce its unfused counterpart's outputs bitwise on the CPU
+    reference path (trajectory-level equivalence + the Pallas kernel
+    itself live in tests/test_fused_optim.py)."""
+    rng = np.random.RandomState(11)
+    adam_ins = {
+        "Param": rng.randn(5, 3).astype("float32"),
+        "Grad": rng.randn(5, 3).astype("float32"),
+        "LearningRate": np.full(1, 0.01, "float32"),
+        "Moment1": rng.rand(5, 3).astype("float32"),
+        "Moment2": rng.rand(5, 3).astype("float32"),
+        "Beta1Pow": np.full(1, 0.9, "float32"),
+        "Beta2Pow": np.full(1, 0.999, "float32"),
+    }
+    attrs = {"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8}
+    adam_outs = ("ParamOut", "Moment1Out", "Moment2Out", "Beta1PowOut",
+                 "Beta2PowOut")
+    for base_op, fused_op, extra in (("adam", "fused_adam", {}),
+                                     ("adamw", "fused_adamw",
+                                      {"coeff": 0.01})):
+        want = _run_one_op(base_op, adam_ins, {**attrs, **extra},
+                           adam_outs)
+        got = _run_one_op(fused_op, adam_ins, {**attrs, **extra},
+                          adam_outs)
+        for w, g in zip(want, got):
+            np.testing.assert_array_equal(w, g, err_msg=fused_op)
+    # folded clip: fused with ClipScale == unfused on pre-scaled grads
+    scaled = dict(adam_ins)
+    scaled["Grad"] = adam_ins["Grad"] * np.float32(0.25)
+    want = _run_one_op("adam", scaled, attrs, adam_outs)
+    got = _run_one_op(
+        "fused_adam",
+        {**adam_ins, "ClipScale": np.full((), 0.25, "float32")},
+        attrs, adam_outs)
+    for w, g in zip(want, got):
+        np.testing.assert_array_equal(w, g, err_msg="fused_adam+clip")
+
+    mom_ins = {
+        "Param": rng.randn(5, 3).astype("float32"),
+        "Grad": rng.randn(5, 3).astype("float32"),
+        "Velocity": rng.rand(5, 3).astype("float32"),
+        "LearningRate": np.full(1, 0.05, "float32"),
+    }
+    for nesterov in (False, True):
+        mattrs = {"mu": 0.9, "use_nesterov": nesterov}
+        want = _run_one_op("momentum", mom_ins, mattrs,
+                           ("ParamOut", "VelocityOut"))
+        got = _run_one_op("fused_momentum", mom_ins, mattrs,
+                          ("ParamOut", "VelocityOut"))
+        for w, g in zip(want, got):
+            np.testing.assert_array_equal(w, g, err_msg="fused_momentum")
 
 
 def test_selected_rows_tensor_ops():

@@ -77,11 +77,15 @@ def _child():
     # one kernel must not pay the whole flash sweep every run)
     only = os.environ.get("PT_AOT_ONLY", "")
 
-    def aot(name, fn, abstract_args, **meta):
+    def aot(name, fn, abstract_args, group=None, **meta):
         """Compile fn for the v5e target; record ok/compile_s/memory
-        or the compiler's rejection."""
-        if only and only not in name:
+        or the compiler's rejection. ``group`` is an extra PT_AOT_ONLY
+        match target (e.g. every fused-optimizer row answers to
+        PT_AOT_ONLY=fused_optim regardless of row name)."""
+        if only and only not in name and only != group:
             return True
+        if group:
+            meta["group"] = group
         t0 = time.time()
         try:
             n = len(jax.tree_util.tree_leaves(abstract_args))
@@ -230,6 +234,26 @@ def _child():
         lanes=Rl, chunk=Ck, heads=Hh, head_dim=Dd, pages=Pp,
         page_size=psz)
 
+    # -- fused optimizer: ONE Pallas pass per parameter ----------------
+    # The whole m/v/param Adam update (bias correction + folded
+    # global-norm clip scale) compiles as one Mosaic kernel over
+    # donated buffers — for a GPT-scale [4096, 1024] parameter panel in
+    # f32 AND the bf16-param/f32-moment mixed-precision form. Run just
+    # these with PT_AOT_ONLY=fused_optim.
+    from paddle_tpu.kernels.fused_optim import fused_adam_update
+
+    scalar = jax.ShapeDtypeStruct((1,), jnp.float32)
+    for tag, dt in (("f32", jnp.float32), ("bf16", bf)):
+        pshape = jax.ShapeDtypeStruct((4096, 1024), dt)
+        mshape = jax.ShapeDtypeStruct((4096, 1024), jnp.float32)
+        aot(f"fused_adam_{tag}",
+            lambda p, g, m, v, lr, b1p, b2p, c: fused_adam_update(
+                p, g, m, v, lr, b1p, b2p, beta1=0.9, beta2=0.999,
+                epsilon=1e-8, clip_scale=c),
+            (pshape, pshape, mshape, mshape, scalar, scalar, scalar,
+             scalar),
+            group="fused_optim", shape=[4096, 1024])
+
     # -- the bench stages: full train steps at their REAL shapes -------
     # the exact (kind, model, batch, seq) of bench.py's stage ladder,
     # params + adam state as abstract args, full fwd+bwd+update. This
@@ -298,9 +322,11 @@ def _child():
         devs4 = list(topo.devices)
         rng = np.random.RandomState(0)
 
-        def mc(name, cp_fn, prog_pack, feed, **meta):
-            if only and only not in name:
+        def mc(name, cp_fn, prog_pack, feed, group=None, **meta):
+            if only and only not in name and only != group:
                 return
+            if group:
+                meta["group"] = group
             main_prog, startup, loss = prog_pack
             t0 = time.time()
             try:
@@ -444,6 +470,31 @@ def _child():
                    collective_quantization=q)),
                (cmain, cstart, cf["loss"]), cfeed,
                mesh=f"dp4 collective {ctag}")
+
+        # (i) FUSED OPTIMIZER under dp4 + ZeRO-1: the one-pass Pallas
+        # Adam composes with the partitioner — sharded moments feed
+        # the Mosaic kernel through the same GSPMD optimizer tail the
+        # unfused chain used, compiled for real v5e silicon. Also
+        # answers PT_AOT_ONLY=fused_optim.
+        _fuse_old = fluid.get_flags(["optimizer_fuse"])
+        fluid.set_flags({"optimizer_fuse": "on"})
+        fcfg = GPTConfig.tiny()
+        fmain, fstart, _, ff = build_gpt_lm(
+            fcfg, 128, optimizer=fluid.optimizer.Adam(1e-3))
+        ffeed = {"tokens": rng.randint(0, fcfg.vocab_size,
+                                       (8, 128)).astype("int64"),
+                 "labels": rng.randint(0, fcfg.vocab_size,
+                                       (8, 128)).astype("int64")}
+        fused_ops = sum(op.type == "fused_adam"
+                        for op in fmain.global_block().ops)
+        mc("multichip_fused_adam_dp4_zero1",
+           lambda m: fluid.CompiledProgram(m).with_partitioning(
+               pt.PartitionConfig(mesh_axes={"dp": 4}, zero=1)),
+           (fmain, fstart, ff["loss"]), ffeed, group="fused_optim",
+           mesh="dp4 zero1", fused_adam_ops=fused_ops)
+        # restore the OPERATOR's value, not a literal: an env-driven
+        # FLAGS_optimizer_fuse=on sweep must keep fusing after this row
+        fluid.set_flags(_fuse_old)
 
         # (g) the TP-predict executable (the ServingEngine worker form):
         # forward-only logits over a tp4 mesh from the same tags

@@ -253,6 +253,61 @@ def _phase_collectives(fluid):
     return [exe, scope, compiled]
 
 
+def _phase_fused_optim(fluid):
+    """Fused one-pass optimizer (kernels/fused_optim.py) under dp4 +
+    ZeRO-1 with a folded global-norm clip: the whole point of the
+    fusion is REMOVING state copies, so the proof is this audit — every
+    rewritten state buffer (params + both sharded Adam moments + the
+    beta-pow scalars) must still donate, with ZERO extra state copies
+    or host syncs vs the unfused chain's phase."""
+    import numpy as np
+
+    old = fluid.get_flags(["optimizer_fuse"])
+    fluid.set_flags({"optimizer_fuse": "on"})
+    try:
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup), fluid.unique_name.guard():
+            x = fluid.layers.data("x", [16])
+            y = fluid.layers.data("y", [1], dtype="int64")
+            h = fluid.layers.fc(
+                x, 32, act="relu",
+                param_attr=fluid.ParamAttr(name="fo_w1",
+                                           logical_axes=("embed", "mlp")),
+                bias_attr=fluid.ParamAttr(name="fo_b1",
+                                          logical_axes=("mlp",)))
+            logits = fluid.layers.fc(
+                h, 4, param_attr=fluid.ParamAttr(name="fo_w2",
+                                                 logical_axes=("mlp",
+                                                               "embed")))
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits, y))
+            fluid.optimizer.Adam(
+                0.01, grad_clip=fluid.clip.GradientClipByGlobalNorm(1.0)
+            ).minimize(loss)
+        ops = [op.type for op in main.global_block().ops]
+        if "fused_adam" not in ops:
+            raise RuntimeError(
+                "fused_optim phase: optimizer_fuse=on did not emit "
+                "fused_adam ops — the audit would silently re-prove "
+                "the unfused chain")
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe._force_donation = True  # CPU skips donation; audit must see it
+            exe.run(startup)
+            cfg = fluid.partition.PartitionConfig(mesh_axes={"dp": 4},
+                                                  zero=1)
+            compiled = fluid.CompiledProgram(main).with_partitioning(cfg)
+            feed = {"x": np.random.RandomState(7).rand(8, 16)
+                    .astype("float32"),
+                    "y": np.zeros((8, 1), "int64")}
+            for _ in range(3):
+                exe.run(compiled, feed=feed, fetch_list=[loss])
+        return [exe, scope, compiled]
+    finally:
+        fluid.set_flags(old)
+
+
 # -- the audit ----------------------------------------------------------------
 
 
@@ -291,13 +346,15 @@ def run_audit():
         snapshot("partition")
         keep.extend(_phase_collectives(fluid))
         snapshot("collectives")
+        keep.extend(_phase_fused_optim(fluid))
+        snapshot("fused_optim")
     finally:
         shutil.rmtree(tmpdir, ignore_errors=True)
 
-    # the partition/collectives phases exist to prove mesh-bound
-    # executables are audited, not skipped — an empty mesh column there
-    # means the audit silently lost its sharded coverage
-    for site in ("partition", "collectives"):
+    # the partition/collectives/fused_optim phases exist to prove
+    # mesh-bound executables are audited, not skipped — an empty mesh
+    # column there means the audit silently lost its sharded coverage
+    for site in ("partition", "collectives", "fused_optim"):
         if not any(b.audit_info().get("mesh")
                    for b in sites.get(site, [])):
             raise RuntimeError(

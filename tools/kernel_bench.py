@@ -294,6 +294,85 @@ def main():
                 row(name + "_device_loop", rows=R, vocab=V,
                     error=repr(e)[:300])
 
+    # -- fused optimizer: one-pass Adam vs the unfused XLA chain -------
+    # Step wall-ms AND bytes-moved (XLA cost analysis) per variant.
+    # The gate: the fused path must never move MORE bytes than the
+    # unfused chain — the whole claim of the fusion is the bandwidth
+    # floor (read p/g/m/v once, write p'/m'/v' once). SCOPE of the
+    # smoke arm: CPU XLA cannot cost-analyze a Mosaic kernel, so smoke
+    # gates the fused op's pure-JAX reference LOWERING (it catches a
+    # wrapper that grows extra copies/outputs, not kernel-internal
+    # traffic); the real Mosaic-kernel byte accounting is gated by the
+    # non-smoke TPU run of this tool plus the AOT rows'
+    # temp_bytes == 0 (tools/aot_check.py fused_adam_{f32,bf16}).
+    # Rows carry mode= so the evidence file says which was measured.
+    if _left() > 90:
+        from paddle_tpu.kernels import fused_optim as fo
+
+        N = (64, 256) if SMOKE else (4096, 2048)
+        p0 = jnp.asarray(rng.randn(*N), jnp.float32)
+        g0 = jnp.asarray(rng.randn(*N), jnp.float32)
+        m0 = jnp.zeros_like(p0)
+        v0 = jnp.zeros_like(p0)
+        lr0 = jnp.float32(1e-3)
+        b1p = jnp.full((1,), 0.9, jnp.float32)
+        b2p = jnp.full((1,), 0.999, jnp.float32)
+
+        def unfused_chain(p, g, m1, m2, lr, b1, b2):
+            # ops/optim.py's exact adam math — the chain being replaced
+            beta1, beta2, eps = 0.9, 0.999, 1e-8
+            lr_t = lr * jnp.sqrt(1 - b2.reshape(())) / (1 - b1.reshape(()))
+            m1n = beta1 * m1 + (1 - beta1) * g
+            m2n = beta2 * m2 + (1 - beta2) * jnp.square(g)
+            return p - lr_t * m1n / (jnp.sqrt(m2n) + eps), m1n, m2n
+
+        def fused(p, g, m1, m2, lr, b1, b2):
+            return fo.fused_adam_update(p, g, m1, m2, lr, b1, b2,
+                                        beta1=0.9, beta2=0.999,
+                                        epsilon=1e-8)
+
+        def fused_reference(p, g, m1, m2, lr, b1, b2):
+            lr_t = lr * jnp.sqrt(1 - b2.reshape(())) / (1 - b1.reshape(()))
+            return fo._reference_adam(p, g, m1, m2, lr_t, lr, None,
+                                      0.9, 0.999, 1e-8, 0.0)
+
+        args = (p0, g0, m0, v0, lr0, b1p, b2p)
+
+        def bytes_of(fn):
+            comp = jax.jit(fn).lower(*args).compile()
+            cost = comp.cost_analysis()
+            if isinstance(cost, (list, tuple)):
+                cost = cost[0] if cost else {}
+            v = cost.get("bytes accessed") if hasattr(cost, "get") else None
+            return float(v) if isinstance(v, (int, float)) else None
+
+        opt_rows = {}
+        for name, fn in (("adam_unfused_chain", unfused_chain),
+                         ("adam_fused", fused)):
+            try:
+                ms, cs = bench(jax.jit(fn), args, iters=10)
+                nbytes = bytes_of(fused_reference if (SMOKE
+                                  and name == "adam_fused") else fn)
+                opt_rows[name] = nbytes
+                row(name, shape=list(N), ms=ms, compile_s=cs,
+                    bytes_accessed=nbytes,
+                    mode=("reference_lowering" if SMOKE else "mosaic"))
+            except Exception as e:  # noqa: BLE001
+                row(name, shape=list(N), error=repr(e)[:300])
+        fb, ub = opt_rows.get("adam_fused"), opt_rows.get(
+            "adam_unfused_chain")
+        if fb is not None and ub is not None:
+            ok = fb <= ub * 1.01  # float-accounting slack only
+            r = {"name": "fused_optim_bytes_gate", "fused_bytes": fb,
+                 "unfused_bytes": ub, "ok": bool(ok),
+                 "mode": ("reference_lowering" if SMOKE else "mosaic")}
+            if not ok:
+                r["error"] = (f"fused adam moves MORE bytes than the "
+                              f"unfused chain ({fb:.0f} > {ub:.0f})")
+            RESULTS["rows"].append(r)
+            _save()
+            print(json.dumps(r))
+
     # -- microbench: locate the ResNet/BERT MFU gap --------------------
     # r4 first capture: ResNet-50 ran at 1.7% MFU with every conv
     # confirmed bf16 — these isolated timings tell WHERE the time goes

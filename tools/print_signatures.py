@@ -12,6 +12,8 @@ import sys
 
 MODULES = [
     "paddle_tpu",
+    "paddle_tpu.kernels",
+    "paddle_tpu.flags",
     "paddle_tpu.serving",
     "paddle_tpu.generation",
     "paddle_tpu.resilience",
