@@ -74,6 +74,7 @@ from . import resilience  # fault-tolerant training supervisor (chaos-tested)
 from . import partition  # logical-axis-rules partitioner (sharded execution)
 from . import observability  # unified telemetry: metrics/tracing/flight
 from . import traffic  # SLO-aware admission + multi-tenant scheduling
+from . import quantize  # post-training weight quantization (inference)
 
 # ``fluid``-style alias so reference user code reads naturally:
 #   import paddle_tpu as fluid

@@ -44,6 +44,11 @@ _DTYPE_ALIASES = {
     "bf16": "bfloat16",
     "int8": "int8",
     "uint8": "uint8",
+    # fp8 (ml_dtypes via jax): the quantized-inference weight dtype
+    # (paddle_tpu.quantize, wdtype="fp8" — e4m3 weights, bf16 compute)
+    "float8_e4m3fn": "float8_e4m3fn",
+    "fp8": "float8_e4m3fn",
+    "e4m3": "float8_e4m3fn",
     "int16": "int16",
     "int32": "int32",
     "int64": "int64",

@@ -89,6 +89,20 @@ _FLAG_DEFS: Dict[str, Any] = {
     "generation_chunk_tokens": 16,
     "generation_spec_tokens": 0,
     "generation_kv_dtype": "float32",
+    # paddle_tpu.quantize (inference weight quantization): "off" keeps
+    # fp32/bf16 weights; "int8" (per-output-channel fp32 scales) /
+    # "int8_block" (blockwise scales down the contraction axis, block
+    # size quantize_block) / "fp8" (e4m3 weights, bf16 compute) make
+    # Predictor construction and GenerationEngine rewrite every
+    # eligible matmul/fc weight ONCE at load into device-resident
+    # quantized buffers + scale planes (fp32 originals dropped — a
+    # 2-4x weight-HBM cut), repointing the program onto the
+    # quantized_matmul/quantized_fc ops. Composes with
+    # generation_kv_dtype="int8" for a fully-quantized ragged decode.
+    # Per-instance override: Config.enable_weight_quantization /
+    # GenerationEngine(quantize_weights=...).
+    "quantize_weights": "off",
+    "quantize_block": 256,
     # resilience/supervisor.py defaults (overridable per Supervisor /
     # CheckpointPolicy): checkpoint cadence is every-N-steps OR
     # every-T-seconds, whichever fires first (0 disables that trigger);

@@ -340,6 +340,7 @@ class GenerationEngine:
                  spec_tokens: Optional[int] = None,
                  draft=None,
                  kv_dtype: Optional[str] = None,
+                 quantize_weights: Optional[str] = None,
                  warmup: bool = False, start: bool = True):
         from ..flags import flag
 
@@ -390,6 +391,15 @@ class GenerationEngine:
             kv_dtype = (dtype if dtype != "float32"
                         else flag("generation_kv_dtype"))
         self.kv_dtype = str(kv_dtype)
+        # weight quantization (paddle_tpu.quantize): param > flag. The
+        # engine's programs rewrite onto the scope's quantized buffers
+        # below, AFTER they are built — composing with int8 KV pages
+        # for the fully-quantized ragged decode
+        self.quantize_weights = str(
+            quantize_weights if quantize_weights is not None
+            else flag("quantize_weights")) or "off"
+        self.quantize_report = None
+        self._quant_block = int(flag("quantize_block"))
         if self.kv_dtype == "int8" and self.mode != "ragged":
             raise ValueError("int8 KV pages require the ragged engine "
                              "(generation_engine_mode='ragged')")
@@ -432,6 +442,45 @@ class GenerationEngine:
         else:
             self._decode_prog, self._decode_fetches = build_decode_program(
                 config, self.geom)
+        if self.quantize_weights != "off":
+            from .. import quantize as _quantize
+
+            # the caller's predictor shares this scope — dropping the
+            # fp32 buffers under a program still pointing at them
+            # would brick predictor.run, so the predictor's program is
+            # rewritten FIRST (a no-op when Predictor construction
+            # already consumed the flag: the scope conversion is
+            # shared and idempotent)
+            if getattr(self._pred, "quantize_report", None) is None:
+                if getattr(self._pred, "partition", None) is not None:
+                    # with_partitioning resolved its shardings from
+                    # the fp32 var names at Predictor construction —
+                    # rewriting underneath it would bind the .q/
+                    # .qscale vars REPLICATED (no resolve entry, no
+                    # tag fallback), silently defeating the TP layout.
+                    # The ordered path exists: quantize at Predictor
+                    # construction, where the rewrite runs BEFORE the
+                    # partition resolve.
+                    raise ValueError(
+                        "quantize_weights on a partitioned predictor "
+                        "must be enabled at Predictor construction "
+                        "(Config.enable_weight_quantization or the "
+                        "quantize_weights flag), so the partition "
+                        "resolve sees the quantized vars")
+                rep = _quantize.rewrite_for_inference(
+                    self._pred._program, self._scope,
+                    wdtype=self.quantize_weights, block=self._quant_block)
+                # stamp the CALLER's predictor too — the clone copied
+                # the attribute by value, and the caller is the object
+                # later code inspects (and the one a second engine's
+                # already-rewritten check must see)
+                self._pred.quantize_report = rep
+                predictor.quantize_report = rep
+            prog = (self._ragged_prog if self.mode == "ragged"
+                    else self._decode_prog)
+            self.quantize_report = _quantize.rewrite_for_inference(
+                prog, self._scope, wdtype=self.quantize_weights,
+                block=self._quant_block)
 
         self._cond = threading.Condition()
         self._queue: "collections.deque[_GenRequest]" = collections.deque()
@@ -681,6 +730,15 @@ class GenerationEngine:
         entry = self._prefill_progs.get(bucket)
         if entry is None:
             entry = build_prefill_program(self.config, bucket, self.geom)
+            if self.quantize_weights != "off":
+                # two_lane prefill executables build lazily per seq
+                # bucket — each one repoints onto the scope's (already
+                # converted) quantized buffers before first bind
+                from .. import quantize as _quantize
+
+                _quantize.rewrite_for_inference(
+                    entry[0], self._scope, wdtype=self.quantize_weights,
+                    block=self._quant_block)
             self._prefill_progs[bucket] = entry
         return entry
 

@@ -19,6 +19,11 @@ attention/ffn/layer_norm/adam/softmax-ce):
     pool (kernels/ragged_paged_attention.py, custom Pallas lowering),
     with an int8-page variant reusing the kernels/quant.py blockwise
     machinery — the kernel under the ragged GenerationEngine
+  * quantized weight matmul — int8 / blockwise-int8 / fp8 weights with
+    per-channel or blockwise fp32 scale tracking
+    (kernels/quant_matmul.py): dequantize-in-registers inside the
+    matmul tile loop, the kernel layer under
+    paddle_tpu.quantize.rewrite_for_inference's quantized serving path
   * fused optimizer — one-pass Adam/AdamW/Momentum over donated
     buffers (kernels/fused_optim.py): the whole m/v/param update is a
     single Pallas pass per parameter with the global-norm-clip scale
@@ -37,6 +42,8 @@ from .flash_attention import flash_attention, flash_attention_layer
 from .fused_optim import (fused_adam_update, fused_momentum_update,
                           optimizer_fuse_enabled)
 from .layer_norm import fused_layer_norm, layer_norm_pallas
+from .quant_matmul import (dequantize_weight, quantize_weight,
+                           quantized_matmul, quantized_weight_bytes)
 from .paged_attention import (kv_cache_write, kv_cache_write_layer,
                               paged_attention, paged_attention_layer)
 from .ragged_paged_attention import (quantized_kv_cache_write,

@@ -4,6 +4,17 @@ Reference: operators/fake_quantize_op.cc / fake_dequantize_op.cc —
 quantize to int range and immediately dequantize, with straight-through
 gradients, so training sees quantization error. Scales: abs_max
 (per-tensor, current batch) or moving-average abs_max (running).
+
+Role split with the inference path (paddle_tpu.quantize): these ops
+are the TRAINING-side family — straight-through fake quant/dequant for
+QAT, plus the scale OBSERVERS. The observer op
+(``moving_average_abs_max_scale``) is also the engine behind
+``paddle_tpu.quantize.calibrate(program, feeds)``, which wires one
+observer per matmul input and runs calibration batches to produce the
+activation scales an activation-quantized (w8a8) variant would
+consume. Post-training WEIGHT quantization itself uses the real
+quantized ops in kernels/quant_matmul.py (int8/fp8 buffers + scale
+planes), not this family.
 """
 
 from __future__ import annotations

@@ -34,3 +34,6 @@ def test_all_kernels_and_headline_compile_for_v5e():
     assert not bad, bad
     names = {r["name"] for r in results["rows"]}
     assert "stage_headline_bert_base_s512_flash" in names
+    # the quantized-inference kernel rows (PT_AOT_ONLY=quant group)
+    for mode in ("int8", "int8_block", "fp8"):
+        assert f"quant_matmul_{mode}" in names

@@ -260,3 +260,46 @@ def test_workload_fingerprint_stable_across_processes(adir):
                            check=True).stdout.strip().splitlines()[-1]
             for _ in range(2)}
     assert len(outs) == 1 and len(next(iter(outs))) == 64
+
+
+def test_cost_model_reads_quantized_weight_bytes(adir):
+    """A quantized inference program (paddle_tpu.quantize rewrite) must
+    feed the cost model its ACTUAL weight bytes — int8 buffers at
+    1 byte + fp32 scale planes — not the pre-rewrite fp32 sizes, and
+    the dequant-inflated bytes_accessed of the CPU reference lowering
+    must be corrected before the intensity classification."""
+    import autotune as at
+
+    from paddle_tpu import quantize
+    from paddle_tpu.runtime.dispatch import program_fingerprint
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data("x", [64])
+        h = fluid.layers.fc(x, 128, act="relu")
+        out = fluid.layers.fc(h, 16)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        fp32_bytes = at.weight_stream_bytes(main)
+        fp32_fp = program_fingerprint(main)
+        rep = quantize.rewrite_for_inference(main, scope, "int8")
+    assert rep.n_quantized == 2
+    q_bytes = at.weight_stream_bytes(main)
+    # int8 weights + fp32 scales + fp32 biases: well under half
+    assert q_bytes < 0.5 * fp32_bytes
+    assert at._quantized_weight_elems(main) == 64 * 128 + 128 * 16
+    # the rewrite changes program content -> a DIFFERENT fingerprint,
+    # so an fp32 profile can never cross-apply to the quantized engine
+    assert program_fingerprint(main) != fp32_fp
+    # intensity correction: with gauges whose bytes_accessed carries
+    # the fp32 dequant inflation, the effective bytes drop by the
+    # quantized weights' fp32-equivalent (floored at the true stream)
+    gauges = {"paddle_xla_flops": 1e6,
+              "paddle_xla_bytes_accessed": 4.0 * at._quantized_weight_elems(
+                  main) + 1e5}
+    _flags, rat = at.derive_cost_model_flags(main, gauges, batch=32)
+    assert rat["quantized_weight_elems"] == 64 * 128 + 128 * 16
+    assert rat["weight_stream_bytes"] == q_bytes
+    assert rat["bytes_accessed_effective"] <= max(1e5, q_bytes)

@@ -544,6 +544,10 @@ COVERED_ELSEWHERE = {
     # ragged==two_lane==oracle equivalence through churn/eviction)
     'ragged_paged_attention', 'ragged_paged_attention_q',
     'kv_cache_write_q',
+    # PR-15 quantized weight matmul (tests/test_quantize.py: kernel vs
+    # oracle all three formats + tile-unaligned shapes, rewrite
+    # output-parity, fully-quantized ragged engine agreement)
+    'quantized_matmul', 'quantized_fc',
     # PR-9 gradient-collective planner (tests/test_collectives.py:
     # bucketed fp32 bit-identity vs monolithic x4 trajectories, int8
     # quant round-trip bound, exchange==psum-form equivalence, and

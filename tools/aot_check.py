@@ -234,6 +234,27 @@ def _child():
         lanes=Rl, chunk=Ck, heads=Hh, head_dim=Dd, pages=Pp,
         page_size=psz)
 
+    # -- quantized weight matmul (the inference serving path) ----------
+    # paddle_tpu.quantize rewrites every matmul/fc weight onto these
+    # kernels at load; the rows compile the custom Pallas lowering
+    # (dequantize-in-registers, scales streamed as [1, bn] blocks) for
+    # v5e in all three weight formats at a GPT-shaped [M, K] x [K, N].
+    # Run just these with PT_AOT_ONLY=quant.
+    from paddle_tpu.kernels.quant_matmul import _quant_matmul_pallas
+
+    Mq, Kq, Nq = 256, 2048, 2048
+    xq = jax.ShapeDtypeStruct((Mq, Kq), bf)
+    for qtag, qdt, sshape in (
+            ("int8", jnp.int8, (Nq,)),
+            ("int8_block", jnp.int8, (Kq // 256, Nq)),
+            ("fp8", jnp.float8_e4m3fn, (Nq,))):
+        wq8 = jax.ShapeDtypeStruct((Kq, Nq), qdt)
+        sq = jax.ShapeDtypeStruct(sshape, jnp.float32)
+        aot(f"quant_matmul_{qtag}",
+            lambda x, w, s, m=qtag: _quant_matmul_pallas(
+                x, w, s, m, 256, interpret=False),
+            (xq, wq8, sq), group="quant", M=Mq, K=Kq, N=Nq, mode=qtag)
+
     # -- fused optimizer: ONE Pallas pass per parameter ----------------
     # The whole m/v/param Adam update (bias correction + folded
     # global-norm clip scale) compiles as one Mosaic kernel over

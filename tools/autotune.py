@@ -162,6 +162,55 @@ def _state_bytes(main):
     return total
 
 
+def _dtype_itemsize(dtype) -> int:
+    import numpy as np
+
+    try:
+        return int(np.dtype(str(dtype)).itemsize)
+    except (TypeError, ValueError):
+        return 1  # fp8 family: 1 byte (ml_dtypes normally registers it)
+
+
+def weight_stream_bytes(program) -> int:
+    """DTYPE-AWARE bytes of the program's persistable weights — what a
+    serving step actually streams from HBM. A quantized program
+    (paddle_tpu.quantize rewrite) counts its int8/fp8 buffers at
+    1 byte/element plus the fp32 scale planes, NOT the pre-rewrite
+    fp32 sizes — assuming 4 bytes everywhere would over-estimate a
+    quantized engine's weight traffic (and with it mis-classify its
+    arithmetic intensity) by the dequant factor."""
+    total = 0
+    for v in program.global_block().vars.values():
+        if not getattr(v, "persistable", False) or not v.shape:
+            continue
+        if any(d is None or int(d) < 0 for d in v.shape):
+            continue
+        n = 1
+        for d in v.shape:
+            n *= int(d)
+        total += n * _dtype_itemsize(v.dtype)
+    return total
+
+
+def _quantized_weight_elems(program) -> int:
+    """Total elements of weights consumed through the quantized matmul
+    ops — the tensors whose CPU-reference lowering materializes an
+    fp32 dequantized copy that inflates XLA's bytes_accessed."""
+    gb = program.global_block()
+    names = set()
+    for op in gb.ops:
+        if op.type in ("quantized_matmul", "quantized_fc"):
+            names.update(op.inputs.get("QWeight", ()))
+    total = 0
+    for n in names:
+        if gb.has_var(n) and gb.var(n).shape:
+            k = 1
+            for d in gb.var(n).shape:
+                k *= max(int(d), 1)
+            total += k
+    return total
+
+
 def _xla_gauges():
     """The observability_xla_analysis compile-time gauges of the TRAIN
     step. Several executables register gauges in one process (the
@@ -198,6 +247,18 @@ def derive_cost_model_flags(main, xla, batch, seq_extent=None):
 
     flops = xla.get("paddle_xla_flops", 0.0)
     bytes_acc = xla.get("paddle_xla_bytes_accessed", 0.0)
+    # quantized programs (paddle_tpu.quantize): the gauges may have
+    # been captured on the CPU-reference lowering, whose dequantize
+    # materializes an fp32 copy of every quantized weight — on TPU the
+    # dequant stays in registers, so the weight stream is the int8/fp8
+    # bytes. Swap the fp32-equivalent weight traffic for the true
+    # quantized bytes before classifying intensity, or a quantized
+    # engine's serving batch / generation chunk knobs would be derived
+    # from weight bytes it no longer moves.
+    q_elems = _quantized_weight_elems(main)
+    w_stream = weight_stream_bytes(main)
+    if q_elems and bytes_acc:
+        bytes_acc = max(bytes_acc - 4.0 * q_elems, float(w_stream))
     intensity = (flops / bytes_acc) if bytes_acc else 0.0
     # bandwidth-bound (< ~4 flops/byte): bigger serving batches / decode
     # chunks amortize the weight streaming; compute-bound: keep them
@@ -225,6 +286,9 @@ def derive_cost_model_flags(main, xla, batch, seq_extent=None):
         "target_buckets": TARGET_BUCKETS,
         "arithmetic_intensity_flops_per_byte": round(intensity, 3),
         "bandwidth_bound": bandwidth_bound,
+        "weight_stream_bytes": int(w_stream),
+        "quantized_weight_elems": int(q_elems),
+        "bytes_accessed_effective": float(bytes_acc),
         "xla": xla,
     }
     return flags, rationale

@@ -308,6 +308,45 @@ def _phase_fused_optim(fluid):
         fluid.set_flags(old)
 
 
+def _phase_quantized_predict(fluid, tmpdir):
+    """Quantized tp2 GPT predict (paddle_tpu.quantize): the rewrite
+    swaps every matmul weight for int8 buffer + scale plane state —
+    the audit proves the quantized path adds ZERO new host-sync points
+    vs the fp32 predict allowlist, and that the mesh-bound quantized
+    executable is audited like every other sharded one (the
+    mesh-coverage hard error covers this site)."""
+    import numpy as np
+
+    from paddle_tpu.inference import Config, create_predictor
+    from paddle_tpu.models.gpt import GPTConfig, build_gpt_lm
+
+    gcfg = GPTConfig.tiny()
+    qdir = os.path.join(tmpdir, "quant_lm")
+    main, startup, _, fetches = build_gpt_lm(gcfg, 32, is_test=True)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        fluid.io.save_inference_model(qdir, ["tokens"],
+                                      [fetches["logits"]], exe, main)
+    icfg = Config(qdir)
+    icfg.enable_weight_quantization("int8")
+    # the gpt ParamAttr logical_axes tags survive save/load AND the
+    # quantize rewrite (the int8 weight + scale vars inherit them), so
+    # the same rules table shards the quantized predict over tp2
+    icfg.enable_partitioning(mesh_axes={"tp": 2})
+    pred = create_predictor(icfg)
+    if pred.quantize_report is None or pred.quantize_report.n_quantized == 0:
+        raise RuntimeError(
+            "quantized_predict phase: the rewrite quantized nothing — "
+            "the audit would silently re-prove the fp32 path")
+    pred._exe._force_donation = True
+    rng = np.random.RandomState(8)
+    for _ in range(3):
+        pred.run([rng.randint(0, gcfg.vocab_size, (2, 32)).astype("int64")])
+    return [pred]
+
+
 # -- the audit ----------------------------------------------------------------
 
 
@@ -348,13 +387,16 @@ def run_audit():
         snapshot("collectives")
         keep.extend(_phase_fused_optim(fluid))
         snapshot("fused_optim")
+        keep.extend(_phase_quantized_predict(fluid, tmpdir))
+        snapshot("quantized_predict")
     finally:
         shutil.rmtree(tmpdir, ignore_errors=True)
 
     # the partition/collectives/fused_optim phases exist to prove
     # mesh-bound executables are audited, not skipped — an empty mesh
     # column there means the audit silently lost its sharded coverage
-    for site in ("partition", "collectives", "fused_optim"):
+    for site in ("partition", "collectives", "fused_optim",
+                 "quantized_predict"):
         if not any(b.audit_info().get("mesh")
                    for b in sites.get(site, [])):
             raise RuntimeError(

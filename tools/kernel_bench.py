@@ -18,6 +18,7 @@ Timing discipline: through the axon tunnel `block_until_ready` does
 NOT block — every timing forces a `np.asarray` readback.
 """
 
+import functools
 import json
 import os
 import sys
@@ -372,6 +373,48 @@ def main():
             RESULTS["rows"].append(r)
             _save()
             print(json.dumps(r))
+
+    # -- quantized weight matmul (the inference serving path) ----------
+    # int8 / blockwise-int8 / fp8 weight matmul vs the fp32 baseline:
+    # wall-ms per variant + a numerics row against the dequantized
+    # reference. Smoke runs the interpret-mode Pallas kernel; on TPU
+    # the compiled Mosaic kernel's weight-streaming win is the number
+    # this table exists to capture.
+    if _left() > 90:
+        from paddle_tpu.kernels import quant_matmul as qm
+
+        Mq, Kq, Nq = (32, 256, 128) if SMOKE else (1024, 4096, 4096)
+        wq = rng.randn(Kq, Nq).astype("float32") * 0.1
+        xq = jnp.asarray(rng.randn(Mq, Kq).astype("float32"))
+        base = jax.jit(jnp.matmul)
+        try:
+            ms, cs = bench(base, (xq, jnp.asarray(wq)))
+            row("matmul_fp32_baseline", M=Mq, K=Kq, N=Nq, ms=ms,
+                compile_s=cs)
+        except Exception as e:  # noqa: BLE001
+            row("matmul_fp32_baseline", error=repr(e)[:300])
+        want = np.asarray(xq) @ wq
+        # block must be a 128-multiple: the contraction tile IS the
+        # block, and Mosaic rejects sub-lane trailing tiles — a
+        # smaller value would error the TPU row this table exists for
+        qblk = 128
+        for mode, tol in (("int8", 0.05), ("int8_block", 0.05),
+                          ("fp8", 0.08)):
+            try:
+                q, s = qm.quantize_weight(wq, mode, block=qblk)
+                fn = jax.jit(functools.partial(
+                    qm.quantized_matmul, mode=mode, block=qblk))
+                ms, cs = bench(fn, (xq, q, s))
+                got = np.asarray(fn(xq, q, s), np.float32)
+                rel = float(np.abs(got - want).max()
+                            / (np.abs(want).max() or 1.0))
+                row(f"quant_matmul_{mode}", M=Mq, K=Kq, N=Nq, ms=ms,
+                    compile_s=cs, max_rel_err=round(rel, 5),
+                    ok=bool(rel < tol),
+                    mode=("interpret" if SMOKE else "mosaic"))
+            except Exception as e:  # noqa: BLE001
+                row(f"quant_matmul_{mode}", M=Mq, K=Kq, N=Nq,
+                    error=repr(e)[:300])
 
     # -- microbench: locate the ResNet/BERT MFU gap --------------------
     # r4 first capture: ResNet-50 ran at 1.7% MFU with every conv

@@ -20,6 +20,7 @@ MODULES = [
     "paddle_tpu.observability",
     "paddle_tpu.partition",
     "paddle_tpu.traffic",
+    "paddle_tpu.quantize",
     "paddle_tpu.layers",
     "paddle_tpu.optimizer",
     "paddle_tpu.nets",
