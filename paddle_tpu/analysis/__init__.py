@@ -33,6 +33,13 @@ from .analyzer import (
     validate_for_run,
 )
 from . import passes  # noqa: F401  — registers the built-in passes
+from . import dist_passes  # noqa: F401  — registers the distlint passes
+from .dist_passes import (
+    check_program_batch,
+    collective_stream,
+    compare_collective_streams,
+    donation_plan,
+)
 
 __all__ = [
     "AnalysisReport",
@@ -46,6 +53,10 @@ __all__ = [
     "WARN",
     "PassContext",
     "analyze_program",
+    "check_program_batch",
+    "collective_stream",
+    "compare_collective_streams",
+    "donation_plan",
     "emit_eager",
     "is_suppressed",
     "register_pass",

@@ -59,11 +59,24 @@ class PassContext:
 
     def __init__(self, program, report: AnalysisReport,
                  fetch_names: Optional[Sequence[str]] = None,
-                 feed_names: Optional[Sequence[str]] = None):
+                 feed_names: Optional[Sequence[str]] = None,
+                 mesh_axes: Optional[Dict[str, int]] = None,
+                 rules=None):
         self.program = program
         self.report = report
         self.fetch_names = list(fetch_names) if fetch_names else []
         self.feed_names = list(feed_names) if feed_names else []
+        # distributed context for the PTL06x partition passes: the
+        # mesh's {axis: size} and the logical-axis rules table. None
+        # mesh means "no mesh bound" — mesh-dependent checks stay
+        # quiet (a program is not wrong for being lintable without a
+        # mesh); rules default to partition.rules.DEFAULT_RULES.
+        self.mesh_axes = dict(mesh_axes) if mesh_axes else None
+        if rules is None:
+            from ..partition.rules import DEFAULT_RULES
+
+            rules = DEFAULT_RULES
+        self.rules = tuple(rules)
         self._pass_name = ""
 
     # -- emission -------------------------------------------------------------
@@ -116,7 +129,9 @@ class PassContext:
 
 def analyze_program(program, fetch_names=None, feed_names=None,
                     passes: Optional[Sequence[str]] = None,
-                    label: str = "<program>") -> AnalysisReport:
+                    label: str = "<program>",
+                    mesh_axes: Optional[Dict[str, int]] = None,
+                    rules=None) -> AnalysisReport:
     """Run the analyzer over `program` and return the report.
 
     ``passes`` selects a subset by name (default: all registered, in
@@ -126,10 +141,12 @@ def analyze_program(program, fetch_names=None, feed_names=None,
     pass means the program was NOT verified (fail closed, not open).
     """
     from . import passes as _passes  # noqa: F401  (registers on import)
+    from . import dist_passes as _dist  # noqa: F401  (registers on import)
 
     report = AnalysisReport(label)
     ctx = PassContext(program, report, fetch_names=fetch_names,
-                      feed_names=feed_names)
+                      feed_names=feed_names, mesh_axes=mesh_axes,
+                      rules=rules)
     selected = list(_PASS_REGISTRY) if passes is None else list(passes)
     for name in selected:
         if name not in _PASS_REGISTRY:
@@ -154,7 +171,9 @@ def analyze_program(program, fetch_names=None, feed_names=None,
 
 def validate_for_run(program, fetch_names=None, feed_names=None,
                      mode: str = "warn",
-                     label: str = "<program>") -> AnalysisReport:
+                     label: str = "<program>",
+                     mesh_axes: Optional[Dict[str, int]] = None,
+                     rules=None) -> AnalysisReport:
     """Executor pre-lowering hook (core/executor.py::_compile).
 
     off    — no-op: returns an empty (ok) report.
@@ -163,6 +182,7 @@ def validate_for_run(program, fetch_names=None, feed_names=None,
              ProgramVerificationError BEFORE any lowering happens.
     """
     from . import passes as _passes  # noqa: F401
+    from . import dist_passes as _dist  # noqa: F401
 
     if mode == "off":
         return AnalysisReport(label)  # disabled: an empty, ok report
@@ -174,7 +194,7 @@ def validate_for_run(program, fetch_names=None, feed_names=None,
              if not expensive]
     report = analyze_program(program, fetch_names=fetch_names,
                              feed_names=feed_names, passes=cheap,
-                             label=label)
+                             label=label, mesh_axes=mesh_axes, rules=rules)
     if mode == "strict":
         # structural errors reject BEFORE the expensive passes so that
         # no op lowering is consulted (even abstractly) for a program
@@ -184,7 +204,8 @@ def validate_for_run(program, fetch_names=None, feed_names=None,
         expensive = [n for n, (_, e) in _PASS_REGISTRY.items() if e]
         deep = analyze_program(program, fetch_names=fetch_names,
                                feed_names=feed_names, passes=expensive,
-                               label=label)
+                               label=label, mesh_axes=mesh_axes,
+                               rules=rules)
         report.extend(deep.diagnostics)
         report.passes_run.extend(deep.passes_run)
         if not report.ok:

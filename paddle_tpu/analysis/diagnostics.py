@@ -45,7 +45,27 @@ CODES: Dict[str, tuple] = {
     "PTL050": (ERROR, "same variable written by two pipeline stages (WAW)"),
     "PTL051": (ERROR, "variable read by an earlier pipeline stage is written by a later one (WAR)"),
     "PTL052": (ERROR, "pipeline segmentation is inconsistent"),
+    # PTL06x — partition consistency (analysis/dist_passes.py)
+    "PTL060": (WARN, "partition tag dropped or unresolvable"),
+    "PTL061": (ERROR, "conflicting partition specs reach one variable"),
+    "PTL062": (WARN, "partition axis size does not divide the dimension"),
+    "PTL063": (INFO, "implicit reshard hotspot (GSPMD will insert a collective)"),
+    "PTL064": (ERROR, "quantized var partition tags inconsistent with the original's"),
+    # PTL07x — collective safety
+    "PTL070": (ERROR, "collective inside data-dependent control flow (deadlock class)"),
+    "PTL071": (ERROR, "collectives on one ring split across concurrent pipeline stages"),
+    "PTL072": (ERROR, "collective uses a ring the dist plan never initializes"),
+    "PTL073": (ERROR, "collective streams differ across ranks (deadlock class)"),
+    # PTL08x — donation / aliasing
+    "PTL080": (ERROR, "use-after-donation: var consumed after its buffer was donated away"),
+    "PTL081": (WARN, "double donation: state var rewritten in place more than once"),
+    "PTL082": (ERROR, "fed variable is also donated rewritten state"),
     "PTL090": (ERROR, "analysis pass crashed (internal error)"),
+    # PTL09x — kernel call-site geometry (kernels/constraints.py table)
+    "PTL091": (ERROR, "kernel tile geometry violates the Mosaic lane constraints"),
+    "PTL092": (WARN, "kernel geometry forces the reference fallback on TPU"),
+    "PTL093": (ERROR, "kernel call-site shape contract violation"),
+    "PTL094": (WARN, "kernel VMEM estimate exceeds the per-core budget"),
 }
 
 

@@ -1,0 +1,726 @@
+"""Distributed / TPU analysis passes over the Program IR (distlint).
+
+Four pass families extend the structural analyzer (passes.py) to the
+properties that used to be guarded only reactively at runtime:
+
+  partition-consistency  PTL060-064 — partition tags checked against a
+                         mesh/rules context: dead or unresolvable tags,
+                         conflicting specs reaching one var, axis sizes
+                         that do not divide the dim, tags dropped by
+                         the quantize rewrite (its inheritance is a
+                         CHECKED invariant via ``_quant_tag_record``),
+                         and implicit-reshard hotspots (a light spec
+                         propagation finds the matmuls GSPMD will wrap
+                         in collectives).
+  collective-safety      PTL070-073 — the static deadlock detector:
+                         collectives inside data-dependent control
+                         flow, one ring split across concurrent
+                         pipeline stages, rings the dist plan never
+                         initializes, and (cross-program, via
+                         ``collective_stream``) ranks observing
+                         different collective sequences.
+  donation-safety        PTL081/082 — the donation plan derived
+                         offline through the EXACT function the
+                         executor uses (core.executor.
+                         analyze_block_state), so ``donation_audit``'s
+                         runtime findings are reproducible without
+                         running anything; PTL080's cross-program form
+                         (quantize-erasure stale reads) lives in
+                         ``check_program_batch`` for the CLI.
+  kernel-geometry        PTL091-094 — every call site of a
+                         Pallas-backed op checked against the
+                         declarative constraint table in
+                         kernels/constraints.py.
+
+All four are CHEAP passes (pure metadata walks — no tracing), so the
+executor's default warn-mode hook runs them on every compile-cache
+miss; strict mode raises before lowering. Mesh-dependent checks only
+fire when the run supplies a mesh context (PassContext.mesh_axes) —
+a program is not wrong for being linted without one.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .analyzer import PassContext, register_pass
+from .diagnostics import ERROR, INFO, WARN
+from .passes import (
+    _PSEUDO_OPS,
+    _control_flow_types,
+    _op_reads,
+    _op_writes,
+    _resolve_var,
+)
+
+# ops whose lowering is (or contains) a cross-device collective; the
+# attr key is always ring_id (reference NCCL ring convention)
+COLLECTIVE_OPS = frozenset({
+    "c_allreduce_sum", "c_allreduce_max", "c_allreduce_min",
+    "c_allreduce_prod", "allreduce", "c_broadcast", "broadcast",
+    "c_allgather", "c_reducescatter", "collective_bucket_reduce",
+})
+
+# control-flow bodies whose execution count depends on runtime DATA
+# (while's condition, conditional_block's predicate). A collective in
+# one is the classic SPMD deadlock: ranks disagree on the trip count
+# and someone blocks forever. recompute_segment_grad re-runs a fixed
+# body — not data-dependent.
+_DATA_DEPENDENT_CF = frozenset({"while", "conditional_block"})
+
+# the matmul family (+ quantized twins): out = X[..., :-1] ++ Y[-1:],
+# contracting X's last dim against the weight's first
+_MATMUL_OPS = frozenset({
+    "mul", "matmul", "matmul_v2", "quantized_matmul", "quantized_fc",
+})
+
+# ops that keep their input's layout: the output inherits the spec
+_SPEC_PASSTHROUGH = frozenset({
+    "elementwise_add", "elementwise_sub", "elementwise_mul",
+    "elementwise_div", "relu", "gelu", "tanh", "sigmoid", "dropout",
+    "scale", "cast", "clip", "sqrt", "square", "softmax", "layer_norm",
+})
+
+# ops that reduce/normalize over their LAST dim: a sharded last dim
+# means a cross-shard reduction per call (PTL063)
+_LASTDIM_REDUCERS = frozenset({
+    "softmax", "softmax_with_cross_entropy", "layer_norm",
+    "cross_entropy", "log_softmax",
+})
+
+
+# ==========================================================================
+# PTL06x — partition consistency
+# ==========================================================================
+
+
+def _var_spec(var, mesh_axes, rules) -> Optional[Tuple]:
+    """A var's mesh-space placement: explicit sharding wins; else the
+    rules-resolved logical_axes; else unknown (None). Mirrors
+    partition.PartitionConfig.resolve precedence for the two sources a
+    Variable itself carries."""
+    sh = getattr(var, "sharding", None)
+    if sh is not None:
+        if mesh_axes is not None and any(
+                a is not None and a not in mesh_axes for a in sh):
+            return tuple(None for _ in sh)  # resolver overrides to replicated
+        return tuple(sh)
+    la = getattr(var, "logical_axes", None)
+    if la is not None and mesh_axes is not None:
+        from ..partition.rules import resolve_spec
+
+        spec, _ = resolve_spec(la, rules, mesh_axes, var.shape)
+        return spec
+    return None
+
+
+def _rule_names(rules) -> Set[str]:
+    return {l for l, _ in rules}
+
+
+@register_pass("partition-consistency")
+def check_partition_consistency(ctx: PassContext) -> None:
+    from ..partition.rules import resolve_spec
+
+    program = ctx.program
+    mesh = ctx.mesh_axes  # {axis: size} or None
+    rules = ctx.rules
+    known_logical = _rule_names(rules)
+
+    seen: Set[str] = set()
+    for blk in program.blocks:
+        for name, var in blk.vars.items():
+            if name in seen:
+                continue
+            seen.add(name)
+            shape = var.shape
+            la = getattr(var, "logical_axes", None)
+            sh = getattr(var, "sharding", None)
+
+            if la is not None:
+                if shape is not None and len(la) != len(shape):
+                    ctx.emit(
+                        "PTL060",
+                        f"var {name!r} tags {len(la)} logical axes "
+                        f"{tuple(la)} but has {len(shape)} dims "
+                        f"(shape {tuple(shape)}) — the resolver cannot "
+                        "line them up", block=blk, var=name)
+                dead = [a for a in la
+                        if a is not None and a not in known_logical]
+                for a in dead:
+                    ctx.emit(
+                        "PTL060",
+                        f"var {name!r} tags logical axis {a!r} which no "
+                        "rule maps — the dim silently stays replicated "
+                        "on every mesh", block=blk, var=name,
+                        suggestion=f"add a ('{a}', <mesh axis>) rule or "
+                                   "drop the tag")
+                if mesh is not None:
+                    # a tagged axis whose EVERY rule targets a mesh axis
+                    # absent from this mesh resolves to nothing — often
+                    # intended (one rules table serves a dp-only training
+                    # mesh and a tp-only serving mesh), so INFO, but it is
+                    # how dead mappings surface: DEFAULT_RULES shipped
+                    # expert->tp for a codebase whose expert-parallel
+                    # meshes are all named "ep"
+                    for a in la:
+                        if a is None or a in dead:
+                            continue
+                        targets = [m for l, m in rules if l == a]
+                        if targets and all(
+                                m is not None and m not in mesh
+                                for m in targets):
+                            ctx.emit(
+                                "PTL060",
+                                f"var {name!r} logical axis {a!r} maps "
+                                f"only to mesh ax{'is' if len(targets) == 1 else 'es'} "
+                                f"{sorted(set(targets))} absent from the "
+                                f"mesh {dict(mesh)} — the dim stays "
+                                "replicated here", block=blk, var=name,
+                                severity=INFO)
+                if mesh is not None and shape is not None \
+                        and len(la) == len(shape):
+                    _, skipped = resolve_spec(la, rules, mesh, shape)
+                    for d, lax, maxis, reason in skipped:
+                        if "not divisible" in reason:
+                            ctx.emit(
+                                "PTL062",
+                                f"var {name!r} dim {d} (logical {lax!r}) "
+                                f"wants mesh axis {maxis!r} but {reason} "
+                                "— it stays replicated on this mesh",
+                                block=blk, var=name)
+
+            if sh is not None:
+                non_none = [a for a in sh if a is not None]
+                dupes = {a for a in non_none if non_none.count(a) > 1}
+                for a in sorted(dupes):
+                    ctx.emit(
+                        "PTL061",
+                        f"var {name!r} explicit sharding {tuple(sh)} "
+                        f"uses mesh axis {a!r} on more than one dim — "
+                        "one axis cannot shard two dims of one tensor",
+                        block=blk, var=name)
+                if shape is not None and len(sh) != len(shape):
+                    ctx.emit(
+                        "PTL060",
+                        f"var {name!r} explicit sharding {tuple(sh)} has "
+                        f"{len(sh)} entries for {len(shape)} dims",
+                        block=blk, var=name)
+                if mesh is not None:
+                    missing = [a for a in non_none if a not in mesh]
+                    for a in sorted(set(missing)):
+                        ctx.emit(
+                            "PTL060",
+                            f"var {name!r} explicit sharding names mesh "
+                            f"axis {a!r} absent from the mesh "
+                            f"{dict(mesh)} — the resolver overrides the "
+                            "whole spec to replicated", block=blk,
+                            var=name)
+                    if not missing and shape is not None \
+                            and len(sh) == len(shape) and not dupes:
+                        for d, a in enumerate(sh):
+                            if a is None:
+                                continue
+                            dim = shape[d]
+                            size = mesh[a]
+                            if dim is not None and int(dim) > 0 \
+                                    and int(dim) % size:
+                                ctx.emit(
+                                    "PTL062",
+                                    f"var {name!r} explicit sharding pins "
+                                    f"dim {d} ({dim}) on mesh axis {a!r} "
+                                    f"of size {size}, which does not "
+                                    "divide it — GSPMD would need uneven "
+                                    "shards", block=blk, var=name,
+                                    severity=ERROR)
+                    # explicit vs rules: both resolving to DIFFERENT
+                    # non-None axes on this mesh is a real conflict
+                    # (explicit-replicated overriding a rule is the
+                    # documented escape hatch, so None never conflicts)
+                    if la is not None and shape is not None \
+                            and len(la) == len(shape) \
+                            and len(sh) == len(shape) and not missing:
+                        rspec, _ = resolve_spec(la, rules, mesh, shape)
+                        for d, (ra, ea) in enumerate(zip(rspec, sh)):
+                            if ra is not None and ea is not None \
+                                    and ra != ea:
+                                ctx.emit(
+                                    "PTL061",
+                                    f"var {name!r} dim {d}: explicit "
+                                    f"sharding says {ea!r} but logical "
+                                    f"axis {la[d]!r} resolves to {ra!r} "
+                                    "on this mesh — two sources disagree "
+                                    "on the placement", block=blk,
+                                    var=name, severity=WARN)
+
+    _check_quant_tag_invariant(ctx)
+    if mesh is not None:
+        _check_reshard_hotspots(ctx)
+
+
+def _check_quant_tag_invariant(ctx: PassContext) -> None:
+    """The quantize rewrite's tag inheritance as a checked invariant:
+    every recorded drop is a finding (PTL060, error — serving-path
+    tags do not vanish silently), and the .q/.qscale tags on the
+    program must still MATCH what the rewrite recorded + what the
+    kernel's layout expects (PTL064)."""
+    program = ctx.program
+    gb = program.global_block()
+
+    for rec in getattr(program, "_quant_tag_record", None) or ():
+        if rec.get("dropped_reason"):
+            ctx.emit(
+                "PTL060",
+                f"quantize rewrite dropped {rec['kind']} "
+                f"{tuple(rec['original'])} of {rec['name']!r}: "
+                f"{rec['dropped_reason']} — the quantized serving path "
+                "lost the partition intent", var=rec.get("qname"),
+                severity=ERROR)
+
+    for blk, i, op in ctx.iter_ops():
+        if op.type not in ("quantized_matmul", "quantized_fc"):
+            continue
+        qnames = op.inputs.get("QWeight", [])
+        snames = op.inputs.get("Scale", [])
+        if not qnames or not snames:
+            continue
+        qv = _resolve_var(blk, qnames[0])
+        sv = _resolve_var(blk, snames[0])
+        if qv is None or sv is None:
+            continue  # PTL001's finding
+        mode = str(op.attrs.get("quant_mode", "int8"))
+        for kind in ("logical_axes", "sharding"):
+            qt = getattr(qv, kind, None)
+            st = getattr(sv, kind, None)
+            if qt is None and st is None:
+                continue
+            if qt is None or len(qt) != 2:
+                ctx.emit(
+                    "PTL064",
+                    f"scale plane {snames[0]!r} carries {kind} "
+                    f"{st and tuple(st)} but the quantized weight "
+                    f"{qnames[0]!r} has none — the pair would shard "
+                    "differently", block=blk, op_idx=i, op=op,
+                    var=qnames[0])
+                continue
+            want = (None, qt[1]) if mode == "int8_block" else (qt[1],)
+            if st is None or tuple(st) != want:
+                ctx.emit(
+                    "PTL064",
+                    f"scale plane {snames[0]!r} {kind} is "
+                    f"{st and tuple(st)} but the {mode} layout for a "
+                    f"weight tagged {tuple(qt)} requires {want} — the "
+                    "scale must shard with the output-channel axis",
+                    block=blk, op_idx=i, op=op, var=snames[0])
+
+
+def _check_reshard_hotspots(ctx: PassContext) -> None:
+    """Light forward spec propagation over the global block to find
+    the sites where GSPMD must insert a collective: a matmul whose
+    contraction dim is sharded (allreduce / reduce-scatter per call)
+    and a last-dim reducer over a sharded last dim (cross-shard
+    softmax/norm). INFO severity: these are often intended (megatron
+    TP pays exactly one allreduce per block) — the pass makes the
+    placement visible, strict mode never fails on it."""
+    program = ctx.program
+    mesh = ctx.mesh_axes
+    rules = ctx.rules
+    gb = program.global_block()
+
+    spec: Dict[str, Tuple] = {}
+    for blk in program.blocks:
+        for name, var in blk.vars.items():
+            s = _var_spec(var, mesh, rules)
+            if s is not None and any(a is not None for a in s):
+                spec[name] = s
+
+    def first(slot_names):
+        return slot_names[0] if slot_names else None
+
+    for i, op in enumerate(gb.ops):
+        if op.type in _PSEUDO_OPS:
+            continue
+        if op.type in _MATMUL_OPS:
+            xn = first(op.inputs.get("X", []))
+            yn = first(op.inputs.get("QWeight" if op.type.startswith(
+                "quantized") else "Y", []))
+            xs = spec.get(xn) if xn else None
+            ys = spec.get(yn) if yn else None
+            contracted = []
+            if xs is not None and xs[-1] is not None:
+                contracted.append((xn, xs[-1]))
+            if ys is not None and ys[0] is not None:
+                contracted.append((yn, ys[0]))
+            for n, axis in contracted:
+                ctx.emit(
+                    "PTL063",
+                    f"{op.type} contracts over a dim of {n!r} sharded on "
+                    f"mesh axis {axis!r} — GSPMD inserts an "
+                    "allreduce/reduce-scatter here on every call",
+                    block=gb, op_idx=i, op=op, var=n, severity=INFO)
+            on = first(op.outputs.get("Out", []))
+            if on:
+                lead = xs[:-1] if xs is not None else None
+                tail = ys[-1] if ys is not None else None
+                if lead is not None or tail is not None:
+                    v = _resolve_var(gb, on)
+                    rank = len(v.shape) if v is not None and \
+                        v.shape is not None else (
+                            len(lead) + 1 if lead is not None else None)
+                    if rank:
+                        out = [None] * rank
+                        if lead is not None:
+                            for d in range(min(len(lead), rank - 1)):
+                                out[d] = lead[d]
+                        out[-1] = tail
+                        if any(a is not None for a in out):
+                            spec[on] = tuple(out)
+        elif op.type in _SPEC_PASSTHROUGH:
+            xn = first(op.inputs.get("X", []))
+            on = first(op.outputs.get("Out", []))
+            if xn and on and xn in spec:
+                spec[on] = spec[xn]
+        if op.type in _LASTDIM_REDUCERS:
+            slot = "Logits" if op.type == "softmax_with_cross_entropy" \
+                else "X"
+            xn = first(op.inputs.get(slot, []))
+            s = spec.get(xn) if xn else None
+            if s is not None and s[-1] is not None:
+                ctx.emit(
+                    "PTL063",
+                    f"{op.type} reduces over the last dim of {xn!r}, "
+                    f"which is sharded on mesh axis {s[-1]!r} — every "
+                    "call pays a cross-shard reduction (vocab-sharded "
+                    "logits are the classic case)",
+                    block=gb, op_idx=i, op=op, var=xn, severity=INFO)
+
+
+# ==========================================================================
+# PTL07x — collective safety
+# ==========================================================================
+
+
+def _collectives_in(block, acc, path=()):
+    """(op, path) for every collective op under `block`, where path is
+    the chain of enclosing control-flow op types."""
+    from ..core.framework import Block
+
+    for op in block.ops:
+        if op.type in COLLECTIVE_OPS:
+            acc.append((op, path))
+        for v in op.attrs.values():
+            if isinstance(v, Block):
+                _collectives_in(v, acc, path + (op.type,))
+
+
+def collective_stream(program) -> List[Tuple]:
+    """The ordered collective signature a rank executing `program`
+    observes: (op type, ring_id, input shapes, dtype, quantization).
+    Two ranks of one SPMD job must produce IDENTICAL streams or the
+    job deadlocks — the PTL073 comparison key."""
+    stream: List[Tuple] = []
+    acc: List[Tuple] = []
+    _collectives_in(program.global_block(), acc)
+    gb = program.global_block()
+    for op, _path in acc:
+        shapes = []
+        dtype = None
+        for n in op.inputs.get("X", []):
+            v = _resolve_var(gb, n)
+            if v is not None:
+                shapes.append(tuple(v.shape) if v.shape is not None
+                              else None)
+                dtype = dtype or str(v.dtype)
+        stream.append((
+            op.type,
+            int(op.attrs.get("ring_id", 0)),
+            tuple(shapes),
+            dtype,
+            str(op.attrs.get("quantization", "")) or None,
+        ))
+    return stream
+
+
+def compare_collective_streams(streams: Dict[str, List[Tuple]]):
+    """Diff collective streams across ranks/programs. Returns a list
+    of human-ready divergence descriptions (empty == safe). Used by
+    the CLI's --dist mode over a batch of per-rank programs."""
+    out: List[str] = []
+    if len(streams) < 2:
+        return out
+    labels = list(streams)
+    ref_label = labels[0]
+    ref = streams[ref_label]
+    for lbl in labels[1:]:
+        cur = streams[lbl]
+        if cur == ref:
+            continue
+        n = min(len(ref), len(cur))
+        idx = next((i for i in range(n) if ref[i] != cur[i]), n)
+        if idx < n:
+            out.append(
+                f"{lbl}: collective #{idx} is {cur[idx]} but "
+                f"{ref_label} executes {ref[idx]} — ranks would "
+                "rendezvous on different collectives and deadlock")
+        else:
+            longer, m = (ref_label, len(ref)) if len(ref) > len(cur) \
+                else (lbl, len(cur))
+            out.append(
+                f"{lbl} executes {len(cur)} collective(s) but "
+                f"{ref_label} executes {len(ref)} — the rank with fewer "
+                f"returns while {longer} blocks on collective #{n} "
+                "forever")
+    return out
+
+
+@register_pass("collective-safety")
+def check_collective_safety(ctx: PassContext) -> None:
+    program = ctx.program
+    gb = program.global_block()
+
+    acc: List[Tuple] = []
+    _collectives_in(gb, acc)
+    if not acc:
+        return
+
+    op_index = {id(op): i for i, op in enumerate(gb.ops)}
+
+    # PTL070: collective under data-dependent control flow
+    for op, path in acc:
+        dd = [t for t in path if t in _DATA_DEPENDENT_CF]
+        if dd:
+            ctx.emit(
+                "PTL070",
+                f"collective {op.type!r} executes inside data-dependent "
+                f"control flow ({' > '.join(path)}) — ranks whose "
+                "predicate/trip count differs stop participating and "
+                "every other rank blocks forever", op=op)
+
+    # PTL072: ring_id outside the rings the dist plan initializes.
+    # Gated on a plan with >1 trainers: single-process programs lower
+    # collectives to identity, and the startup/main split means THIS
+    # program may legitimately hold zero c_comm_init ops — the ring
+    # count must come from the plan (stamped by the transpiler) or
+    # from same-program c_comm_init ops as a fallback.
+    plan = getattr(program, "_dist_plan", None)
+    if plan and plan.get("mode") == "collective" \
+            and int(plan.get("trainers", 1) or 1) > 1:
+        nrings = plan.get("nrings")
+        if nrings is None:
+            inits = [op for _, _, op in ctx.iter_ops()
+                     if op.type == "c_comm_init"]
+            nrings = len(inits) or None
+        if nrings:
+            for op, _path in acc:
+                ring = int(op.attrs.get("ring_id", 0))
+                if ring >= int(nrings) or ring < 0:
+                    ctx.emit(
+                        "PTL072",
+                        f"collective {op.type!r} uses ring_id {ring} but "
+                        f"the dist plan initializes {nrings} ring(s) "
+                        f"(0..{int(nrings) - 1}) — the op would wait on "
+                        "a communicator that never exists",
+                        block=gb, op_idx=op_index.get(id(op)), op=op)
+
+    # PTL071: one ring shared by concurrent pipeline stages. Stages
+    # run concurrently over microbatches; two stages issuing on one
+    # ring interleave non-deterministically — the collective pairs up
+    # across stages and wedges.
+    cuts = list(getattr(program, "_pipeline_cuts", None) or ())
+    if cuts:
+        from ..core.framework import OpRole
+        from ..core.pipeline_program import _segment_ops
+
+        def role(op):
+            return int(op.attrs.get("op_role", 0))
+
+        fwd_ops = [
+            op for op in gb.ops
+            if op.type not in _PSEUDO_OPS
+            and role(op) & (OpRole.Backward | OpRole.Optimize
+                            | OpRole.LRSched) == 0
+        ]
+        try:
+            segments = _segment_ops(fwd_ops, cuts)
+        except ValueError:
+            return  # PTL052 (write-hazard pass) already reports this
+        stage_of = {}
+        for s, seg in enumerate(segments):
+            for op in seg:
+                stage_of[id(op)] = s
+        ring_stages: Dict[int, Dict[int, object]] = {}
+        for op, _path in acc:
+            s = stage_of.get(id(op))
+            if s is None:
+                continue
+            ring = int(op.attrs.get("ring_id", 0))
+            ring_stages.setdefault(ring, {})[s] = op
+        for ring, stages in sorted(ring_stages.items()):
+            if len(stages) > 1:
+                which = sorted(stages)
+                op2 = stages[which[1]]
+                ctx.emit(
+                    "PTL071",
+                    f"ring {ring} carries collectives from pipeline "
+                    f"stages {which} — stages run concurrently over "
+                    "microbatches, so their collectives interleave "
+                    "non-deterministically on one communicator",
+                    block=gb, op_idx=op_index.get(id(op2)), op=op2)
+
+
+# ==========================================================================
+# PTL08x — donation / aliasing
+# ==========================================================================
+
+
+def donation_plan(program, feed_names=()) -> Dict[str, List[str]]:
+    """The executor's donation decision, derived statically: runs the
+    SAME classification the runtime compile runs
+    (core.executor.analyze_block_state) and returns
+    {state, written, donatable}. ``tools/donation_audit.py
+    --check-static`` diffs this against live executables."""
+    from ..core.executor import analyze_block_state
+
+    state, written = analyze_block_state(program.global_block(),
+                                         list(feed_names))
+    written_set = set(written)
+    return {
+        "state": list(state),
+        "written": list(written),
+        "donatable": [n for n in state if n in written_set],
+    }
+
+
+@register_pass("donation-safety")
+def check_donation_safety(ctx: PassContext) -> None:
+    from ..core.framework import OpRole
+
+    program = ctx.program
+    gb = program.global_block()
+
+    # PTL082: a var that is both fed AND donated-rewritten state. The
+    # executor classifies feeds first, so the same name silently stops
+    # being donated — but the CALLER almost certainly still holds the
+    # array they fed, and under a no-feed run config the buffer IS
+    # donated away; the alias contract differs per call site.
+    if ctx.feed_names:
+        plan_nofeed = donation_plan(program, ())
+        for n in ctx.feed_names:
+            if n in plan_nofeed["donatable"]:
+                ctx.emit(
+                    "PTL082",
+                    f"var {n!r} is fed this run but is donated rewritten "
+                    "state when not fed — the caller's array aliases a "
+                    "buffer the executable donates away under other run "
+                    "configurations", var=n)
+
+    # PTL081: double donation — the same persistable var updated
+    # in place by TWO optimizer ops of one type (minimize() wired
+    # twice over one param set: both updates donate/rewrite the same
+    # buffer, and the second consumes the first's output as if it were
+    # the pre-step value). Composed updaters of DIFFERENT types (sgd +
+    # local_sgd_select) are the intended pattern and stay quiet.
+    updates: Dict[Tuple[str, str], List] = {}
+    for i, op in enumerate(gb.ops):
+        if not int(op.attrs.get("op_role", 0)) & OpRole.Optimize:
+            continue
+        reads = set(_op_reads(op))
+        for n in _op_writes(op):
+            if n not in reads:
+                continue
+            v = _resolve_var(gb, n)
+            if v is None or not getattr(v, "persistable", False):
+                continue
+            updates.setdefault((n, op.type), []).append((i, op))
+    for (n, op_type), sites in sorted(updates.items()):
+        if len(sites) > 1:
+            i2, op2 = sites[1]
+            ctx.emit(
+                "PTL081",
+                f"state var {n!r} is rewritten in place by "
+                f"{len(sites)} {op_type!r} ops (ops "
+                f"{[i for i, _ in sites]}) — a double in-place update "
+                "applies the step twice per run (one minimize() wired "
+                "twice?)", block=gb, op_idx=i2, op=op2, var=n)
+
+
+def check_program_batch(programs: Dict[str, object]):
+    """Cross-program donation/collective checks over a batch of
+    programs that share one Scope (the CLI's --dist mode): returns
+    (code, label, message) findings.
+
+    PTL080's cross-program form: program A's quantize rewrite erased
+    var X from the scope (A consumes X.q; X itself is gone), while
+    program B still reads X as state — B's bind raises KeyError at
+    runtime; statically it is a use-after-erasure. Only programs
+    REWRITTEN together are safe, which is exactly the invariant
+    rewrite_for_inference documents.
+
+    PTL073: programs carrying a _dist_plan (per-rank artifacts of one
+    job) must observe identical collective streams."""
+    findings: List[Tuple[str, str, str]] = []
+    items = list(programs.items())
+
+    erased: Dict[str, Tuple[str, str]] = {}
+    for label, prog in items:
+        names = {n for blk in prog.blocks for n in blk.vars}
+        for n in names:
+            if n.endswith(".q") and n[:-2] not in names:
+                erased[n[:-2]] = (label, n)
+    if erased:
+        for label, prog in items:
+            plan = donation_plan(prog, ())
+            for n in plan["state"]:
+                if n in erased and erased[n][0] != label:
+                    src, qn = erased[n]
+                    findings.append((
+                        "PTL080",
+                        label,
+                        f"reads var {n!r} as scope state, but program "
+                        f"{src!r} was quantize-rewritten and erased it "
+                        f"(only {qn!r} remains) — binding this program "
+                        "against the shared scope raises KeyError; "
+                        "every program sharing one Scope must be "
+                        "rewritten together"))
+
+    dist = {label: prog for label, prog in items
+            if getattr(prog, "_dist_plan", None)}
+    if len(dist) > 1:
+        streams = {label: collective_stream(p) for label, p in dist.items()}
+        for msg in compare_collective_streams(streams):
+            label = msg.split(":", 1)[0]
+            findings.append(("PTL073", label, msg))
+    return findings
+
+
+# ==========================================================================
+# PTL09x — kernel call-site geometry
+# ==========================================================================
+
+
+@register_pass("kernel-geometry")
+def check_kernel_geometry(ctx: PassContext) -> None:
+    """Every call site of a constraint-declaring kernel op checked
+    against kernels/constraints.py — the PR 15 runtime guards, run
+    before any lowering and without a TPU."""
+    from ..kernels.constraints import KernelCall, check_call, constrained_op_types
+
+    table = set(constrained_op_types())
+    for blk, i, op in ctx.iter_ops():
+        if op.type not in table:
+            continue
+        shapes: Dict[str, Optional[tuple]] = {}
+        dtypes: Dict[str, Optional[str]] = {}
+        for slot, names in op.inputs.items():
+            if not names:
+                continue
+            v = _resolve_var(blk, names[0])
+            if v is not None:
+                shapes[slot] = tuple(v.shape) if v.shape is not None \
+                    else None
+                dtypes[slot] = str(v.dtype) if v.dtype is not None \
+                    else None
+        call = KernelCall(op.type, op.attrs, shapes, dtypes)
+        for code, message, severity in check_call(call):
+            ctx.emit(code, message, block=blk, op_idx=i, op=op,
+                     severity=severity)

@@ -422,9 +422,14 @@ class CompiledProgram:
         fetch_names = [
             getattr(v, "name", str(v)) for v in (fetch_list or [])
         ]
+        # a resolved mesh (with_partitioning / with_pipeline) gives the
+        # PTL06x partition checks their axis sizes; unpartitioned
+        # programs lint with mesh_axes=None (mesh checks stay quiet)
+        mesh_axes = dict(self._mesh.shape) if self._mesh is not None else None
         report = analyze_program(
             self._program, fetch_names=fetch_names,
-            label=f"CompiledProgram uid={self._program.uid}")
+            label=f"CompiledProgram uid={self._program.uid}",
+            mesh_axes=mesh_axes)
         if strict and not report.ok:
             raise ProgramVerificationError(report)
         return report

@@ -403,6 +403,57 @@ def _build_gradient_merge_fn(
     return fn
 
 
+def analyze_block_state(block: "Block", feed_names):
+    """Classify a block's vars for the donation contract: returns
+    (state_needed, written) — persistable/scope inputs the executable
+    must be handed, and persistable outputs it rewrites. The donation
+    plan is exactly ``[n for n in state_needed if n in written]``.
+
+    Module-level single source of truth: ``Executor._compile`` derives
+    the runtime donate_argnums from this, and the static
+    ``donation-safety`` analysis pass (analysis/dist_passes.py, PTL08x)
+    plus ``tools/donation_audit.py --check-static`` call the SAME
+    function — the offline plan and the runtime plan cannot drift."""
+    produced = set(feed_names)
+    state_needed: List[str] = []
+    written: List[str] = []
+    seen_state = set()
+    seen_written = set()
+
+    def is_persistable(name: str) -> bool:
+        if block.has_var(name):
+            return block.var(name).persistable
+        return False
+
+    def visit_block(blk: Block, local_names=frozenset()):
+        # local_names: vars created IN a nested block (recurrent
+        # step inputs / pre-memories) — bound by the structured
+        # op's lowering, never scope state
+        for op in blk.ops:
+            if op.type in ("feed", "fetch"):
+                continue
+            for names in op.inputs.values():
+                for n in names:
+                    if n in local_names:
+                        continue
+                    if n not in produced and n not in seen_state:
+                        # must come from scope
+                        seen_state.add(n)
+                        state_needed.append(n)
+            for names in op.outputs.values():
+                for n in names:
+                    produced.add(n)
+                    if is_persistable(n) and n not in seen_written:
+                        seen_written.add(n)
+                        written.append(n)
+            for v in op.attrs.values():
+                if isinstance(v, Block):
+                    visit_block(v, local_names | set(v.vars))
+
+    visit_block(block)
+    return state_needed, written
+
+
 def _cpu_only_target(mesh) -> bool:
     """True when the step will run exclusively on CPU devices (donation
     is pure overhead there)."""
@@ -935,44 +986,7 @@ class Executor:
     def _analyze_block(self, program: Program, block: Block, feed_names):
         """Classify vars: produced (by ops), state (persistable inputs),
         written state (persistable outputs)."""
-        produced = set(feed_names)
-        state_needed: List[str] = []
-        written: List[str] = []
-        seen_state = set()
-        seen_written = set()
-
-        def is_persistable(name: str) -> bool:
-            if block.has_var(name):
-                return block.var(name).persistable
-            return False
-
-        def visit_block(blk: Block, local_names=frozenset()):
-            # local_names: vars created IN a nested block (recurrent
-            # step inputs / pre-memories) — bound by the structured
-            # op's lowering, never scope state
-            for op in blk.ops:
-                if op.type in ("feed", "fetch"):
-                    continue
-                for names in op.inputs.values():
-                    for n in names:
-                        if n in local_names:
-                            continue
-                        if n not in produced and n not in seen_state:
-                            # must come from scope
-                            seen_state.add(n)
-                            state_needed.append(n)
-                for names in op.outputs.values():
-                    for n in names:
-                        produced.add(n)
-                        if is_persistable(n) and n not in seen_written:
-                            seen_written.add(n)
-                            written.append(n)
-                for v in op.attrs.values():
-                    if isinstance(v, Block):
-                        visit_block(v, local_names | set(v.vars))
-
-        visit_block(block)
-        return state_needed, written
+        return analyze_block_state(block, feed_names)
 
     def _compile(
         self,
@@ -1008,7 +1022,8 @@ class Executor:
 
             validate_for_run(
                 program, fetch_names=fetch_names, feed_names=feed_names,
-                mode=mode, label=f"program uid={program.uid}")
+                mode=mode, label=f"program uid={program.uid}",
+                mesh_axes=dict(mesh.shape) if mesh is not None else None)
 
         state_names, written_names = self._analyze_block(program, block, feed_names)
 

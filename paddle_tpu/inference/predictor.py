@@ -207,6 +207,7 @@ class Predictor:
         # serialized var tags, so a loaded GPT is tp-ready untouched)
         self._run_program = self._program
         self.partition = None
+        self.lint_report = None
         if config._partition is not None:
             from ..core.compiler import CompiledProgram
 
@@ -214,6 +215,24 @@ class Predictor:
                 config._partition)
             self._run_program = cp
             self.partition = cp.partition
+            # distlint over the serving program under the resolved
+            # partition context — warn-mode only (a serving process must
+            # come up even with lint findings; strict gating belongs to
+            # proglint --strict --dist in CI). Kept on the Predictor so
+            # serving/engine.py predictor_stats() can surface it.
+            from .. import analysis as _analysis
+
+            self.lint_report = _analysis.analyze_program(
+                self._program,
+                passes=["partition-consistency", "collective-safety",
+                        "donation-safety", "kernel-geometry"],
+                feed_names=list(self._feed_names),
+                fetch_names=[v.name for v in self._fetch_vars],
+                mesh_axes=dict(config._partition.mesh_axes) or None,
+                rules=config._partition.rules or None,
+                label="predictor")
+            for d in self.lint_report.errors + self.lint_report.warnings:
+                _analysis.emit_eager(d)
         block = self._program.global_block()
         self._inputs = {
             n: _Tensor(n, block.var(n).shape if block.has_var(n) else None)
@@ -482,9 +501,11 @@ class Predictor:
         p._scope = self._scope
         p._exe = self._exe
         p._program = self._program
-        # one mesh + one sharding resolve for the whole worker pool
+        # one mesh + one sharding resolve (and one lint report) for the
+        # whole worker pool
         p._run_program = self._run_program
         p.partition = self.partition
+        p.lint_report = self.lint_report
         p.quantize_report = self.quantize_report
         p._feed_names = self._feed_names
         p._fetch_vars = self._fetch_vars

@@ -1,0 +1,492 @@
+"""Declarative tile/geometry constraint table for the Pallas kernels.
+
+Every registered kernel-backed op declares its call-site constraints
+HERE — lane multiples, panel bounds, shape contracts, a per-call VMEM
+estimate — as data, not as scattered runtime ``raise`` statements.
+Two consumers read the table:
+
+  * the static ``kernel-geometry`` analysis pass
+    (analysis/dist_passes.py, PTL091–094): every call site in a
+    Program is checked against the table BEFORE any lowering, so the
+    bug classes that used to surface as opaque Mosaic compile errors
+    (or silent reference fallbacks) are proglint findings;
+  * the kernels' own runtime guards, which now emit through the same
+    helpers (``int8_block_geometry_issue`` below) — the static pass
+    and the runtime backstop can never disagree on what "tileable"
+    means.
+
+Finding severities follow the analyzer contract:
+
+  PTL091 (error)  geometry Mosaic cannot tile at all — the Pallas
+                  path would fail to compile (loud under
+                  PADDLE_TPU_FORCE_PALLAS / AOT validation);
+  PTL092 (warn)   geometry that silently loses the kernel (reference
+                  fallback on TPU) — numerics fine, perf win gone;
+  PTL093 (error)  call-site shape contract violation — the lowering
+                  itself would raise (heads not dividing the hidden
+                  dim, a prefill Q fed to the decode-only op, a scale
+                  plane that does not match its weight);
+  PTL094 (warn)   the per-call VMEM estimate exceeds the per-core
+                  budget — Mosaic would spill or abort at compile.
+
+The checks consume DECLARED Variable shapes; unknown/dynamic dims
+(None / -1) make a check vacuously pass rather than guess.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+LANES = 128          # TPU lane count: the trailing-dim tile unit
+SUBLANES = 8         # (8, 128) float32 native tile
+# per-core VMEM budget the PTL094 estimates gate against (v4/v5e have
+# 16 MB avail minus runtime reserves; 12 MB is the usable headline the
+# layer_norm/softmax panel bounds were derived from)
+VMEM_BUDGET_BYTES = 12 * 2 ** 20
+
+# A finding is (code, message, severity-or-None). severity None means
+# the code's default from analysis.diagnostics.CODES.
+Finding = Tuple[str, str, Optional[str]]
+
+
+# -- shared geometry helpers (runtime guards emit through these) -------------
+
+
+def int8_block_geometry_issue(K, block: int) -> Optional[str]:
+    """The Mosaic lane constraint on the blockwise-int8 matmul: the
+    contraction tile (the quantize block) must be a 128-multiple or
+    cover the whole (padded) K. Returns the diagnosis string when the
+    geometry is NOT Pallas-tileable, else None.
+
+    Single source of truth: ``_quant_matmul_pallas``'s runtime guard
+    raises this exact message; the static kernel-geometry pass emits
+    it as PTL092 (the public wrapper demotes the raise to a warned
+    reference fallback, so statically it is a lost kernel, not a
+    crash)."""
+    block = int(block)
+    if block % LANES == 0:
+        return None
+    if K is not None:
+        K = int(K)
+        if K > 0 and -(-K // block) * block == block:
+            return None  # one block covers all of K: full-dim tile is legal
+        geom = f"for K={K}"
+    else:
+        geom = "for a dynamic K"
+    return (
+        f"int8_block block={block} is not Mosaic-tileable {geom}: "
+        f"the contraction tile must be a multiple of {LANES} (or "
+        ">= K) — quantize with a 128-multiple quantize_block, or "
+        "this matmul runs the reference dequantize path on TPU")
+
+
+def _static_dim(d) -> Optional[int]:
+    if d is None:
+        return None
+    d = int(d)
+    return d if d > 0 else None
+
+
+def _static_shape(shape) -> Optional[Tuple[Optional[int], ...]]:
+    if shape is None:
+        return None
+    return tuple(_static_dim(d) for d in shape)
+
+
+def _numel(shape) -> Optional[int]:
+    """Static element count, or None when any dim is dynamic."""
+    n = 1
+    for d in shape or ():
+        d = _static_dim(d)
+        if d is None:
+            return None
+        n *= d
+    return n
+
+
+class KernelCall:
+    """A call site as the constraint checks see it: declared input
+    shapes/dtypes by slot plus the op attrs. ``shape(slot)`` is the
+    first var of the slot or None when absent/undeclared."""
+
+    def __init__(self, op_type: str, attrs: Dict[str, Any],
+                 shapes: Dict[str, Optional[tuple]],
+                 dtypes: Optional[Dict[str, Optional[str]]] = None):
+        self.op_type = op_type
+        self.attrs = dict(attrs or {})
+        self._shapes = dict(shapes or {})
+        self._dtypes = dict(dtypes or {})
+
+    def attr(self, name: str, default=None):
+        return self.attrs.get(name, default)
+
+    def shape(self, slot: str) -> Optional[Tuple[Optional[int], ...]]:
+        return _static_shape(self._shapes.get(slot))
+
+    def dtype(self, slot: str) -> Optional[str]:
+        d = self._dtypes.get(slot)
+        return str(d) if d is not None else None
+
+
+# op type -> (check fn, one-line description). Ordered for docs/tests.
+_CONSTRAINTS: "collections.OrderedDict[str, tuple]" = collections.OrderedDict()
+
+
+def declare_constraints(op_type: str, description: str):
+    """Decorator declaring the constraint checker for a kernel-backed
+    op type. The checker takes a KernelCall and returns Finding
+    tuples."""
+
+    def deco(fn: Callable[[KernelCall], List[Finding]]):
+        _CONSTRAINTS[op_type] = (fn, description)
+        return fn
+
+    return deco
+
+
+def constraint_table() -> Dict[str, str]:
+    """op type -> constraint description (the documented table)."""
+    return {k: d for k, (_, d) in _CONSTRAINTS.items()}
+
+
+def constrained_op_types():
+    return list(_CONSTRAINTS)
+
+
+def check_call(call: KernelCall) -> List[Finding]:
+    """Run the declared checks for one call site; unknown op types
+    have no constraints (empty list)."""
+    ent = _CONSTRAINTS.get(call.op_type)
+    if ent is None:
+        return []
+    return list(ent[0](call))
+
+
+# -- helpers shared by several declarations ----------------------------------
+
+
+def _heads_divide(call: KernelCall, slot: str, findings: List[Finding],
+                  attr: str = "num_heads") -> Optional[int]:
+    """[..., H*D] layer layout: the trailing dim must split into
+    ``num_heads`` heads (the lowering reshapes; a remainder crashes it
+    with an opaque reshape error). Returns D when derivable."""
+    h = call.attr(attr)
+    s = call.shape(slot)
+    if h is None or not s:
+        return None
+    h = int(h)
+    hd = _static_dim(s[-1])
+    if h <= 0:
+        findings.append(("PTL093",
+                         f"{call.op_type}: {attr}={h} must be positive",
+                         None))
+        return None
+    if hd is None:
+        return None
+    if hd % h:
+        findings.append((
+            "PTL093",
+            f"{call.op_type}: trailing dim {hd} of input {slot!r} is not "
+            f"divisible by {attr}={h} — the lowering's [..., H, D] "
+            "reshape cannot split it", None))
+        return None
+    return hd // h
+
+
+def _same_shape(call: KernelCall, slots, findings: List[Finding]):
+    """Element-count equality across slots (the fused optimizers
+    flatten, so rank may differ but the element count must not)."""
+    known = [(s, _numel(call.shape(s))) for s in slots
+             if call.shape(s) is not None]
+    known = [(s, n) for s, n in known if n is not None]
+    if len(known) < 2:
+        return
+    ref_slot, ref_n = known[0]
+    for s, n in known[1:]:
+        if n != ref_n:
+            findings.append((
+                "PTL093",
+                f"{call.op_type}: input {s!r} has {n} elements but "
+                f"{ref_slot!r} has {ref_n} — the fused kernel updates "
+                "them as one flattened panel, so every state operand "
+                "must match the param's element count", None))
+
+
+# -- the declarations --------------------------------------------------------
+
+
+def _check_quant_matmul(call: KernelCall) -> List[Finding]:
+    from .quant_matmul import (DEFAULT_BLOCK, QUANT_MODES, scale_shape)
+
+    out: List[Finding] = []
+    mode = str(call.attr("quant_mode", "int8"))
+    if mode not in QUANT_MODES:
+        out.append(("PTL093",
+                    f"{call.op_type}: quant_mode {mode!r} is not one of "
+                    f"{QUANT_MODES}", None))
+        return out
+    try:
+        block = int(call.attr("quant_block", DEFAULT_BLOCK) or DEFAULT_BLOCK)
+    except (TypeError, ValueError):
+        out.append(("PTL093",
+                    f"{call.op_type}: quant_block "
+                    f"{call.attr('quant_block')!r} is not an integer", None))
+        return out
+    w = call.shape("QWeight")
+    if w is None:
+        return out
+    if len(w) != 2:
+        out.append(("PTL093",
+                    f"{call.op_type}: QWeight must be 2-D [K, N], got "
+                    f"rank {len(w)}", None))
+        return out
+    K, N = w
+    if mode == "int8_block":
+        issue = int8_block_geometry_issue(K, block)
+        if issue:
+            import os
+
+            # with the fallback available the kernel is lost, not the
+            # run (PTL092); under FORCE_PALLAS there is no fallback and
+            # the Mosaic compile fails outright (PTL091)
+            if os.environ.get("PADDLE_TPU_FORCE_PALLAS") == "1":
+                out.append((
+                    "PTL091",
+                    f"{call.op_type}: {issue} "
+                    "(PADDLE_TPU_FORCE_PALLAS=1: no reference fallback — "
+                    "the Mosaic compile fails)", None))
+            else:
+                out.append(("PTL092", f"{call.op_type}: {issue}", None))
+    s = call.shape("Scale")
+    if s is not None and K is not None and N is not None:
+        want = scale_shape((K, N), mode, block)
+        have = tuple(d for d in s)
+        if len(have) != len(want) or any(
+                h is not None and h != w_ for h, w_ in zip(have, want)):
+            out.append((
+                "PTL093",
+                f"{call.op_type}: Scale shape {have} does not match the "
+                f"{mode} plane {want} for a [{K}, {N}] weight (was the "
+                "weight quantized with a different mode/block?)", None))
+    # VMEM: x tile + dequantized w tile + acc scratch + out tile, all
+    # f32 at the largest bm the kernel picks (256) and bn = LANES
+    kb = block if mode == "int8_block" else DEFAULT_BLOCK
+    est = 4 * (256 * kb + kb * LANES + 2 * 256 * LANES) + kb * LANES
+    if est > VMEM_BUDGET_BYTES:
+        out.append((
+            "PTL094",
+            f"{call.op_type}: tile VMEM estimate {est} B (quant_block="
+            f"{block}) exceeds the per-core budget {VMEM_BUDGET_BYTES} B "
+            "— use a smaller quantize block", None))
+    return out
+
+
+declare_constraints(
+    "quantized_matmul",
+    "QWeight 2-D [K,N]; Scale matches scale_shape(mode, block); "
+    "int8_block block 128-multiple or >= K (else reference fallback); "
+    "tile VMEM (bm*KB + KB*bn + acc) within budget",
+)(_check_quant_matmul)
+
+declare_constraints(
+    "quantized_fc",
+    "same geometry as quantized_matmul (the `mul` twin: X flattened at "
+    "x_num_col_dims)",
+)(_check_quant_matmul)
+
+
+@declare_constraints(
+    "flash_attention",
+    "Q/K/V [B, S, H*D] with H*D % num_heads == 0; per-(b,h) K/V panel "
+    "(2*S*D f32) + q block must fit VMEM")
+def _check_flash_attention(call: KernelCall) -> List[Finding]:
+    out: List[Finding] = []
+    q = call.shape("Q")
+    if q is not None and len(q) != 3:
+        out.append(("PTL093",
+                    "flash_attention: Q must be [B, S, H*D] layer layout, "
+                    f"got rank {len(q)}", None))
+        return out
+    d = _heads_divide(call, "Q", out)
+    if q is not None and d is not None:
+        s_len = _static_dim(q[1])
+        if s_len is not None:
+            # full-K/V-panel design: one [S, D] K panel + V panel per
+            # (b, h) in VMEM, plus the [blk_q, D] query block and the
+            # lane-replicated softmax stats
+            blk_q = min(512, s_len)
+            est = 4 * (2 * s_len * d + blk_q * d + 3 * blk_q * LANES)
+            if est > VMEM_BUDGET_BYTES:
+                out.append((
+                    "PTL094",
+                    f"flash_attention: [S={s_len}, D={d}] K/V panels "
+                    f"estimate {est} B of VMEM, over the per-core budget "
+                    f"{VMEM_BUDGET_BYTES} B — the blocked-KV variant "
+                    "(O(blk) VMEM) is required at this length", None))
+    return out
+
+
+@declare_constraints(
+    "paged_attention",
+    "decode-only: Q [B, 1, H*D] (seq dim exactly 1), H*D % num_heads "
+    "== 0, page pools [Hkv, P, page, D] with D == H*D/num_heads")
+def _check_paged_attention(call: KernelCall) -> List[Finding]:
+    out: List[Finding] = []
+    q = call.shape("Q")
+    if q is not None and len(q) == 3:
+        s1 = _static_dim(q[1])
+        if s1 is not None and s1 != 1:
+            out.append((
+                "PTL093",
+                "paged_attention is a decode op: Q must be [B, 1, H*D], "
+                f"got seq dim {s1} (use flash_attention for the prefill "
+                "lane)", None))
+    elif q is not None:
+        out.append(("PTL093",
+                    "paged_attention: Q must be [B, 1, H*D] layer layout, "
+                    f"got rank {len(q)}", None))
+    d = _heads_divide(call, "Q", out)
+    kp = call.shape("KPages")
+    if kp is not None:
+        if len(kp) != 4:
+            out.append((
+                "PTL093",
+                "paged_attention: KPages must be [num_kv_heads, pages, "
+                f"page_size, head_dim], got rank {len(kp)}", None))
+        elif d is not None and _static_dim(kp[3]) not in (None, d):
+            out.append((
+                "PTL093",
+                f"paged_attention: page pool head_dim {kp[3]} != Q's "
+                f"per-head dim {d}", None))
+    return out
+
+
+def _check_kv_write(call: KernelCall) -> List[Finding]:
+    out: List[Finding] = []
+    _heads_divide(call, "K", out)
+    kp = call.shape("KPages")
+    if kp is not None and len(kp) != 4:
+        out.append((
+            "PTL093",
+            f"{call.op_type}: KPages must be [num_kv_heads, pages, "
+            f"page_size, head_dim], got rank {len(kp)}", None))
+    return out
+
+
+declare_constraints(
+    "kv_cache_write",
+    "K/V [B, S, H*D] with H*D % num_heads == 0 into [Hkv, P, page, D] "
+    "pools",
+)(_check_kv_write)
+
+declare_constraints(
+    "kv_cache_write_q",
+    "quantized-pool twin of kv_cache_write (int8 pages + scale planes)",
+)(_check_kv_write)
+
+
+def _check_ragged(call: KernelCall) -> List[Finding]:
+    out: List[Finding] = []
+    q = call.shape("Q")
+    if q is not None and len(q) != 3:
+        out.append(("PTL093",
+                    f"{call.op_type}: Q must be [lanes, chunk, H*D], got "
+                    f"rank {len(q)}", None))
+        return out
+    _heads_divide(call, "Q", out)
+    return out
+
+
+declare_constraints(
+    "ragged_paged_attention",
+    "Q [lanes, chunk, H*D] with H*D % num_heads == 0 over the paged "
+    "pools",
+)(_check_ragged)
+
+declare_constraints(
+    "ragged_paged_attention_q",
+    "quantized-KV twin of ragged_paged_attention",
+)(_check_ragged)
+
+
+def _check_fused_adam(call: KernelCall) -> List[Finding]:
+    out: List[Finding] = []
+    _same_shape(call, ("Param", "Grad", "Moment1", "Moment2"), out)
+    for slot in ("Beta1Pow", "Beta2Pow"):
+        s = call.shape(slot)
+        n = _numel(s) if s is not None else None
+        if n is not None and n != 1:
+            out.append((
+                "PTL093",
+                f"{call.op_type}: {slot} must be a single scalar, got "
+                f"shape {s} — per-element beta powers would desync the "
+                "bias correction", None))
+    return out
+
+
+declare_constraints(
+    "fused_adam",
+    "Param/Grad/Moment1/Moment2 equal element counts (one flattened "
+    "[R,128] panel, BLOCK_R <= 512); Beta*Pow scalar",
+)(_check_fused_adam)
+
+declare_constraints(
+    "fused_adamw",
+    "same panel geometry as fused_adam (decoupled weight decay)",
+)(_check_fused_adam)
+
+
+@declare_constraints(
+    "fused_momentum",
+    "Param/Grad/Velocity equal element counts (one flattened [R,128] "
+    "panel)")
+def _check_fused_momentum(call: KernelCall) -> List[Finding]:
+    out: List[Finding] = []
+    _same_shape(call, ("Param", "Grad", "Velocity"), out)
+    return out
+
+
+@declare_constraints(
+    "layer_norm",
+    "fused kernel holds a [BLOCK_R, C] panel: C <= MAX_C (4096) or the "
+    "op stays on XLA")
+def _check_layer_norm(call: KernelCall) -> List[Finding]:
+    from .layer_norm import MAX_C
+
+    out: List[Finding] = []
+    x = call.shape("X")
+    if x is None:
+        return out
+    axis = int(call.attr("begin_norm_axis", 1) or 1)
+    if not 0 < axis <= len(x):
+        return out  # the lowering's own validation territory
+    c = _numel(x[axis:])
+    if c is not None and c > MAX_C:
+        out.append((
+            "PTL092",
+            f"layer_norm: normalized size C={c} exceeds the fused "
+            f"kernel's VMEM panel bound MAX_C={MAX_C} — the op runs via "
+            "XLA (numerics fine, fused-kernel win lost)", None))
+    return out
+
+
+@declare_constraints(
+    "softmax_with_cross_entropy",
+    "fused kernel holds a [BLOCK_R, C] logits panel: C <= MAX_C "
+    "(32768) or the op stays on XLA")
+def _check_softmax_xent(call: KernelCall) -> List[Finding]:
+    from .softmax_xent import MAX_C
+
+    out: List[Finding] = []
+    lg = call.shape("Logits")
+    if lg is None or not lg:
+        return out
+    c = _static_dim(lg[-1])
+    if c is not None and c > MAX_C:
+        out.append((
+            "PTL092",
+            f"softmax_with_cross_entropy: vocab C={c} exceeds the fused "
+            f"kernel's VMEM panel bound MAX_C={MAX_C} — the op runs via "
+            "XLA (numerics fine, fused-kernel win lost)", None))
+    return out

@@ -237,19 +237,20 @@ def _quant_matmul_pallas(x2, qw, scales, mode: str, block: int,
     N = qw.shape[1]
     KB = block if mode == "int8_block" else DEFAULT_BLOCK
     Kp = -(-K // KB) * KB
-    if not interpret and mode == "int8_block" and KB % LANES and Kp != KB:
+    if not interpret and mode == "int8_block":
         # Mosaic's lane constraint: the x tile's trailing dim (KB) must
-        # be 128-divisible or the FULL padded K. Fail here with the
-        # geometry named instead of an opaque Mosaic compile error —
-        # the public wrapper turns this into a warned reference
+        # be 128-divisible or the FULL padded K. The diagnosis lives in
+        # kernels/constraints.py so the static kernel-geometry pass
+        # (PTL092) and this runtime backstop can never disagree; the
+        # public wrapper turns the raise into a warned reference
         # fallback (and the FORCE_PALLAS/AOT path into a loud failure).
         # Interpret mode executes any geometry, so CPU CI still covers
         # small blocks.
-        raise ValueError(
-            f"int8_block block={KB} is not Mosaic-tileable for K={K}: "
-            f"the contraction tile must be a multiple of {LANES} (or "
-            ">= K) — quantize with a 128-multiple quantize_block, or "
-            "this matmul runs the reference dequantize path on TPU")
+        from .constraints import int8_block_geometry_issue
+
+        issue = int8_block_geometry_issue(K, KB)
+        if issue:
+            raise ValueError(issue)
     Mp = -(-M // 16) * 16              # bf16 sublane tile (covers f32)
     Np = -(-N // LANES) * LANES
     bm = next(c for c in (256, 128, 64, 32, 16) if Mp % c == 0)

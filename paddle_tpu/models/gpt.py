@@ -203,14 +203,28 @@ def apply_gpt_megatron_sharding(program: Program, mp_axis: str = "mp"):
     for name, v in block.vars.items():
         if v.sharding is not None or not getattr(v, "persistable", False):
             continue
-        if "_qkv.w" in name or "_ffn1.w" in name:
+        # suffix match, not substring: optimizer accumulators are named
+        # <param>_<acc>_0, so '"_qkv.w" in name' also tagged
+        # dec0_qkv.w_beta1_pow_acc_0 — a [1]-shaped scalar — with a
+        # rank-2 spec (distlint PTL060/PTL062 caught this; accumulators
+        # get their spec below via structural inheritance instead)
+        if name.endswith("_qkv.w") or name.endswith("_ffn1.w"):
             v.sharding = (None, mp_axis)
-        elif "_qkv.b" in name or "_ffn1.b" in name:
+        elif name.endswith("_qkv.b") or name.endswith("_ffn1.b"):
             v.sharding = (mp_axis,)
-        elif "_proj.w" in name or "_ffn2.w" in name:
+        elif name.endswith("_proj.w") or name.endswith("_ffn2.w"):
             v.sharding = (mp_axis, None)
         elif name in ("gpt_tok_emb", "gpt_head.w"):
             v.sharding = (None, mp_axis) if name == "gpt_head.w" else (mp_axis, None)
+    # optimizer accumulators inherit their param's sharding only when
+    # the shapes line up (moment buffers yes; scalar beta-pow stays
+    # replicated) — same scheme as models/bert.py
+    for name, v in block.vars.items():
+        owner = getattr(v, "accumulator_owner", None)
+        if owner and owner in block.vars:
+            base = block.vars[owner]
+            if base.sharding is not None and v.shape == base.shape:
+                v.sharding = base.sharding
     program._bump()
 
 

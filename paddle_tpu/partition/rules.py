@@ -52,7 +52,13 @@ DEFAULT_RULES: LogicalAxisRules = (
     ("kv_pages", "tp"),
     ("mlp", "tp"),
     ("vocab", "tp"),
-    ("expert", "tp"),
+    # experts live on the expert-parallel axis: with_expert_parallel,
+    # ops/moe.py and the MoE examples all build meshes named "ep" —
+    # mapping expert->tp here could never shard an expert-tagged
+    # tensor on an actual expert-parallel mesh (the rule was silently
+    # inapplicable and the tensor stayed replicated; PTL060 surfaces
+    # exactly this class of dead mapping)
+    ("expert", "ep"),
     ("stage", None),
 )
 

@@ -77,6 +77,14 @@ class QuantizeReport:
         self.mode = mode
         self.block = block
         self.rows: List[Dict[str, Any]] = []
+        # machine-readable partition-tag accounting, one row per
+        # quantized var that carried tags: what the original declared,
+        # what the rewrite put on the .q/.qscale vars, and why anything
+        # was dropped. The same rows are stamped onto the program as
+        # ``_quant_tag_record`` so the partition-consistency analysis
+        # pass (PTL060/PTL064) can check the inheritance invariant on
+        # the rewritten program alone.
+        self.tag_rows: List[Dict[str, Any]] = []
 
     def quantized(self, name, shape, dtype, q_bytes):
         self.rows.append({
@@ -114,8 +122,20 @@ class QuantizeReport:
             "weight_bytes_ratio": round(after / before, 4) if before else 1.0,
         }
 
+    def tag_record(self, name, qname, sname, kind, original, inherited,
+                   dropped_reason=None):
+        row = {
+            "name": name, "qname": qname, "sname": sname, "kind": kind,
+            "original": list(original),
+            "inherited": list(inherited) if inherited is not None else None,
+            "dropped_reason": dropped_reason,
+        }
+        self.tag_rows.append(row)
+        return row
+
     def to_dict(self) -> Dict[str, Any]:
-        return {"summary": self.summary(), "vars": list(self.rows)}
+        return {"summary": self.summary(), "vars": list(self.rows),
+                "partition_tags": list(self.tag_rows)}
 
 
 def _nbytes(shape, dtype) -> int:
@@ -290,17 +310,31 @@ def rewrite_for_inference(program, scope, wdtype: str = "int8",
             # TP composes: the quantized weight means the same thing
             # the fp32 one did, so it inherits the partition tags; the
             # scale plane shards with the OUTPUT-channel axis (its
-            # last dim tracks N)
-            la = getattr(var, "logical_axes", None)
-            sh = getattr(var, "sharding", None)
-            if la is not None and len(la) == 2:
-                qv.logical_axes = tuple(la)
-                sv.logical_axes = ((None, la[1]) if wdtype == "int8_block"
-                                   else (la[1],))
-            if sh is not None and len(sh) == 2:
-                qv.sharding = tuple(sh)
-                sv.sharding = ((None, sh[1]) if wdtype == "int8_block"
-                               else (sh[1],))
+            # last dim tracks N). Every inheritance (and every drop)
+            # is recorded machine-readably — PTL060/PTL064 check these
+            # records instead of re-guessing what the rewrite meant.
+            tag_rec = getattr(program, "_quant_tag_record", None)
+            if tag_rec is None:
+                tag_rec = program._quant_tag_record = []
+            for kind, tags in (("logical_axes",
+                                getattr(var, "logical_axes", None)),
+                               ("sharding", getattr(var, "sharding", None))):
+                if tags is None:
+                    continue
+                if len(tags) == 2:
+                    setattr(qv, kind, tuple(tags))
+                    setattr(sv, kind,
+                            ((None, tags[1]) if wdtype == "int8_block"
+                             else (tags[1],)))
+                    tag_rec.append(report.tag_record(
+                        name, qname, sname, kind, tags, tuple(tags)))
+                else:
+                    tag_rec.append(report.tag_record(
+                        name, qname, sname, kind, tags, None,
+                        dropped_reason=(
+                            f"{kind} arity {len(tags)} does not match the "
+                            "2-D weight — tags dropped by the quantize "
+                            "rewrite")))
 
         for op, _role in consumers:
             if op.type == "mul":

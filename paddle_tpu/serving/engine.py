@@ -379,7 +379,7 @@ class ServingEngine:
             request_shapes = max(request_shapes, st["request_shapes"])
             for k, v in st.get("bucket_hits", {}).items():
                 hits[k] = hits.get(k, 0) + v
-        return {
+        out = {
             "runs": runs,
             "padding_waste": (round(1.0 - real / padded, 4)
                               if padded else 0.0),
@@ -387,6 +387,17 @@ class ServingEngine:
             "compiled_shapes": len(hits),
             "bucket_hits": hits,
         }
+        # distlint findings from the partitioned load (predictor.py runs
+        # the dist passes warn-mode when a mesh is resolved) — clones
+        # share the source predictor's report, so read it once
+        lint = getattr(self._worker_preds[0], "lint_report", None)
+
+        if lint is not None:
+            out["distlint"] = {"errors": len(lint.errors),
+                               "warnings": len(lint.warnings),
+                               "codes": sorted({d.code for d in
+                                                lint.errors + lint.warnings})}
+        return out
 
     def predictor_stats_numeric(self) -> Dict[str, Any]:
         """The registry collector's view: predictor_stats() with the

@@ -29,8 +29,12 @@ class Collective:
         self.main_program = main_program or Program()
         self._transpile_startup_program()
         self._transpile_main_program()
+        # nrings is part of the plan: the collective-safety analysis
+        # pass (PTL072) checks every collective's ring_id against the
+        # rings the plan actually initializes
         self.main_program._dist_plan = {
             "mode": "collective", "trainer_id": rank, "trainers": self.nranks,
+            "nrings": self.nrings,
         }
 
     def _transpile_startup_program(self):

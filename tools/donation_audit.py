@@ -421,7 +421,62 @@ def run_audit():
             for name in r["donation_missed"]:
                 report["summary"]["donation_missed"].append(
                     {"site": site, "tag": r["tag"], "state": name})
-    return report
+    return report, sites
+
+
+def static_cross_check(report, sites, allow):
+    """--check-static: re-derive every live executable's donation plan
+    OFFLINE through the same classifier the compile used
+    (core.executor.analyze_block_state — what the PTL08x
+    donation-safety pass runs over the Program IR) and fail on drift:
+
+      * a bound executable whose static plan disagrees with the
+        runtime donatable set means the static pass no longer models
+        the executor (the single-source-of-truth contract broke);
+      * an allowlisted donation_miss whose (site, state) no static
+        plan can produce is stale hand-maintained state.
+
+    Returns (static_rows, violations). The rows are what ``--update``
+    regenerates the allowlist from, making donation_allowlist.json a
+    derived artifact of the static pass rather than a hand-edited one.
+    """
+    from paddle_tpu.core.executor import analyze_block_state
+
+    static_rows = []
+    violations = []
+    donatable_by_site = {}
+    for site, bounds in sites.items():
+        for b in bounds:
+            c = b.compiled
+            state, written = analyze_block_state(b.block,
+                                                 list(c.feed_names))
+            written_set = set(written)
+            static_don = sorted(n for n in state if n in written_set)
+            runtime_don = sorted(getattr(c, "donatable_names", ()) or ())
+            row = {
+                "site": site, "tag": c.tag or "program",
+                "static_donatable": static_don,
+                "runtime_donatable": runtime_don,
+                "agrees": static_don == runtime_don,
+            }
+            static_rows.append(row)
+            donatable_by_site.setdefault(site, set()).update(static_don)
+            if not row["agrees"]:
+                violations.append(
+                    f"static-plan drift: {site} / {row['tag']}: the "
+                    f"static donation plan {static_don} disagrees with "
+                    f"the runtime donatable set {runtime_don} — "
+                    "analysis PTL08x and the executor no longer share "
+                    "one classification")
+    for m in allow.get("donation_miss", []):
+        site_don = donatable_by_site.get(m.get("site"), set())
+        if m.get("state") not in site_don:
+            violations.append(
+                f"stale allowlist entry: donation_miss "
+                f"{m.get('site')!r}/{m.get('state')!r} names state no "
+                "static donation plan produces — regenerate the "
+                "allowlist (--check-static --update)")
+    return static_rows, violations
 
 
 def load_allowlist():
@@ -470,11 +525,20 @@ def main():
     ap.add_argument("--out", default=None, help="write the report JSON here")
     ap.add_argument("--update", action="store_true",
                     help="rewrite the allowlist from the observed state")
+    ap.add_argument("--check-static", action="store_true",
+                    help="cross-validate every executable's runtime "
+                    "donation plan against the static PTL08x derivation "
+                    "and the allowlist; fail on drift or stale entries")
     args = ap.parse_args()
 
-    report = run_audit()
+    report, sites = run_audit()
     allow = load_allowlist()
     violations = check(report, allow)
+    if args.check_static:
+        static_rows, static_violations = static_cross_check(
+            report, sites, allow)
+        report["static_plans"] = static_rows
+        violations = violations + static_violations
     report["violations"] = violations
     report["allowlist"] = allow
 

@@ -5,15 +5,26 @@
     python tools/proglint.py --json main.json          # machine-readable
     python tools/proglint.py --fetch loss_var main.json
     python tools/proglint.py --passes well-formedness,def-before-use main.json
+    python tools/proglint.py --dist --mesh dp=4,tp=2 main.json startup.json
 
 Input files are Program JSON as produced by ``Program.to_json()``
 (examples/author_trainer_program.py writes them). Runs every
-registered analysis pass (paddle_tpu/analysis/passes.py) by default
-and prints a human report, or one JSON document with ``--json``.
+registered analysis pass (paddle_tpu/analysis/passes.py +
+dist_passes.py) by default and prints a human report, or one JSON
+document with ``--json``.
+
+``--dist`` turns on the distributed profile: ``--mesh "dp=4,tp=2"``
+(and optionally ``--rules "batch=dp,heads=tp,..."``) supplies the
+partition context the PTL06x checks resolve tags against, and the
+whole input batch is additionally cross-checked as programs sharing
+one Scope/job — divergent per-rank collective streams (PTL073) and
+quantize-erasure stale state reads (PTL080) are findings no single
+program can show.
 
 Exit code: 0 when no error-severity diagnostics were found in any
 input, 1 when at least one program has errors, 2 on usage/IO problems.
-With ``--strict``, warnings are promoted to failures (exit 1) too.
+With ``--strict``, warnings are promoted to failures (exit 1) too
+(info-severity findings, e.g. PTL063 reshard hotspots, never fail).
 """
 
 from __future__ import annotations
@@ -37,14 +48,16 @@ def _load_program(path: str):
         return Program.from_json(f.read())
 
 
-def lint_path(path: str, fetch_names=None, passes=None):
-    """Analyze one serialized program; returns its AnalysisReport."""
+def lint_path(path: str, fetch_names=None, passes=None, mesh_axes=None,
+              rules=None):
+    """Analyze one serialized program; returns (program, report)."""
     from paddle_tpu import analysis
 
     program = _load_program(path)
-    return analysis.analyze_program(
+    report = analysis.analyze_program(
         program, fetch_names=fetch_names, passes=passes,
-        label=os.path.basename(path))
+        label=os.path.basename(path), mesh_axes=mesh_axes, rules=rules)
+    return program, report
 
 
 def main(argv=None) -> int:
@@ -66,7 +79,28 @@ def main(argv=None) -> int:
     ap.add_argument("--min-severity", default="info",
                     choices=["info", "warn", "error"],
                     help="lowest severity shown in the human report")
+    ap.add_argument("--dist", action="store_true",
+                    help="distributed profile: cross-check the input "
+                    "batch as programs sharing one Scope/job (PTL073 "
+                    "collective streams, PTL080 quantize-erasure)")
+    ap.add_argument("--mesh", default=None, metavar="dp=4,tp=2",
+                    help="mesh axis sizes for the PTL06x partition "
+                    "checks (no mesh: mesh-dependent checks stay quiet)")
+    ap.add_argument("--rules", default=None, metavar="batch=dp,heads=tp",
+                    help="logical-axis rules table "
+                    "(default: partition.rules.DEFAULT_RULES)")
     args = ap.parse_args(argv)
+
+    mesh_axes = rules = None
+    if args.mesh is not None or args.rules is not None:
+        from paddle_tpu.partition.rules import parse_mesh, parse_rules
+
+        try:
+            mesh_axes = parse_mesh(args.mesh) if args.mesh else None
+            rules = parse_rules(args.rules) if args.rules else None
+        except ValueError as exc:
+            print(f"proglint: {exc}", file=sys.stderr)
+            return 2
 
     passes = args.passes.split(",") if args.passes else None
     if passes is not None:
@@ -85,13 +119,15 @@ def main(argv=None) -> int:
         return 2
 
     reports = []
+    programs = {}
     for path in args.programs:
         if not os.path.exists(path):
             print(f"proglint: {path}: no such file", file=sys.stderr)
             return 2
         try:
-            reports.append(lint_path(path, fetch_names=args.fetch,
-                                     passes=passes))
+            program, report = lint_path(path, fetch_names=args.fetch,
+                                        passes=passes,
+                                        mesh_axes=mesh_axes, rules=rules)
         except (ValueError, KeyError, TypeError, AttributeError,
                 json.JSONDecodeError) as exc:
             # valid JSON with an invalid Program structure surfaces as
@@ -100,6 +136,18 @@ def main(argv=None) -> int:
             print(f"proglint: {path}: cannot load program: "
                   f"{type(exc).__name__}: {exc}", file=sys.stderr)
             return 2
+        reports.append(report)
+        programs[report.program_label] = program
+
+    if args.dist and len(programs) > 1:
+        from paddle_tpu.analysis import check_program_batch
+        from paddle_tpu.analysis.diagnostics import Diagnostic
+
+        by_label = {r.program_label: r for r in reports}
+        for code, label, message in check_program_batch(programs):
+            target = by_label.get(label, reports[0])
+            target.add(Diagnostic(code, message,
+                                  pass_name="cross-program"))
 
     if args.as_json:
         doc = {
