@@ -89,6 +89,19 @@ _FLAG_DEFS: Dict[str, Any] = {
     "generation_chunk_tokens": 16,
     "generation_spec_tokens": 0,
     "generation_kv_dtype": "float32",
+    # radix prefix cache (generation/kvcache.py trie, ragged only):
+    # generation_prefix_cache publishes every full KV page into a
+    # refcounted prefix trie and admits new prompts ONTO their matched
+    # prefix pages (copy-on-write sharing — a warm shared prompt
+    # prefills once, ever, and occupies one set of pages).
+    # generation_prefix_min_pages is the match granularity floor
+    # (matches shorter than this many full pages are ignored);
+    # generation_trie_max_pages caps trie-resident pages (0 =
+    # unlimited; the pool itself still reclaims trie leaves LRU-first
+    # under pressure)
+    "generation_prefix_cache": False,
+    "generation_prefix_min_pages": 1,
+    "generation_trie_max_pages": 0,
     # paddle_tpu.quantize (inference weight quantization): "off" keeps
     # fp32/bf16 weights; "int8" (per-output-channel fp32 scales) /
     # "int8_block" (blockwise scales down the contraction axis, block

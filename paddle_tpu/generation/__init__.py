@@ -13,9 +13,12 @@ join/leave the running batch every step; every token streams to its
 caller the moment it is sampled. Long prompts prefill in chunks
 across steps (decode ITL never stalls on a fat prompt); a draft model
 (draft.HostDraft or any DraftModel) + spec_tokens turns on
-speculative decoding (greedy-identical by construction); and
-kv_dtype="int8" quantizes the page pools for ~2x+ resident sequences
-per byte budget.
+speculative decoding (greedy-identical by construction); kv_dtype=
+"int8" quantizes the page pools for ~2x+ resident sequences per byte
+budget; and generation_prefix_cache turns on the radix KV cache —
+per-page refcounts + a token-keyed prefix trie, so prompts sharing a
+prefix attach its pages copy-on-write and prefill only their suffix
+(ragged engine only; see PagedKVCache.acquire/publish/release).
 
     from paddle_tpu.inference import Config, create_predictor
     from paddle_tpu import generation

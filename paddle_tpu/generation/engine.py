@@ -13,6 +13,16 @@ does what modern LLM serving does instead:
   fixed-size pages behind a block table; join/leave never copies or
   reallocates. ``kv_dtype="int8"`` stores pages blockwise-quantized
   (kernels/quant.py scales) for ~2x+ resident sequences per byte.
+* **Radix prefix cache** (``prefix_cache=True`` /
+  ``generation_prefix_cache``, ragged only): full pages publish into
+  a refcounted prefix trie as they are produced; admission attaches a
+  new prompt's matched prefix pages by reference and chunked prefill
+  starts at the FORK POINT — a fully-warm prefix (shared system
+  prompt, few-shot header, RAG boilerplate) collapses prefill to ~one
+  step and its pages to one copy in HBM. Copy-on-write is structural
+  (growth always pops fresh pages; full shared pages are never
+  written), release is refcounted, and pool pressure reclaims
+  trie-only leaves (LRU) before any live sequence is preempted.
 * **ONE ragged executable** (mode="ragged", the default — Ragged
   Paged Attention, arXiv:2604.15464): every step runs a single
   [lanes, chunk] mixed batch where each row is whatever its sequence
@@ -341,6 +351,7 @@ class GenerationEngine:
                  draft=None,
                  kv_dtype: Optional[str] = None,
                  quantize_weights: Optional[str] = None,
+                 prefix_cache: Optional[bool] = None,
                  warmup: bool = False, start: bool = True):
         from ..flags import flag
 
@@ -406,6 +417,17 @@ class GenerationEngine:
         if self.mode != "ragged" and self.spec_tokens:
             raise ValueError("speculative decoding requires the ragged "
                              "engine (generation_engine_mode='ragged')")
+        # radix prefix cache: param > flag. Ragged-only — the two_lane
+        # prefill executable writes the whole window from position 0,
+        # so it cannot start at a fork point (and is kept pristine as
+        # the cold token-identity oracle the radix tests prove
+        # against).
+        self.prefix_cache = bool(
+            prefix_cache if prefix_cache is not None
+            else flag("generation_prefix_cache"))
+        if self.prefix_cache and self.mode != "ragged":
+            raise ValueError("prefix caching requires the ragged engine "
+                             "(generation_engine_mode='ragged')")
         if prefill_buckets is None:
             prefill_buckets = tuple(
                 int(x) for x in
@@ -422,7 +444,10 @@ class GenerationEngine:
             config.hidden_size // config.num_heads,
             num_pages=self.num_pages, page_size=self.page_size,
             max_seqs=self.lanes, max_pages_per_seq=maxp,
-            dtype=self.kv_dtype)
+            dtype=self.kv_dtype,
+            prefix_cache=self.prefix_cache,
+            prefix_min_pages=int(flag("generation_prefix_min_pages")),
+            trie_max_pages=int(flag("generation_trie_max_pages")))
         self.metrics = GenerationMetrics()
         # unified telemetry: this engine's counters + page-pool stats
         # join the scrape as paddle_generation_*{engine=} series
@@ -612,9 +637,21 @@ class GenerationEngine:
         an off-by-a-few readout only shifts one dispatch decision."""
         return len(self._queue)
 
+    def prefix_probe(self, tokens) -> int:
+        """Matched-prefix token count this prompt would get right now
+        (a pure trie peek — no refcounts, no LRU touch). The traffic
+        layer prices generate TTFT on the UNMATCHED suffix only; 0
+        with the radix cache off."""
+        if not self.prefix_cache:
+            return 0
+        return int(self.cache.match_len(
+            np.asarray(tokens, dtype=np.int64).reshape(-1)))
+
     def stats(self) -> Dict[str, Any]:
         out = self.metrics.snapshot()
         out["cache"] = self.cache.stats()
+        # flattened by the registry into paddle_generation_radix_*
+        out["radix"] = self.cache.radix_stats()
         return out
 
     def stats_numeric(self) -> Dict[str, Any]:
@@ -695,13 +732,21 @@ class GenerationEngine:
                         f"deadline passed after "
                         f"{(now - req.enqueue_t) * 1e3:.1f}ms in queue"))
                     continue
-                # allocate_slot marks slot + pages taken immediately,
-                # so these checks already see earlier admissions
+                # acquire marks slot + pages taken immediately, so
+                # these checks already see earlier admissions. The
+                # trie peek is race-free: only this loop thread
+                # mutates the trie, so the acquire below matches at
+                # least what match_len just saw. A matched prefix is
+                # page-aligned, so suffix pages needed = total pages
+                # - matched pages exactly.
+                matched = (self.cache.match_len(req.prompt)
+                           if self.prefix_cache else 0)
                 if (self.cache.free_slots() <= 0
-                        or not self.cache.can_allocate(int(req.prompt.size))):
+                        or not self.cache.can_acquire(
+                            int(req.prompt.size) - matched)):
                     break
                 admitted.append(self._queue.popleft())
-                req.slot = self.cache.allocate_slot(int(req.prompt.size))
+                req.slot, req.prefill_off = self.cache.acquire(req.prompt)
                 if req.admit_seq == 0:
                     # first admission only: an evicted-and-resumed
                     # request keeps its original seniority, otherwise
@@ -816,9 +861,11 @@ class GenerationEngine:
         """Admission without a prefill executable: an admitted request
         takes a lane + pages for its whole prompt (the same FIFO
         head-of-line discipline as two_lane) and starts CHUNKED
-        prefill on the next ragged step."""
+        prefill on the next ragged step — at the trie fork point when
+        the radix cache matched a prefix (acquire already set
+        ``prefill_off`` / the cache length to the matched run, whose
+        K/V is resident in the shared pages)."""
         for req in self._pop_admissible():
-            req.prefill_off = 0
             req.pending = None
             req.drafts = None
             self._by_slot[req.slot] = req
@@ -1000,11 +1047,16 @@ class GenerationEngine:
                 continue
             if req.prefill_off < int(req.prompt.size):
                 # a prefill chunk: its K/V is cached now; the FINAL
-                # chunk additionally samples the first token (TTFT)
+                # chunk additionally samples the first token (TTFT).
+                # Publish BEFORE _emit: a request retiring on its very
+                # first token must still leave its prompt pages in the
+                # trie for the siblings behind it.
                 self.cache.advance(slot, nv)
                 req.prefill_off += nv
                 self.metrics.inc("prefill_chunks_total")
                 self.metrics.inc("prefill_tokens_total", nv)
+                if self.prefix_cache:
+                    self.cache.publish(slot, req.prompt)
                 if req.prefill_off >= int(req.prompt.size):
                     self.metrics.inc("prefill_batches_total")
                     self._emit(req, int(next_all[slot, nv - 1]), now)
@@ -1027,6 +1079,13 @@ class GenerationEngine:
                     self._emit(req, int(next_all[slot, j]), now)
                     if slot not in self._by_slot:
                         break           # retired (eos/length/deadline)
+                if self.prefix_cache and slot in self._by_slot:
+                    # decode-produced full pages join the trie too:
+                    # only positions < length publish, and rejected
+                    # drafts live strictly at positions >= length
+                    self.cache.publish(slot, np.concatenate(
+                        [req.orig_prompt,
+                         np.asarray(req.stream._tokens, np.int64)]))
         n_active = sum(1 for s, _ in active if num_valid[s] > 0)
         self.metrics.observe_decode_step(
             (now - t0) * 1e3, n_active, R, tokens=emitted_total)
@@ -1041,16 +1100,23 @@ class GenerationEngine:
 
     def _make_room(self, slot: int) -> bool:
         """The pool is dry and `slot` needs one more page: evict the
-        YOUNGEST other sequence (its request re-queues at the queue
-        head; greedy decode resumes identically after re-prefill).
-        Returns False when slot is alone and simply cannot grow — the
-        engine finishes it early ("capacity")."""
+        YOUNGEST other sequence that would actually RETURN pages (its
+        request re-queues at the queue head; greedy decode resumes
+        identically after re-prefill). Under the radix cache a
+        sequence's pages may be shared with siblings or the trie —
+        evicting a mostly-shared victim frees ~zero pages, so victims
+        are filtered by ``reclaimable_pages`` first (without sharing
+        every active sequence holds >= 1 private page, so this is
+        exactly the old evict-youngest). Returns False when no
+        eviction can free a page — the engine finishes `slot` early
+        ("capacity") instead of deadlocking admission."""
         victims = sorted(
             (r for s, r in self._by_slot.items() if s != slot),
             key=lambda r: -r.admit_seq)
-        if not victims:
+        victim = next((r for r in victims
+                       if self.cache.reclaimable_pages(r.slot) > 0), None)
+        if victim is None:
             return False
-        victim = victims[0]
         vslot = victim.slot
         del self._by_slot[vslot]
         self.cache.evict(vslot)
@@ -1163,6 +1229,16 @@ class GenerationEngine:
     def _retire(self, slot: int, reason: str,
                 error: Optional[BaseException] = None):
         req = self._by_slot.pop(slot, None)
+        if (self.prefix_cache and req is not None and error is None
+                and self.cache.is_active(slot)):
+            # last publish before the pages go back: every full page
+            # below the length holds verified K/V whatever the finish
+            # reason (cancel/deadline included — the release below is
+            # refcounted, so trie-resident pages survive for siblings
+            # while everything private frees)
+            self.cache.publish(slot, np.concatenate(
+                [req.orig_prompt,
+                 np.asarray(req.stream._tokens, np.int64)]))
         self.cache.release(slot)
         if req is not None:
             if error is None and reason in ("eos", "length", "capacity"):
@@ -1197,6 +1273,9 @@ class GenerationEngine:
                     self._retire(slot, "length")
                 elif self.cache.is_active(slot):
                     self.cache.release(slot)
+            if self.prefix_cache:
+                # warmup's dummy [0, 0] prompt must not seed the trie
+                self.cache.drop_trie()
             self.metrics.__init__()
             return
         for bucket in self._seq_buckets:

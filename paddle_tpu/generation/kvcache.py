@@ -17,8 +17,9 @@ per layer) are held here too, but they are only ever *mutated* inside
 the compiled prefill/decode steps (kernels/paged_attention.py
 ``kv_cache_write``) — the engine fetches the functionally-updated
 pools and swaps them back via ``set_buffers``. All bookkeeping methods
-are called from the engine's single step loop; the lock only protects
-the metric-reader path (``stats()`` from a scrape thread).
+are called from the engine's single step loop; the lock protects the
+metric/probe reader paths (``stats()`` / ``match_len`` from scrape and
+traffic threads).
 
 Page 0 is permanently reserved as the JUNK page: idle decode lanes and
 batch-padding rows point their tables at it, so their (discarded)
@@ -32,17 +33,34 @@ block = head_dim. A page then costs ~1/3.6 the fp32 bytes
 ~2x+ the resident sequences — the capacity multiplier
 tools/generation_bench.py --int8 gates.
 
-Exhaustion is backpressure, not corruption: ``allocate_slot`` /
-``ensure_capacity`` raise ``PagePoolExhausted``; the engine responds
-by delaying admission (queued requests wait for pages) or by evicting
-a victim sequence (whose request is re-queued for re-prefill — greedy
-decode makes the recomputed continuation identical).
+**Radix prefix cache** (``prefix_cache=True``, ragged engine only):
+every page carries a REFCOUNT, and full (page-aligned) token runs are
+published into a prefix TRIE keyed by the exact page_size-token tuple
+each page holds. ``acquire(prompt_tokens)`` walks the trie and
+attaches the matched prefix pages to the new sequence's block table BY
+REFERENCE — the shared prompt prefills once, ever — while the
+unmatched suffix gets private pages (copy-on-write is structural: the
+engine only ever writes positions >= the sequence length, and growth
+always pops FRESH pages, so a full shared page is immutable by
+construction). ``release`` decrements and returns a page to the free
+list only at refcount zero; pool pressure evicts trie-only leaves
+first, LRU, before admission ever backpressures or a live sequence is
+preempted. Since int8 scale planes ride the same page indirection,
+a shared page is also a shared quantized page — the two capacity
+multipliers compose.
+
+Exhaustion is backpressure, not corruption: ``acquire`` /
+``allocate_slot`` / ``ensure_capacity`` raise ``PagePoolExhausted``;
+the engine responds by delaying admission (queued requests wait for
+pages) or by evicting a victim sequence (whose request is re-queued
+for re-prefill — greedy decode makes the recomputed continuation
+identical).
 """
 
 from __future__ import annotations
 
 import threading
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -54,10 +72,28 @@ class PagePoolExhausted(RuntimeError):
     (or eviction) must resolve it; never an allocation."""
 
 
+class _TrieNode:
+    """One published page: ``key`` is the exact page_size-token tuple
+    the page holds, ``page`` the pool page id. Children extend the
+    token run by one more full page. ``last_used`` is a monotonic tick
+    (NOT wall time — deterministic LRU under test)."""
+
+    __slots__ = ("key", "page", "parent", "children", "last_used")
+
+    def __init__(self, key, page, parent):
+        self.key = key
+        self.page = page
+        self.parent = parent
+        self.children: Dict[tuple, "_TrieNode"] = {}
+        self.last_used = 0
+
+
 class PagedKVCache:
     def __init__(self, num_layers: int, num_kv_heads: int, head_dim: int, *,
                  num_pages: int, page_size: int, max_seqs: int,
-                 max_pages_per_seq: int, dtype: str = "float32"):
+                 max_pages_per_seq: int, dtype: str = "float32",
+                 prefix_cache: bool = False, prefix_min_pages: int = 1,
+                 trie_max_pages: int = 0):
         if num_pages < 2:
             raise ValueError("num_pages must be >= 2 (page 0 is reserved)")
         if page_size < 1 or max_seqs < 1 or max_pages_per_seq < 1:
@@ -71,6 +107,9 @@ class PagedKVCache:
         self.max_pages_per_seq = int(max_pages_per_seq)
         self.dtype = dtype
         self.quantized = dtype == "int8"
+        self.prefix_cache = bool(prefix_cache)
+        self.prefix_min_pages = max(1, int(prefix_min_pages))
+        self.trie_max_pages = max(0, int(trie_max_pages))
         self._lock = threading.Lock()
         # device pools, one K + one V per layer (lazy: first access
         # allocates, so constructing a cache in a test costs nothing);
@@ -86,8 +125,33 @@ class PagedKVCache:
         self._active = [False] * max_seqs
         # page 0 = junk page, never on the free list
         self._free = list(range(num_pages - 1, 0, -1))
+        # refcounts: one per sequence chain holding the page, plus one
+        # if the page is trie-resident; a page returns to the free
+        # list only at zero
+        self._ref = np.zeros(num_pages, np.int64)
+        # the prefix trie (radix cache): root holds no page; each
+        # child edge is one full page keyed by its exact token tuple
+        self._root = _TrieNode(None, None, None)
+        self._node_of_page: Dict[int, _TrieNode] = {}
+        self._tick = 0
+        # per-slot publish cursor: how many leading chain pages are
+        # trie-resident, and the node at that depth (walks resume
+        # there instead of re-keying from the root every step)
+        self._published_of = [0] * max_seqs
+        self._pub_node: List[Optional[_TrieNode]] = [None] * max_seqs
+        # a sibling published the same token run onto a DIFFERENT page
+        # first — this chain stays private from that depth on
+        self._pub_dead = [False] * max_seqs
         self.evictions_total = 0
         self.allocations_total = 0
+        # radix counters (radix_stats -> paddle_generation_radix_*)
+        self.prefix_lookups_total = 0
+        self.prefix_hits_total = 0
+        self.prefix_hit_tokens_total = 0
+        self.prefix_requested_tokens_total = 0
+        self.cow_forks_total = 0
+        self.leaf_evictions_total = 0
+        self.published_pages_total = 0
 
     # -- device buffers ------------------------------------------------------
     def _ensure_buffers(self):
@@ -188,14 +252,251 @@ class PagedKVCache:
     def can_allocate(self, n_tokens: int) -> bool:
         return self.pages_needed(n_tokens) <= len(self._free)
 
+    def can_acquire(self, n_tokens: int) -> bool:
+        """can_allocate, but counting trie-only pages the allocator
+        may legally reclaim (LRU leaf eviction) on top of the free
+        list — the admission check under a warm radix cache."""
+        with self._lock:
+            budget = len(self._free) + sum(
+                1 for p in self._node_of_page if int(self._ref[p]) == 1)
+        return self.pages_needed(n_tokens) <= budget
+
     def free_slots(self) -> int:
         return sum(1 for a in self._active if not a)
 
+    # -- the prefix trie (radix cache) ---------------------------------------
+    def _touch(self, node: _TrieNode) -> None:
+        self._tick += 1
+        node.last_used = self._tick
+
+    def _page_key(self, tokens, i: int) -> tuple:
+        ps = self.page_size
+        return tuple(int(t) for t in tokens[i * ps:(i + 1) * ps])
+
+    def _match_nodes(self, tokens) -> List[_TrieNode]:
+        """Trie path for the longest page-aligned prefix of
+        ``tokens``, capped so at least one prompt token is left to
+        prefill (the step that samples the first output token), and
+        floored at prefix_min_pages (shorter matches are not worth the
+        shared-page bookkeeping)."""
+        if not self.prefix_cache:
+            return []
+        cap = (len(tokens) - 1) // self.page_size
+        nodes: List[_TrieNode] = []
+        node = self._root
+        for i in range(cap):
+            child = node.children.get(self._page_key(tokens, i))
+            if child is None:
+                break
+            nodes.append(child)
+            node = child
+        if len(nodes) < self.prefix_min_pages:
+            return []
+        return nodes
+
+    def match_len(self, tokens) -> int:
+        """Matched-prefix length IN TOKENS a prompt would get right
+        now. Pure peek — no refcount, no LRU touch, no counters — safe
+        from the traffic thread (suffix-only TTFT pricing)."""
+        with self._lock:
+            return len(self._match_nodes(np.asarray(tokens).reshape(-1))) \
+                * self.page_size
+
+    def _evict_leaf_locked(self) -> bool:
+        """Reclaim ONE trie-only page: the least-recently-used leaf
+        whose page no live sequence holds (refcount 1 = the trie's own
+        reference). Interior nodes and shared pages are never touched
+        — evicting them would free nothing and orphan the path."""
+        best: Optional[_TrieNode] = None
+        stack = [self._root]
+        while stack:
+            node = stack.pop()
+            for child in node.children.values():
+                if child.children:
+                    stack.append(child)
+                elif int(self._ref[child.page]) == 1:
+                    if best is None or child.last_used < best.last_used:
+                        best = child
+        if best is None:
+            return False
+        del best.parent.children[best.key]
+        del self._node_of_page[best.page]
+        self._ref[best.page] = 0
+        self._free.append(best.page)
+        self.leaf_evictions_total += 1
+        return True
+
+    def _pop_page_locked(self) -> int:
+        """One page off the free list; a dry list reclaims trie-only
+        leaves (LRU) BEFORE surfacing backpressure — cached prefixes
+        yield to live sequences, never the other way around."""
+        if not self._free and not self._evict_leaf_locked():
+            raise PagePoolExhausted("page pool dry (no evictable "
+                                    "trie leaves)")
+        return self._free.pop()
+
+    def publish(self, slot: int, context_tokens) -> int:
+        """Insert ``slot``'s full pages into the trie so later prompts
+        can attach them. ``context_tokens`` must cover the sequence's
+        cached context (prompt + emitted); only pages fully covered by
+        ``lengths[slot]`` publish — positions past the length may
+        still hold rejected-draft garbage, full pages below it are
+        immutable (writes only ever target positions >= length).
+        Returns the newly published page count. No-op unless
+        prefix_cache."""
+        if not self.prefix_cache:
+            return 0
+        with self._lock:
+            if not self._active[slot] or self._pub_dead[slot]:
+                return 0
+            tokens = np.asarray(context_tokens).reshape(-1)
+            full = min(int(self.lengths[slot]),
+                       int(tokens.size)) // self.page_size
+            idx = self._published_of[slot]
+            if full <= idx:
+                return 0
+            node = self._pub_node[slot] or self._root
+            chain = self._pages_of[slot]
+            new = 0
+            while idx < full:
+                key = self._page_key(tokens, idx)
+                child = node.children.get(key)
+                if child is not None:
+                    if child.page != chain[idx]:
+                        # a sibling that cold-prefilled the same run
+                        # concurrently published first; keep ours
+                        # private rather than re-point live tables
+                        self._pub_dead[slot] = True
+                        break
+                    self._touch(child)
+                else:
+                    if (self.trie_max_pages
+                            and len(self._node_of_page) >= self.trie_max_pages
+                            and not self._evict_leaf_locked()):
+                        break   # cap reached, nothing evictable: retry later
+                    child = _TrieNode(key, chain[idx], node)
+                    node.children[key] = child
+                    self._node_of_page[chain[idx]] = child
+                    self._ref[chain[idx]] += 1
+                    self._touch(child)
+                    new += 1
+                node = child
+                idx += 1
+            self._published_of[slot] = idx
+            self._pub_node[slot] = node
+            self.published_pages_total += new
+            return new
+
+    def drop_trie(self) -> int:
+        """Flush the whole prefix trie: every trie-resident page loses
+        the trie's reference (freed at zero — shared pages survive
+        until their sequences release). Live sequences republish from
+        scratch on their next publish. Returns pages freed. The
+        teardown/drain hook: after drop_trie + releasing every slot,
+        ``pages_in_use`` must be exactly zero."""
+        with self._lock:
+            freed = 0
+            for p in list(self._node_of_page):
+                self._ref[p] -= 1
+                if self._ref[p] == 0:
+                    self._free.append(p)
+                    freed += 1
+            self._node_of_page.clear()
+            self._root.children.clear()
+            for s in range(self.max_seqs):
+                self._published_of[s] = 0
+                self._pub_node[s] = self._root if self._active[s] else None
+                self._pub_dead[s] = False
+            return freed
+
+    def trie_pages(self) -> int:
+        with self._lock:
+            return len(self._node_of_page)
+
+    def reclaimable_pages(self, slot: int) -> int:
+        """Pages that evicting ``slot`` would actually give back: the
+        ones only THIS sequence holds (net of the trie's reference —
+        a trie-resident page drops to trie-only on release and LRU
+        leaf eviction reclaims it on the retry). The engine's pool-dry
+        victim ranking uses this instead of raw page count, so a
+        mostly-shared sequence is never evicted for ~zero gain."""
+        with self._lock:
+            return sum(
+                1 for p in self._pages_of[slot]
+                if int(self._ref[p])
+                - (1 if p in self._node_of_page else 0) == 1)
+
     # -- sequence lifecycle --------------------------------------------------
+    def acquire(self, prompt_tokens) -> Tuple[int, int]:
+        """Claim a batch slot + pages for a prompt, attaching any
+        trie-matched prefix pages BY REFERENCE (their K/V is already
+        resident — prefill starts at the fork point). Returns
+        ``(slot, matched_tokens)`` with matched_tokens page-aligned
+        and < len(prompt). Raises PagePoolExhausted when slots or
+        pages are unavailable *right now* (backpressure, not
+        rejection). With prefix_cache off this is exactly
+        ``allocate_slot`` (matched_tokens == 0)."""
+        tokens = np.asarray(prompt_tokens).reshape(-1)
+        n = int(tokens.size)
+        need_total = self.pages_needed(n)
+        if need_total > self.max_pages_per_seq:
+            raise ValueError(
+                f"{n} tokens need {need_total} pages > max_pages_per_seq="
+                f"{self.max_pages_per_seq}")
+        with self._lock:
+            slot = next((i for i, a in enumerate(self._active) if not a),
+                        None)
+            if slot is None:
+                raise PagePoolExhausted("no free decode slots")
+            nodes = self._match_nodes(tokens)
+            if self.prefix_cache:
+                self.prefix_lookups_total += 1
+                self.prefix_requested_tokens_total += n
+            # bump the matched path FIRST: refcount >= 2 shields those
+            # pages from the leaf eviction the suffix allocation below
+            # may trigger
+            for nd in nodes:
+                self._ref[nd.page] += 1
+                self._touch(nd)
+            priv: List[int] = []
+            try:
+                for _ in range(need_total - len(nodes)):
+                    p = self._pop_page_locked()
+                    self._ref[p] = 1
+                    priv.append(p)
+            except PagePoolExhausted:
+                for p in priv:
+                    self._ref[p] = 0
+                    self._free.append(p)
+                for nd in nodes:
+                    self._ref[nd.page] -= 1
+                raise
+            pages = [nd.page for nd in nodes] + priv
+            self._pages_of[slot] = pages
+            row = self.block_tables[slot]
+            row[:] = 0
+            row[:len(pages)] = pages
+            # the matched prefix's K/V is genuinely resident: the new
+            # sequence starts at length = matched (the fork point)
+            self.lengths[slot] = len(nodes) * self.page_size
+            self._active[slot] = True
+            self.allocations_total += len(priv)
+            self._published_of[slot] = len(nodes)
+            self._pub_node[slot] = nodes[-1] if nodes else self._root
+            self._pub_dead[slot] = False
+            if nodes:
+                self.prefix_hits_total += 1
+                self.prefix_hit_tokens_total += len(nodes) * self.page_size
+                # the first private page past the shared prefix IS the
+                # copy-on-write fork
+                self.cow_forks_total += 1
+            return slot, len(nodes) * self.page_size
+
     def allocate_slot(self, n_tokens: int) -> int:
-        """Claim a batch slot + pages for an n_tokens prompt. Returns
-        the slot id; raises PagePoolExhausted when pages or slots are
-        unavailable *right now* (backpressure, not rejection)."""
+        """Claim a batch slot + pages for an n_tokens prompt with NO
+        trie consultation (the pre-radix API; warmup and token-count
+        callers). Returns the slot id; raises PagePoolExhausted when
+        pages or slots are unavailable *right now*."""
         need = self.pages_needed(n_tokens)
         if need > self.max_pages_per_seq:
             raise ValueError(
@@ -206,10 +507,17 @@ class PagedKVCache:
                         None)
             if slot is None:
                 raise PagePoolExhausted("no free decode slots")
-            if need > len(self._free):
-                raise PagePoolExhausted(
-                    f"need {need} pages, {len(self._free)} free")
-            pages = [self._free.pop() for _ in range(need)]
+            pages: List[int] = []
+            try:
+                for _ in range(need):
+                    p = self._pop_page_locked()
+                    self._ref[p] = 1
+                    pages.append(p)
+            except PagePoolExhausted:
+                for p in pages:
+                    self._ref[p] = 0
+                    self._free.append(p)
+                raise
             self._pages_of[slot] = pages
             row = self.block_tables[slot]
             row[:] = 0
@@ -217,11 +525,17 @@ class PagedKVCache:
             self.lengths[slot] = 0
             self._active[slot] = True
             self.allocations_total += need
+            self._published_of[slot] = 0
+            self._pub_node[slot] = self._root
+            self._pub_dead[slot] = False
             return slot
 
     def ensure_capacity(self, slot: int, new_len: int) -> None:
-        """Grow slot's page chain to cover new_len tokens; raises
-        PagePoolExhausted when the pool is dry (engine evicts then)."""
+        """Grow slot's page chain to cover new_len tokens; growth pops
+        FRESH private pages (never a shared one — that is what makes
+        copy-on-write structural); raises PagePoolExhausted when the
+        pool is dry even after trie-leaf reclaim (engine evicts
+        then)."""
         need = self.pages_needed(new_len)
         if new_len > self.max_pages_per_seq * self.page_size:
             raise ValueError(
@@ -230,10 +544,8 @@ class PagedKVCache:
         with self._lock:
             pages = self._pages_of[slot]
             while len(pages) < need:
-                if not self._free:
-                    raise PagePoolExhausted(
-                        f"slot {slot} needs page {len(pages)}, pool dry")
-                p = self._free.pop()
+                p = self._pop_page_locked()
+                self._ref[p] = 1
                 self.block_tables[slot, len(pages)] = p
                 pages.append(p)
                 self.allocations_total += 1
@@ -243,14 +555,22 @@ class PagedKVCache:
         return int(self.lengths[slot])
 
     def release(self, slot: int) -> None:
-        """Sequence done: pages back on the free list, table row back
+        """Sequence done: every chain page drops one reference; pages
+        reach the free list only at refcount ZERO — a page the trie
+        (or a sibling sequence) still holds survives. Table row back
         to the junk page, slot reusable."""
         with self._lock:
-            self._free.extend(self._pages_of[slot])
+            for p in self._pages_of[slot]:
+                self._ref[p] -= 1
+                if self._ref[p] == 0:
+                    self._free.append(p)
             self._pages_of[slot] = []
             self.block_tables[slot, :] = 0
             self.lengths[slot] = 0
             self._active[slot] = False
+            self._published_of[slot] = 0
+            self._pub_node[slot] = None
+            self._pub_dead[slot] = False
 
     def evict(self, slot: int) -> None:
         """Preemption: identical to release, but counted — the engine
@@ -282,23 +602,56 @@ class PagedKVCache:
                 "pool_bytes": self.pool_bytes(),
             }
 
+    def radix_stats(self) -> Dict[str, Any]:
+        """The ``paddle_generation_radix_*`` gauge family (nested into
+        engine.stats() as the "radix" group): prefix hit volume/rate,
+        the shared/private/trie-resident page split, CoW forks and
+        trie-leaf evictions."""
+        with self._lock:
+            chained: Dict[int, int] = {}
+            for slot in range(self.max_seqs):
+                for p in self._pages_of[slot]:
+                    chained[p] = chained.get(p, 0) + 1
+            shared = sum(1 for p in chained if int(self._ref[p]) >= 2)
+            private = sum(1 for p in chained if int(self._ref[p]) == 1)
+            req = self.prefix_requested_tokens_total
+            return {
+                "enabled": int(self.prefix_cache),
+                "prefix_lookups_total": self.prefix_lookups_total,
+                "prefix_hits_total": self.prefix_hits_total,
+                "prefix_hit_tokens_total": self.prefix_hit_tokens_total,
+                "prefix_requested_tokens_total": req,
+                "prefix_hit_rate": (
+                    round(self.prefix_hit_tokens_total / req, 4)
+                    if req else 0.0),
+                "shared_pages": shared,
+                "private_pages": private,
+                "trie_pages": len(self._node_of_page),
+                "cow_forks_total": self.cow_forks_total,
+                "leaf_evictions_total": self.leaf_evictions_total,
+                "published_pages_total": self.published_pages_total,
+            }
+
     def check_integrity(self) -> None:
         """Invariant audit (tests call this after concurrent
-        join/leave churn): every allocated page appears in exactly one
-        chain, free + allocated covers the pool, tables mirror chains."""
-        seen: Dict[int, int] = {}
+        join/leave churn and in every radix-test teardown): chains and
+        tables mirror each other, the trie is structurally sound,
+        every page's refcount equals (chains holding it) +
+        (1 if trie-resident), a page shared by chains is always
+        trie-resident, free + in-use covers the pool exactly."""
         with self._lock:
+            holders: Dict[int, List[int]] = {}
             for slot in range(self.max_seqs):
                 pages = self._pages_of[slot]
                 if not self._active[slot] and pages:
                     raise AssertionError(f"inactive slot {slot} holds pages")
+                if len(set(pages)) != len(pages):
+                    raise AssertionError(
+                        f"slot {slot} chain repeats a page: {pages}")
                 for j, p in enumerate(pages):
-                    if p in seen:
-                        raise AssertionError(
-                            f"page {p} in slots {seen[p]} and {slot}")
                     if p == 0:
                         raise AssertionError("junk page 0 inside a chain")
-                    seen[p] = slot
+                    holders.setdefault(p, []).append(slot)
                     if int(self.block_tables[slot, j]) != p:
                         raise AssertionError(
                             f"table/chain mismatch at slot {slot} idx {j}")
@@ -307,10 +660,75 @@ class PagedKVCache:
                     raise AssertionError(
                         f"slot {slot} length {self.lengths[slot]} > "
                         f"allocated {covered}")
-            dup = set(self._free) & set(seen)
-            if dup:
-                raise AssertionError(f"pages both free and allocated: {dup}")
-            if len(self._free) + len(seen) != self.usable_pages:
+            # trie structure: parent/child links coherent, every page
+            # appears at most once, node_of_page is exactly the trie
+            trie: Dict[int, _TrieNode] = {}
+            stack = [self._root]
+            while stack:
+                node = stack.pop()
+                for key, child in node.children.items():
+                    if child.parent is not node or child.key != key:
+                        raise AssertionError(
+                            f"trie link broken at page {child.page}")
+                    p = child.page
+                    if not isinstance(p, int) or p <= 0:
+                        raise AssertionError(f"trie node with bad page {p!r}")
+                    if p in trie:
+                        raise AssertionError(f"page {p} twice in the trie")
+                    if (child.key is not None
+                            and len(child.key) != self.page_size):
+                        raise AssertionError(
+                            f"trie key of {len(child.key)} tokens != "
+                            f"page_size {self.page_size}")
+                    trie[p] = child
+                    stack.append(child)
+            if set(trie) != set(self._node_of_page):
                 raise AssertionError(
-                    f"page leak: {len(self._free)} free + {len(seen)} "
-                    f"allocated != {self.usable_pages}")
+                    "node_of_page desynced from the trie: "
+                    f"{set(trie) ^ set(self._node_of_page)}")
+            for p, nd in trie.items():
+                if self._node_of_page[p] is not nd:
+                    raise AssertionError(f"node_of_page[{p}] is a stale node")
+            # refcounts: chains + trie residency, nothing else
+            for p in range(1, self.num_pages):
+                expected = len(holders.get(p, ())) + (1 if p in trie else 0)
+                if int(self._ref[p]) != expected:
+                    raise AssertionError(
+                        f"refcount leak: page {p} ref {int(self._ref[p])} "
+                        f"!= {expected} (chains {holders.get(p, [])}, "
+                        f"trie={p in trie})")
+            # a page in two chains got there only via the trie
+            for p, slots in holders.items():
+                if len(slots) > 1 and p not in trie:
+                    raise AssertionError(
+                        f"page {p} shared by slots {slots} without trie "
+                        "residency")
+            # publish cursors stay inside the trie
+            for slot in range(self.max_seqs):
+                if not self._active[slot]:
+                    continue
+                pub = self._published_of[slot]
+                pages = self._pages_of[slot]
+                if pub > len(pages):
+                    raise AssertionError(
+                        f"slot {slot} published {pub} > chain {len(pages)}")
+                for j in range(pub):
+                    if pages[j] not in trie:
+                        raise AssertionError(
+                            f"slot {slot} counts page {pages[j]} as "
+                            "published but it is not trie-resident")
+            # free list: unique, disjoint from use, refcount zero
+            fs = set(self._free)
+            if len(fs) != len(self._free):
+                raise AssertionError("free list holds duplicates")
+            in_use = set(holders) | set(trie)
+            dup = fs & in_use
+            if dup:
+                raise AssertionError(f"pages both free and in use: {dup}")
+            bad = [p for p in fs if int(self._ref[p]) != 0]
+            if bad:
+                raise AssertionError(f"free pages with refs: {bad}")
+            if len(fs) + len(in_use) != self.usable_pages:
+                raise AssertionError(
+                    f"page leak: {len(fs)} free + {len(in_use)} in use "
+                    f"!= {self.usable_pages}")

@@ -335,7 +335,10 @@ def watch_generation(metrics) -> None:
     TTFT / inter-token latency quantiles, and the speculative-decoding
     health series (``paddle_generation_spec_proposed_total`` /
     ``_spec_accepted_total`` / ``_spec_acceptance_rate`` /
-    ``_spec_accepted_tokens_per_step``) in the one scrape."""
+    ``_spec_accepted_tokens_per_step``) and the radix prefix-cache
+    group (``paddle_generation_radix_*``: hit volume/rate, the
+    shared/private/trie page split, CoW forks, leaf evictions) in the
+    one scrape."""
     _obs_id(metrics)
     _generation.add(metrics)
 

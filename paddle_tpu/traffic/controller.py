@@ -259,8 +259,8 @@ class ServiceTimeEstimator:
         return None
 
     def generate_service_ms(self, max_new: Optional[int],
-                            prompt_tokens: Optional[int] = None
-                            ) -> Optional[float]:
+                            prompt_tokens: Optional[int] = None,
+                            prompt=None) -> Optional[float]:
         """TTFT estimate + max_new x inter-token p50; None until the
         engine has served (medians for the same shed-must-be-provable
         reason).
@@ -271,7 +271,14 @@ class ServiceTimeEstimator:
         fat prompt is priced as the several bounded slices it actually
         costs, not as one monolithic prefill at the global TTFT
         median (which a mixed workload would badly under/over-state
-        for the tails of the prompt-length distribution)."""
+        for the tails of the prompt-length distribution).
+
+        With the radix prefix cache warm, a matched prefix costs no
+        prefill steps at all, so when the actual ``prompt`` tokens are
+        available the engine's trie is probed (a pure peek) and only
+        the UNMATCHED suffix is priced — otherwise a boilerplate-heavy
+        prompt would be shed as unmeetable when it is really ~one
+        chunk of work."""
         if self._gen is None:
             return None
         snap = self._gen.metrics.snapshot()
@@ -287,6 +294,15 @@ class ServiceTimeEstimator:
         chunk = (int(getattr(self._gen, "chunk_tokens", 0) or 0)
                  if getattr(self._gen, "mode", "") == "ragged" else 0)
         step_p50 = float(snap["decode_step_ms"]["p50"] or 0.0)
+        if (prompt is not None and prompt_tokens
+                and getattr(self._gen, "prefix_cache", False)):
+            try:
+                matched = int(self._gen.prefix_probe(prompt))
+            except Exception:  # noqa: BLE001 — pricing must never raise
+                matched = 0
+            # at least one token always prefills (it samples the
+            # first output token)
+            prompt_tokens = max(1, int(prompt_tokens) - matched)
         if prompt_tokens and chunk and step_p50 > 0:
             chunks = -(-int(prompt_tokens) // chunk)
             # queue-to-lane wait is already in the measured TTFT; keep
@@ -303,7 +319,7 @@ class ServiceTimeEstimator:
                 pass
             return self.generate_service_ms(
                 req.gen_args.get("max_new_tokens"),
-                prompt_tokens=prompt_tokens)
+                prompt_tokens=prompt_tokens, prompt=req.feed)
         return self.predict_service_ms()
 
 

@@ -1,0 +1,427 @@
+"""paddle_tpu radix KV cache (ISSUE 17): refcounted copy-on-write
+pages + a prefix trie so shared prompts prefill once.
+
+Correctness anchors:
+  * trie — page-aligned insert/match with the >=1-token-to-prefill
+    cap and the prefix_min_pages floor, LRU leaf eviction under pool
+    pressure, exhaustion rollback;
+  * refcounts — chain + trie references per page, CoW isolation
+    (a sibling's release never touches shared pages), reclaimable-page
+    accounting for the pool-dry victim ranking;
+  * engine — warm requests are token-identical to the naive oracle
+    AND the cold two-lane engine, through churn/eviction and over
+    int8-quantized pages;
+  * integrity — ``check_integrity`` recomputes every refcount and
+    catches a seeded leak; after drain + ``drop_trie`` the pool holds
+    exactly zero pages, in every test.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.generation import (GenerationEngine, PagedKVCache,
+                                   PagePoolExhausted)
+from paddle_tpu.generation.model import GPTConfig, build_lm_program
+from paddle_tpu.inference import Config, create_predictor
+
+CFG = GPTConfig(vocab_size=97, hidden_size=32, num_layers=2, num_heads=4,
+                ffn_size=64, max_position=64, hidden_dropout=0.0,
+                attention_dropout=0.0)
+SEQ = 48
+
+
+@pytest.fixture(scope="module")
+def lm_dir(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("radix_lm"))
+    main, startup, _feeds, fetches = build_lm_program(CFG, SEQ)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        fluid.io.save_inference_model(d, ["tokens"],
+                                      [fetches["logits"]], exe, main)
+    return d
+
+
+@pytest.fixture(scope="module")
+def predictor(lm_dir):
+    return create_predictor(Config(lm_dir))
+
+
+@pytest.fixture(scope="module")
+def oracle(predictor):
+    def _decode(prompt, n):
+        toks = list(int(t) for t in prompt)
+        out = []
+        for _ in range(n):
+            arr = np.zeros((1, SEQ), np.int64)
+            arr[0, :len(toks)] = toks
+            (logits,) = predictor.run([arr])
+            t = int(np.argmax(logits[0, len(toks) - 1]))
+            toks.append(t)
+            out.append(t)
+        return out
+    return _decode
+
+
+def _engine(predictor, **kw):
+    kw.setdefault("page_size", 4)
+    kw.setdefault("num_pages", 64)
+    kw.setdefault("max_decode_batch", 4)
+    kw.setdefault("chunk_tokens", 6)
+    return GenerationEngine(predictor, CFG, **kw)
+
+
+def _cache(**kw):
+    kw.setdefault("num_pages", 16)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("max_seqs", 4)
+    kw.setdefault("max_pages_per_seq", 12)
+    kw.setdefault("prefix_cache", True)
+    return PagedKVCache(2, 4, 8, **kw)
+
+
+def _toks(*vals):
+    return np.asarray(vals, dtype=np.int64)
+
+
+def _drain(c):
+    """Uniform teardown: flush the trie, audit, demand an empty pool."""
+    c.drop_trie()
+    c.check_integrity()
+    assert c.stats()["pages_in_use"] == 0
+
+
+# -- trie mechanics ----------------------------------------------------------
+
+
+def test_trie_publish_match_acquire_roundtrip():
+    """Cold acquire -> publish -> the next prompt attaches the shared
+    run by reference and starts prefill at the fork point."""
+    c = _cache()
+    p = np.arange(1, 13, dtype=np.int64)            # 12 tokens = 3 pages
+    slot, matched = c.acquire(p)
+    assert matched == 0
+    c.advance(slot, 12)
+    assert c.publish(slot, p) == 3
+    assert c.trie_pages() == 3
+    shared = list(c._pages_of[slot])
+    # the cap: at least one prompt token must prefill (it samples the
+    # first output token), so an exact-3-page prompt matches only 2
+    assert c.match_len(p) == 8
+    assert c.match_len(np.concatenate([p, p[:4]])) == 12
+    c.release(slot)
+    assert c.trie_pages() == 3                       # survives retirement
+    s2, m2 = c.acquire(np.concatenate([p, _toks(77, 78)]))
+    assert m2 == 12
+    assert int(c.lengths[s2]) == 12                  # fork point
+    assert list(c._pages_of[s2][:3]) == shared       # by REFERENCE
+    assert c.prefix_hits_total == 1 and c.cow_forks_total == 1
+    c.check_integrity()
+    c.release(s2)
+    _drain(c)
+
+
+def test_prefix_min_pages_floor():
+    """Matches shorter than the floor are not worth the shared-page
+    bookkeeping and report as misses."""
+    c = _cache(prefix_min_pages=2)
+    p8 = np.arange(1, 9, dtype=np.int64)             # 2 full pages
+    slot, _ = c.acquire(p8)
+    c.advance(slot, 8)
+    c.publish(slot, p8)
+    c.release(slot)
+    # an 8-token prompt can match at most 1 page (cap) -> below floor
+    assert c.match_len(p8) == 0
+    # a 12-token prompt can take both pages -> meets the floor
+    assert c.match_len(np.concatenate([p8, _toks(1, 2, 3, 4)])) == 8
+    _drain(c)
+
+
+def test_cow_fork_isolation_and_refcounts():
+    """Two sequences over one prefix: shared pages carry both chain
+    refs + the trie's; growth pops FRESH pages (CoW is structural);
+    releasing one sibling leaves the other's pages untouched."""
+    c = _cache()
+    p = np.arange(1, 13, dtype=np.int64)
+    a, _ = c.acquire(p)
+    c.advance(a, 12)
+    c.publish(a, p)
+    shared = list(c._pages_of[a])
+    b, mb = c.acquire(np.concatenate([p, _toks(60, 61, 62)]))
+    assert mb == 12
+    assert list(c._pages_of[b][:3]) == shared
+    assert all(int(c._ref[pg]) == 3 for pg in shared)   # 2 chains + trie
+    bpriv = c._pages_of[b][3]
+    assert int(c._ref[bpriv]) == 1
+    c.advance(b, 3)
+    c.ensure_capacity(b, 17)                         # decode growth
+    assert list(c._pages_of[b][:3]) == shared
+    assert len(c._pages_of[b]) == 5                  # fresh private pages
+    c.release(a)
+    assert all(int(c._ref[pg]) == 2 for pg in shared)   # sibling intact
+    c.check_integrity()
+    c.release(b)
+    _drain(c)
+
+
+def test_pool_pressure_evicts_lru_leaf_first():
+    """A dry free list reclaims the least-recently-used trie-only
+    LEAF; recently-matched runs and interior pages survive."""
+    c = _cache(num_pages=8)                          # 7 usable
+    pa = np.arange(1, 9, dtype=np.int64)
+    pb = np.arange(11, 19, dtype=np.int64)
+    for p in (pa, pb):
+        s, _ = c.acquire(p)
+        c.advance(s, 8)
+        c.publish(s, p)
+        c.release(s)
+    # refresh pa's first page in the LRU order
+    sa, ma = c.acquire(pa)
+    assert ma == 4
+    c.release(sa)
+    # a 16-token cold prompt needs 4 pages with 3 free: ONE leaf must
+    # go, and the LRU leaf is pa's second page
+    sc, mc = c.acquire(np.arange(41, 57, dtype=np.int64))
+    assert mc == 0
+    assert c.leaf_evictions_total == 1
+    tail = _toks(9, 9, 9, 9)
+    assert c.match_len(np.concatenate([pa, tail])) == 4   # pa2 evicted
+    assert c.match_len(np.concatenate([pb, tail])) == 8   # pb intact
+    c.check_integrity()
+    c.release(sc)
+    _drain(c)
+
+
+def test_acquire_exhaustion_rolls_back_refs():
+    """A failed acquire is backpressure, not corruption: popped pages
+    return to the free list and matched-node refcounts roll back."""
+    c = _cache(num_pages=4, max_seqs=2)              # 3 usable
+    p = np.arange(1, 9, dtype=np.int64)
+    a, _ = c.acquire(p)
+    c.advance(a, 8)
+    c.publish(a, p)
+    free_before = c.free_pages()
+    with pytest.raises(PagePoolExhausted):
+        c.acquire(np.arange(21, 37, dtype=np.int64))     # cold, needs 4
+    assert c.free_pages() == free_before
+    # warm variant: the matched path's refs must roll back too
+    q = np.concatenate([p, np.arange(41, 61, dtype=np.int64)])
+    with pytest.raises(PagePoolExhausted):
+        c.acquire(q)                                     # 2 matched + 5 > free
+    assert all(int(c._ref[pg]) == 2 for pg in c._pages_of[a])
+    c.check_integrity()
+    c.release(a)
+    _drain(c)
+
+
+def test_reclaimable_pages_ranks_victims():
+    """The pool-dry eviction bugfix's arithmetic: a fully-shared
+    sequence reclaims ZERO pages (evicting it frees nothing), the
+    CoW sibling reclaims exactly its private suffix."""
+    c = _cache()
+    p = np.arange(1, 13, dtype=np.int64)
+    a, _ = c.acquire(p)
+    c.advance(a, 12)
+    assert c.reclaimable_pages(a) == 3               # all private
+    c.publish(a, p)
+    assert c.reclaimable_pages(a) == 3               # trie ref discounted
+    b, _ = c.acquire(np.concatenate([p, _toks(7, 8)]))
+    assert c.reclaimable_pages(a) == 0               # fully shared now
+    assert c.reclaimable_pages(b) == 1               # its CoW suffix page
+    c.release(b)
+    assert c.reclaimable_pages(a) == 3
+    c.check_integrity()
+    c.release(a)
+    _drain(c)
+
+
+def test_check_integrity_catches_seeded_refcount_leak():
+    """The auditor recomputes every page's refcount from the chains +
+    trie; a seeded drift in either direction raises."""
+    c = _cache()
+    p = np.arange(1, 13, dtype=np.int64)
+    s, _ = c.acquire(p)
+    c.advance(s, 12)
+    c.publish(s, p)
+    c.check_integrity()
+    victim = c._pages_of[s][0]
+    c._ref[victim] += 1                              # leak
+    with pytest.raises(AssertionError, match="refcount leak"):
+        c.check_integrity()
+    c._ref[victim] -= 2                              # premature free
+    with pytest.raises(AssertionError, match="refcount leak"):
+        c.check_integrity()
+    c._ref[victim] += 1
+    c.check_integrity()
+    c.release(s)
+    _drain(c)
+
+
+# -- engine integration ------------------------------------------------------
+
+
+def test_radix_requires_ragged_mode(predictor):
+    """two_lane prefills the whole window from position 0 — it cannot
+    start at a fork point, and stays the cold oracle."""
+    with pytest.raises(ValueError, match="ragged"):
+        GenerationEngine(predictor, CFG, mode="two_lane",
+                         prefill_buckets=(8, 16, 32), page_size=4,
+                         num_pages=16, max_decode_batch=2,
+                         prefix_cache=True)
+
+
+def test_warm_requests_match_oracle_and_two_lane(predictor, oracle):
+    """THE sharing proof: prompts over a common prefix served warm by
+    the radix engine emit exactly the cold two-lane engine's tokens
+    (== the naive oracle's), and the gauges show the hits."""
+    rng = np.random.RandomState(31)
+    pre = rng.randint(1, CFG.vocab_size, 12).astype(np.int64)
+    prompts = [np.concatenate([pre, rng.randint(
+        1, CFG.vocab_size, rng.randint(2, 5)).astype(np.int64)])
+        for _ in range(3)]
+    outs = {}
+    for mode in ("ragged", "two_lane"):
+        kw = dict(mode=mode)
+        if mode == "ragged":
+            kw["prefix_cache"] = True
+        else:
+            kw["prefill_buckets"] = (8, 16, 32)
+        eng = _engine(predictor, **kw) if mode == "ragged" else \
+            GenerationEngine(predictor, CFG, page_size=4, num_pages=64,
+                             max_decode_batch=4, **kw)
+        with eng:
+            # serial: the first request publishes the prefix, the rest
+            # attach warm
+            outs[mode] = [eng.generate(p, max_new_tokens=8, timeout=600)
+                          for p in prompts]
+            st = eng.stats()
+            eng.cache.check_integrity()
+            if mode == "ragged":
+                assert st["radix"]["prefix_hits_total"] >= 2
+                assert st["radix"]["prefix_hit_tokens_total"] >= 16
+                eng.cache.drop_trie()
+                eng.cache.check_integrity()
+        assert eng.stats()["cache"]["pages_in_use"] == 0
+    assert outs["ragged"] == outs["two_lane"]
+    for p, got in zip(prompts, outs["ragged"]):
+        assert got == oracle(p, 8), list(p)
+
+
+def test_radix_churn_eviction_resume_token_identity(predictor, oracle):
+    """Refcount integrity under the hard path: a small pool, shared
+    prefixes, decode budgets that force mid-flight eviction + resume —
+    tokens stay oracle-identical and the pool drains to zero."""
+    rng = np.random.RandomState(41)
+    pre = rng.randint(1, CFG.vocab_size, 8).astype(np.int64)
+    prompts = [np.concatenate([pre, rng.randint(
+        1, CFG.vocab_size, rng.randint(2, 6)).astype(np.int64)])
+        for _ in range(4)]
+    with _engine(predictor, num_pages=16, max_decode_batch=3,
+                 prefix_cache=True) as eng:
+        streams = [eng.submit(p, max_new_tokens=18) for p in prompts]
+        outs = [s.result(timeout=600) for s in streams]
+        st = eng.stats()
+        eng.cache.check_integrity()
+        assert st["evicted_total"] >= 1, "must exercise eviction/resume"
+        eng.cache.drop_trie()
+        eng.cache.check_integrity()
+    assert eng.stats()["cache"]["pages_in_use"] == 0
+    for p, got in zip(prompts, outs):
+        assert got == oracle(p, 18), list(p)
+
+
+def test_int8_kv_sharing_agreement(predictor, oracle):
+    """Shared int8 pages decode the same tokens a cold int8 engine
+    (and, at this tiny scale, the fp32 oracle) produces — attaching a
+    quantized page by reference shares its scale plane too."""
+    rng = np.random.RandomState(53)
+    pre = rng.randint(1, CFG.vocab_size, 12).astype(np.int64)
+    prompts = [np.concatenate([pre, rng.randint(
+        1, CFG.vocab_size, 3).astype(np.int64)]) for _ in range(3)]
+    outs = {}
+    for warm in (True, False):
+        kw = dict(kv_dtype="int8")
+        if warm:
+            kw["prefix_cache"] = True
+        with _engine(predictor, **kw) as eng:
+            outs[warm] = [eng.generate(p, max_new_tokens=6, timeout=600)
+                          for p in prompts]
+            eng.cache.check_integrity()
+            if warm:
+                assert eng.stats()["radix"]["prefix_hits_total"] >= 2
+                eng.cache.drop_trie()
+        assert eng.stats()["cache"]["pages_in_use"] == 0
+    assert outs[True] == outs[False]
+    for p, got in zip(prompts, outs[True]):
+        assert got == oracle(p, 6), list(p)
+
+
+def test_radix_gauges_reach_prometheus(predictor):
+    """engine.stats()['radix'] flattens into the scrape as the
+    paddle_generation_radix_* family."""
+    from paddle_tpu import observability
+
+    rng = np.random.RandomState(61)
+    pre = rng.randint(1, CFG.vocab_size, 12).astype(np.int64)
+    with _engine(predictor, prefix_cache=True) as eng:
+        for sfx in ((3, 5), (7, 11)):
+            eng.generate(np.concatenate([pre, _toks(*sfx)]),
+                         max_new_tokens=4, timeout=600)
+        text = observability.to_prometheus_text()
+        eng.cache.drop_trie()
+    assert "paddle_generation_radix_prefix_hits_total" in text
+    assert "paddle_generation_radix_prefix_hit_tokens_total" in text
+    assert "paddle_generation_radix_shared_pages" in text
+
+
+def test_traffic_prices_unmatched_suffix_only(predictor):
+    """The estimator probes the trie (a pure peek) and charges chunked
+    prefill for the UNMATCHED suffix only."""
+    from paddle_tpu.traffic.controller import ServiceTimeEstimator
+
+    rng = np.random.RandomState(71)
+    p = rng.randint(1, CFG.vocab_size, 30).astype(np.int64)
+    with _engine(predictor, prefix_cache=True) as eng:
+        eng.generate(p, max_new_tokens=8, timeout=600)   # publishes
+        lookups = eng.stats()["radix"]["prefix_lookups_total"]
+        assert eng.prefix_probe(p) == 28                 # cap leaves 2
+        # the probe is a pure peek: no counters, no pages
+        assert eng.stats()["radix"]["prefix_lookups_total"] == lookups
+        est = ServiceTimeEstimator(generation_engine=eng)
+        warm = est.generate_service_ms(8, prompt_tokens=p.size, prompt=p)
+        cold = est.generate_service_ms(
+            8, prompt_tokens=p.size,
+            prompt=rng.randint(1, CFG.vocab_size, 30).astype(np.int64))
+        assert warm is not None and cold is not None
+        assert warm <= cold
+        eng.cache.drop_trie()
+        eng.cache.check_integrity()
+    assert eng.stats()["cache"]["pages_in_use"] == 0
+
+
+@pytest.mark.slow  # tiny LM + HTTP stack; radix-bench CI job
+@pytest.mark.parametrize("kv_dtype", ["float32", "int8"])
+def test_cancelled_sibling_leaves_shared_pages_intact(kv_dtype):
+    """Regression (ISSUE 17 satellite): a stalled /v1/generate client
+    sharing a prefix with a healthy sibling is cancelled through the
+    REFCOUNTED release — the sibling finishes over the shared pages
+    (fp32 AND quantized ones), check_integrity stays green, and the
+    drained pool is empty."""
+    import os
+    import sys
+    import tempfile
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import traffic_replay
+
+    res = traffic_replay.run_slow_client(
+        tempfile.mkdtemp(prefix=f"pt_slow_client_radix_{kv_dtype}_"),
+        {"stall_timeout_s": 0.8, "max_new_tokens": 900,
+         "shared_prefix": True, "kv_dtype": kv_dtype})
+    assert res["ok"], res
+    assert res["prefix_hit_tokens"] >= 32, res
+    assert res["healthy_tokens"] > 0, res
+    assert res["pages_in_use_after"] == 0, res

@@ -28,6 +28,18 @@ properties ISSUE 10 promises:
                     freed BEFORE the generation would have finished,
                     decode work saved, batcher never stalled (a
                     healthy concurrent stream completes meanwhile).
+                    ``shared_prefix: true`` in the spec runs the same
+                    regression with the radix cache on and the healthy
+                    client SHARING the stalled client's prefix — the
+                    cancel must route through the refcounted release
+                    and leave the sibling's pages intact.
+  shared_prefix     the radix-cache gates: N tenants x M requests over
+                    K common prefixes (heavy-tail suffixes). Warm TTFT
+                    <= 0.3x cold TTFT, >= 2x peak resident sequences
+                    at the same fixed page pool with sharing on vs
+                    off, emitted tokens identical on-vs-off (greedy),
+                    zero leaked pages after drain, and the
+                    paddle_generation_radix_* gauge family populated.
   rolling_restart   WorkerPool.rolling_restart under live closed-loop
                     load: zero failed in-flight requests, replacement
                     workers warm-start from the persistent compile
@@ -530,7 +542,7 @@ def run_mixed_tenant(pred, spec):
 # -- scenario: slow client over HTTP ----------------------------------------
 
 
-def _build_lm_stack(tmp_dir, kv_dtype="float32"):
+def _build_lm_stack(tmp_dir, kv_dtype="float32", **gen_kw):
     import paddle_tpu as fluid
     from paddle_tpu.generation import GenerationEngine
     from paddle_tpu.generation.model import GPTConfig, build_lm_program
@@ -540,17 +552,19 @@ def _build_lm_stack(tmp_dir, kv_dtype="float32"):
                     num_heads=4, ffn_size=64, max_position=1024,
                     hidden_dropout=0.0, attention_dropout=0.0)
     d = os.path.join(tmp_dir, "lm")
-    main, startup, _feeds, fetches = build_lm_program(cfg, 32)
-    scope = fluid.Scope()
-    with fluid.scope_guard(scope):
-        exe = fluid.Executor(fluid.TPUPlace())
-        exe.run(startup)
-        fluid.io.save_inference_model(d, ["tokens"],
-                                      [fetches["logits"]], exe, main)
+    if not os.path.isdir(d):
+        main, startup, _feeds, fetches = build_lm_program(cfg, 32)
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.TPUPlace())
+            exe.run(startup)
+            fluid.io.save_inference_model(d, ["tokens"],
+                                          [fetches["logits"]], exe, main)
     pred = create_predictor(Config(d))
-    gen = GenerationEngine(pred, cfg, page_size=16, num_pages=192,
-                           max_decode_batch=4, prefill_buckets=(16,),
-                           kv_dtype=kv_dtype, warmup=False)
+    kw = dict(page_size=16, num_pages=192, max_decode_batch=4,
+              prefill_buckets=(16,), kv_dtype=kv_dtype, warmup=False)
+    kw.update(gen_kw)
+    gen = GenerationEngine(pred, cfg, **kw)
     return pred, gen
 
 
@@ -561,24 +575,38 @@ def run_slow_client(tmp_dir, spec):
     finishes normally — the batcher never stalled. ``spec["kv_dtype"]
     = "int8"`` runs the same regression over QUANTIZED pages — a
     stalled socket must free int8 pages + scale planes at the next
-    step boundary exactly like fp32 ones."""
+    step boundary exactly like fp32 ones. ``spec["shared_prefix"] =
+    True`` turns the radix cache on and gives the healthy client the
+    STALLED client's prompt prefix: the write-stall cancel must go
+    through the refcounted release — the sibling keeps decoding over
+    the shared pages, nothing leaks, and check_integrity stays
+    green."""
     from paddle_tpu.serving import ServingEngine, ServingServer
 
-    pred, gen = _build_lm_stack(tmp_dir,
-                                kv_dtype=spec.get("kv_dtype", "float32"))
+    shared = bool(spec.get("shared_prefix"))
+    pred, gen = _build_lm_stack(
+        tmp_dir, kv_dtype=spec.get("kv_dtype", "float32"),
+        **({"prefix_cache": True} if shared else {}))
     engine = ServingEngine(pred, num_workers=1)
     server = ServingServer(engine, generation_engine=gen,
                            stream_write_timeout_s=spec["stall_timeout_s"],
                            sndbuf=4096)
     max_new = spec["max_new_tokens"]
     result = {"max_new_tokens": max_new}
+    # shared-prefix mode: both prompts open with the same two FULL
+    # pages (page_size 16), so the healthy sibling attaches the
+    # stalled client's published prefix by reference
+    prefix = [(i % 83) + 1 for i in range(32)] if shared else []
+    stall_prompt = prefix + [3, 5, 7]
+    healthy_prompt = prefix + [2, 4] if shared else [2, 4]
     try:
         # stalled client: raw socket, tiny receive buffer, reads ~1KB
         # then stops forever
         s = socket.socket()
         s.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 2048)
         s.connect((server.host, server.port))
-        body = json.dumps({"tokens": [3, 5, 7], "max_new_tokens": max_new,
+        body = json.dumps({"tokens": stall_prompt,
+                           "max_new_tokens": max_new,
                            "stream": True}).encode()
         s.sendall(b"POST /v1/generate HTTP/1.1\r\n"
                   b"Host: x\r\nContent-Type: application/json\r\n"
@@ -594,13 +622,21 @@ def run_slow_client(tmp_dir, spec):
 
             conn = http.client.HTTPConnection(server.host, server.port,
                                               timeout=60)
-            b = json.dumps({"tokens": [2, 4], "max_new_tokens": 8,
+            b = json.dumps({"tokens": healthy_prompt, "max_new_tokens": 8,
                             "stream": False}).encode()
             conn.request("POST", "/v1/generate", b)
             resp = conn.getresponse()
             healthy_tokens.extend(json.loads(resp.read()).get("tokens", []))
             conn.close()
 
+        if shared:
+            # recv() above can return on headers alone, mid-prefill —
+            # wait for the stalled sequence to publish BOTH prefix
+            # pages so the sibling attaches the full shared run
+            t_pub = time.monotonic() + 10
+            while (time.monotonic() < t_pub
+                   and gen.prefix_probe(healthy_prompt) < 32):
+                time.sleep(0.01)
         ht = threading.Thread(target=healthy, daemon=True)
         ht.start()
         ht.join(60)
@@ -613,6 +649,16 @@ def run_slow_client(tmp_dir, spec):
                 break
             time.sleep(0.1)
         st = gen.stats()
+        if shared:
+            # the sibling-intact proof: sharing actually engaged, the
+            # refcounted release left the trie/refcounts coherent, and
+            # flushing the trie accounts for every page
+            result["prefix_hit_tokens"] = st["radix"][
+                "prefix_hit_tokens_total"]
+            gen.cache.check_integrity()
+            gen.cache.drop_trie()
+            gen.cache.check_integrity()
+            st = gen.stats()
         result.update({
             "cancelled_total": st["cancelled_total"],
             "active_seqs_after": st["cache"]["active_seqs"],
@@ -630,9 +676,146 @@ def run_slow_client(tmp_dir, spec):
         engine.close(drain=False)
     result["ok"] = (result.get("cancelled_total", 0) >= 1
                     and result.get("active_seqs_after", 1) == 0
+                    and result.get("pages_in_use_after", 1) == 0
                     and result.get("healthy_tokens", 0) > 0
-                    and result.get("tokens_decoded", max_new) < max_new)
+                    and result.get("tokens_decoded", max_new) < max_new
+                    and (not shared
+                         or result.get("prefix_hit_tokens", 0) >= 32))
     return result
+
+
+# -- scenario: shared-prefix fleet (radix KV cache) --------------------------
+
+
+def run_shared_prefix(tmp_dir, spec):
+    """N tenants x M requests over K common prompt prefixes with
+    heavy-tail suffixes — the system-prompt fleet. Radix cache ON must
+    (1) serve warm requests with TTFT <= 0.3x a cold prefill of the
+    same prompt (only the unmatched suffix prefills), (2) hold >= 2x
+    the concurrently-resident sequences of the OFF engine at the SAME
+    page pool (shared prefix pages are charged once), (3) emit
+    token-identical greedy output to a cold engine, and (4) leak zero
+    pages after drain + trie flush, with ``check_integrity`` green."""
+    import random
+    import statistics
+
+    ps = 16
+    pref_len = int(spec.get("prefix_tokens", 128))
+    max_new = int(spec.get("max_new_tokens", 16))
+    geom = dict(page_size=ps,
+                num_pages=int(spec.get("num_pages", 34)),
+                max_decode_batch=int(spec.get("max_decode_batch", 8)))
+    rng = random.Random(1234)
+
+    def make_prefix(k):
+        return [(i * 7 + k * 13) % 83 + 1 for i in range(pref_len)]
+
+    def make_suffix():
+        # heavy tail: mostly a couple of tokens, the odd long one
+        n = rng.choice([2, 2, 3, 3, 4, 4, 5, 6, 14])
+        return [rng.randrange(1, 84) for _ in range(n)]
+
+    def timed(gen, prompt):
+        t0 = time.monotonic()
+        stream = gen.submit(prompt, max_new, eos_id=None)
+        toks = stream.result(300)
+        return (stream.first_token_at - t0) * 1e3, toks
+
+    def burst(gen, prompts):
+        # peak concurrently-RESIDENT sequences (admitted, holding KV
+        # pages), sampled while the whole fleet is in flight
+        peak = [0]
+        stop = threading.Event()
+
+        def sampler():
+            while not stop.is_set():
+                peak[0] = max(peak[0],
+                              gen.stats()["cache"]["active_seqs"])
+                time.sleep(0.002)
+
+        th = threading.Thread(target=sampler, daemon=True)
+        th.start()
+        streams = [gen.submit(p, max_new, eos_id=None) for p in prompts]
+        toks = [s.result(300) for s in streams]
+        stop.set()
+        th.join(5)
+        return peak[0], toks
+
+    # -- radix ON -------------------------------------------------------------
+    pred_on, gen_on = _build_lm_stack(tmp_dir, prefix_cache=True, **geom)
+    ttft_pairs = []
+    try:
+        # absorb the one-time executable compile OFF the clock, then
+        # flush the throwaway's published pages
+        gen_on.generate(make_prefix(999) + [1, 2], 2, eos_id=None,
+                        timeout=300)
+        gen_on.cache.drop_trie()
+
+        # TTFT: per fresh prefix, one COLD request publishes it, then
+        # warm siblings prefill only their suffix. Trie flushed
+        # between prefixes so every cold sample is truly cold.
+        colds, warms = [], []
+        for k in range(int(spec.get("ttft_prefixes", 3))):
+            pre = make_prefix(100 + k)
+            for i in range(1 + int(spec.get("warm_per_prefix", 2))):
+                prompt = pre + make_suffix()
+                ms, toks = timed(gen_on, prompt)
+                (colds if i == 0 else warms).append(ms)
+                ttft_pairs.append((prompt, toks))
+            gen_on.cache.drop_trie()
+
+        # resident-fleet burst: K prefixes x (tenants x M) requests,
+        # interleaved like independent tenants would arrive
+        burst_prompts = [make_prefix(k) + make_suffix()
+                         for k in range(int(spec.get("num_prefixes", 2)))
+                         for _ in range(int(spec.get("tenants", 4))
+                                        * int(spec.get(
+                                            "requests_per_tenant", 2)))]
+        rng.shuffle(burst_prompts)
+        peak_on, toks_on = burst(gen_on, burst_prompts)
+
+        radix = gen_on.stats()["radix"]
+        gen_on.cache.check_integrity()
+        gen_on.cache.drop_trie()
+        gen_on.cache.check_integrity()
+        pages_after_on = gen_on.stats()["cache"]["pages_in_use"]
+    finally:
+        gen_on.close(drain=False)
+
+    # -- radix OFF: same pool, same prompts -----------------------------------
+    pred_off, gen_off = _build_lm_stack(tmp_dir, **geom)
+    try:
+        identical = all(
+            list(gen_off.generate(p, max_new, eos_id=None, timeout=300))
+            == list(t) for p, t in ttft_pairs)
+        peak_off, toks_off = burst(gen_off, burst_prompts)
+        identical = identical and all(
+            list(a) == list(b) for a, b in zip(toks_on, toks_off))
+        pages_after_off = gen_off.stats()["cache"]["pages_in_use"]
+    finally:
+        gen_off.close(drain=False)
+
+    cold_ms = statistics.median(colds)
+    warm_ms = statistics.median(warms)
+    return {
+        "prefix_tokens": pref_len, "max_new_tokens": max_new,
+        "usable_pages": geom["num_pages"] - 1,
+        "requests_burst": len(burst_prompts),
+        "cold_ttft_ms": round(cold_ms, 2),
+        "warm_ttft_ms": round(warm_ms, 2),
+        "warm_over_cold": round(warm_ms / max(cold_ms, 1e-9), 4),
+        "peak_resident_on": peak_on,
+        "peak_resident_off": peak_off,
+        "tokens_identical": bool(identical),
+        "prefix_hit_tokens": radix["prefix_hit_tokens_total"],
+        "prefix_hits": radix["prefix_hits_total"],
+        "prefix_lookups": radix["prefix_lookups_total"],
+        "hit_rate": radix["prefix_hit_rate"],
+        "cow_forks": radix["cow_forks_total"],
+        "leaf_evictions": radix["leaf_evictions_total"],
+        "pages_in_use_after_on": pages_after_on,
+        "pages_in_use_after_off": pages_after_off,
+    }
 
 
 # -- scenario: rolling restart under live load -------------------------------
@@ -756,7 +939,7 @@ def main():
     ap.add_argument("--scenario", default="all",
                     choices=["all", "bursty_overload", "priority_mix",
                              "mixed_tenant", "slow_client",
-                             "rolling_restart"])
+                             "shared_prefix", "rolling_restart"])
     ap.add_argument("--out", default=None, help="also write JSON here")
     args = ap.parse_args()
 
@@ -851,6 +1034,36 @@ def main():
         result["slow_client"] = run_slow_client(tmp, spec)
         gates["slow_client_cancelled_and_freed"] = bool(
             result["slow_client"]["ok"])
+
+    if args.scenario in ("all", "shared_prefix"):
+        spec = {
+            "prefix_tokens": 128, "num_prefixes": 2, "tenants": 4,
+            "requests_per_tenant": 2, "max_new_tokens": 16,
+            "num_pages": 34, "max_decode_batch": 8,
+            "ttft_prefixes": 3, "warm_per_prefix": 2,
+        }
+        result["shared_prefix"] = run_shared_prefix(tmp, spec)
+        r = result["shared_prefix"]
+        gates["radix_warm_ttft_le_0.3x_cold"] = r["warm_over_cold"] <= 0.3
+        gates["radix_resident_ge_2x_on_vs_off"] = (
+            r["peak_resident_off"] > 0
+            and r["peak_resident_on"] >= 2 * r["peak_resident_off"])
+        gates["radix_tokens_identical_on_vs_off"] = bool(
+            r["tokens_identical"])
+        gates["radix_gauges_populated"] = (
+            r["prefix_hits"] > 0
+            and r["prefix_hit_tokens"] >= spec["prefix_tokens"])
+        gates["radix_zero_leaked_pages"] = (
+            r["pages_in_use_after_on"] == 0
+            and r["pages_in_use_after_off"] == 0)
+        # cancel-under-sharing: a stalled sibling's write-timeout
+        # cancel goes through the refcounted release — the healthy
+        # sibling decoding over the SAME prefix pages is untouched
+        result["slow_client_shared"] = run_slow_client(
+            tmp, {"stall_timeout_s": 0.8, "max_new_tokens": 900,
+                  "shared_prefix": True})
+        gates["slow_client_shared_sibling_intact"] = bool(
+            result["slow_client_shared"]["ok"])
 
     if args.scenario in ("all", "rolling_restart"):
         spec = {"workers": 2, "clients": 4}
