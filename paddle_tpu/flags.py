@@ -99,9 +99,15 @@ _FLAG_DEFS: Dict[str, Any] = {
     # generation_trie_max_pages caps trie-resident pages (0 =
     # unlimited; the pool itself still reclaims trie leaves LRU-first
     # under pressure)
+    # generation_trie_tenant_quota caps trie-resident pages PER TENANT
+    # (the traffic tier's tenant identity rides submit(tenant=) into
+    # publish attribution): a tenant at quota recycles its OWN LRU
+    # leaves, so one tenant's boilerplate cannot monopolize the trie
+    # (0 = no per-tenant cap)
     "generation_prefix_cache": False,
     "generation_prefix_min_pages": 1,
     "generation_trie_max_pages": 0,
+    "generation_trie_tenant_quota": 0,
     # paddle_tpu.quantize (inference weight quantization): "off" keeps
     # fp32/bf16 weights; "int8" (per-output-channel fp32 scales) /
     # "int8_block" (blockwise scales down the contraction axis, block
@@ -182,6 +188,26 @@ _FLAG_DEFS: Dict[str, Any] = {
     # is the same seam invoked by hand.
     "autotune_dir": os.path.join("~", ".cache", "paddle_tpu", "autotune"),
     "autotune_apply": True,
+    # disagg/ (disaggregated prefill/decode serving): the page-store
+    # rendezvous between prefill and decode workers.
+    # disagg_wire_encoding picks how fp32 KV pages cross the wire —
+    # "int8_block" quantizes blockwise at block=head_dim (one fp32
+    # scale per head/token slot, ~0.28x the fp32 bytes at head_dim 32;
+    # int8 pool pages always ship verbatim), "raw" ships fp32 bytes
+    # untouched (bitwise fidelity over bandwidth).
+    # disagg_store_endpoint ("host:port") names the page store when
+    # the env contract (PADDLE_PAGESTORE_ENDPOINT, or the first
+    # PADDLE_TRAINER_ENDPOINTS host at disagg_store_port) does not;
+    # disagg_store_max_bytes caps the store's host RAM (LRU leaf
+    # eviction; 0 = unbounded); disagg_fetch_timeout_s bounds every
+    # store RPC; disagg_handoff_threads sizes the DisaggService's
+    # prefill->decode dispatcher pool
+    "disagg_wire_encoding": "int8_block",
+    "disagg_store_endpoint": "",
+    "disagg_store_port": 8793,
+    "disagg_store_max_bytes": 268435456,
+    "disagg_fetch_timeout_s": 5.0,
+    "disagg_handoff_threads": 2,
     # traffic/ (SLO-aware admission + multi-tenant scheduling) defaults,
     # consumed by TrafficConfig.from_flags(): traffic_queue_capacity is
     # the per-PRIORITY-CLASS bounded queue depth (a full class queue

@@ -207,9 +207,11 @@ class GenerationStream:
 class _GenRequest:
     __slots__ = ("prompt", "orig_prompt", "max_new", "eos_id", "deadline",
                  "stream", "enqueue_t", "slot", "pending", "n_generated",
-                 "ctx", "admit_seq", "last_tok_t", "prefill_off", "drafts")
+                 "ctx", "admit_seq", "last_tok_t", "prefill_off", "drafts",
+                 "tenant", "store_checked")
 
-    def __init__(self, prompt, max_new, eos_id, deadline, stream, ctx):
+    def __init__(self, prompt, max_new, eos_id, deadline, stream, ctx,
+                 tenant=None):
         self.prompt = prompt            # context to prefill (grows on resume)
         self.orig_prompt = prompt       # the caller's prompt, immutable
         self.max_new = max_new
@@ -225,6 +227,8 @@ class _GenRequest:
         self.last_tok_t: Optional[float] = None
         self.prefill_off = 0            # prompt tokens already written
         self.drafts = None              # this step's speculative proposals
+        self.tenant = tenant            # traffic identity (trie quotas)
+        self.store_checked = False      # page-store consult done once
 
 
 class GenerationMetrics:
@@ -352,6 +356,7 @@ class GenerationEngine:
                  kv_dtype: Optional[str] = None,
                  quantize_weights: Optional[str] = None,
                  prefix_cache: Optional[bool] = None,
+                 page_store=None, phase: Optional[str] = None,
                  warmup: bool = False, start: bool = True):
         from ..flags import flag
 
@@ -447,7 +452,23 @@ class GenerationEngine:
             dtype=self.kv_dtype,
             prefix_cache=self.prefix_cache,
             prefix_min_pages=int(flag("generation_prefix_min_pages")),
-            trie_max_pages=int(flag("generation_trie_max_pages")))
+            trie_max_pages=int(flag("generation_trie_max_pages")),
+            tenant_quota_pages=int(flag("generation_trie_tenant_quota")))
+        # disagg seam: a page store (HostPageStore / PageStoreClient
+        # duck) makes this engine a split-topology citizen — admission
+        # consults it for queued prompts before cold prefill
+        # (_consult_store), spill_run/spill_trie export finished pages
+        # back, and close(drain=True) spills the whole trie so rolling
+        # restarts resume warm. ``phase`` is the routing label the
+        # traffic tier and /healthz report ("prefill"/"decode"/"both").
+        self._page_store = page_store
+        self.phase = str(phase) if phase else "both"
+        self._wire_encoding = str(flag("disagg_wire_encoding"))
+        self.store_lookups_total = 0
+        self.store_hits_total = 0
+        self.store_pages_pulled_total = 0
+        self.store_pages_spilled_total = 0
+        self.store_errors_total = 0
         self.metrics = GenerationMetrics()
         # unified telemetry: this engine's counters + page-pool stats
         # join the scrape as paddle_generation_*{engine=} series
@@ -550,6 +571,13 @@ class GenerationEngine:
             self._loop_thread.join(timeout)
         else:
             self._fail_queued(EngineClosed("engine closed before start()"))
+        if drain and self._page_store is not None and self.prefix_cache:
+            # drain-spill: trie-only pages outlive this engine in the
+            # page store, so the rolling-restart replacement (or any
+            # decode worker on this store) resumes warm instead of
+            # re-prefilling the fleet's shared prefixes from scratch
+            self.spill_trie()
+            self.cache.drop_trie()
 
     def __enter__(self) -> "GenerationEngine":
         return self
@@ -569,11 +597,14 @@ class GenerationEngine:
     def submit(self, prompt, max_new_tokens: Optional[int] = None,
                eos_id: Optional[int] = "default",  # type: ignore[assignment]
                deadline_ms: Optional[float] = None,
-               on_token=None) -> GenerationStream:
+               on_token=None, tenant: Optional[str] = None
+               ) -> GenerationStream:
         """Admit one prompt (1-D int sequence). Raises ``Overloaded``
         when the admission queue is full OR when the prompt + budget
         could never fit the page pool — both BEFORE any prefill
-        work; raises ``EngineClosed`` after close()."""
+        work; raises ``EngineClosed`` after close(). ``tenant`` is the
+        traffic-tier identity trie publishes are attributed to (the
+        per-tenant quota unit)."""
         from ..observability import tracing
 
         prompt = np.asarray(prompt, dtype=np.int64).reshape(-1)
@@ -603,7 +634,8 @@ class GenerationEngine:
         with (tracing.span("generation/submit", {"prompt": int(prompt.size),
                                                  "max_new": max_new})
               if tracing.enabled() else contextlib.nullcontext()) as ctx:
-            req = _GenRequest(prompt, max_new, eos, deadline, stream, ctx)
+            req = _GenRequest(prompt, max_new, eos, deadline, stream, ctx,
+                              tenant=tenant)
             with self._cond:
                 if self._closed:
                     raise EngineClosed("GenerationEngine is closed")
@@ -652,6 +684,19 @@ class GenerationEngine:
         out["cache"] = self.cache.stats()
         # flattened by the registry into paddle_generation_radix_*
         out["radix"] = self.cache.radix_stats()
+        if self._page_store is not None:
+            lk = self.store_lookups_total
+            # flattened into paddle_generation_store_* — this WORKER's
+            # page-store traffic (the store's own gauges are global)
+            out["store"] = {
+                "lookups_total": lk,
+                "hits_total": self.store_hits_total,
+                "hit_rate": (round(self.store_hits_total / lk, 4)
+                             if lk else 0.0),
+                "pages_pulled_total": self.store_pages_pulled_total,
+                "pages_spilled_total": self.store_pages_spilled_total,
+                "errors_total": self.store_errors_total,
+            }
         return out
 
     def stats_numeric(self) -> Dict[str, Any]:
@@ -743,7 +788,8 @@ class GenerationEngine:
                            if self.prefix_cache else 0)
                 if (self.cache.free_slots() <= 0
                         or not self.cache.can_acquire(
-                            int(req.prompt.size) - matched)):
+                            int(req.prompt.size) - matched,
+                            prompt=req.prompt)):
                     break
                 admitted.append(self._queue.popleft())
                 req.slot, req.prefill_off = self.cache.acquire(req.prompt)
@@ -865,10 +911,95 @@ class GenerationEngine:
         the radix cache matched a prefix (acquire already set
         ``prefill_off`` / the cache length to the matched run, whose
         K/V is resident in the shared pages)."""
+        self._consult_store()
         for req in self._pop_admissible():
             req.pending = None
             req.drafts = None
             self._by_slot[req.slot] = req
+
+    # -- the page store seam (disagg) ----------------------------------------
+    def _consult_store(self) -> None:
+        """Before cold-prefilling queue-head prompts, ask the page
+        store for their prefixes and splice any match into the local
+        pool + trie — the decode-worker half of disaggregation and
+        the warm-restart path. Runs on the LOOP THREAD only (the
+        device writes in ``ingest_run`` race ``set_buffers``
+        otherwise); the TCP fetch happens outside ``self._cond`` so
+        submitters never block on the wire."""
+        if self._page_store is None or not self.prefix_cache:
+            return
+        with self._cond:
+            heads = [r for r in list(self._queue)[:self.lanes]
+                     if not r.store_checked]
+        for req in heads:
+            req.store_checked = True
+            try:
+                self._pull_run(req.prompt, tenant=req.tenant)
+            except Exception:  # noqa: BLE001 — a dead store degrades to cold prefill
+                self.store_errors_total += 1
+
+    def _pull_run(self, tokens, tenant=None) -> int:
+        """Fetch + ingest the store's longest run for ``tokens``
+        (capped like the trie match: at least one token is left to
+        prefill). Returns pages ingested; 0 when the local trie
+        already covers the store's match."""
+        tokens = np.asarray(tokens, np.int64).reshape(-1)
+        ps = self.page_size
+        cap = (int(tokens.size) - 1) // ps
+        local = self.cache.match_len(tokens) // ps
+        if cap <= local:
+            return 0
+        self.store_lookups_total += 1
+        blobs = self._page_store.match(tokens, max_pages=cap)
+        if len(blobs) <= local:
+            return 0
+        from ..disagg.pagestore import run_for_pool
+
+        n, k_run, v_run, ksc, vsc = run_for_pool(blobs, self.kv_dtype)
+        if n <= local:
+            return 0
+        got = self.cache.ingest_run(tokens[:n * ps], k_run, v_run,
+                                    ksc, vsc, tenant=tenant)
+        if got:
+            self.store_hits_total += 1
+            self.store_pages_pulled_total += got
+        return got
+
+    def spill_run(self, tokens) -> int:
+        """Export ``tokens``' trie-resident pages to the page store
+        (the prefill-worker publish path). Safe from any thread —
+        full trie pages are immutable and ``export_run`` snapshots
+        buffer refs under the cache lock. No-op without a store."""
+        if self._page_store is None or not self.prefix_cache:
+            return 0
+        n, k_run, v_run, ksc, vsc = self.cache.export_run(tokens)
+        if not n:
+            return 0
+        from ..disagg.pagestore import encode_page
+
+        blobs = [encode_page(k_run[i], v_run[i],
+                             None if ksc is None else ksc[i],
+                             None if vsc is None else vsc[i],
+                             encoding=self._wire_encoding)
+                 for i in range(n)]
+        toks = np.asarray(tokens, np.int64).reshape(-1)[:n * self.page_size]
+        self._page_store.put_run(toks, blobs)
+        self.store_pages_spilled_total += n
+        return n
+
+    def spill_trie(self) -> int:
+        """Spill EVERY trie-resident page run to the store — the
+        drain hook: a rolling restart's replacement worker (or any
+        fresh decode worker) then starts warm instead of cold."""
+        if self._page_store is None or not self.prefix_cache:
+            return 0
+        total = 0
+        for run in self.cache.trie_leaf_runs():
+            try:
+                total += self.spill_run(run)
+            except Exception:  # noqa: BLE001 — spill is best-effort
+                self.store_errors_total += 1
+        return total
 
     def _bind_ragged(self, feed):
         if self._ragged_bound is None:
@@ -1056,7 +1187,8 @@ class GenerationEngine:
                 self.metrics.inc("prefill_chunks_total")
                 self.metrics.inc("prefill_tokens_total", nv)
                 if self.prefix_cache:
-                    self.cache.publish(slot, req.prompt)
+                    self.cache.publish(slot, req.prompt,
+                                       tenant=req.tenant)
                 if req.prefill_off >= int(req.prompt.size):
                     self.metrics.inc("prefill_batches_total")
                     self._emit(req, int(next_all[slot, nv - 1]), now)
@@ -1085,7 +1217,8 @@ class GenerationEngine:
                     # drafts live strictly at positions >= length
                     self.cache.publish(slot, np.concatenate(
                         [req.orig_prompt,
-                         np.asarray(req.stream._tokens, np.int64)]))
+                         np.asarray(req.stream._tokens, np.int64)]),
+                        tenant=req.tenant)
         n_active = sum(1 for s, _ in active if num_valid[s] > 0)
         self.metrics.observe_decode_step(
             (now - t0) * 1e3, n_active, R, tokens=emitted_total)
@@ -1238,7 +1371,8 @@ class GenerationEngine:
             # while everything private frees)
             self.cache.publish(slot, np.concatenate(
                 [req.orig_prompt,
-                 np.asarray(req.stream._tokens, np.int64)]))
+                 np.asarray(req.stream._tokens, np.int64)]),
+                tenant=req.tenant)
         self.cache.release(slot)
         if req is not None:
             if error is None and reason in ("eos", "length", "capacity"):

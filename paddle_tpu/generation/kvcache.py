@@ -72,20 +72,44 @@ class PagePoolExhausted(RuntimeError):
     (or eviction) must resolve it; never an allocation."""
 
 
+_SCATTER_JIT = []
+
+
+def _scatter_pages(bufs, sel, blks):
+    """Write page blocks into pool buffers as ONE jitted call: the
+    ingest path (disagg page splice) touches 2-4 buffers per layer,
+    and un-jitted per-buffer ``at[].set`` dispatch costs multiples of
+    a decode step. jax.jit caches per pytree shape, so the
+    power-of-two padding upstream bounds the executable set."""
+    if not _SCATTER_JIT:
+        import jax
+
+        def _run(bufs, sel, blks):
+            return [b.at[:, sel].set(x.astype(b.dtype))
+                    for b, x in zip(bufs, blks)]
+
+        _SCATTER_JIT.append(jax.jit(_run))
+    return _SCATTER_JIT[0](bufs, sel, blks)
+
+
 class _TrieNode:
     """One published page: ``key`` is the exact page_size-token tuple
     the page holds, ``page`` the pool page id. Children extend the
     token run by one more full page. ``last_used`` is a monotonic tick
-    (NOT wall time — deterministic LRU under test)."""
+    (NOT wall time — deterministic LRU under test). ``tenant`` is the
+    traffic-tier identity that published the page — the per-tenant
+    trie-quota accounting unit."""
 
-    __slots__ = ("key", "page", "parent", "children", "last_used")
+    __slots__ = ("key", "page", "parent", "children", "last_used",
+                 "tenant")
 
-    def __init__(self, key, page, parent):
+    def __init__(self, key, page, parent, tenant="default"):
         self.key = key
         self.page = page
         self.parent = parent
         self.children: Dict[tuple, "_TrieNode"] = {}
         self.last_used = 0
+        self.tenant = tenant
 
 
 class PagedKVCache:
@@ -93,7 +117,7 @@ class PagedKVCache:
                  num_pages: int, page_size: int, max_seqs: int,
                  max_pages_per_seq: int, dtype: str = "float32",
                  prefix_cache: bool = False, prefix_min_pages: int = 1,
-                 trie_max_pages: int = 0):
+                 trie_max_pages: int = 0, tenant_quota_pages: int = 0):
         if num_pages < 2:
             raise ValueError("num_pages must be >= 2 (page 0 is reserved)")
         if page_size < 1 or max_seqs < 1 or max_pages_per_seq < 1:
@@ -110,6 +134,7 @@ class PagedKVCache:
         self.prefix_cache = bool(prefix_cache)
         self.prefix_min_pages = max(1, int(prefix_min_pages))
         self.trie_max_pages = max(0, int(trie_max_pages))
+        self.tenant_quota_pages = max(0, int(tenant_quota_pages))
         self._lock = threading.Lock()
         # device pools, one K + one V per layer (lazy: first access
         # allocates, so constructing a cache in a test costs nothing);
@@ -152,6 +177,16 @@ class PagedKVCache:
         self.cow_forks_total = 0
         self.leaf_evictions_total = 0
         self.published_pages_total = 0
+        # disagg splice counters (ingest = pulled from a page store,
+        # exported = read back out for spill/streaming)
+        self.ingested_pages_total = 0
+        self.exported_pages_total = 0
+        # per-tenant trie accounting: pages currently resident, leaf
+        # evictions forced by the tenant's own quota, and publishes
+        # refused because the quota held and nothing was evictable
+        self._tenant_pages: Dict[str, int] = {}
+        self._tenant_evictions: Dict[str, int] = {}
+        self.tenant_quota_rejections_total = 0
 
     # -- device buffers ------------------------------------------------------
     def _ensure_buffers(self):
@@ -252,13 +287,26 @@ class PagedKVCache:
     def can_allocate(self, n_tokens: int) -> bool:
         return self.pages_needed(n_tokens) <= len(self._free)
 
-    def can_acquire(self, n_tokens: int) -> bool:
+    def can_acquire(self, n_tokens: int, prompt=None) -> bool:
         """can_allocate, but counting trie-only pages the allocator
         may legally reclaim (LRU leaf eviction) on top of the free
-        list — the admission check under a warm radix cache."""
+        list — the admission check under a warm radix cache.
+
+        With ``prompt`` given, trie-only pages on the prompt's OWN
+        match path are excluded from the budget: ``acquire`` ATTACHES
+        them (refcount 2, no longer evictable) while still popping
+        ``n_tokens`` worth of suffix pages, so counting them as
+        reclaimable-for-the-suffix double-books exactly the pages a
+        store-ingested run just inserted and admits requests the pool
+        cannot serve."""
         with self._lock:
+            excl = set()
+            if prompt is not None:
+                excl = {nd.page for nd in self._match_nodes(prompt)
+                        if int(self._ref[nd.page]) == 1}
             budget = len(self._free) + sum(
-                1 for p in self._node_of_page if int(self._ref[p]) == 1)
+                1 for p in self._node_of_page
+                if int(self._ref[p]) == 1 and p not in excl)
         return self.pages_needed(n_tokens) <= budget
 
     def free_slots(self) -> int:
@@ -302,11 +350,18 @@ class PagedKVCache:
             return len(self._match_nodes(np.asarray(tokens).reshape(-1))) \
                 * self.page_size
 
-    def _evict_leaf_locked(self) -> bool:
+    @staticmethod
+    def _tenant_key(tenant) -> str:
+        return str(tenant) if tenant else "default"
+
+    def _evict_leaf_locked(self, tenant: Optional[str] = None) -> bool:
         """Reclaim ONE trie-only page: the least-recently-used leaf
         whose page no live sequence holds (refcount 1 = the trie's own
         reference). Interior nodes and shared pages are never touched
-        — evicting them would free nothing and orphan the path."""
+        — evicting them would free nothing and orphan the path. With
+        ``tenant`` set only that tenant's leaves are candidates (the
+        per-tenant quota recycles the tenant's own pages, never a
+        neighbour's)."""
         best: Optional[_TrieNode] = None
         stack = [self._root]
         while stack:
@@ -314,7 +369,8 @@ class PagedKVCache:
             for child in node.children.values():
                 if child.children:
                     stack.append(child)
-                elif int(self._ref[child.page]) == 1:
+                elif (int(self._ref[child.page]) == 1
+                      and (tenant is None or child.tenant == tenant)):
                     if best is None or child.last_used < best.last_used:
                         best = child
         if best is None:
@@ -324,6 +380,14 @@ class PagedKVCache:
         self._ref[best.page] = 0
         self._free.append(best.page)
         self.leaf_evictions_total += 1
+        left = self._tenant_pages.get(best.tenant, 0) - 1
+        if left > 0:
+            self._tenant_pages[best.tenant] = left
+        else:
+            self._tenant_pages.pop(best.tenant, None)
+        if tenant is not None:
+            self._tenant_evictions[tenant] = \
+                self._tenant_evictions.get(tenant, 0) + 1
         return True
 
     def _pop_page_locked(self) -> int:
@@ -335,17 +399,32 @@ class PagedKVCache:
                                     "trie leaves)")
         return self._free.pop()
 
-    def publish(self, slot: int, context_tokens) -> int:
+    def _quota_room_locked(self, tenant: str) -> bool:
+        """True once ``tenant`` may insert one more trie page: either
+        under its quota, or an LRU leaf of its OWN was evicted to make
+        room. A refusal is counted — the per-tenant rejection gauge."""
+        if not self.tenant_quota_pages:
+            return True
+        if self._tenant_pages.get(tenant, 0) < self.tenant_quota_pages:
+            return True
+        if self._evict_leaf_locked(tenant=tenant):
+            return True
+        self.tenant_quota_rejections_total += 1
+        return False
+
+    def publish(self, slot: int, context_tokens, tenant=None) -> int:
         """Insert ``slot``'s full pages into the trie so later prompts
         can attach them. ``context_tokens`` must cover the sequence's
         cached context (prompt + emitted); only pages fully covered by
         ``lengths[slot]`` publish — positions past the length may
         still hold rejected-draft garbage, full pages below it are
         immutable (writes only ever target positions >= length).
+        ``tenant`` attributes the new pages for the per-tenant quota.
         Returns the newly published page count. No-op unless
         prefix_cache."""
         if not self.prefix_cache:
             return 0
+        tn = self._tenant_key(tenant)
         with self._lock:
             if not self._active[slot] or self._pub_dead[slot]:
                 return 0
@@ -374,11 +453,14 @@ class PagedKVCache:
                             and len(self._node_of_page) >= self.trie_max_pages
                             and not self._evict_leaf_locked()):
                         break   # cap reached, nothing evictable: retry later
-                    child = _TrieNode(key, chain[idx], node)
+                    if not self._quota_room_locked(tn):
+                        break   # tenant at quota, nothing of theirs to evict
+                    child = _TrieNode(key, chain[idx], node, tn)
                     node.children[key] = child
                     self._node_of_page[chain[idx]] = child
                     self._ref[chain[idx]] += 1
                     self._touch(child)
+                    self._tenant_pages[tn] = self._tenant_pages.get(tn, 0) + 1
                     new += 1
                 node = child
                 idx += 1
@@ -403,6 +485,7 @@ class PagedKVCache:
                     freed += 1
             self._node_of_page.clear()
             self._root.children.clear()
+            self._tenant_pages.clear()
             for s in range(self.max_seqs):
                 self._published_of[s] = 0
                 self._pub_node[s] = self._root if self._active[s] else None
@@ -425,6 +508,174 @@ class PagedKVCache:
                 1 for p in self._pages_of[slot]
                 if int(self._ref[p])
                 - (1 if p in self._node_of_page else 0) == 1)
+
+    # -- disagg splice path (page store <-> pool) ----------------------------
+    def export_run(self, tokens, max_pages: Optional[int] = None):
+        """Read the trie-resident pages along ``tokens``' page-aligned
+        prefix out of the device pools, uncapped (a spill wants EVERY
+        full page, including the one ``_match_nodes`` reserves for the
+        first-output-token prefill). Returns ``(n_pages, k_run, v_run,
+        k_scales, v_scales)`` with k/v ``[n, L, KVH, ps, hd]`` in the
+        pool dtype and scales ``[n, L, KVH, ps]`` (None for fp32
+        pools). Safe against a concurrently running step: full
+        trie-resident pages are immutable by construction (writes only
+        ever target positions >= length; growth pops fresh pages), and
+        the buffer refs are snapshotted under the lock."""
+        empty = (0, None, None, None, None)
+        if not self.prefix_cache:
+            return empty
+        tokens = np.asarray(tokens).reshape(-1)
+        with self._lock:
+            if self._k_pages is None:
+                return empty
+            pids: List[int] = []
+            node = self._root
+            for i in range(int(tokens.size) // self.page_size):
+                child = node.children.get(self._page_key(tokens, i))
+                if child is None:
+                    break
+                self._touch(child)
+                pids.append(child.page)
+                node = child
+                if max_pages and len(pids) >= max_pages:
+                    break
+            kbufs = list(self._k_pages)
+            vbufs = list(self._v_pages)
+            ksb = list(self._k_scales) if self.quantized else None
+            vsb = list(self._v_scales) if self.quantized else None
+            self.exported_pages_total += len(pids)
+        if not pids:
+            return empty
+        sel = np.asarray(pids, np.int32)
+        k_run = np.stack([np.asarray(b[:, sel]).transpose(1, 0, 2, 3)
+                          for b in kbufs], axis=1)
+        v_run = np.stack([np.asarray(b[:, sel]).transpose(1, 0, 2, 3)
+                          for b in vbufs], axis=1)
+        k_sc = v_sc = None
+        if ksb is not None:
+            k_sc = np.stack([np.asarray(b[:, sel]).transpose(1, 0, 2)
+                             for b in ksb], axis=1)
+            v_sc = np.stack([np.asarray(b[:, sel]).transpose(1, 0, 2)
+                             for b in vsb], axis=1)
+        return len(pids), k_run, v_run, k_sc, v_sc
+
+    def ingest_run(self, tokens, k_run, v_run, k_scales=None,
+                   v_scales=None, *, tenant=None) -> int:
+        """Splice externally-produced full pages (a page-store fetch)
+        into the pool + trie so the next ``acquire`` attaches them by
+        reference and resumes at ``lengths=matched``. Array layouts
+        mirror ``export_run``; data must already be in the POOL dtype
+        (int8 pools take int8 bodies + fp32 scale planes verbatim).
+        Pages already trie-resident are skipped without a device
+        write; caps (``trie_max_pages``, the per-tenant quota, pool
+        pressure) truncate the run — a partial ingest just matches
+        less, never wrong tokens. MUST be called from the engine's
+        step-loop thread: the device writes race ``set_buffers``
+        otherwise. Returns pages ingested."""
+        if not self.prefix_cache:
+            return 0
+        tokens = np.asarray(tokens).reshape(-1)
+        k_run = np.asarray(k_run)
+        v_run = np.asarray(v_run)
+        n_avail = min(int(tokens.size) // self.page_size,
+                      int(k_run.shape[0]), int(v_run.shape[0]))
+        if n_avail <= 0:
+            return 0
+        want = (self.num_layers, self.num_kv_heads, self.page_size,
+                self.head_dim)
+        if k_run.shape[1:] != want or v_run.shape[1:] != want:
+            raise ValueError(
+                f"ingest_run: page shape {k_run.shape[1:]} != "
+                f"[L,KVH,ps,hd] {want}")
+        if self.quantized and (k_scales is None or v_scales is None):
+            raise ValueError("ingest_run: int8 pool needs scale planes")
+        self._ensure_buffers()
+        tn = self._tenant_key(tenant)
+        fresh: List[Tuple[int, int]] = []   # (run index, page id)
+        with self._lock:
+            node = self._root
+            for i in range(n_avail):
+                key = self._page_key(tokens, i)
+                child = node.children.get(key)
+                if child is not None:
+                    self._touch(child)
+                    node = child
+                    continue
+                if (self.trie_max_pages
+                        and len(self._node_of_page) >= self.trie_max_pages
+                        and not self._evict_leaf_locked()):
+                    break
+                if not self._quota_room_locked(tn):
+                    break
+                try:
+                    p = self._pop_page_locked()
+                except PagePoolExhausted:
+                    break   # partial ingest: shorter match, never wrong
+                child = _TrieNode(key, p, node, tn)
+                node.children[key] = child
+                self._node_of_page[p] = child
+                self._ref[p] = 1
+                self._touch(child)
+                self._tenant_pages[tn] = self._tenant_pages.get(tn, 0) + 1
+                fresh.append((i, p))
+                node = child
+            self.ingested_pages_total += len(fresh)
+        if not fresh:
+            return 0
+        # one fused jitted scatter for every buffer, padded to the next
+        # power of two with junk page 0 (block 0 data, harmless): an
+        # unbucketed length would compile a fresh executable per
+        # distinct run size, and per-buffer at[].set dispatch alone
+        # costs multiples of a decode step — both are splice-time
+        # stalls on exactly the latency-critical warm-start path
+        n = len(fresh)
+        width = 1
+        while width < n:
+            width *= 2
+        sel = np.zeros(width, np.int32)
+        sel[:n] = [p for _, p in fresh]
+        idx = [i for i, _ in fresh] + [fresh[0][0]] * (width - n)
+        bufs, blks = [], []
+        for li in range(self.num_layers):
+            bufs.append(self._k_pages[li])
+            blks.append(np.stack([k_run[i, li] for i in idx], axis=1))
+            bufs.append(self._v_pages[li])
+            blks.append(np.stack([v_run[i, li] for i in idx], axis=1))
+            if self.quantized:
+                bufs.append(self._k_scales[li])
+                blks.append(np.stack(
+                    [np.asarray(k_scales)[i, li] for i in idx], axis=1))
+                bufs.append(self._v_scales[li])
+                blks.append(np.stack(
+                    [np.asarray(v_scales)[i, li] for i in idx], axis=1))
+        out = _scatter_pages(bufs, sel, blks)
+        per = 4 if self.quantized else 2
+        for li in range(self.num_layers):
+            self._k_pages[li] = out[per * li]
+            self._v_pages[li] = out[per * li + 1]
+            if self.quantized:
+                self._k_scales[li] = out[per * li + 2]
+                self._v_scales[li] = out[per * li + 3]
+        return len(fresh)
+
+    def trie_leaf_runs(self) -> List[np.ndarray]:
+        """Token runs (root-to-leaf concatenated page keys) covering
+        every trie leaf — the drain-spill walk: exporting each run
+        spills the whole trie with shared interior pages read once per
+        leaf path."""
+        with self._lock:
+            runs: List[np.ndarray] = []
+            stack: List[Tuple[_TrieNode, List[int]]] = [(self._root, [])]
+            while stack:
+                node, path = stack.pop()
+                if node is not self._root:
+                    path = path + list(node.key)
+                if node.children:
+                    for child in node.children.values():
+                        stack.append((child, path))
+                elif path:
+                    runs.append(np.asarray(path, np.int64))
+            return runs
 
     # -- sequence lifecycle --------------------------------------------------
     def acquire(self, prompt_tokens) -> Tuple[int, int]:
@@ -630,6 +881,13 @@ class PagedKVCache:
                 "cow_forks_total": self.cow_forks_total,
                 "leaf_evictions_total": self.leaf_evictions_total,
                 "published_pages_total": self.published_pages_total,
+                "ingested_pages_total": self.ingested_pages_total,
+                "exported_pages_total": self.exported_pages_total,
+                "tenant_quota_pages": self.tenant_quota_pages,
+                "tenant_quota_rejections_total":
+                    self.tenant_quota_rejections_total,
+                "tenant_pages": dict(self._tenant_pages),
+                "tenant_leaf_evictions": dict(self._tenant_evictions),
             }
 
     def check_integrity(self) -> None:
@@ -686,6 +944,14 @@ class PagedKVCache:
                 raise AssertionError(
                     "node_of_page desynced from the trie: "
                     f"{set(trie) ^ set(self._node_of_page)}")
+            # per-tenant page counts mirror the trie exactly
+            tcount: Dict[str, int] = {}
+            for nd in trie.values():
+                tcount[nd.tenant] = tcount.get(nd.tenant, 0) + 1
+            if tcount != self._tenant_pages:
+                raise AssertionError(
+                    f"tenant page accounting desynced: {tcount} != "
+                    f"{self._tenant_pages}")
             for p, nd in trie.items():
                 if self._node_of_page[p] is not nd:
                     raise AssertionError(f"node_of_page[{p}] is a stale node")

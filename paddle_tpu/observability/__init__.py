@@ -32,10 +32,10 @@ from .flight import dump as flight_dump
 from .flight import install_signal_handlers
 from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
                        overlap_telemetry, step_telemetry,
-                       watch_collectives, watch_coordinator, watch_engine,
-                       watch_executor, watch_generation, watch_loader,
-                       watch_partition, watch_serving, watch_supervisor,
-                       watch_traffic)
+                       watch_collectives, watch_coordinator, watch_disagg,
+                       watch_engine, watch_executor, watch_generation,
+                       watch_loader, watch_partition, watch_serving,
+                       watch_supervisor, watch_traffic)
 from .registry import registry as get_registry
 from .tracing import SpanContext, attach, current, span, traced
 
@@ -47,7 +47,7 @@ __all__ = [
     "watch_serving", "watch_engine", "watch_executor", "watch_supervisor",
     "watch_loader", "watch_generation", "watch_partition",
     "watch_collectives", "watch_coordinator", "watch_traffic",
-    "step_telemetry", "overlap_telemetry", "snapshot",
+    "watch_disagg", "step_telemetry", "overlap_telemetry", "snapshot",
     "to_prometheus_text",
 ]
 

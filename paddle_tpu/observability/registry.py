@@ -37,8 +37,8 @@ from . import flight
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "registry",
     "watch_serving", "watch_engine", "watch_executor", "watch_supervisor",
-    "watch_loader", "watch_generation", "watch_traffic", "step_telemetry",
-    "overlap_telemetry",
+    "watch_loader", "watch_generation", "watch_traffic", "watch_disagg",
+    "step_telemetry", "overlap_telemetry",
 ]
 
 
@@ -298,6 +298,7 @@ _partitions: "weakref.WeakSet" = weakref.WeakSet()
 _collectives: "weakref.WeakSet" = weakref.WeakSet()
 _traffic: "weakref.WeakSet" = weakref.WeakSet()
 _coordinators: "weakref.WeakSet" = weakref.WeakSet()
+_disagg: "weakref.WeakSet" = weakref.WeakSet()
 
 
 def watch_serving(metrics) -> None:
@@ -341,6 +342,17 @@ def watch_generation(metrics) -> None:
     one scrape."""
     _obs_id(metrics)
     _generation.add(metrics)
+
+
+def watch_disagg(obj) -> None:
+    """Called by disagg ctors (HostPageStore / PageStoreClient /
+    DisaggService): anything exposing ``stats_numeric()`` exports as
+    the ``paddle_disagg_*{svc=}`` family — pages shipped and pulled,
+    wire bytes vs the fp32 bytes they replace (the <=0.3x gate is one
+    division away), store hit rate, and the prefill->decode handoff
+    latency quantiles."""
+    _obs_id(obj)
+    _disagg.add(obj)
 
 
 def watch_partition(resolved) -> None:
@@ -538,6 +550,11 @@ def _collect_dist():
                     lambda c: c.stats_numeric())
 
 
+def _collect_disagg():
+    return _labeled(_disagg, "svc", "paddle_disagg",
+                    lambda s: s.stats_numeric())
+
+
 def _collect_build_info():
     from .. import version
 
@@ -557,6 +574,7 @@ for _name, _fn in (
     ("collective", _collect_collectives),
     ("traffic", _collect_traffic),
     ("dist", _collect_dist),
+    ("disagg", _collect_disagg),
     ("build_info", _collect_build_info),
 ):
     _REGISTRY.register_collector(_name, _fn)

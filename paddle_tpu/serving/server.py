@@ -91,6 +91,7 @@ class _Handler(BaseHTTPRequestHandler):
     engine: ServingEngine = None  # set by the subclass ServingServer makes
     gen_engine = None             # generation.GenerationEngine (optional)
     traffic = None                # traffic.TrafficController (optional)
+    phase = None                  # disagg worker phase (optional)
     started_at: float = 0.0       # time.monotonic() at server start
     stream_timeout_s: float = 0.0  # /v1/generate write stall budget
     sndbuf: int = 0               # test hook: shrink SO_SNDBUF
@@ -154,6 +155,17 @@ class _Handler(BaseHTTPRequestHandler):
                 "version": version.full_version,
                 "tpu": version.tpu(),
             }
+            if self.phase:
+                # disaggregated serving: the router needs to know which
+                # phase this worker serves ("prefill"/"decode"/"both")
+                # from the SAME probe it already polls for drain state
+                body["phase"] = self.phase
+            gen = self.gen_engine
+            if gen is not None and hasattr(gen, "phase_health"):
+                try:
+                    body["phases"] = gen.phase_health()
+                except Exception:  # noqa: BLE001 — a closing service
+                    pass
             if self.traffic is not None:
                 # per-class queue depths + drain state + miss ratio:
                 # the router/autoscaler decides from THIS endpoint,
@@ -436,12 +448,15 @@ class ServingServer:
                  port: int = 0, start: bool = True, generation_engine=None,
                  traffic=None, reuse_port: bool = False,
                  stream_write_timeout_s: Optional[float] = None,
-                 sndbuf: int = 0):
+                 sndbuf: int = 0, phase: Optional[str] = None):
         from ..flags import flag
 
         self.engine = engine
         self.generation_engine = generation_engine
         self.traffic = traffic
+        if phase is None:
+            phase = getattr(generation_engine, "phase", None)
+        self.phase = str(phase) if phase else None
         if stream_write_timeout_s is None:
             stream_write_timeout_s = float(
                 flag("traffic_stream_write_timeout_s"))
@@ -449,7 +464,7 @@ class ServingServer:
         self._active_lock = threading.Lock()
         handler = type("_BoundHandler", (_Handler,),
                        {"engine": engine, "gen_engine": generation_engine,
-                        "traffic": traffic,
+                        "traffic": traffic, "phase": self.phase,
                         "stream_timeout_s": float(stream_write_timeout_s),
                         "sndbuf": int(sndbuf),
                         "active": self._active,

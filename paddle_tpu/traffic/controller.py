@@ -308,6 +308,18 @@ class ServiceTimeEstimator:
             # queue-to-lane wait is already in the measured TTFT; keep
             # its single-chunk share and add the extra chunk steps
             ttft = max(ttft, chunks * step_p50)
+        # disaggregated backend (disagg.DisaggService): the
+        # prefill->decode handoff (spill + store put + decode admit)
+        # is real wall time on every request's critical path — price
+        # it, or deadlines near the TTFT median shed wrongly. The
+        # store-hit discount itself already landed above: prefix_probe
+        # on a disagg service consults the page store too.
+        hand = getattr(self._gen, "handoff_overhead_ms", None)
+        if hand is not None:
+            try:
+                ttft += float(hand() or 0.0)
+            except Exception:  # noqa: BLE001 — pricing must never raise
+                pass
         return ttft + itl * max(0, n - 1)
 
     def service_ms(self, req: _TReq) -> Optional[float]:
@@ -696,10 +708,16 @@ class TrafficController:
                     lambda fut, r=req: self._on_engine_done(r, fut))
             else:
                 ga = req.gen_args
-                stream = self.generation_engine.submit(
-                    req.feed, max_new_tokens=ga["max_new_tokens"],
-                    eos_id=ga["eos_id"], deadline_ms=remaining_ms,
-                    on_token=ga["on_token"])
+                kw = {"max_new_tokens": ga["max_new_tokens"],
+                      "eos_id": ga["eos_id"], "deadline_ms": remaining_ms,
+                      "on_token": ga["on_token"]}
+                # the tenant identity rides into the engine so trie
+                # publishes attribute to the right per-tenant quota;
+                # engine-likes without the kwarg (older mocks) still
+                # dispatch
+                if self._gen_takes_tenant():
+                    kw["tenant"] = req.tenant
+                stream = self.generation_engine.submit(req.feed, **kw)
                 req.inner = stream
                 req.dispatched = True
                 req.ticket._set_stream(stream)
@@ -831,11 +849,29 @@ class TrafficController:
             for name, b in buckets}
         return out
 
+    def _gen_takes_tenant(self) -> bool:
+        """Whether generation_engine.submit accepts tenant= (cached
+        one-time signature probe — per-dispatch inspect would be pure
+        overhead)."""
+        cached = getattr(self, "_gen_tenant_kw", None)
+        if cached is None:
+            import inspect
+
+            try:
+                cached = "tenant" in inspect.signature(
+                    self.generation_engine.submit).parameters
+            except (TypeError, ValueError):
+                cached = False
+            self._gen_tenant_kw = cached
+        return cached
+
     def health(self) -> Dict[str, Any]:
         """The /healthz fragment: per-class depths + drain state —
-        everything a router/autoscaler needs from one endpoint."""
+        everything a router/autoscaler needs from one endpoint. A
+        disaggregated backend adds the per-worker phase fragment
+        (which workers prefill, which decode, their load)."""
         ratio, _ = self.metrics.miss_ratio()
-        return {
+        out = {
             "draining": self.draining,
             "queue_depth": self.queue_depths(),
             "inflight": self._inflight + self._gen_inflight,
@@ -844,3 +880,14 @@ class TrafficController:
             "deadline_miss_ratio": round(ratio, 4),
             "classes": list(CLASSES),
         }
+        gen = self.generation_engine
+        if gen is not None:
+            ph = getattr(gen, "phase_health", None)
+            if ph is not None:
+                try:
+                    out["phases"] = ph()
+                except Exception:  # noqa: BLE001 — health must never raise
+                    pass
+            elif getattr(gen, "phase", None):
+                out["phase"] = gen.phase
+        return out

@@ -103,7 +103,8 @@ def _worker_main(spec: Dict[str, Any], conn) -> None:
                     **(spec.get("traffic_kwargs") or {})))
         server = ServingServer(
             engine, host=spec["host"], port=spec["port"],
-            traffic=controller, reuse_port=bool(spec.get("reuse_port")))
+            traffic=controller, reuse_port=bool(spec.get("reuse_port")),
+            phase=spec.get("phase"))
         stats = dispatch.cache_stats()
         conn.send(("ready", {
             "pid": os.getpid(),
@@ -111,6 +112,7 @@ def _worker_main(spec: Dict[str, Any], conn) -> None:
             "warmup_ms": round(warmup_ms, 2),
             "jit_compiles": stats.get("jit_compiles", 0),
             "persistent_cache_dir": stats.get("persistent_cache_dir"),
+            "phase": spec.get("phase"),
         }))
     except Exception as e:  # noqa: BLE001 — the parent must see the failure
         try:
@@ -292,6 +294,7 @@ class WorkerPool:
                  flags: Optional[Dict[str, Any]] = None,
                  drain_grace_s: float = 0.3,
                  ready_timeout_s: float = 120.0,
+                 phase: Optional[str] = None,
                  start: bool = True):
         if num_workers < 1:
             raise ValueError("num_workers must be >= 1")
@@ -312,6 +315,10 @@ class WorkerPool:
             "traffic_kwargs": dict(traffic_kwargs or {}),
             "flags": dict(flags or {}),
             "drain_grace_s": float(drain_grace_s),
+            # disagg: which inference phase this pool serves — stamped
+            # on every worker's /healthz so the router can tell tiers
+            # apart ("prefill" / "decode" / None for a unified pool)
+            "phase": phase,
         }
         self._ctx = _mp.get_context("spawn")
         self.workers: List[_Worker] = []

@@ -1,0 +1,563 @@
+"""paddle_tpu.disagg (ISSUE 18): disaggregated prefill/decode serving
+with int8 KV-page streaming and cross-engine prefix persistence.
+
+Correctness anchors:
+  * wire — blockwise-int8 page encoding respects the analytic error
+    bound, ``raw`` and int8-verbatim paths are BITWISE, and the int8
+    blob beats the <=0.3x-of-fp32 byte gate at head_dim 32;
+  * store — radix-keyed put/match with first-publisher-wins dedup,
+    byte-cap LRU leaf eviction, and the same semantics over the TCP
+    server/client as in-process;
+  * handoff — the split prefill->store->decode topology emits tokens
+    IDENTICAL to the co-located engine (and the naive oracle), through
+    decode-pool churn/eviction, slow-client cancel mid-handoff, and
+    over int8 KV pools (bit-identical pages on the wire);
+  * persistence — a fresh decode worker on a populated store starts
+    warm (ROADMAP 2(a)); engine drain spills the trie so a rolling
+    restart resumes warm; per-tenant trie quotas reject and evict with
+    per-tenant gauges;
+  * integrity — ``check_integrity`` green + zero pages in use after
+    drain, on every engine in every test.
+"""
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.disagg import (DecodeWorker, DisaggService, HostPageStore,
+                               PageStoreClient, PageStoreServer,
+                               PrefillWorker, decode_page, encode_page,
+                               fp32_page_bytes, run_for_pool,
+                               store_endpoint_from_env)
+from paddle_tpu.generation import GenerationEngine, PagedKVCache
+from paddle_tpu.generation.model import GPTConfig, build_lm_program
+from paddle_tpu.inference import Config, create_predictor
+from paddle_tpu.kernels.quant import blockwise_error_bound
+
+CFG = GPTConfig(vocab_size=97, hidden_size=32, num_layers=2, num_heads=4,
+                ffn_size=64, max_position=64, hidden_dropout=0.0,
+                attention_dropout=0.0)
+SEQ = 48
+
+
+@pytest.fixture(scope="module")
+def lm_dir(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("disagg_lm"))
+    main, startup, _feeds, fetches = build_lm_program(CFG, SEQ)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        fluid.io.save_inference_model(d, ["tokens"],
+                                      [fetches["logits"]], exe, main)
+    return d
+
+
+@pytest.fixture(scope="module")
+def predictor(lm_dir):
+    return create_predictor(Config(lm_dir))
+
+
+@pytest.fixture(scope="module")
+def oracle(predictor):
+    def _decode(prompt, n):
+        toks = list(int(t) for t in prompt)
+        out = []
+        for _ in range(n):
+            arr = np.zeros((1, SEQ), np.int64)
+            arr[0, :len(toks)] = toks
+            (logits,) = predictor.run([arr])
+            t = int(np.argmax(logits[0, len(toks) - 1]))
+            toks.append(t)
+            out.append(t)
+        return out
+    return _decode
+
+
+def _engine(predictor, **kw):
+    kw.setdefault("page_size", 4)
+    kw.setdefault("num_pages", 64)
+    kw.setdefault("max_decode_batch", 4)
+    kw.setdefault("chunk_tokens", 6)
+    return GenerationEngine(predictor, CFG, **kw)
+
+
+def _toks(*vals):
+    return np.asarray(vals, dtype=np.int64)
+
+
+def _page(seed, L=2, kvh=4, ps=4, hd=32):
+    rng = np.random.RandomState(seed)
+    return (rng.randn(L, kvh, ps, hd).astype(np.float32),
+            rng.randn(L, kvh, ps, hd).astype(np.float32))
+
+
+def _assert_drained(eng):
+    eng.cache.check_integrity()
+    assert eng.stats()["cache"]["pages_in_use"] == 0
+
+
+class _FlagGuard:
+    def __init__(self, **kv):
+        self._kv = kv
+
+    def __enter__(self):
+        self._old = fluid.get_flags(list(self._kv))
+        fluid.set_flags(self._kv)
+
+    def __exit__(self, *exc):
+        fluid.set_flags(self._old)
+
+
+# -- wire encoding -----------------------------------------------------------
+
+
+def test_wire_int8_block_error_bound():
+    """Blockwise-int8 round trip stays inside the analytic bound
+    (scale/2 per block) — the lossy path is bounded, not hopeful."""
+    k, v = _page(3)
+    blob = encode_page(k, v)
+    d = decode_page(blob)
+    n, kr, vr, ks, vs = run_for_pool([blob], np.float32)
+    assert n == 1 and d["enc"] == "int8_block"
+    for orig, got in ((k, kr[0]), (v, vr[0])):
+        bound = blockwise_error_bound(orig.reshape(-1, orig.shape[-1]),
+                                      orig.shape[-1])
+        err = float(np.abs(orig - got).max())
+        assert err <= float(bound) + 1e-6, (err, float(bound))
+
+
+def test_wire_raw_bitwise():
+    """encoding="raw" ships fp32 verbatim — the bitwise-identity
+    escape hatch for fp32 pools."""
+    k, v = _page(5)
+    blob = encode_page(k, v, encoding="raw")
+    _, kr, vr, ks, vs = run_for_pool([blob], np.float32)
+    assert ks is None and vs is None
+    assert np.array_equal(kr[0], k) and np.array_equal(vr[0], v)
+
+
+def test_wire_int8_pages_ship_verbatim():
+    """int8 pool pages + their scale planes cross the wire untouched
+    in BOTH directions — the bit-identity that makes split int8
+    serving exactly equal co-located int8 serving."""
+    rng = np.random.RandomState(7)
+    L, kvh, ps, hd = 2, 4, 4, 8
+    k8 = rng.randint(-127, 128, (L, kvh, ps, hd)).astype(np.int8)
+    v8 = rng.randint(-127, 128, (L, kvh, ps, hd)).astype(np.int8)
+    ks = rng.rand(L, kvh, ps).astype(np.float32) + 0.01
+    vs = rng.rand(L, kvh, ps).astype(np.float32) + 0.01
+    blob = encode_page(k8, v8, ks, vs)
+    _, kr, vr, ksr, vsr = run_for_pool([blob], np.int8)
+    assert kr.dtype == np.int8
+    assert np.array_equal(kr[0], k8) and np.array_equal(vr[0], v8)
+    assert np.array_equal(ksr[0], ks) and np.array_equal(vsr[0], vs)
+
+
+def test_wire_ratio_gate():
+    """The acceptance gate: int8_block blob <= 0.3x the fp32 bytes it
+    replaces at head_dim 32 (ratio = 0.25 + 1/head_dim + header)."""
+    k, v = _page(11, hd=32)
+    blob = encode_page(k, v)
+    assert len(blob) <= 0.3 * fp32_page_bytes(2, 4, 4, 32), len(blob)
+
+
+# -- host page store ---------------------------------------------------------
+
+
+def test_store_put_match_dedup():
+    store = HostPageStore(page_size=4)
+    k, v = _page(13)
+    blobs = [encode_page(*_page(13 + i)) for i in range(3)]
+    toks = np.arange(1, 13, dtype=np.int64)
+    assert store.put_run(toks, blobs) == 3
+    # first publisher wins: a re-put of the same run is pure dedup
+    assert store.put_run(toks, [encode_page(k, v)] * 3) == 0
+    st = store.stats()
+    assert st["pages"] == 3 and st["dup_pages_total"] == 3
+    got = store.match(toks)
+    assert [bytes(b) for b in got] == [bytes(b) for b in blobs]
+    # a diverging suffix matches only the shared prefix pages
+    fork = np.concatenate([toks[:8], _toks(90, 91, 92, 93)])
+    assert len(store.match(fork)) == 2
+    assert store.match_pages(toks) == 3
+    assert store.match(toks, max_pages=1) and len(
+        store.match(toks, max_pages=1)) == 1
+
+
+def test_store_byte_cap_lru_eviction():
+    blob = encode_page(*_page(17))
+    store = HostPageStore(page_size=4, max_bytes=int(len(blob) * 2.5))
+    a = np.arange(1, 9, dtype=np.int64)          # 2 pages
+    b = np.arange(50, 54, dtype=np.int64)        # 1 page, disjoint
+    store.put_run(a, [blob, blob])
+    store.match(a)                               # a is now most-recent
+    store.put_run(b, [blob])                     # overflows: evict LRU leaf
+    st = store.stats()
+    assert st["evictions_total"] >= 1
+    assert st["bytes"] <= int(len(blob) * 2.5)
+
+
+def test_store_tcp_roundtrip_and_counters():
+    """The TCP server/client pair speaks the same duck as the
+    in-process store; wire-byte counters feed the <=0.3x gauge."""
+    srv = PageStoreServer(page_size=4)
+    host, port = srv.endpoint.split(":")
+    cli = PageStoreClient(host, int(port), page_size=4)
+    try:
+        blobs = [encode_page(*_page(19 + i)) for i in range(2)]
+        toks = np.arange(1, 9, dtype=np.int64)
+        assert cli.put_run(toks, blobs) == 2
+        assert cli.match_pages(toks) == 2
+        got = cli.match(toks)
+        assert [bytes(x) for x in got] == [bytes(x) for x in blobs]
+        st = srv.store.stats()
+        assert st["pages"] == 2 and st["wire_bytes_total"] > 0
+        assert st["wire_ratio"] <= 0.3
+        cs = cli.stats_numeric()
+        assert cs["client_bytes_sent_total"] > 0
+        assert cs["client_bytes_received_total"] > 0
+        cli.clear()
+        assert srv.store.stats()["pages"] == 0
+    finally:
+        cli.close()
+        srv.close()
+
+
+def test_store_endpoint_from_env(monkeypatch):
+    monkeypatch.setenv("PADDLE_PAGESTORE_ENDPOINT", "10.0.0.7:9999")
+    assert store_endpoint_from_env() == "10.0.0.7:9999"
+    monkeypatch.delenv("PADDLE_PAGESTORE_ENDPOINT")
+    monkeypatch.setenv("PADDLE_TRAINER_ENDPOINTS",
+                       "10.0.0.1:8672,10.0.0.2:8672")
+    port = int(fluid.flags.flag("disagg_store_port"))
+    assert store_endpoint_from_env() == f"10.0.0.1:{port}"
+
+
+# -- tenant quotas (satellite 1) ---------------------------------------------
+
+
+def test_tenant_quota_cache_level():
+    """A tenant at its trie quota evicts its OWN least-recent leaf (or
+    is rejected) — one tenant's boilerplate cannot monopolize the
+    shared trie; the per-tenant gauges show the split."""
+    c = PagedKVCache(2, 4, 8, num_pages=32, page_size=4, max_seqs=4,
+                     max_pages_per_seq=12, prefix_cache=True,
+                     tenant_quota_pages=2)
+    pa = np.arange(1, 13, dtype=np.int64)        # 3 pages > quota of 2
+    slot, _ = c.acquire(pa)
+    c.advance(slot, 12)
+    pub = c.publish(slot, pa, tenant="alice")
+    st = c.radix_stats()
+    assert st["tenant_pages"].get("alice", 0) <= 2
+    # a 3rd page for alice either self-evicted or was rejected
+    assert (st["tenant_leaf_evictions"].get("alice", 0)
+            + st["tenant_quota_rejections_total"]) >= 1, (pub, st)
+    c.release(slot)
+    # bob is unaffected by alice's quota pressure
+    pb = np.arange(60, 68, dtype=np.int64)       # 2 pages
+    s2, _ = c.acquire(pb)
+    c.advance(s2, 8)
+    assert c.publish(s2, pb, tenant="bob") == 2
+    st = c.radix_stats()
+    assert st["tenant_pages"]["bob"] == 2
+    c.check_integrity()
+    c.release(s2)
+    c.drop_trie()
+    c.check_integrity()
+    assert c.stats()["pages_in_use"] == 0
+
+
+def test_tenant_quota_through_engine(predictor):
+    """The traffic tenant identity reaches publish: submit(tenant=)
+    tags trie pages per tenant and the quota holds end to end."""
+    with _FlagGuard(generation_trie_tenant_quota=2):
+        with _engine(predictor, prefix_cache=True) as eng:
+            rng = np.random.RandomState(71)
+            p = rng.randint(1, CFG.vocab_size, 14).astype(np.int64)
+            eng.submit(p, max_new_tokens=4, tenant="acme").result(600)
+            st = eng.cache.radix_stats()
+            assert st["tenant_quota_pages"] == 2
+            assert 0 < sum(st["tenant_pages"].values()) <= 2
+            assert set(st["tenant_pages"]) <= {"acme"}
+            eng.cache.check_integrity()
+            eng.cache.drop_trie()
+        _assert_drained(eng)
+
+
+def test_controller_forwards_tenant(predictor):
+    """TrafficController passes the admission tenant through to the
+    generation engine (signature-probed, so legacy engines without
+    tenant= still work)."""
+    from paddle_tpu.traffic import TrafficConfig, TrafficController
+
+    with _engine(predictor, prefix_cache=True) as eng:
+        ctl = TrafficController(
+            engine=None, generation_engine=eng,
+            config=TrafficConfig.from_flags(), start=False)
+        tk = ctl.submit_generation(
+            _toks(5, 6, 7, 8, 9, 10), tenant="tenant-z", max_new_tokens=3)
+        while not tk.done():
+            ctl.pump()
+            time.sleep(0.01)
+        assert tk.result(timeout=600)
+        st = eng.cache.radix_stats()
+        assert "tenant-z" in st["tenant_pages"]
+        ctl.close(drain=True)
+        eng.cache.drop_trie()
+    _assert_drained(eng)
+
+
+# -- estimator pricing -------------------------------------------------------
+
+
+def test_estimator_prices_handoff():
+    """A disagg backend's handoff latency lands in the TTFT estimate —
+    deadlines near the bare-TTFT median must not shed wrongly."""
+    from paddle_tpu.traffic.controller import ServiceTimeEstimator
+
+    class _Gen:
+        mode = "ragged"
+        chunk_tokens = 0
+        prefix_cache = False
+        default_max_new = 4
+
+        class metrics:
+            @staticmethod
+            def snapshot():
+                return {"ttft_ms": {"count": 5, "p50": 10.0},
+                        "itl_ms": {"p50": 2.0},
+                        "decode_step_ms": {"p50": 2.0}}
+
+        @staticmethod
+        def handoff_overhead_ms():
+            return 7.0
+
+    est = ServiceTimeEstimator(generation_engine=_Gen())
+    base = ServiceTimeEstimator(generation_engine=type(
+        "_G", (_Gen,), {"handoff_overhead_ms": None})())
+    got = est.generate_service_ms(4)
+    assert got == pytest.approx(10.0 + 7.0 + 2.0 * 3)
+
+
+# -- cross-engine persistence (the splice path) ------------------------------
+
+
+def test_spill_then_warm_start(predictor, lm_dir, oracle):
+    """ROADMAP 2(a): engine A publishes + spills to the store; a FRESH
+    engine B consults the store at admission, splices the run, resumes
+    at the fork point, and emits oracle-identical tokens. Warm TTFT
+    must beat cold by the acceptance margin (<=0.5x)."""
+    store = HostPageStore(page_size=4)
+    rng = np.random.RandomState(83)
+    p = rng.randint(1, CFG.vocab_size, 20).astype(np.int64)
+    with _FlagGuard(disagg_wire_encoding="raw"):
+        with _engine(predictor, prefix_cache=True,
+                     page_store=store) as eng_a:
+            cold = eng_a.generate(p, max_new_tokens=6, timeout=600)
+            assert eng_a.spill_run(p) == 5          # 20 tokens = 5 pages
+            eng_a.cache.drop_trie()
+        _assert_drained(eng_a)
+        assert store.stats()["pages"] == 5
+
+        pred_b = create_predictor(Config(lm_dir))
+        with _engine(pred_b, prefix_cache=True,
+                     page_store=store) as eng_b:
+            warm = eng_b.generate(p, max_new_tokens=6, timeout=600)
+            st = eng_b.stats()["store"]
+            assert st["hits_total"] == 1
+            # the >=1-token-to-prefill cap: 5 pages spilled, 4 spliced
+            assert st["pages_pulled_total"] == 4
+            assert eng_b.cache.ingested_pages_total == 4
+            eng_b.cache.check_integrity()
+            eng_b.cache.drop_trie()
+        _assert_drained(eng_b)
+    assert warm == cold == oracle(p, 6)
+
+
+def test_drain_spills_trie_to_store(predictor, lm_dir, oracle):
+    """Satellite 2: close(drain=True) exports trie-resident runs to
+    the store before drop_trie — a rolling restart's replacement
+    worker starts WARM from its predecessor's prefix working set."""
+    store = HostPageStore(page_size=4)
+    rng = np.random.RandomState(89)
+    p = rng.randint(1, CFG.vocab_size, 16).astype(np.int64)
+    with _FlagGuard(disagg_wire_encoding="raw"):
+        eng = _engine(predictor, prefix_cache=True, page_store=store)
+        cold = eng.generate(p, max_new_tokens=5, timeout=600)
+        eng.close(drain=True)                       # spill happens HERE
+        _assert_drained(eng)
+        assert eng.store_pages_spilled_total >= 4
+        assert store.stats()["pages"] >= 4
+
+        pred_b = create_predictor(Config(lm_dir))
+        with _engine(pred_b, prefix_cache=True,
+                     page_store=store) as eng_b:
+            warm = eng_b.generate(p, max_new_tokens=5, timeout=600)
+            assert eng_b.stats()["store"]["hits_total"] == 1
+            eng_b.cache.drop_trie()
+        _assert_drained(eng_b)
+    assert warm == cold == oracle(p, 5)
+
+
+# -- the split topology ------------------------------------------------------
+
+
+def _split(lm_dir, store, *, kv_dtype="float32", decode_kw=None):
+    pf = PrefillWorker(create_predictor(Config(lm_dir)), CFG, store,
+                       page_size=4, num_pages=64, max_decode_batch=4,
+                       chunk_tokens=6, kv_dtype=kv_dtype)
+    dkw = dict(page_size=4, num_pages=64, max_decode_batch=4,
+               chunk_tokens=6, kv_dtype=kv_dtype)
+    dkw.update(decode_kw or {})
+    dw = DecodeWorker(create_predictor(Config(lm_dir)), CFG, store, **dkw)
+    return DisaggService(prefill=[pf], decode=[dw])
+
+
+def _split_drained(svc):
+    for w in svc._prefill + svc._decode:
+        _assert_drained(w.engine)
+
+
+@pytest.mark.parametrize("kv_dtype,encoding", [
+    ("float32", "raw"), ("int8", "int8_block")])
+def test_split_token_identity(lm_dir, predictor, oracle, kv_dtype,
+                              encoding):
+    """THE zero-token-loss proof: prefill-tier -> store -> decode-tier
+    emits exactly the co-located engine's greedy tokens (== oracle for
+    fp32). int8 pages cross the wire verbatim, so the int8 split is
+    bit-identical to co-located int8 serving."""
+    rng = np.random.RandomState(97)
+    pre = rng.randint(1, CFG.vocab_size, 12).astype(np.int64)
+    prompts = [np.concatenate([pre, rng.randint(
+        1, CFG.vocab_size, 3 + i).astype(np.int64)]) for i in range(3)]
+    with _engine(predictor, prefix_cache=True,
+                 kv_dtype=kv_dtype) as coloc:
+        want = [coloc.generate(p, max_new_tokens=8, timeout=600)
+                for p in prompts]
+        coloc.cache.drop_trie()
+    _assert_drained(coloc)
+
+    with _FlagGuard(disagg_wire_encoding=encoding):
+        svc = _split(lm_dir, HostPageStore(page_size=4),
+                     kv_dtype=kv_dtype)
+        try:
+            got = [svc.generate(p, max_new_tokens=8, timeout=600)
+                   for p in prompts]
+            sn = svc.stats_numeric()
+            assert sn["handoffs_total"] == 3
+            assert sn["pages_shipped_total"] >= 3
+            assert sn["store_hits_total"] >= 1
+            assert sn["pages_pulled_total"] >= 1
+            ph = svc.phase_health()
+            assert {w["phase"] for w in ph} == {"prefill", "decode"}
+        finally:
+            svc.close(drain=True)
+        _split_drained(svc)
+    assert got == want
+    if kv_dtype == "float32":
+        for p, toks in zip(prompts, got):
+            assert toks == oracle(p, 8), list(p)
+
+
+def test_split_churn_eviction_resume(lm_dir, predictor, oracle):
+    """Token identity holds through the hard path: a small decode
+    pool forces mid-flight eviction + resume while spliced store runs
+    are live — nothing decodes from a stale page."""
+    rng = np.random.RandomState(101)
+    pre = rng.randint(1, CFG.vocab_size, 8).astype(np.int64)
+    prompts = [np.concatenate([pre, rng.randint(
+        1, CFG.vocab_size, 2 + i).astype(np.int64)]) for i in range(4)]
+    with _FlagGuard(disagg_wire_encoding="raw"):
+        svc = _split(lm_dir, HostPageStore(page_size=4),
+                     decode_kw=dict(num_pages=16, max_decode_batch=3))
+        try:
+            streams = [svc.submit(p, max_new_tokens=18) for p in prompts]
+            outs = [s.result(timeout=600) for s in streams]
+            dw = svc._decode[0].engine
+            assert dw.stats()["evicted_total"] >= 1, \
+                "must exercise eviction/resume"
+        finally:
+            svc.close(drain=True)
+        _split_drained(svc)
+    for p, got in zip(prompts, outs):
+        assert got == oracle(p, 18), list(p)
+
+
+def test_cancel_mid_handoff(lm_dir):
+    """A slow client cancelling between prefill and decode burns no
+    decode lane; its pages stay in the store for siblings; every pool
+    drains clean."""
+    rng = np.random.RandomState(103)
+    p = rng.randint(1, CFG.vocab_size, 16).astype(np.int64)
+    with _FlagGuard(disagg_wire_encoding="raw"):
+        svc = _split(lm_dir, HostPageStore(page_size=4))
+        try:
+            svc._handoff_hook = lambda job: job.stream.cancel()
+            s = svc.submit(p, max_new_tokens=8)
+            with pytest.raises(Exception) as ei:
+                s.result(timeout=600)
+            assert s.finish_reason == "cancelled"
+            assert "cancelled" in str(ei.value)
+            sn = svc.metrics.snapshot()
+            assert sn["cancelled_total"] == 1
+            assert sn["handoffs_total"] == 0
+            # the prefilled pages survive for siblings
+            assert svc._decode[0].store.stats()["pages"] >= 3
+            dw = svc._decode[0].engine
+            assert dw.metrics.snapshot()["requests_total"] == 0
+            # an uncancelled sibling reuses them
+            svc._handoff_hook = None
+            assert svc.generate(p, max_new_tokens=4, timeout=600)
+            assert dw.stats()["store"]["hits_total"] == 1
+        finally:
+            svc.close(drain=True)
+        _split_drained(svc)
+
+
+def test_disagg_gauges_reach_prometheus(lm_dir):
+    """DisaggService + stores export as the paddle_disagg_* family in
+    the unified scrape."""
+    from paddle_tpu import observability
+
+    with _FlagGuard(disagg_wire_encoding="raw"):
+        svc = _split(lm_dir, HostPageStore(page_size=4))
+        try:
+            svc.generate(_toks(3, 4, 5, 6, 7, 8, 9, 10),
+                         max_new_tokens=3, timeout=600)
+            text = observability.to_prometheus_text()
+            for family in ("paddle_disagg_handoffs_total",
+                           "paddle_disagg_pages_shipped_total",
+                           "paddle_disagg_store_hit_rate",
+                           "paddle_disagg_handoff_ms_p50",
+                           "paddle_disagg_wire_bytes_total"):
+                assert family in text, family
+        finally:
+            svc.close(drain=True)
+        _split_drained(svc)
+
+
+@pytest.mark.slow
+def test_healthz_phase_fragment(lm_dir, predictor):
+    """/healthz carries the worker phase so the router can tell tiers
+    apart from the probe it already polls."""
+    from paddle_tpu.serving import ServingEngine, ServingServer
+
+    eng = ServingEngine(predictor, max_batch_size=2, batch_timeout_ms=1)
+    with _engine(predictor, prefix_cache=True) as gen:
+        gen.phase = "decode"
+        srv = ServingServer(eng, port=0, generation_engine=gen)
+        try:
+            with urllib.request.urlopen(
+                    srv.address + "/healthz", timeout=10) as r:
+                body = json.loads(r.read())
+            assert body["phase"] == "decode"
+        finally:
+            srv.close()
+            eng.close()
+        gen.cache.drop_trie()
+    _assert_drained(gen)

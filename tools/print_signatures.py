@@ -16,6 +16,7 @@ MODULES = [
     "paddle_tpu.flags",
     "paddle_tpu.serving",
     "paddle_tpu.generation",
+    "paddle_tpu.disagg",
     "paddle_tpu.resilience",
     "paddle_tpu.observability",
     "paddle_tpu.partition",

@@ -40,6 +40,12 @@ properties ISSUE 10 promises:
                     off, emitted tokens identical on-vs-off (greedy),
                     zero leaked pages after drain, and the
                     paddle_generation_radix_* gauge family populated.
+  disagg            shared-prefix flood against a split prefill/decode
+                    topology (paddle_tpu.disagg) vs a co-located
+                    oracle: greedy tokens identical, every request
+                    handed off with its KV pages streamed through the
+                    page store, both tiers visible in phase health,
+                    zero leaked pages after drain.
   rolling_restart   WorkerPool.rolling_restart under live closed-loop
                     load: zero failed in-flight requests, replacement
                     workers warm-start from the persistent compile
@@ -542,15 +548,21 @@ def run_mixed_tenant(pred, spec):
 # -- scenario: slow client over HTTP ----------------------------------------
 
 
+def _lm_cfg():
+    from paddle_tpu.generation.model import GPTConfig
+
+    return GPTConfig(vocab_size=89, hidden_size=32, num_layers=2,
+                     num_heads=4, ffn_size=64, max_position=1024,
+                     hidden_dropout=0.0, attention_dropout=0.0)
+
+
 def _build_lm_stack(tmp_dir, kv_dtype="float32", **gen_kw):
     import paddle_tpu as fluid
     from paddle_tpu.generation import GenerationEngine
-    from paddle_tpu.generation.model import GPTConfig, build_lm_program
+    from paddle_tpu.generation.model import build_lm_program
     from paddle_tpu.inference import Config, create_predictor
 
-    cfg = GPTConfig(vocab_size=89, hidden_size=32, num_layers=2,
-                    num_heads=4, ffn_size=64, max_position=1024,
-                    hidden_dropout=0.0, attention_dropout=0.0)
+    cfg = _lm_cfg()
     d = os.path.join(tmp_dir, "lm")
     if not os.path.isdir(d):
         main, startup, _feeds, fetches = build_lm_program(cfg, 32)
@@ -818,6 +830,83 @@ def run_shared_prefix(tmp_dir, spec):
     }
 
 
+# -- scenario: disaggregated prefill/decode ----------------------------------
+
+
+def run_disagg(tmp_dir, spec):
+    """Shared-prefix flood replayed against a split prefill/decode
+    topology (paddle_tpu.disagg) with a co-located engine as the
+    token-identity oracle. Gates: (1) every split request emits
+    greedy tokens IDENTICAL to the co-located engine's, (2) every
+    request went through a handoff and its pages shipped over the
+    store (handoffs == requests, pages pulled > 0), (3) the phase
+    health fragment exposes both tiers, and (4) drain leaves zero
+    pages on every engine with ``check_integrity`` green."""
+    import random
+
+    from paddle_tpu.disagg import (DecodeWorker, DisaggService,
+                                   HostPageStore, PrefillWorker)
+    from paddle_tpu.inference import Config, create_predictor
+
+    cfg = _lm_cfg()
+    pref_len = int(spec.get("prefix_tokens", 64))
+    max_new = int(spec.get("max_new_tokens", 12))
+    rng = random.Random(4321)
+    prompts = []
+    for k in range(int(spec.get("num_prefixes", 2))):
+        pre = [(i * 7 + k * 13) % 83 + 1 for i in range(pref_len)]
+        for _ in range(int(spec.get("requests_per_prefix", 4))):
+            n = rng.choice([2, 3, 3, 4, 5, 9])
+            prompts.append(pre + [rng.randrange(1, 84) for _ in range(n)])
+    rng.shuffle(prompts)
+
+    # co-located oracle: one engine does prefill AND decode
+    pred, gen = _build_lm_stack(tmp_dir, prefix_cache=True)
+    try:
+        oracle = [list(gen.generate(p, max_new, eos_id=None, timeout=300))
+                  for p in prompts]
+    finally:
+        gen.close(drain=False)
+
+    # split topology: one prefill worker + one decode worker over a
+    # host page store; the same flood arrives as a burst
+    d = os.path.join(tmp_dir, "lm")
+    store = HostPageStore(page_size=16)
+    kw = dict(page_size=16, num_pages=192, max_decode_batch=4,
+              chunk_tokens=16, warmup=False)
+    pf = PrefillWorker(create_predictor(Config(d)), cfg, store, **kw)
+    dw = DecodeWorker(create_predictor(Config(d)), cfg, store, **kw)
+    svc = DisaggService(prefill=[pf], decode=[dw])
+    try:
+        streams = [svc.submit(p, max_new_tokens=max_new, eos_id=None)
+                   for p in prompts]
+        toks = [list(s.result(timeout=300)) for s in streams]
+        stats = svc.stats_numeric()
+        phases = {h["phase"] for h in svc.phase_health()}
+    finally:
+        svc.close(drain=True)
+    leaked = 0
+    for w in svc._prefill + svc._decode:
+        w.engine.cache.check_integrity()
+        leaked += int(w.engine.stats()["cache"]["pages_in_use"])
+
+    identical = all(a == b for a, b in zip(toks, oracle))
+    return {
+        "requests": len(prompts),
+        "prefix_tokens": pref_len,
+        "max_new_tokens": max_new,
+        "tokens_identical": bool(identical),
+        "handoffs": int(stats["handoffs_total"]),
+        "handoff_failures": int(stats["handoff_failures_total"]),
+        "pages_shipped": int(stats["pages_shipped_total"]),
+        "pages_pulled": int(stats["pages_pulled_total"]),
+        "store_hit_rate": stats["store_hit_rate"],
+        "wire_ratio": stats.get("wire_ratio", 0.0),
+        "phases": sorted(phases),
+        "leaked_pages": leaked,
+    }
+
+
 # -- scenario: rolling restart under live load -------------------------------
 
 
@@ -939,7 +1028,8 @@ def main():
     ap.add_argument("--scenario", default="all",
                     choices=["all", "bursty_overload", "priority_mix",
                              "mixed_tenant", "slow_client",
-                             "shared_prefix", "rolling_restart"])
+                             "shared_prefix", "disagg",
+                             "rolling_restart"])
     ap.add_argument("--out", default=None, help="also write JSON here")
     args = ap.parse_args()
 
@@ -1064,6 +1154,23 @@ def main():
                   "shared_prefix": True})
         gates["slow_client_shared_sibling_intact"] = bool(
             result["slow_client_shared"]["ok"])
+
+    if args.scenario in ("all", "disagg"):
+        spec = {
+            "prefix_tokens": 64, "num_prefixes": 2,
+            "requests_per_prefix": 4, "max_new_tokens": 12,
+        }
+        result["disagg"] = run_disagg(tmp, spec)
+        r = result["disagg"]
+        gates["disagg_tokens_identical"] = bool(r["tokens_identical"])
+        gates["disagg_every_request_handed_off"] = (
+            r["handoffs"] == r["requests"]
+            and r["handoff_failures"] == 0)
+        gates["disagg_pages_streamed"] = (
+            r["pages_shipped"] > 0 and r["pages_pulled"] > 0)
+        gates["disagg_phases_exposed"] = (
+            r["phases"] == ["decode", "prefill"])
+        gates["disagg_zero_leaked_pages"] = r["leaked_pages"] == 0
 
     if args.scenario in ("all", "rolling_restart"):
         spec = {"workers": 2, "clients": 4}
