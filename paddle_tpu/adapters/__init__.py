@@ -1,0 +1,30 @@
+"""paddle_tpu.adapters — batched LoRA multiplexing + hot model swap.
+
+Multi-model serving from ONE engine (ROADMAP item 6): device-resident
+paged LoRA factor pools (``store.AdapterStore``), a one-shot program
+rewrite repointing the matmul/fc ops onto the batched-LoRA ops
+(``rewrite.rewrite_for_lora`` over kernels/lora.py), per-row adapter
+routing through the ragged step's ``gen_adapter_slots`` feed, and the
+serving/traffic tier's upload/evict + per-tenant adapter quotas.
+
+The hot-swap half (``GenerationEngine.swap_base``) lives with the
+engine: a signature-identical checkpoint is staged off-loop and the
+serving pointer flips between steps — scope-resident weights mean the
+flip is ``scope.set_var``, zero recompiles, zero dropped requests.
+
+See README "Multi-model serving" for the lifecycle, flags, gauges and
+quota syntax.
+"""
+
+from .rewrite import LoraReport, lora_targets, rewrite_for_lora
+from .store import (DEFAULT_RANK_BUCKETS, SLOTS_FEED, AdapterError,
+                    AdapterInUse, AdapterMissing, AdapterPoolFull,
+                    AdapterQuotaExceeded, AdapterStore, a_var_name,
+                    b_var_name, scale_var_name)
+
+__all__ = [
+    "AdapterStore", "AdapterError", "AdapterMissing", "AdapterPoolFull",
+    "AdapterQuotaExceeded", "AdapterInUse", "SLOTS_FEED",
+    "DEFAULT_RANK_BUCKETS", "a_var_name", "b_var_name", "scale_var_name",
+    "rewrite_for_lora", "lora_targets", "LoraReport",
+]

@@ -1,0 +1,241 @@
+"""One-shot LoRA program rewrite: repoint eligible matmul/fc ops onto
+the batched-LoRA ops so ONE ragged executable serves many adapters.
+
+``rewrite_for_lora(program, store)`` walks the program once (the
+quantize.rewrite eligibility walk, same op table) and, for every
+eligible consumer — ``mul`` / ``matmul`` / ``matmul_v2`` whose weight
+is a 2-D persistable, or an ALREADY-quantized ``quantized_fc`` /
+``quantized_matmul`` (the rewrite composes: the delta applies to the
+dequantized product) —
+
+  * repoints the op onto ``batched_lora_fc`` / ``batched_lora_matmul``
+    (kernels/lora.py), carrying the base op's attrs through so the
+    base computation stays BITWISE what it was (``base_kind`` records
+    dense vs int8/int8_block/fp8);
+  * wires the op's A/B/AdapterScale input slots onto the store's
+    per-bucket pool Parameters (created in the program once, list-
+    valued slots carrying one pool pair per rank bucket) and its Slots
+    slot onto the ``gen_adapter_slots`` data feed ([rows, n_buckets]
+    int32, assembled per step by the engine exactly like a block
+    table);
+  * records a per-op skip reason for everything left alone.
+
+NOTHING is erased (unlike the quantize rewrite, which drops fp32
+originals from the scope): the base weights keep serving every other
+program over the same scope, so only the RAGGED program needs
+rewriting and the predictor stays untouched. Idempotent — a second
+call finds only ``batched_lora_*`` consumers and changes nothing. The
+rewritten program passes strict proglint (ops registered, shapes
+re-inferable).
+
+Run order with quantization: quantize first, then LoRA — the walk
+recognizes the quantized op types and keys their pools by the LOGICAL
+weight name (``dec0_qkv.w``, not ``dec0_qkv.w.q``), which is the name
+adapter uploads use.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+from ..kernels.lora import lora_pool_shapes
+from .store import (SLOTS_FEED, AdapterStore, a_var_name, b_var_name,
+                    scale_var_name)
+
+__all__ = ["rewrite_for_lora", "lora_targets", "LoraReport"]
+
+# op type -> (new op type, the attr that makes it ineligible)
+_DENSE_OPS = {
+    "mul": ("batched_lora_fc", None),
+    "matmul": ("batched_lora_matmul", "transpose_Y"),
+    "matmul_v2": ("batched_lora_matmul", "trans_y"),
+}
+_QUANT_OPS = {
+    "quantized_fc": "batched_lora_fc",
+    "quantized_matmul": "batched_lora_matmul",
+}
+_LORA_OPS = {"batched_lora_fc", "batched_lora_matmul"}
+
+
+class LoraReport:
+    """What the rewrite did, per op: repointed (with target/base_kind)
+    or skipped (with the reason) — the QuantizeReport shape."""
+
+    def __init__(self):
+        self.rows: List[Dict[str, Any]] = []
+
+    def repointed(self, op_type, new_type, target, base_kind):
+        self.rows.append({"op": op_type, "action": "repointed",
+                          "new_op": new_type, "target": target,
+                          "base_kind": base_kind, "reason": None})
+
+    def skipped(self, op_type, target, reason):
+        self.rows.append({"op": op_type, "action": "skipped",
+                          "new_op": None, "target": target,
+                          "base_kind": None, "reason": reason})
+
+    @property
+    def n_repointed(self) -> int:
+        return sum(1 for r in self.rows if r["action"] == "repointed")
+
+    def targets(self) -> List[str]:
+        return sorted({r["target"] for r in self.rows
+                       if r["action"] == "repointed"})
+
+    def summary(self) -> Dict[str, Any]:
+        return {"ops_repointed": self.n_repointed,
+                "ops_skipped": len(self.rows) - self.n_repointed,
+                "targets": self.targets()}
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"summary": self.summary(), "ops": list(self.rows)}
+
+
+def _logical_target(qweight_name: str) -> str:
+    return qweight_name[:-2] if qweight_name.endswith(".q") \
+        else qweight_name
+
+
+def lora_targets(program) -> Dict[str, Tuple[int, int, bool]]:
+    """{logical weight name: (K, N, quantized)} for every weight an
+    eligible op consumes — the table ``AdapterStore.for_program``
+    builds pools against, derived with the same walk the rewrite uses
+    so the two can never disagree. Already-rewritten ``batched_lora_*``
+    consumers count too (idempotent re-derivation)."""
+    out: Dict[str, Tuple[int, int, bool]] = {}
+    for blk in program.blocks:
+        for op in blk.ops:
+            info = _classify(blk, op)
+            if info is None:
+                continue
+            _new_type, target, wname, _sname, base_kind = info
+            var = blk._find_var_recursive(wname)
+            if var is None:
+                continue
+            out[target] = (int(var.shape[0]), int(var.shape[1]),
+                           base_kind != "dense")
+    return out
+
+
+def _classify(blk, op):
+    """(new_type, logical_target, weight_var, scale_var|None, base_kind)
+    for an op the rewrite (or a re-derivation) cares about; None for
+    everything else. Eligibility filtering happens in the caller —
+    this only decodes the op's weight wiring."""
+    if op.type in _DENSE_OPS:
+        new_type, tattr = _DENSE_OPS[op.type]
+        ys = op.inputs.get("Y", [])
+        if len(ys) != 1 or (tattr and op.attrs.get(tattr, False)):
+            return None
+        var = blk._find_var_recursive(ys[0])
+        if var is None or not getattr(var, "persistable", False) \
+                or var.ndim != 2:
+            return None
+        return new_type, ys[0], ys[0], None, "dense"
+    if op.type in _QUANT_OPS:
+        qs = op.inputs.get("QWeight", [])
+        ss = op.inputs.get("Scale", [])
+        if len(qs) != 1 or len(ss) != 1:
+            return None
+        return (_QUANT_OPS[op.type], _logical_target(qs[0]), qs[0],
+                ss[0], str(op.attrs.get("quant_mode", "int8")))
+    if op.type in _LORA_OPS:
+        ws = op.inputs.get("W", [])
+        if len(ws) != 1:
+            return None
+        return (op.type, _logical_target(ws[0]), ws[0],
+                (op.inputs.get("WScale") or [None])[0],
+                str(op.attrs.get("base_kind", "dense")))
+    return None
+
+
+def _ensure_vars(program, store: AdapterStore):
+    """Create the slots feed + per-bucket pool Parameters in the
+    program's global block (once — re-runs find them present)."""
+    gb = program.global_block()
+    if not gb.has_var(SLOTS_FEED):
+        gb.create_var(name=SLOTS_FEED, shape=[-1, store.n_buckets],
+                      dtype="int32", is_data=True, stop_gradient=True)
+    for bi, rb in enumerate(store.rank_buckets):
+        s = store.slots[bi]
+        if not gb.has_var(scale_var_name(rb)):
+            gb.create_parameter(scale_var_name(rb), [s], "float32",
+                                trainable=False, stop_gradient=True)
+        for t, (k, n) in store.targets.items():
+            a_shape, b_shape = lora_pool_shapes(k, n, rb, s)
+            if gb.has_var(a_var_name(t, rb)):
+                continue
+            gb.create_parameter(a_var_name(t, rb), list(a_shape),
+                                "float32", trainable=False,
+                                stop_gradient=True)
+            gb.create_parameter(b_var_name(t, rb), list(b_shape),
+                                "float32", trainable=False,
+                                stop_gradient=True)
+
+
+def rewrite_for_lora(program, store: AdapterStore) -> LoraReport:
+    """Repoint every eligible matmul/fc op of ``program`` onto the
+    batched-LoRA ops wired to ``store``'s pools (see module
+    docstring). In place; idempotent; returns the ``LoraReport``."""
+    report = LoraReport()
+    rewrote = False
+    vars_made = False
+    for blk in program.blocks:
+        for op in blk.ops:
+            if op.type in _LORA_OPS:
+                info = _classify(blk, op)
+                report.skipped(op.type, info[1] if info else None,
+                               "already a batched-LoRA op")
+                continue
+            if op.type not in _DENSE_OPS and op.type not in _QUANT_OPS:
+                continue
+            info = _classify(blk, op)
+            if info is None:
+                # decode the skip reason for the report
+                if op.type in _DENSE_OPS:
+                    _nt, tattr = _DENSE_OPS[op.type]
+                    ys = op.inputs.get("Y", [])
+                    if tattr and op.attrs.get(tattr, False):
+                        report.skipped(op.type, ys[0] if ys else None,
+                                       f"{tattr}=True (transposed weight)")
+                    else:
+                        report.skipped(
+                            op.type, ys[0] if ys else None,
+                            "weight is not a 2-D persistable")
+                else:
+                    report.skipped(op.type, None,
+                                   "malformed quantized op wiring")
+                continue
+            new_type, target, wname, sname, base_kind = info
+            if target not in store.targets:
+                report.skipped(op.type, target,
+                               "not in the store's target table "
+                               "(shape mismatch or filtered)")
+                continue
+            if not vars_made:
+                _ensure_vars(program, store)
+                vars_made = True
+            a_names, b_names, sc_names = [], [], []
+            for rb in store.rank_buckets:
+                a_names.append(a_var_name(target, rb))
+                b_names.append(b_var_name(target, rb))
+                sc_names.append(scale_var_name(rb))
+            old_type = op.type
+            op.type = new_type
+            op.inputs = {
+                "X": list(op.inputs["X"]),
+                "W": [wname],
+                "WScale": [sname] if sname else [],
+                "A": a_names,
+                "B": b_names,
+                "AdapterScale": sc_names,
+                "Slots": [SLOTS_FEED],
+            }
+            op.attrs["base_kind"] = base_kind
+            if base_kind != "dense":
+                op.attrs.setdefault("quant_block", 0)
+            report.repointed(old_type, new_type, target, base_kind)
+            rewrote = True
+    if rewrote:
+        program._bump()
+    return report

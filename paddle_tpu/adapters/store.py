@@ -1,0 +1,460 @@
+"""AdapterStore — paged, device-resident LoRA (A, B) factor pools.
+
+The paged-KV block-table pattern applied to WEIGHTS: instead of one
+engine per fine-tune, every target weight of the serving program gets
+rank-bucketed factor POOLS (``A [slots, K, r]``, ``B [slots, r, N]``
+per bucket, plus a per-bucket ``scale [slots]`` = alpha/r vector), and
+each batch row names its adapter by SLOT through the
+``gen_adapter_slots`` feed — one ragged executable serves any adapter
+mix per micro-batch.
+
+Slot 0 of every bucket is the reserved ZERO adapter (all-zero factors,
+scale 0): base-only rows, rows owned by another rank bucket, and
+padding all point there and contribute an exact +0.0 delta.
+
+Residency mechanics (the PR-17/18 page-pool shape, for weights):
+
+* pools live in the SCOPE as non-trainable Parameters — upload/evict
+  is ``scope.set_var`` of the mutated pool, which bumps the scope
+  generation so the live BoundStep re-resolves its state operands on
+  the next step with ZERO recompiles (the program never changes shape);
+* upload picks the smallest bucket whose rank fits and zero-pads the
+  factors to the bucket rank; partial adapters (factors for a subset
+  of targets) are legal — uncovered targets keep zero rows;
+* slots are REFCOUNTED: the engine acquires on submit and releases at
+  request retirement, and ``evict`` refuses a live slot (force evicts
+  anyway — the serving row would silently lose its delta, so force is
+  for teardown, not steady state);
+* a full bucket auto-evicts its least-recently-used IDLE adapter
+  (refcount 0) before failing with ``AdapterPoolFull``;
+* per-tenant quotas mirror the PR-18 trie-quota shape: an over-quota
+  tenant self-evicts its OWN least-recently-used idle adapter rather
+  than raising, and only raises ``AdapterQuotaExceeded`` when every
+  one of its residents is pinned by in-flight rows.
+
+``for_program`` derives the target-weight table from a (possibly
+already quantize-rewritten) inference program, so the store's pool
+shapes always agree with what ``adapters.rewrite_for_lora`` wires in.
+Gauges ride ``watch_adapters`` (paddle_adapter_*).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..kernels.lora import lora_slot_bytes
+from ..observability import watch_adapters
+
+__all__ = ["AdapterStore", "AdapterError", "AdapterMissing",
+           "AdapterPoolFull", "AdapterQuotaExceeded", "AdapterInUse",
+           "SLOTS_FEED", "DEFAULT_RANK_BUCKETS",
+           "a_var_name", "b_var_name", "scale_var_name"]
+
+SLOTS_FEED = "gen_adapter_slots"
+DEFAULT_RANK_BUCKETS = (8, 16)
+
+
+def _device(a):
+    """Snapshot a host pool mirror as a DEVICE array for the scope.
+    The dispatch hot path passes scope state straight into the jitted
+    step: a jax.Array passes through by reference, while a numpy array
+    pays a fresh host->device copy on EVERY call — for megabytes of
+    factor pools that transfer, not the rank-r matmuls, would dominate
+    the step. One copy per upload/evict here buys zero per step. Pools
+    are read-only in the step (never in written_names), so they are
+    never donation-aliased and the cached array stays valid."""
+    try:
+        import jax.numpy as jnp
+
+        return jnp.asarray(a)
+    except Exception:  # pragma: no cover — jax-less host mirror mode
+        return np.asarray(a)
+
+
+class AdapterError(RuntimeError):
+    """Base for adapter-store failures (shed as kind="adapter" by the
+    traffic tier, 4xx/5xx by the serving tier)."""
+
+
+class AdapterMissing(AdapterError):
+    """The named adapter is not resident (upload it first)."""
+
+
+class AdapterPoolFull(AdapterError):
+    """No free slot and every resident adapter in the bucket is pinned
+    by in-flight rows."""
+
+
+class AdapterQuotaExceeded(AdapterError):
+    """The tenant is at its adapter quota and owns no idle adapter to
+    self-evict."""
+
+
+class AdapterInUse(AdapterError):
+    """Evict refused: the slot is referenced by in-flight rows."""
+
+
+def a_var_name(target: str, rank: int) -> str:
+    return f"adapter_a__{target}__r{int(rank)}"
+
+
+def b_var_name(target: str, rank: int) -> str:
+    return f"adapter_b__{target}__r{int(rank)}"
+
+
+def scale_var_name(rank: int) -> str:
+    return f"adapter_scale__r{int(rank)}"
+
+
+class _Resident:
+    __slots__ = ("adapter_id", "bucket", "slot", "rank", "alpha", "tenant",
+                 "refcount", "last_used", "targets", "bytes")
+
+    def __init__(self, adapter_id, bucket, slot, rank, alpha, tenant,
+                 targets, nbytes):
+        self.adapter_id = adapter_id
+        self.bucket = bucket          # index into rank_buckets
+        self.slot = slot
+        self.rank = rank              # the ACTUAL uploaded rank
+        self.alpha = alpha
+        self.tenant = tenant
+        self.refcount = 0
+        self.last_used = time.monotonic()
+        self.targets = targets        # tuple of covered target names
+        self.bytes = nbytes
+
+
+class AdapterStore:
+    """See module docstring. Thread-safe: the serving tier uploads and
+    evicts from HTTP threads while the engine loop reads slot rows."""
+
+    def __init__(self, targets: Dict[str, Tuple[int, int]], *,
+                 rank_buckets: Sequence[int] = DEFAULT_RANK_BUCKETS,
+                 max_bytes: int = 0,
+                 slots_per_bucket: Optional[int] = None,
+                 tenant_quota: int = 0):
+        if not targets:
+            raise AdapterError(
+                "AdapterStore: no target weights (the program has no "
+                "eligible matmul/fc weights — see rewrite_for_lora)")
+        self.targets = {str(n): (int(k), int(nn))
+                        for n, (k, nn) in targets.items()}
+        self.rank_buckets = tuple(sorted(int(r) for r in rank_buckets))
+        if not self.rank_buckets or min(self.rank_buckets) < 1:
+            raise AdapterError(
+                f"AdapterStore: bad rank_buckets {rank_buckets!r}")
+        self.tenant_quota = int(tenant_quota)
+        self._slot_bytes = [
+            sum(lora_slot_bytes(k, n, rb) for k, n in self.targets.values())
+            for rb in self.rank_buckets]
+        if slots_per_bucket is not None:
+            ns = [max(2, int(slots_per_bucket) + 1)] * len(self.rank_buckets)
+        else:
+            per = int(max_bytes) // max(len(self.rank_buckets), 1)
+            # slot 0 is the zero adapter: capacity = slots - 1. Never
+            # fewer than one usable slot per bucket — a cap too small
+            # for a single adapter would make the store stillborn
+            ns = [max(2, 1 + per // sb) for sb in self._slot_bytes]
+        self.slots = tuple(ns)
+        self.max_bytes = int(max_bytes)
+        self._lock = threading.RLock()
+        self._resident: Dict[str, _Resident] = {}
+        # per bucket: slot index -> adapter_id
+        self._slot_owner: List[Dict[int, str]] = [
+            {} for _ in self.rank_buckets]
+        self._scope = None
+        # host mirrors; pushed wholesale to the scope on every mutation
+        self._a = {}      # (target, bucket) -> np [S, K, rb] f32
+        self._b = {}      # (target, bucket) -> np [S, rb, N] f32
+        self._scale = []  # per bucket np [S] f32
+        for bi, rb in enumerate(self.rank_buckets):
+            s = self.slots[bi]
+            for t, (k, n) in self.targets.items():
+                self._a[(t, bi)] = np.zeros((s, k, rb), np.float32)
+                self._b[(t, bi)] = np.zeros((s, rb, n), np.float32)
+            self._scale.append(np.zeros(s, np.float32))
+        self._counters = dict(uploads=0, evictions=0, lru_evictions=0,
+                              quota_evictions=0, evict_refusals=0,
+                              misses=0)
+        watch_adapters(self)
+
+    # -- program/scope wiring ------------------------------------------------
+
+    @classmethod
+    def for_program(cls, program, **kw) -> "AdapterStore":
+        """Build a store whose targets are exactly the weights
+        ``rewrite_for_lora`` would repoint in ``program`` (dense OR
+        already quantize-rewritten)."""
+        from .rewrite import lora_targets
+
+        return cls({n: (k, nn) for n, (k, nn, _q) in
+                    lora_targets(program).items()}, **kw)
+
+    @property
+    def n_buckets(self) -> int:
+        return len(self.rank_buckets)
+
+    def pool_var_names(self) -> List[Tuple[str, str]]:
+        """Per (target, bucket): the (A, B) scope var names, in the
+        deterministic order the rewrite wires them."""
+        out = []
+        for t in sorted(self.targets):
+            for rb in self.rank_buckets:
+                out.append((a_var_name(t, rb), b_var_name(t, rb)))
+        return out
+
+    def attach(self, scope) -> None:
+        """Seed every pool + scale var into ``scope``. Later mutations
+        go through ``scope.set_var`` (scope-generation bump: the live
+        BoundStep re-resolves state, zero recompiles)."""
+        with self._lock:
+            self._scope = scope
+            for bi in range(self.n_buckets):
+                self._push(bi)
+
+    def _push(self, bucket: int) -> None:
+        if self._scope is None:
+            return
+        rb = self.rank_buckets[bucket]
+        for t in self.targets:
+            self._scope.set_var(a_var_name(t, rb),
+                                _device(self._a[(t, bucket)]))
+            self._scope.set_var(b_var_name(t, rb),
+                                _device(self._b[(t, bucket)]))
+        self._scope.set_var(scale_var_name(rb), _device(self._scale[bucket]))
+
+    # -- residency -----------------------------------------------------------
+
+    def upload(self, adapter_id: str, factors: Dict[str, Tuple[Any, Any]],
+               *, alpha: Optional[float] = None,
+               tenant: Optional[str] = None) -> Dict[str, Any]:
+        """Make ``adapter_id`` resident. ``factors`` maps target weight
+        name -> (A [K, r], B [r, N]); a subset of targets is legal
+        (uncovered targets contribute zero delta). Returns the
+        residency row (id/bucket/slot/rank/bytes)."""
+        adapter_id = str(adapter_id)
+        if not factors:
+            raise AdapterError(f"upload {adapter_id!r}: empty factors")
+        prep = {}
+        rank = None
+        for t, (a, b) in factors.items():
+            if t not in self.targets:
+                raise AdapterError(
+                    f"upload {adapter_id!r}: unknown target {t!r} "
+                    f"(known: {sorted(self.targets)})")
+            k, n = self.targets[t]
+            a = np.asarray(a, np.float32)
+            b = np.asarray(b, np.float32)
+            if a.ndim != 2 or b.ndim != 2 or a.shape[0] != k \
+                    or b.shape[1] != n or a.shape[1] != b.shape[0]:
+                raise AdapterError(
+                    f"upload {adapter_id!r}: target {t!r} wants "
+                    f"A [{k}, r] @ B [r, {n}], got A {a.shape} "
+                    f"B {b.shape}")
+            if rank is None:
+                rank = int(a.shape[1])
+            elif int(a.shape[1]) != rank:
+                raise AdapterError(
+                    f"upload {adapter_id!r}: mixed ranks across targets "
+                    f"({rank} vs {a.shape[1]} at {t!r}) — one adapter, "
+                    "one rank")
+            prep[t] = (a, b)
+        bucket = next((i for i, rb in enumerate(self.rank_buckets)
+                       if rb >= rank), None)
+        if bucket is None:
+            raise AdapterError(
+                f"upload {adapter_id!r}: rank {rank} exceeds the largest "
+                f"rank bucket {self.rank_buckets[-1]} "
+                "(adapter_rank_buckets flag)")
+        scale = float(alpha if alpha is not None else rank) / float(rank)
+        with self._lock:
+            if adapter_id in self._resident:
+                r = self._resident[adapter_id]
+                if r.refcount:
+                    raise AdapterInUse(
+                        f"upload {adapter_id!r}: already resident with "
+                        f"{r.refcount} in-flight rows — evict first")
+                self._evict_locked(adapter_id)
+            if tenant and self.tenant_quota > 0:
+                self._enforce_tenant_quota(tenant)
+            slot = self._take_slot(bucket, adapter_id)
+            rb = self.rank_buckets[bucket]
+            for t, (a, b) in prep.items():
+                pa, pb = self._a[(t, bucket)], self._b[(t, bucket)]
+                pa[slot] = 0.0
+                pb[slot] = 0.0
+                pa[slot, :, :rank] = a
+                pb[slot, :rank, :] = b
+            # untouched targets get explicit zero rows (a previous
+            # occupant of this slot may have covered them)
+            for t in self.targets:
+                if t not in prep:
+                    self._a[(t, bucket)][slot] = 0.0
+                    self._b[(t, bucket)][slot] = 0.0
+            self._scale[bucket][slot] = scale
+            res = _Resident(adapter_id, bucket, slot, rank,
+                            float(alpha if alpha is not None else rank),
+                            tenant, tuple(sorted(prep)),
+                            self._slot_bytes[bucket])
+            self._resident[adapter_id] = res
+            self._slot_owner[bucket][slot] = adapter_id
+            self._counters["uploads"] += 1
+            self._push(bucket)
+            return self._row(res)
+
+    def _take_slot(self, bucket: int, for_id: str) -> int:
+        owner = self._slot_owner[bucket]
+        for s in range(1, self.slots[bucket]):
+            if s not in owner:
+                return s
+        # bucket full: LRU-evict an idle resident
+        idle = sorted((r for r in self._resident.values()
+                       if r.bucket == bucket and r.refcount == 0),
+                      key=lambda r: r.last_used)
+        if not idle:
+            raise AdapterPoolFull(
+                f"upload {for_id!r}: rank-{self.rank_buckets[bucket]} "
+                f"bucket full ({self.slots[bucket] - 1} slots) and every "
+                "resident adapter is pinned by in-flight rows")
+        victim = idle[0]
+        self._evict_locked(victim.adapter_id)
+        self._counters["lru_evictions"] += 1
+        return victim.slot
+
+    def _enforce_tenant_quota(self, tenant: str) -> None:
+        mine = [r for r in self._resident.values() if r.tenant == tenant]
+        if len(mine) < self.tenant_quota:
+            return
+        idle = sorted((r for r in mine if r.refcount == 0),
+                      key=lambda r: r.last_used)
+        if not idle:
+            raise AdapterQuotaExceeded(
+                f"tenant {tenant!r} is at its adapter quota "
+                f"({self.tenant_quota}) and every resident adapter is "
+                "pinned by in-flight rows")
+        # the PR-18 trie-quota shape: over-quota publishes self-evict
+        # the tenant's OWN least-recently-used idle adapter
+        self._evict_locked(idle[0].adapter_id)
+        self._counters["quota_evictions"] += 1
+
+    def evict(self, adapter_id: str, force: bool = False) -> Dict[str, Any]:
+        with self._lock:
+            r = self._resident.get(str(adapter_id))
+            if r is None:
+                self._counters["misses"] += 1
+                raise AdapterMissing(f"evict: {adapter_id!r} not resident")
+            if r.refcount and not force:
+                self._counters["evict_refusals"] += 1
+                raise AdapterInUse(
+                    f"evict {adapter_id!r}: {r.refcount} in-flight rows "
+                    "reference it (force=true to tear down anyway)")
+            row = self._row(r)
+            self._evict_locked(r.adapter_id)
+            return row
+
+    def _evict_locked(self, adapter_id: str) -> None:
+        r = self._resident.pop(adapter_id)
+        self._slot_owner[r.bucket].pop(r.slot, None)
+        for t in self.targets:
+            self._a[(t, r.bucket)][r.slot] = 0.0
+            self._b[(t, r.bucket)][r.slot] = 0.0
+        self._scale[r.bucket][r.slot] = 0.0
+        self._counters["evictions"] += 1
+        self._push(r.bucket)
+
+    # -- per-request pinning -------------------------------------------------
+
+    def acquire(self, adapter_id: str) -> None:
+        """Pin ``adapter_id`` for one in-flight request (engine submit
+        path). Raises AdapterMissing when not resident — the admission
+        layer turns that into a shed, not a 500 mid-batch."""
+        with self._lock:
+            r = self._resident.get(str(adapter_id))
+            if r is None:
+                self._counters["misses"] += 1
+                raise AdapterMissing(
+                    f"adapter {adapter_id!r} is not resident — upload it "
+                    "via /v1/admin/adapters first")
+            r.refcount += 1
+            r.last_used = time.monotonic()
+
+    def release(self, adapter_id: str) -> None:
+        with self._lock:
+            r = self._resident.get(str(adapter_id))
+            if r is not None and r.refcount > 0:
+                r.refcount -= 1
+                r.last_used = time.monotonic()
+
+    def is_resident(self, adapter_id: str) -> bool:
+        """Side-effect-free residency probe (no refcount, no LRU
+        touch) — the traffic layer's admission check."""
+        with self._lock:
+            return str(adapter_id) in self._resident
+
+    def slots_row(self, adapter_id: Optional[str]) -> np.ndarray:
+        """The [n_buckets] int32 slot vector one batch row feeds:
+        zeros (the zero adapter everywhere) for base-only rows, else
+        the adapter's slot in its bucket's column."""
+        row = np.zeros(self.n_buckets, np.int32)
+        if adapter_id is None:
+            return row
+        with self._lock:
+            r = self._resident.get(str(adapter_id))
+            if r is None:
+                self._counters["misses"] += 1
+                raise AdapterMissing(
+                    f"adapter {adapter_id!r} vanished from the store "
+                    "while rows were in flight (force-evicted?)")
+            r.last_used = time.monotonic()
+            row[r.bucket] = r.slot
+            return row
+
+    # -- introspection -------------------------------------------------------
+
+    def _row(self, r: _Resident) -> Dict[str, Any]:
+        return {"id": r.adapter_id, "rank": r.rank,
+                "rank_bucket": self.rank_buckets[r.bucket],
+                "slot": r.slot, "alpha": r.alpha, "tenant": r.tenant,
+                "refcount": r.refcount, "bytes": r.bytes,
+                "targets": list(r.targets)}
+
+    def resident(self) -> List[Dict[str, Any]]:
+        """The /healthz ``models.adapters`` fragment: id/rank/bytes per
+        resident adapter, so a router can place by residency."""
+        with self._lock:
+            return [self._row(r) for r in
+                    sorted(self._resident.values(),
+                           key=lambda r: r.adapter_id)]
+
+    def used_bytes(self) -> int:
+        with self._lock:
+            return sum(r.bytes for r in self._resident.values())
+
+    def capacity_bytes(self) -> int:
+        return sum((s - 1) * sb
+                   for s, sb in zip(self.slots, self._slot_bytes))
+
+    def stats_numeric(self) -> Dict[str, float]:
+        with self._lock:
+            c = dict(self._counters)
+            return {
+                "resident": float(len(self._resident)),
+                "pinned": float(sum(1 for r in self._resident.values()
+                                    if r.refcount)),
+                "active_refs": float(sum(r.refcount for r in
+                                         self._resident.values())),
+                "used_bytes": float(sum(r.bytes for r in
+                                        self._resident.values())),
+                "capacity_bytes": float(self.capacity_bytes()),
+                "capacity_slots": float(sum(s - 1 for s in self.slots)),
+                "uploads_total": float(c["uploads"]),
+                "evictions_total": float(c["evictions"]),
+                "lru_evictions_total": float(c["lru_evictions"]),
+                "quota_evictions_total": float(c["quota_evictions"]),
+                "evict_refusals_total": float(c["evict_refusals"]),
+                "misses_total": float(c["misses"]),
+            }

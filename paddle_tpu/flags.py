@@ -122,6 +122,29 @@ _FLAG_DEFS: Dict[str, Any] = {
     # GenerationEngine(quantize_weights=...).
     "quantize_weights": "off",
     "quantize_block": 256,
+    # paddle_tpu.adapters (batched LoRA multiplexing, ragged engine
+    # only): adapter_pool_max_bytes > 0 builds an AdapterStore of
+    # device-resident rank-bucketed (A, B) factor pools at engine
+    # construction, rewrites the ragged program onto the
+    # batched_lora_fc/batched_lora_matmul ops (composes with
+    # quantize_weights — the delta applies to the dequantized
+    # product), and threads the per-row gen_adapter_slots feed
+    # through the ragged step so ONE executable serves a different
+    # adapter per batch row. adapter_rank_buckets names the bucket
+    # ranks ("8,16"): an upload lands in the smallest bucket its rank
+    # fits, zero-padded. adapter_slots_per_bucket > 0 overrides the
+    # byte-derived per-bucket capacity (adapters per bucket, excluding
+    # the reserved zero slot). adapter_tenant_quota caps RESIDENT
+    # adapters per tenant: an over-quota tenant self-evicts its own
+    # LRU idle adapter (the trie-quota shape). traffic_adapter_quotas
+    # is the traffic tier's per-(tenant, adapter) admission table
+    # ("alice:summarize=10:20,*:translate=5" — name:adapter=rate[:burst],
+    # "*" matches any tenant); "" = no per-adapter admission.
+    "adapter_pool_max_bytes": 0,
+    "adapter_rank_buckets": "8,16",
+    "adapter_slots_per_bucket": 0,
+    "adapter_tenant_quota": 0,
+    "traffic_adapter_quotas": "",
     # resilience/supervisor.py defaults (overridable per Supervisor /
     # CheckpointPolicy): checkpoint cadence is every-N-steps OR
     # every-T-seconds, whichever fires first (0 disables that trigger);

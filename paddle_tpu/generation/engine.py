@@ -208,10 +208,10 @@ class _GenRequest:
     __slots__ = ("prompt", "orig_prompt", "max_new", "eos_id", "deadline",
                  "stream", "enqueue_t", "slot", "pending", "n_generated",
                  "ctx", "admit_seq", "last_tok_t", "prefill_off", "drafts",
-                 "tenant", "store_checked")
+                 "tenant", "store_checked", "adapter")
 
     def __init__(self, prompt, max_new, eos_id, deadline, stream, ctx,
-                 tenant=None):
+                 tenant=None, adapter=None):
         self.prompt = prompt            # context to prefill (grows on resume)
         self.orig_prompt = prompt       # the caller's prompt, immutable
         self.max_new = max_new
@@ -229,6 +229,7 @@ class _GenRequest:
         self.drafts = None              # this step's speculative proposals
         self.tenant = tenant            # traffic identity (trie quotas)
         self.store_checked = False      # page-store consult done once
+        self.adapter = adapter          # resident LoRA adapter id (or None)
 
 
 class GenerationMetrics:
@@ -357,6 +358,7 @@ class GenerationEngine:
                  quantize_weights: Optional[str] = None,
                  prefix_cache: Optional[bool] = None,
                  page_store=None, phase: Optional[str] = None,
+                 adapter_store=None, model_version: Optional[str] = None,
                  warmup: bool = False, start: bool = True):
         from ..flags import flag
 
@@ -528,6 +530,48 @@ class GenerationEngine:
                 prog, self._scope, wdtype=self.quantize_weights,
                 block=self._quant_block)
 
+        # batched LoRA multiplexing (paddle_tpu.adapters): pools built
+        # and the RAGGED program repointed AFTER the quantize seam, so
+        # the lora rewrite sees the quantized ops and composes (the
+        # adapter delta applies to the dequantized product). Nothing
+        # is erased: the predictor's program keeps serving the same
+        # scope untouched. Per-row slots ride the gen_adapter_slots
+        # feed; the pools are scope-resident state, so upload/evict
+        # (and the base swap below) are scope.set_var — the live
+        # BoundStep re-resolves, zero recompiles.
+        self.adapter_store = adapter_store
+        self.lora_report = None
+        if self.adapter_store is None and self.mode == "ragged" \
+                and int(flag("adapter_pool_max_bytes")) > 0:
+            from ..adapters import AdapterStore
+
+            buckets = tuple(
+                int(x) for x in
+                str(flag("adapter_rank_buckets")).split(",") if x)
+            self.adapter_store = AdapterStore.for_program(
+                self._ragged_prog,
+                rank_buckets=buckets or (8, 16),
+                max_bytes=int(flag("adapter_pool_max_bytes")),
+                slots_per_bucket=(
+                    int(flag("adapter_slots_per_bucket")) or None),
+                tenant_quota=int(flag("adapter_tenant_quota")))
+        if self.adapter_store is not None:
+            if self.mode != "ragged":
+                raise ValueError(
+                    "adapter multiplexing requires the ragged engine "
+                    "(generation_engine_mode='ragged')")
+            from ..adapters import rewrite_for_lora
+
+            self.adapter_store.attach(self._scope)
+            self.lora_report = rewrite_for_lora(self._ragged_prog,
+                                                self.adapter_store)
+        # hot base-model swap: a staged signature-identical checkpoint
+        # is applied by the LOOP thread between steps (_pending_swap),
+        # so no in-flight batch ever sees half-old half-new weights
+        self.model_version = str(model_version or "base")
+        self.model_swaps = 0
+        self._pending_swap = None
+
         self._cond = threading.Condition()
         self._queue: "collections.deque[_GenRequest]" = collections.deque()
         self._by_slot: Dict[int, _GenRequest] = {}
@@ -597,14 +641,18 @@ class GenerationEngine:
     def submit(self, prompt, max_new_tokens: Optional[int] = None,
                eos_id: Optional[int] = "default",  # type: ignore[assignment]
                deadline_ms: Optional[float] = None,
-               on_token=None, tenant: Optional[str] = None
-               ) -> GenerationStream:
+               on_token=None, tenant: Optional[str] = None,
+               adapter: Optional[str] = None) -> GenerationStream:
         """Admit one prompt (1-D int sequence). Raises ``Overloaded``
         when the admission queue is full OR when the prompt + budget
         could never fit the page pool — both BEFORE any prefill
         work; raises ``EngineClosed`` after close(). ``tenant`` is the
         traffic-tier identity trie publishes are attributed to (the
-        per-tenant quota unit)."""
+        per-tenant quota unit). ``adapter`` names a RESIDENT LoRA
+        adapter every row of this request decodes through (raises
+        ``AdapterMissing`` before any queueing when it is not); the
+        adapter is refcount-pinned until the request's terminal state,
+        so evict cannot pull the factors out from under it."""
         from ..observability import tracing
 
         prompt = np.asarray(prompt, dtype=np.int64).reshape(-1)
@@ -630,32 +678,53 @@ class GenerationEngine:
                 f"(generation_num_pages x generation_page_size)")
         deadline = (time.monotonic() + deadline_ms / 1e3
                     if deadline_ms is not None else None)
+        if adapter is not None:
+            if self.adapter_store is None:
+                raise ValueError(
+                    f"request names adapter {adapter!r} but this engine "
+                    "has no adapter store (set adapter_pool_max_bytes "
+                    "or pass adapter_store=)")
+            # pin BEFORE queueing (raises AdapterMissing when not
+            # resident); released exactly once at the stream's terminal
+            # state — every retirement path funnels through _finish
+            self.adapter_store.acquire(adapter)
         stream = GenerationStream(self, on_token=on_token)
+        if adapter is not None:
+            stream.add_done_callback(
+                lambda _s, _a=adapter: self.adapter_store.release(_a))
         with (tracing.span("generation/submit", {"prompt": int(prompt.size),
                                                  "max_new": max_new})
               if tracing.enabled() else contextlib.nullcontext()) as ctx:
             req = _GenRequest(prompt, max_new, eos, deadline, stream, ctx,
-                              tenant=tenant)
-            with self._cond:
-                if self._closed:
-                    raise EngineClosed("GenerationEngine is closed")
-                if len(self._queue) >= self.queue_capacity:
-                    self.metrics.inc("rejected_total")
-                    raise Overloaded(
-                        f"generation queue full ({self.queue_capacity} "
-                        "pending); retry with backoff or raise "
-                        "generation_queue_capacity")
-                self._queue.append(req)
-                self.metrics.inc("requests_total")
-                self._cond.notify_all()
+                              tenant=tenant, adapter=adapter)
+            try:
+                with self._cond:
+                    if self._closed:
+                        raise EngineClosed("GenerationEngine is closed")
+                    if len(self._queue) >= self.queue_capacity:
+                        self.metrics.inc("rejected_total")
+                        raise Overloaded(
+                            f"generation queue full ({self.queue_capacity} "
+                            "pending); retry with backoff or raise "
+                            "generation_queue_capacity")
+                    self._queue.append(req)
+                    self.metrics.inc("requests_total")
+                    self._cond.notify_all()
+            except BaseException:
+                # rejected before the queue owned it: unpin here (the
+                # stream never reaches a terminal state)
+                if adapter is not None:
+                    self.adapter_store.release(adapter)
+                raise
         return stream
 
     def generate(self, prompt, max_new_tokens: Optional[int] = None,
                  eos_id="default", deadline_ms: Optional[float] = None,
-                 timeout: Optional[float] = None) -> List[int]:
+                 timeout: Optional[float] = None,
+                 adapter: Optional[str] = None) -> List[int]:
         """Synchronous submit + result."""
         return self.submit(prompt, max_new_tokens, eos_id,
-                           deadline_ms).result(timeout)
+                           deadline_ms, adapter=adapter).result(timeout)
 
     # -- introspection -------------------------------------------------------
     def queue_depth(self) -> int:
@@ -684,6 +753,7 @@ class GenerationEngine:
         out["cache"] = self.cache.stats()
         # flattened by the registry into paddle_generation_radix_*
         out["radix"] = self.cache.radix_stats()
+        out["model_swaps"] = self.model_swaps
         if self._page_store is not None:
             lk = self.store_lookups_total
             # flattened into paddle_generation_store_* — this WORKER's
@@ -704,17 +774,115 @@ class GenerationEngine:
         the registry; this just merges cache stats in)."""
         return self.stats()
 
+    def models_fragment(self) -> Dict[str, Any]:
+        """The /healthz ``models`` fragment: base-model identity
+        (program fingerprint + swap lineage) and the resident-adapter
+        table — what a router needs to place by adapter residency
+        instead of round-robin."""
+        from ..runtime.dispatch import program_fingerprint
+
+        prog = (self._ragged_prog if self.mode == "ragged"
+                else self._decode_prog)
+        return {
+            "base": {
+                "fingerprint": program_fingerprint(prog)[:12],
+                "version": self.model_version,
+                "swaps": int(self.model_swaps),
+                "quantized": self.quantize_weights,
+            },
+            "phase": self.phase,
+            "adapters": (self.adapter_store.resident()
+                         if self.adapter_store is not None else []),
+        }
+
+    # -- hot base-model swap -------------------------------------------------
+    def swap_base(self, weights: Dict[str, Any], *,
+                  version: Optional[str] = None,
+                  timeout: Optional[float] = 60.0) -> str:
+        """Zero-downtime base-model swap: load a SIGNATURE-IDENTICAL
+        checkpoint under live traffic. Heavy staging (array conversion
+        and — when the base is quantized — re-quantization into the
+        scope's exact mode/block) happens on THIS thread; the step
+        loop applies the staged values between steps, so no in-flight
+        batch ever mixes old and new weights and no request drops.
+
+        Signature-identical means every name already lives in the
+        scope with the same shape: the program, its fingerprint and
+        the live BoundStep are untouched, so the swap costs ZERO new
+        compile-cache entries (the rolling-restart warm-start proof,
+        without the restart). Returns the new model version label."""
+        meta = getattr(self._scope, "_quantize_meta", None) or {}
+        staged = {}
+        for name, val in weights.items():
+            val = np.asarray(val)
+            if name in meta:
+                # quantized base: the serving buffers are {name}.q /
+                # {name}.qscale — re-quantize into the scope's format
+                from ..kernels.quant_matmul import quantize_weight
+
+                wdtype, block = meta[name]
+                q, s = quantize_weight(val, wdtype, block)
+                staged[name + ".q"] = q
+                staged[name + ".qscale"] = s
+                continue
+            cur = self._scope.find_var(name)
+            if cur is None:
+                raise ValueError(
+                    f"swap_base: {name!r} is not a scope-resident "
+                    "weight — a hot swap must be signature-identical "
+                    "(same architecture, same var names)")
+            if tuple(np.shape(cur)) != tuple(val.shape):
+                raise ValueError(
+                    f"swap_base: {name!r} shape {tuple(val.shape)} != "
+                    f"serving shape {tuple(np.shape(cur))} — not "
+                    "signature-identical; roll a new engine instead")
+            staged[name] = val
+        label = str(version) if version is not None \
+            else f"swap-{self.model_swaps + 1}"
+        done = threading.Event()
+        with self._cond:
+            if self._started and not self._closed:
+                if self._pending_swap is not None:
+                    raise RuntimeError(
+                        "swap_base: another swap is already staged")
+                self._pending_swap = (staged, label, done)
+                self._cond.notify_all()
+            else:
+                # no loop running: apply inline (construction-time
+                # load, or a drained engine)
+                self._apply_swap(staged, label, done)
+        if not done.wait(timeout if timeout is not None else 1e9):
+            raise TimeoutError(
+                f"swap_base: step loop did not apply the swap within "
+                f"{timeout}s")
+        return label
+
+    def _apply_swap(self, staged: Dict[str, Any], label: str,
+                    done: threading.Event) -> None:
+        for name, val in staged.items():
+            self._scope.set_var(name, val)
+        self.model_swaps += 1
+        self.model_version = label
+        done.set()
+
     # -- the step loop -------------------------------------------------------
     def _loop(self):
         try:
             while True:
                 with self._cond:
                     while (not self._queue and not self._by_slot
-                           and not self._stop and not self._closed):
+                           and not self._stop and not self._closed
+                           and self._pending_swap is None):
                         self._cond.wait(0.05)
                     if self._stop or (self._closed and not self._queue
                                       and not self._by_slot):
                         break
+                    swap, self._pending_swap = self._pending_swap, None
+                if swap is not None:
+                    # the serving pointer flips BETWEEN steps, on the
+                    # loop thread: no in-flight batch ever reads a
+                    # half-swapped scope
+                    self._apply_swap(*swap)
                 if self.mode == "ragged":
                     self._admit_ragged()
                     if self._by_slot:
@@ -732,6 +900,11 @@ class GenerationEngine:
             # nobody will ever serve
             with self._cond:
                 self._closed = True
+                swap, self._pending_swap = self._pending_swap, None
+            if swap is not None:
+                # a swap staged against a closing engine still lands
+                # (scope outlives the loop) so its waiter never hangs
+                self._apply_swap(*swap)
             self._fail_queued(EngineClosed(
                 "engine closed before the request was served"))
             for slot, req in list(self._by_slot.items()):
@@ -1080,6 +1253,20 @@ class GenerationEngine:
             self._grow_or_evict(slot)
         if not self._by_slot:
             return
+        if self.adapter_store is not None:
+            # a force-evicted adapter fails ITS rows here, before they
+            # cost a step — never the whole batch
+            from ..adapters import AdapterMissing
+
+            for slot, req in list(self._by_slot.items()):
+                if req.adapter is None:
+                    continue
+                try:
+                    self.adapter_store.slots_row(req.adapter)
+                except AdapterMissing as e:
+                    self._retire(slot, "error", ServingError(str(e)))
+            if not self._by_slot:
+                return
         # batched drafting: ONE propose() call covers every
         # speculative row, so draft cost amortizes over the batch
         spec_rows = [(s, r, k) for s, r, k in spec_rows
@@ -1132,6 +1319,15 @@ class GenerationEngine:
             "gen_block_tables": np.ascontiguousarray(
                 self.cache.block_tables),
         }
+        if self.adapter_store is not None:
+            # per-row adapter slots, fed exactly like a block table:
+            # zeros = the reserved zero adapter (base-only rows / idle
+            # lanes), so the base path is identity by construction
+            aslots = np.zeros((R, self.adapter_store.n_buckets), np.int32)
+            for slot, req in self._by_slot.items():
+                if req.adapter is not None:
+                    aslots[slot] = self.adapter_store.slots_row(req.adapter)
+            feed["gen_adapter_slots"] = aslots
         for li in range(L):
             feed[f"gen_k_pages_{li}"] = self.cache.k_pages[li]
             feed[f"gen_v_pages_{li}"] = self.cache.v_pages[li]

@@ -24,6 +24,12 @@ attention/ffn/layer_norm/adam/softmax-ce):
     (kernels/quant_matmul.py): dequantize-in-registers inside the
     matmul tile loop, the kernel layer under
     paddle_tpu.quantize.rewrite_for_inference's quantized serving path
+  * batched LoRA matmul — per-row adapter deltas over rank-bucketed,
+    device-resident (A, B) factor pools indexed by a per-row slot
+    vector fed like a block table (kernels/lora.py): slot-masked
+    small-rank matmuls accumulated in VMEM, composing with the
+    dense OR quantized base — the kernel layer under
+    paddle_tpu.adapters' multi-adapter serving
   * fused optimizer — one-pass Adam/AdamW/Momentum over donated
     buffers (kernels/fused_optim.py): the whole m/v/param update is a
     single Pallas pass per parameter with the global-norm-clip scale
@@ -42,6 +48,9 @@ from .flash_attention import flash_attention, flash_attention_layer
 from .fused_optim import (fused_adam_update, fused_momentum_update,
                           optimizer_fuse_enabled)
 from .layer_norm import fused_layer_norm, layer_norm_pallas
+from .lora import (batched_lora_delta, batched_lora_matmul,
+                   lora_pool_shapes, lora_rank_geometry_issue,
+                   lora_slot_bytes)
 from .quant_matmul import (dequantize_weight, quantize_weight,
                            quantized_matmul, quantized_weight_bytes)
 from .paged_attention import (kv_cache_write, kv_cache_write_layer,

@@ -296,6 +296,113 @@ declare_constraints(
 )(_check_quant_matmul)
 
 
+def _check_batched_lora(call: KernelCall) -> List[Finding]:
+    from .lora import LORA_BASE_KINDS, lora_rank_geometry_issue
+
+    out: List[Finding] = []
+    kind = str(call.attr("base_kind", "dense"))
+    if kind not in LORA_BASE_KINDS:
+        out.append(("PTL093",
+                    f"{call.op_type}: base_kind {kind!r} is not one of "
+                    f"{LORA_BASE_KINDS}", None))
+        return out
+    w = call.shape("W")
+    K = N = None
+    if w is not None:
+        if len(w) != 2:
+            out.append(("PTL093",
+                        f"{call.op_type}: W must be 2-D [K, N], got rank "
+                        f"{len(w)}", None))
+            return out
+        K, N = w
+    a, b = call.shape("A"), call.shape("B")
+    rank = slots = None
+    if a is not None:
+        if len(a) != 3:
+            out.append((
+                "PTL093",
+                f"{call.op_type}: A pool must be [slots, K, rank], got "
+                f"rank {len(a)}", None))
+        else:
+            slots, rank = _static_dim(a[0]), _static_dim(a[2])
+            if K is not None and _static_dim(a[1]) not in (None, K):
+                out.append((
+                    "PTL093",
+                    f"{call.op_type}: A pool K={a[1]} does not match the "
+                    f"base weight's K={K}", None))
+    if b is not None:
+        if len(b) != 3:
+            out.append((
+                "PTL093",
+                f"{call.op_type}: B pool must be [slots, rank, N], got "
+                f"rank {len(b)}", None))
+        else:
+            if rank is not None and _static_dim(b[1]) not in (None, rank):
+                out.append((
+                    "PTL093",
+                    f"{call.op_type}: B pool rank {b[1]} != A pool rank "
+                    f"{rank} — the factor pools were built for different "
+                    "rank buckets", None))
+            if N is not None and _static_dim(b[2]) not in (None, N):
+                out.append((
+                    "PTL093",
+                    f"{call.op_type}: B pool N={b[2]} does not match the "
+                    f"base weight's N={N}", None))
+            if slots is not None and _static_dim(b[0]) not in (None, slots):
+                out.append((
+                    "PTL093",
+                    f"{call.op_type}: B pool has {b[0]} slots but A has "
+                    f"{slots} — one eviction updated half a bucket?", None))
+    sc = call.shape("AdapterScale")
+    if (sc is not None and slots is not None
+            and _numel(sc) not in (None, slots)):
+        out.append((
+            "PTL093",
+            f"{call.op_type}: AdapterScale shape {sc} must hold one "
+            f"scalar per slot ({slots})", None))
+    if rank is not None:
+        issue = lora_rank_geometry_issue(rank)
+        if issue:
+            import os
+
+            # mirror of the int8_block stance: with the reference
+            # fallback available the kernel is lost (PTL092); under
+            # FORCE_PALLAS there is no fallback and the delta raises
+            # outright (PTL091) — never a silent wrong answer
+            if os.environ.get("PADDLE_TPU_FORCE_PALLAS") == "1":
+                out.append((
+                    "PTL091",
+                    f"{call.op_type}: {issue} (PADDLE_TPU_FORCE_PALLAS=1: "
+                    "no reference fallback — the lowering raises)", None))
+            else:
+                out.append(("PTL092", f"{call.op_type}: {issue}", None))
+    if K is not None and rank is not None:
+        # x tile + one (A, B) factor pair + f32 acc scratch + out tile
+        est = 4 * (256 * K + K * rank + rank * LANES + 2 * 256 * LANES)
+        if est > VMEM_BUDGET_BYTES:
+            out.append((
+                "PTL094",
+                f"{call.op_type}: tile VMEM estimate {est} B (K={K}, "
+                f"rank={rank}) exceeds the per-core budget "
+                f"{VMEM_BUDGET_BYTES} B", None))
+    return out
+
+
+declare_constraints(
+    "batched_lora_matmul",
+    "W 2-D [K,N]; A/B pools [S,K,r]/[S,r,N] with matching S/K/N/r; "
+    "AdapterScale one scalar per slot; rank an 8-multiple (else "
+    "reference fallback, a raise under FORCE_PALLAS); tile VMEM within "
+    "budget",
+)(_check_batched_lora)
+
+declare_constraints(
+    "batched_lora_fc",
+    "same geometry as batched_lora_matmul (the `mul` twin: X flattened "
+    "at x_num_col_dims)",
+)(_check_batched_lora)
+
+
 @declare_constraints(
     "flash_attention",
     "Q/K/V [B, S, H*D] with H*D % num_heads == 0; per-(b,h) K/V panel "

@@ -31,7 +31,7 @@ from . import flight, registry, tracing
 from .flight import dump as flight_dump
 from .flight import install_signal_handlers
 from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
-                       overlap_telemetry, step_telemetry,
+                       overlap_telemetry, step_telemetry, watch_adapters,
                        watch_collectives, watch_coordinator, watch_disagg,
                        watch_engine, watch_executor, watch_generation,
                        watch_loader, watch_partition, watch_serving,
@@ -47,7 +47,8 @@ __all__ = [
     "watch_serving", "watch_engine", "watch_executor", "watch_supervisor",
     "watch_loader", "watch_generation", "watch_partition",
     "watch_collectives", "watch_coordinator", "watch_traffic",
-    "watch_disagg", "step_telemetry", "overlap_telemetry", "snapshot",
+    "watch_disagg", "watch_adapters", "step_telemetry",
+    "overlap_telemetry", "snapshot",
     "to_prometheus_text",
 ]
 

@@ -299,6 +299,7 @@ _collectives: "weakref.WeakSet" = weakref.WeakSet()
 _traffic: "weakref.WeakSet" = weakref.WeakSet()
 _coordinators: "weakref.WeakSet" = weakref.WeakSet()
 _disagg: "weakref.WeakSet" = weakref.WeakSet()
+_adapters: "weakref.WeakSet" = weakref.WeakSet()
 
 
 def watch_serving(metrics) -> None:
@@ -353,6 +354,17 @@ def watch_disagg(obj) -> None:
     latency quantiles."""
     _obs_id(obj)
     _disagg.add(obj)
+
+
+def watch_adapters(store) -> None:
+    """Called by adapters.AdapterStore.__init__: residency + pool
+    accounting export as the ``paddle_adapter_*{store=}`` family —
+    resident/pinned adapter counts, used vs capacity pool bytes, and
+    the upload/evict churn counters (LRU and tenant-quota self-evicts
+    broken out) — so "which adapters live where and is the pool
+    thrashing" is the same one scrape the router reads."""
+    _obs_id(store)
+    _adapters.add(store)
 
 
 def watch_partition(resolved) -> None:
@@ -555,6 +567,11 @@ def _collect_disagg():
                     lambda s: s.stats_numeric())
 
 
+def _collect_adapters():
+    return _labeled(_adapters, "store", "paddle_adapter",
+                    lambda s: s.stats_numeric())
+
+
 def _collect_build_info():
     from .. import version
 
@@ -575,6 +592,7 @@ for _name, _fn in (
     ("traffic", _collect_traffic),
     ("dist", _collect_dist),
     ("disagg", _collect_disagg),
+    ("adapter", _collect_adapters),
     ("build_info", _collect_build_info),
 ):
     _REGISTRY.register_collector(_name, _fn)

@@ -11,7 +11,8 @@ three endpoints a serving deployment actually needs:
                           504 deadline exceeded
                           400 malformed request
     POST /v1/generate  {"tokens": [..], "max_new_tokens": n, "eos_id": id,
-                        "deadline_ms": n, "stream": true}
+                        "deadline_ms": n, "stream": true,
+                        "adapter"/"model": "summarize-v3"}
                        -> 200 chunked application/x-ndjson: one
                           {"index": i, "token": t} line per token AS IT
                           IS SAMPLED (first line lands at
@@ -26,6 +27,22 @@ three endpoints a serving deployment actually needs:
                           (same usage fragment).
                           Requires a GenerationEngine
                           (ServingServer(..., generation_engine=)).
+                          Multi-model serving: ``adapter`` (alias
+                          ``model``, or the ``X-Adapter`` header) routes
+                          the request through a resident LoRA adapter —
+                          mixed-adapter rows share the SAME continuous
+                          batch (paddle_tpu.adapters). A non-resident
+                          adapter is a 404 (503 shed kind "adapter"
+                          through the traffic tier).
+    POST /v1/admin/adapters        {"adapter_id": id, "alpha": a,
+                        "tenant": t, "factors": {target: {"a": [[..]],
+                        "b": [[..]]}}} -> 200 residency row. Uploads a
+                        LoRA adapter into the device pool (409 in-use
+                        on re-upload of a pinned id, 429 over tenant
+                        quota, 503 pool full).
+    POST /v1/admin/adapters/evict  {"adapter_id": id, "force": false}
+                        -> 200 freed row; 404 not resident; 409 pinned
+                        by in-flight rows unless force.
     GET  /healthz      -> 200 while serving, 503 once closed (a load
                           balancer drains on this flip); with a traffic
                           controller attached, also per-class queue
@@ -133,11 +150,20 @@ class _Handler(BaseHTTPRequestHandler):
         }, headers={"Retry-After": _retry_after_header(e.retry_after_s)})
 
     def _meta(self, payload) -> tuple:
-        """(tenant, priority) from headers first, payload second —
-        a proxy can stamp headers without touching the body."""
+        """(tenant, priority, adapter) from headers first, payload
+        second — a proxy can stamp headers without touching the body.
+        ``model`` is an alias for ``adapter`` (the OpenAI-style field
+        name); ``base`` / the engine's base version mean no adapter."""
         tenant = self.headers.get("X-Tenant") or payload.get("tenant")
         priority = self.headers.get("X-Priority") or payload.get("priority")
-        return tenant, priority
+        adapter = (self.headers.get("X-Adapter") or payload.get("adapter")
+                   or payload.get("model"))
+        if adapter is not None:
+            adapter = str(adapter)
+            base = getattr(self.gen_engine, "model_version", "base")
+            if adapter in ("", "base", base):
+                adapter = None
+        return tenant, priority, adapter
 
     # -- endpoints -----------------------------------------------------------
     def do_GET(self):  # noqa: N802 — http.server contract
@@ -164,6 +190,14 @@ class _Handler(BaseHTTPRequestHandler):
             if gen is not None and hasattr(gen, "phase_health"):
                 try:
                     body["phases"] = gen.phase_health()
+                except Exception:  # noqa: BLE001 — a closing service
+                    pass
+            if gen is not None and hasattr(gen, "models_fragment"):
+                # multi-model serving: base fingerprint/version + the
+                # resident adapter set — a router places adapter
+                # traffic by residency from the probe it already polls
+                try:
+                    body["models"] = gen.models_fragment()
                 except Exception:  # noqa: BLE001 — a closing service
                     pass
             if self.traffic is not None:
@@ -195,6 +229,10 @@ class _Handler(BaseHTTPRequestHandler):
                 self._generate()
             elif self.path == "/v1/predict":
                 self._predict()
+            elif self.path == "/v1/admin/adapters/evict":
+                self._adapter_admin(evict=True)
+            elif self.path == "/v1/admin/adapters":
+                self._adapter_admin(evict=False)
             else:
                 self._reply_json(404,
                                  {"error": f"no such endpoint {self.path}"})
@@ -231,7 +269,7 @@ class _Handler(BaseHTTPRequestHandler):
             # it via the ambient thread-local context
             with tracing.span("serving/http_predict"):
                 if self.traffic is not None:
-                    tenant, priority = self._meta(payload)
+                    tenant, priority, _ = self._meta(payload)
                     outs = self.traffic.predict(
                         inputs, tenant=tenant, priority=priority,
                         deadline_ms=deadline_ms, timeout=timeout)
@@ -259,6 +297,66 @@ class _Handler(BaseHTTPRequestHandler):
             names = self.engine._fetch_names
             self._reply_json(200, {"outputs": {
                 n: np.asarray(o) for n, o in zip(names, outs)}})
+
+    # -- adapter lifecycle (admin) -------------------------------------------
+    def _adapter_admin(self, evict: bool):
+        """Upload / evict LoRA adapters against the GenerationEngine's
+        AdapterStore. The factor payload is plain JSON nested lists —
+        an operator can curl a small adapter in; bulk paths should go
+        through ``store.upload`` in-process."""
+        store = getattr(self.gen_engine, "adapter_store", None)
+        if store is None:
+            self._reply_json(404, {
+                "error": "no AdapterStore attached — construct the "
+                         "GenerationEngine with adapter_store= or set "
+                         "the adapter_pool_max_bytes flag"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+            payload = json.loads(self.rfile.read(length) or b"{}")
+            adapter_id = str(payload["adapter_id"])
+        except (ValueError, KeyError, TypeError) as e:
+            self._reply_json(400, {"error": f"malformed request: {e!r}"})
+            return
+        from ..adapters import (AdapterError, AdapterInUse, AdapterMissing,
+                                AdapterPoolFull, AdapterQuotaExceeded)
+
+        try:
+            if evict:
+                row = store.evict(adapter_id,
+                                  force=bool(payload.get("force", False)))
+                self._reply_json(200, {"evicted": row})
+                return
+            raw = payload["factors"]
+            if not isinstance(raw, dict) or not raw:
+                raise ValueError("factors must be a non-empty object "
+                                 "{target: {'a': [[..]], 'b': [[..]]}}")
+            factors = {}
+            for t, ab in raw.items():
+                if isinstance(ab, dict):
+                    a, b = ab["a"], ab["b"]
+                else:
+                    a, b = ab
+                factors[str(t)] = (np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32))
+            alpha = payload.get("alpha")
+            row = store.upload(
+                factors=factors, adapter_id=adapter_id,
+                alpha=float(alpha) if alpha is not None else None,
+                tenant=payload.get("tenant"))
+            self._reply_json(200, {"uploaded": row})
+        except AdapterQuotaExceeded as e:
+            self._reply_json(429, {"error": str(e), "kind": "quota"})
+        except AdapterPoolFull as e:
+            self._reply_json(503, {"error": str(e), "kind": "pool_full"})
+        except AdapterInUse as e:
+            self._reply_json(409, {"error": str(e), "kind": "in_use"})
+        except AdapterMissing as e:
+            self._reply_json(404, {"error": str(e), "kind": "missing"})
+        except (AdapterError, ValueError, KeyError, TypeError) as e:
+            self._reply_json(400, {"error": str(e)})
+        except Exception as e:  # noqa: BLE001 — the server must survive
+            self._reply_json(500, {"error": repr(e)})
 
     # -- autoregressive generation (streamed) -------------------------------
     def _write_chunk(self, data: bytes):
@@ -298,15 +396,18 @@ class _Handler(BaseHTTPRequestHandler):
         from .engine import Overloaded as _OV
         from ..traffic import TrafficShed, generation_retry_after
 
+        from ..adapters import AdapterError, AdapterMissing
+
         ticket = None
+        tenant, priority, adapter = self._meta(payload)
         try:
             with tracing.span("serving/http_generate"):
                 if self.traffic is not None:
-                    tenant, priority = self._meta(payload)
                     ticket = self.traffic.submit_generation(
                         tokens, tenant=tenant, priority=priority,
                         deadline_ms=deadline_ms, max_new_tokens=max_new,
-                        eos_id=eos_id if eos_id is not None else "default")
+                        eos_id=eos_id if eos_id is not None else "default",
+                        adapter=adapter)
                     # blocks until the dispatcher admits the prompt
                     # into the continuous batch (or sheds it)
                     stream = ticket.stream(
@@ -316,7 +417,16 @@ class _Handler(BaseHTTPRequestHandler):
                     stream = self.gen_engine.submit(
                         tokens, max_new_tokens=max_new,
                         eos_id=eos_id if eos_id is not None else "default",
-                        deadline_ms=deadline_ms)
+                        deadline_ms=deadline_ms, adapter=adapter)
+        except AdapterMissing as e:
+            # the adapter is simply not resident: a 404 tells the
+            # router to upload (or place the request elsewhere), where
+            # a 503 would read as "back off and retry the same worker"
+            self._reply_json(404, {"error": str(e), "kind": "adapter"})
+            return
+        except AdapterError as e:
+            self._reply_json(409, {"error": str(e), "kind": "adapter"})
+            return
         except TrafficShed as e:
             self._reply_shed(e)
             return

@@ -39,6 +39,7 @@ from .admission import (
     TenantSpec,
     TokenBucket,
     TrafficConfig,
+    parse_adapter_quotas,
     parse_tenants,
 )
 from .controller import (
@@ -54,7 +55,8 @@ from .metrics import TrafficMetrics
 
 __all__ = [
     "CLASSES", "INTERACTIVE", "BATCH", "BEST_EFFORT",
-    "TokenBucket", "TenantSpec", "parse_tenants", "TrafficConfig",
+    "TokenBucket", "TenantSpec", "parse_tenants", "parse_adapter_quotas",
+    "TrafficConfig",
     "ClassQueues", "TrafficMetrics",
     "TrafficController", "TrafficTicket", "TrafficShed",
     "ServiceTimeEstimator", "engine_retry_after", "generation_retry_after",

@@ -40,7 +40,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 __all__ = [
     "CLASSES", "INTERACTIVE", "BATCH", "BEST_EFFORT", "class_index",
     "normalize_class", "TokenBucket", "TenantSpec", "parse_tenants",
-    "TrafficConfig", "ClassQueues",
+    "parse_adapter_quotas", "TrafficConfig", "ClassQueues",
 ]
 
 # strict-priority order: lower index preempts higher at dispatch
@@ -172,6 +172,43 @@ def parse_tenants(spec: str) -> Dict[str, TenantSpec]:
     return out
 
 
+def parse_adapter_quotas(spec: str) -> Dict[Tuple[str, str], TenantSpec]:
+    """Flag syntax for per-(tenant, adapter) admission rates:
+    ``"alice:summarize=10:20,*:translate=5"`` — ``tenant:adapter=
+    rate[:burst]`` entries, comma separated. ``*`` as the tenant
+    matches ANY tenant (a per-adapter aggregate cap); an exact tenant
+    entry wins over the wildcard. Keys are ``(tenant, adapter)``."""
+    out: Dict[Tuple[str, str], TenantSpec] = {}
+    if not spec or not str(spec).strip():
+        return out
+    for i, entry in enumerate(str(spec).split(",")):
+        entry = entry.strip()
+        if not entry:
+            continue
+        if "=" not in entry:
+            raise ValueError(
+                f"traffic_adapter_quotas entry {i} ({entry!r}): expected "
+                "tenant:adapter=rate[:burst]")
+        lhs, _, rhs = entry.partition("=")
+        tenant, sep, adapter = lhs.partition(":")
+        tenant, adapter = tenant.strip(), adapter.strip()
+        if not sep or not tenant or not adapter:
+            raise ValueError(
+                f"traffic_adapter_quotas entry {i} ({entry!r}): expected "
+                "tenant:adapter on the left of '=' ('*' = any tenant)")
+        rate_s, _, burst_s = rhs.partition(":")
+        try:
+            rate = float(rate_s)
+            burst = float(burst_s) if burst_s else None
+        except ValueError:
+            raise ValueError(
+                f"traffic_adapter_quotas entry {i} ({entry!r}): rate/burst "
+                "must be numbers") from None
+        out[(tenant, adapter)] = TenantSpec(
+            f"{tenant}:{adapter}", rate, burst)
+    return out
+
+
 class TrafficConfig:
     """The whole admission + scheduling policy in one object. Every
     field mirrors a ``traffic_*`` flag (``from_flags()``); kwargs
@@ -186,7 +223,9 @@ class TrafficConfig:
                  shed_headroom: float = 1.2,
                  max_inflight: int = 0,
                  slo_miss_threshold: float = 0.5,
-                 slo_window_s: float = 5.0):
+                 slo_window_s: float = 5.0,
+                 adapter_quotas: Optional[
+                     Dict[Tuple[str, str], TenantSpec]] = None):
         if queue_capacity < 1:
             raise ValueError("traffic queue_capacity must be >= 1")
         if shed_headroom < 1.0:
@@ -200,6 +239,7 @@ class TrafficConfig:
         self.max_inflight = int(max_inflight)
         self.slo_miss_threshold = float(slo_miss_threshold)
         self.slo_window_s = float(slo_window_s)
+        self.adapter_quotas = dict(adapter_quotas or {})
 
     @classmethod
     def from_flags(cls, **overrides) -> "TrafficConfig":
@@ -215,6 +255,8 @@ class TrafficConfig:
             "max_inflight": int(flag("traffic_max_inflight")),
             "slo_miss_threshold": float(flag("traffic_slo_miss_threshold")),
             "slo_window_s": float(flag("traffic_slo_window_s")),
+            "adapter_quotas": parse_adapter_quotas(
+                flag("traffic_adapter_quotas")),
         }
         kw.update(overrides)
         return cls(**kw)
@@ -224,6 +266,16 @@ class TrafficConfig:
         if spec is None:
             spec = TenantSpec(tenant, self.default_rate,
                               self.default_burst or None)
+        return spec
+
+    def adapter_spec_for(self, tenant: str,
+                         adapter: str) -> Optional[TenantSpec]:
+        """The (tenant, adapter) admission spec — exact tenant entry
+        first, ``*`` wildcard second, None (no per-adapter cap)
+        otherwise."""
+        spec = self.adapter_quotas.get((tenant, adapter))
+        if spec is None:
+            spec = self.adapter_quotas.get(("*", adapter))
         return spec
 
 

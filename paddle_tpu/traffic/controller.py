@@ -65,7 +65,10 @@ __all__ = ["TrafficShed", "TrafficTicket", "ServiceTimeEstimator",
 class TrafficShed(Overloaded):
     """Request shed by the traffic layer before any engine work.
     ``kind`` in {"quota", "queue_full", "infeasible", "backend",
-    "closed"}; ``retry_after_s`` is the computed client backoff."""
+    "closed", "adapter"}; ``retry_after_s`` is the computed client
+    backoff. "adapter" means the requested LoRA adapter is not
+    resident on this worker — the router should upload or place
+    elsewhere rather than blind-retry."""
 
     def __init__(self, msg: str, kind: str, retry_after_s: float):
         super().__init__(msg)
@@ -364,6 +367,7 @@ class TrafficController:
         self._cond = threading.Condition()
         self._queues = ClassQueues(self.config.queue_capacity)
         self._buckets: Dict[str, TokenBucket] = {}
+        self._adapter_buckets: Dict[tuple, TokenBucket] = {}
         self._inflight = 0          # predict requests inside the engine
         self._gen_inflight = 0      # generation requests inside the engine
         max_inflight = self.config.max_inflight
@@ -461,8 +465,25 @@ class TrafficController:
             return 1.0
         return _clamp_retry((ahead + 1) / drain)
 
+    def _adapter_bucket_for(self, tenant: str,
+                            adapter: str) -> Optional[TokenBucket]:
+        """The (tenant, adapter) admission bucket, or None when no
+        per-adapter quota is configured for the pair (exact tenant
+        entry wins over the ``*`` wildcard). Under _cond for the same
+        reason as _bucket_for."""
+        spec = self.config.adapter_spec_for(tenant, adapter)
+        if spec is None:
+            return None
+        key = (tenant, adapter)
+        with self._cond:
+            b = self._adapter_buckets.get(key)
+            if b is None:
+                b = spec.make_bucket(clock=self._clock)
+                self._adapter_buckets[key] = b
+            return b
+
     def _admit(self, kind: str, feed, gen_args, tenant, priority,
-               deadline_ms) -> TrafficTicket:
+               deadline_ms, adapter=None) -> TrafficTicket:
         tenant = str(tenant) if tenant else "default"
         spec = self.config.spec_for(tenant)
         cls = normalize_class(priority or spec.default_class)
@@ -483,7 +504,21 @@ class TrafficController:
             raise TrafficShed(
                 f"deadline {deadline_ms:g}ms provably unmeetable: "
                 f"{detail}", "infeasible", ra)
+        if kind == "generate" and adapter is not None:
+            # residency check BEFORE any quota debit: a request for an
+            # adapter this worker doesn't hold should route elsewhere
+            # (or trigger an upload), not burn tokens and batch slots
+            # only to 500 mid-dispatch
+            store = getattr(self.generation_engine, "adapter_store", None)
+            if store is None or not store.is_resident(adapter):
+                ra = 1.0
+                self.metrics.shed(cls, tenant, "adapter", ra)
+                raise TrafficShed(
+                    f"adapter {adapter!r} is not resident on this worker",
+                    "adapter", ra)
         bucket = self._bucket_for(tenant)
+        abucket = (self._adapter_bucket_for(tenant, adapter)
+                   if adapter is not None else None)
         # 2+3. queue room, THEN quota, THEN push — one atomic block.
         # Quota is checked last so a request shed for capacity reasons
         # never burns a token (otherwise a tenant under overload is
@@ -502,6 +537,16 @@ class TrafficController:
                     f"{cls} queue full "
                     f"({self.config.queue_capacity} pending)",
                     "queue_full", ra)
+            if abucket is not None and abucket.available() < 1.0:
+                # peek-then-take (serialized under _cond): shedding on
+                # the adapter bucket must not have already burned a
+                # tenant token, and vice versa
+                ra = _clamp_retry(abucket.time_until())
+                self.metrics.shed(cls, tenant, "quota", ra)
+                raise TrafficShed(
+                    f"tenant {tenant!r} over adapter quota for "
+                    f"{adapter!r} ({abucket.rate:g} req/s, burst "
+                    f"{abucket.burst:g})", "quota", ra)
             if not bucket.try_take():
                 ra = _clamp_retry(bucket.time_until())
                 self.metrics.shed(cls, tenant, "quota", ra)
@@ -509,6 +554,8 @@ class TrafficController:
                     f"tenant {tenant!r} over quota "
                     f"({bucket.rate:g} req/s, burst {bucket.burst:g})",
                     "quota", ra)
+            if abucket is not None:
+                abucket.try_take()
             self._queues.push(cls, tenant, req)
             self.metrics.admitted(cls, tenant)
             self._update_gauges_locked()
@@ -535,20 +582,24 @@ class TrafficController:
                           priority: Optional[str] = None,
                           deadline_ms: Optional[float] = None,
                           max_new_tokens: Optional[int] = None,
-                          eos_id="default",
+                          eos_id="default", adapter: Optional[str] = None,
                           on_token=None) -> TrafficTicket:
         """Admit one generation request (requires a
         ``generation_engine``). The ticket's ``stream()`` hands back
         the live ``GenerationStream`` once the dispatcher admits the
-        prompt into the continuous batch."""
+        prompt into the continuous batch. ``adapter`` routes the row
+        through a resident LoRA adapter: a non-resident id sheds with
+        kind "adapter" at admission, and any configured
+        (tenant, adapter) quota bucket is enforced alongside the
+        tenant bucket."""
         if self.generation_engine is None:
             raise ServingError(
                 "no GenerationEngine attached — construct "
                 "TrafficController(engine, generation_engine=...)")
         gen_args = {"max_new_tokens": max_new_tokens, "eos_id": eos_id,
-                    "on_token": on_token}
+                    "on_token": on_token, "adapter": adapter}
         return self._admit("generate", prompt, gen_args, tenant, priority,
-                           deadline_ms)
+                           deadline_ms, adapter=adapter)
 
     # -- scheduling ----------------------------------------------------------
     def _infeasible(self, req: _TReq, now: float, at_dispatch: bool):
@@ -717,6 +768,8 @@ class TrafficController:
                 # dispatch
                 if self._gen_takes_tenant():
                     kw["tenant"] = req.tenant
+                if ga.get("adapter") is not None and self._gen_takes_adapter():
+                    kw["adapter"] = ga["adapter"]
                 stream = self.generation_engine.submit(req.feed, **kw)
                 req.inner = stream
                 req.dispatched = True
@@ -842,11 +895,18 @@ class TrafficController:
         out["slo_dump_paths"] = list(self.slo_dump_paths)
         with self._cond:
             buckets = list(self._buckets.items())
+            abuckets = list(self._adapter_buckets.items())
         out["tenants"] = {
             name: {"rate": b.rate, "burst": b.burst,
                    "tokens": (round(b.available(), 2)
                               if b.rate > 0 else -1.0)}
             for name, b in buckets}
+        out["adapter_quotas"] = {
+            f"{tenant}:{adapter}": {
+                "rate": b.rate, "burst": b.burst,
+                "tokens": (round(b.available(), 2)
+                           if b.rate > 0 else -1.0)}
+            for (tenant, adapter), b in abuckets}
         return out
 
     def _gen_takes_tenant(self) -> bool:
@@ -863,6 +923,21 @@ class TrafficController:
             except (TypeError, ValueError):
                 cached = False
             self._gen_tenant_kw = cached
+        return cached
+
+    def _gen_takes_adapter(self) -> bool:
+        """Whether generation_engine.submit accepts adapter= (same
+        cached-probe shape as _gen_takes_tenant)."""
+        cached = getattr(self, "_gen_adapter_kw", None)
+        if cached is None:
+            import inspect
+
+            try:
+                cached = "adapter" in inspect.signature(
+                    self.generation_engine.submit).parameters
+            except (TypeError, ValueError):
+                cached = False
+            self._gen_adapter_kw = cached
         return cached
 
     def health(self) -> Dict[str, Any]:

@@ -548,6 +548,12 @@ COVERED_ELSEWHERE = {
     # oracle all three formats + tile-unaligned shapes, rewrite
     # output-parity, fully-quantized ragged engine agreement)
     'quantized_matmul', 'quantized_fc',
+    # PR-19 batched LoRA (tests/test_adapters.py: slot-gathered delta
+    # vs dense-merge oracle fp32+bf16 w/ exact slot-0 zero, interpret
+    # Pallas == reference, rewrite zero-slot output identity +
+    # quantized-base bitwise composition, mixed-batch == dedicated
+    # engines end-to-end)
+    'batched_lora_matmul', 'batched_lora_fc',
     # PR-9 gradient-collective planner (tests/test_collectives.py:
     # bucketed fp32 bit-identity vs monolithic x4 trajectories, int8
     # quant round-trip bound, exchange==psum-form equivalence, and

@@ -46,6 +46,14 @@ properties ISSUE 10 promises:
                     handed off with its KV pages streamed through the
                     page store, both tiers visible in phase health,
                     zero leaked pages after drain.
+  mixed_adapter     N tenants x M LoRA adapters multiplexed through
+                    ONE ragged engine (paddle_tpu.adapters): every
+                    adapter's greedy output token-identical to a
+                    dedicated single-adapter oracle engine, base rows
+                    bitwise-stable alongside, then an upload/evict
+                    churn loop (LRU evictions under a full pool) that
+                    must leave ZERO leaked pool bytes and the
+                    paddle_adapter_* gauge family populated.
   rolling_restart   WorkerPool.rolling_restart under live closed-loop
                     load: zero failed in-flight requests, replacement
                     workers warm-start from the persistent compile
@@ -907,6 +915,113 @@ def run_disagg(tmp_dir, spec):
     }
 
 
+# -- scenario: multi-adapter multiplexing ------------------------------------
+
+
+def run_mixed_adapter(tmp_dir, spec):
+    """N tenants x M LoRA adapters through ONE ragged engine. Gates:
+    (1) every adapter's greedy output in the MIXED batch is
+    token-identical to a dedicated single-adapter oracle engine (same
+    checkpoint, only that adapter resident), (2) base-only rows served
+    alongside are identical to a no-adapter engine, (3) an
+    upload/evict churn loop over a deliberately small pool (LRU
+    evictions engaged) leaves zero leaked pool bytes, and (4) the
+    paddle_adapter_* gauge family is populated."""
+    import numpy as np
+
+    import paddle_tpu as fluid
+
+    n_adapters = int(spec.get("adapters", 8))
+    max_new = int(spec.get("max_new_tokens", 6))
+    rng = np.random.RandomState(spec.get("seed", 5))
+    prompt = [int(t) for t in rng.randint(1, 84, 10)]
+
+    def with_store(slots):
+        fluid.set_flags({"adapter_pool_max_bytes": 1,
+                         "adapter_slots_per_bucket": int(slots)})
+        try:
+            return _build_lm_stack(tmp_dir, max_decode_batch=n_adapters + 1,
+                                   chunk_tokens=16)
+        finally:
+            fluid.set_flags({"adapter_pool_max_bytes": 0,
+                             "adapter_slots_per_bucket": 0})
+
+    # base oracle: plain engine, no adapters
+    _pred0, gen0 = _build_lm_stack(tmp_dir, max_decode_batch=n_adapters + 1,
+                                   chunk_tokens=16)
+    try:
+        base_tokens = list(gen0.generate(prompt, max_new, eos_id=None,
+                                         timeout=300))
+    finally:
+        gen0.close(drain=False)
+
+    _pred, gen = with_store(slots=n_adapters + 2)
+    result = {"adapters": n_adapters, "max_new_tokens": max_new}
+    try:
+        store = gen.adapter_store
+        targets = sorted(store.targets)
+        factors = {}
+        for i in range(n_adapters):
+            r = 8 if i % 2 == 0 else 16
+            fac = {}
+            for t in targets[: 1 + (i % 3)]:
+                K, N = store.targets[t]
+                fac[t] = (rng.randn(K, r).astype(np.float32) * 0.05,
+                          rng.randn(r, N).astype(np.float32) * 0.05)
+            factors[f"ad{i}"] = (fac, 2.0 * r)
+            store.upload(f"ad{i}", fac, alpha=2.0 * r,
+                         tenant=f"tenant{i % max(1, spec.get('tenants', 3))}")
+
+        # the mixed micro-batch: every adapter + one base row at once
+        streams = [gen.submit(prompt, max_new, eos_id=None,
+                              adapter=f"ad{i}") for i in range(n_adapters)]
+        streams.append(gen.submit(prompt, max_new, eos_id=None))
+        mixed = [list(s.result(300)) for s in streams]
+        result["base_row_identical"] = mixed[-1] == base_tokens
+        result["adapters_diverge_from_base"] = sum(
+            mixed[i] != base_tokens for i in range(n_adapters))
+
+        # per-adapter oracle: dedicated engine, ONLY that adapter
+        identical = True
+        for i in range(n_adapters):
+            _po, oracle = with_store(slots=3)
+            try:
+                fac, alpha = factors[f"ad{i}"]
+                oracle.adapter_store.upload(f"ad{i}", fac, alpha=alpha)
+                solo = list(oracle.generate(prompt, max_new, eos_id=None,
+                                            adapter=f"ad{i}", timeout=300))
+            finally:
+                oracle.close(drain=False)
+            if solo != mixed[i]:
+                identical = False
+        result["tokens_identical"] = identical
+
+        # churn: a pool with room for 2 adapters per bucket cycles
+        # through 3x that many uploads — LRU evictions must engage and
+        # every byte must come back
+        churn_rounds = int(spec.get("churn_rounds", 8))
+        for j in range(churn_rounds):
+            fac = {targets[0]: (rng.randn(*(
+                store.targets[targets[0]][0], 8)).astype(np.float32) * 0.05,
+                rng.randn(8, store.targets[targets[0]][1]).astype(
+                    np.float32) * 0.05)}
+            store.upload(f"churn{j}", fac)
+            gen.generate(prompt, 2, eos_id=None, adapter=f"churn{j}",
+                         timeout=300)
+        stats = store.stats_numeric()
+        for row in store.resident():
+            store.evict(row["id"])
+        result.update({
+            "uploads_total": int(stats["uploads_total"]),
+            "lru_evictions_total": int(stats["lru_evictions_total"]),
+            "leaked_pool_bytes": int(store.used_bytes()),
+            "gauges_populated": stats["uploads_total"] >= n_adapters,
+        })
+    finally:
+        gen.close(drain=False)
+    return result
+
+
 # -- scenario: rolling restart under live load -------------------------------
 
 
@@ -1028,7 +1143,7 @@ def main():
     ap.add_argument("--scenario", default="all",
                     choices=["all", "bursty_overload", "priority_mix",
                              "mixed_tenant", "slow_client",
-                             "shared_prefix", "disagg",
+                             "shared_prefix", "disagg", "mixed_adapter",
                              "rolling_restart"])
     ap.add_argument("--out", default=None, help="also write JSON here")
     args = ap.parse_args()
@@ -1171,6 +1286,18 @@ def main():
         gates["disagg_phases_exposed"] = (
             r["phases"] == ["decode", "prefill"])
         gates["disagg_zero_leaked_pages"] = r["leaked_pages"] == 0
+
+    if args.scenario in ("all", "mixed_adapter"):
+        spec = {"adapters": 8, "tenants": 3, "max_new_tokens": 6,
+                "churn_rounds": 8}
+        result["mixed_adapter"] = run_mixed_adapter(tmp, spec)
+        r = result["mixed_adapter"]
+        gates["adapter_tokens_identical_vs_oracle"] = bool(
+            r["tokens_identical"])
+        gates["adapter_base_row_identical"] = bool(r["base_row_identical"])
+        gates["adapter_zero_leaked_pool_bytes"] = (
+            r["leaked_pool_bytes"] == 0)
+        gates["adapter_gauges_populated"] = bool(r["gauges_populated"])
 
     if args.scenario in ("all", "rolling_restart"):
         spec = {"workers": 2, "clients": 4}
