@@ -747,6 +747,38 @@ def _best_cached_tpu_row():
         return None
 
 
+def _relay_preflight(timeout_s: int) -> dict:
+    """Mandatory TPU-run preflight: ONE clean relay claim probe via
+    tools/relay_probe (ROADMAP item 1 NOTE — BENCH_r01–r05 burned
+    whole windows on a wedged relay, discovering it only as a wall of
+    rc=19 lines). A probe that cannot claim means the multi child
+    cannot either, so bench refuses the TPU attempt up front with the
+    probe's classification instead of spending the window to learn
+    it. The full result (log tail included) persists to
+    .bench_evidence/relay_preflight.json. Escape hatch:
+    PT_BENCH_SKIP_RELAY_PREFLIGHT=1."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    tools_dir = os.path.join(here, "tools")
+    if tools_dir not in sys.path:
+        sys.path.insert(0, tools_dir)
+    try:
+        import relay_probe
+
+        res = relay_probe.probe(timeout_s=timeout_s)
+    except Exception as e:  # noqa: BLE001 — preflight must not crash bench
+        res = {"state": "PROBE_ERROR", "detail": repr(e),
+               "elapsed_s": 0.0}
+    tail = res.pop("log_tail", "")
+    try:
+        evdir = os.path.join(here, ".bench_evidence")
+        os.makedirs(evdir, exist_ok=True)
+        with open(os.path.join(evdir, "relay_preflight.json"), "w") as f:
+            json.dump(dict(res, log_tail=tail[-1500:]), f, indent=1)
+    except OSError:
+        pass
+    return res
+
+
 def _orchestrate():
     """Role 2: no jax anywhere in this process. Spawn ONE multi-stage
     child that claims the relay exactly once and walks the whole TPU
@@ -761,6 +793,22 @@ def _orchestrate():
     pypath = here + (os.pathsep + os.environ["PYTHONPATH"]
                      if os.environ.get("PYTHONPATH") else "")
     axon_ips = os.environ.get("PT_BENCH_AXON_IPS", "")
+    if axon_ips and os.environ.get("PT_BENCH_SKIP_RELAY_PREFLIGHT") != "1":
+        pf = _relay_preflight(int(os.environ.get(
+            "PT_BENCH_PREFLIGHT_TIMEOUT_S", "45")))
+        if pf.get("state") != "GRANTED":
+            # structured one-liner: the driver's log grep gets the
+            # classification, not 30 identical rc=19 lines
+            sys.stderr.write("[bench] relay preflight refused TPU run: "
+                             + json.dumps({
+                                 "event": "relay_preflight_failed",
+                                 "state": pf.get("state"),
+                                 "detail": str(pf.get("detail", ""))[:300],
+                                 "elapsed_s": pf.get("elapsed_s"),
+                                 "evidence":
+                                     ".bench_evidence/relay_preflight.json",
+                             }) + "\n")
+            axon_ips = ""   # cached-row / CPU fallback path below
 
     import subprocess
     import tempfile
@@ -773,7 +821,10 @@ def _orchestrate():
         reserve = (CPU_RESERVE_S + 30
                    if os.environ.get("PT_BENCH_CPU_FALLBACK", "1") == "1"
                    else 30)
-        child_budget = DEADLINE_S - reserve
+        # the preflight already spent part of the window — the child
+        # budget shrinks by that much, not the CPU reserve
+        child_budget = (DEADLINE_S - reserve
+                        - int(time.monotonic() - t_start))
         fd, results_path = tempfile.mkstemp(prefix="pt_bench_rows_")
         os.close(fd)
         env = {**os.environ,

@@ -424,12 +424,23 @@ class PageStoreServer:
                              name="pagestore-conn", daemon=True).start()
 
     def _serve(self, conn: socket.socket) -> None:
+        from ..observability import propagate, tracing
+
         try:
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             while not self._closed:
                 head, payload = _recv_frame(conn)
+                # the caller's trace context rides the frame head
+                # ("trace": traceparent, stamped by PageStoreClient) —
+                # the RPC's span joins the caller's trace across the
+                # TCP hop instead of starting an orphan root
+                ctx = propagate.parse_traceparent(head.pop("trace", None))
                 try:
-                    self._dispatch(conn, head, payload)
+                    with tracing.attach(ctx), \
+                         tracing.span(
+                             f"pagestore/{head.get('op', 'unknown')}",
+                             {"payload_bytes": len(payload)}):
+                        self._dispatch(conn, head, payload)
                 except Exception as exc:   # noqa: BLE001 — wire-reported
                     _send_frame(conn, {"ok": 0, "error": str(exc)})
         except (ConnectionError, OSError):
@@ -513,6 +524,14 @@ class PageStoreClient:
 
     def _rpc(self, head: Dict[str, Any],
              payload: bytes = b"") -> Tuple[Dict[str, Any], bytes]:
+        from ..observability import propagate
+
+        tp = propagate.current_traceparent()
+        if tp is not None:
+            # propagate the ambient trace over the wire: the server
+            # side attaches it, so its pagestore/<op> span parents
+            # under the prefill/decode worker's span
+            head.setdefault("trace", tp)
         with self._lock:
             try:
                 conn = self._ensure_conn()

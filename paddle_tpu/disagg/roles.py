@@ -43,6 +43,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from ..generation.engine import GenerationEngine, GenerationStream
+from ..observability import tracing
 from ..serving.engine import (EngineClosed, Overloaded, RequestCancelled,
                               ServingError)
 from ..serving.metrics import StreamingHistogram
@@ -138,7 +139,7 @@ class DisaggStream(GenerationStream):
 
 class _HandoffJob:
     __slots__ = ("prompt", "max_new", "eos", "deadline", "stream",
-                 "tenant", "enqueue_t")
+                 "tenant", "enqueue_t", "ctx")
 
     def __init__(self, prompt, max_new, eos, deadline, stream, tenant):
         self.prompt = prompt
@@ -148,6 +149,11 @@ class _HandoffJob:
         self.stream = stream
         self.tenant = tenant
         self.enqueue_t = time.monotonic()
+        # the submitter's ambient trace context rides the job across
+        # the queue: the dispatcher thread re-attaches it, so the
+        # handoff/prefill/decode spans stay in the REQUEST's trace
+        # instead of rooting a fresh one per dispatcher thread
+        self.ctx = tracing.current()
 
 
 class _ServiceMetrics:
@@ -343,7 +349,12 @@ class DisaggService:
                     continue
                 job = self._jobs.pop(0)
             try:
-                self._handoff(job)
+                with tracing.attach(job.ctx), \
+                     tracing.span("disagg/handoff", {
+                         "queue_ms": round(
+                             (time.monotonic() - job.enqueue_t) * 1e3, 3),
+                         "prompt_tokens": int(job.prompt.size)}):
+                    self._handoff(job)
             except Exception as e:  # noqa: BLE001 — one bad job must not kill the lane
                 self.metrics.inc("handoff_failures_total")
                 job.stream._finish("error", ServingError(
@@ -364,8 +375,10 @@ class DisaggService:
         t0 = time.monotonic()
         pf = self._pick_prefill()
         try:
-            pf.prefill(job.prompt, deadline_ms=self._remaining_ms(job),
-                       tenant=job.tenant)
+            with tracing.span("disagg/prefill_phase"):
+                pf.prefill(job.prompt,
+                           deadline_ms=self._remaining_ms(job),
+                           tenant=job.tenant)
         except (Overloaded, EngineClosed) as e:
             self.metrics.inc("handoff_failures_total")
             stream._finish("error", e)
@@ -389,10 +402,11 @@ class DisaggService:
             return
         dw = self._pick_decode()
         try:
-            inner = dw.submit(job.prompt, max_new_tokens=job.max_new,
-                              eos_id=job.eos,
-                              deadline_ms=self._remaining_ms(job),
-                              on_token=stream._push, tenant=job.tenant)
+            with tracing.span("disagg/decode_submit"):
+                inner = dw.submit(job.prompt, max_new_tokens=job.max_new,
+                                  eos_id=job.eos,
+                                  deadline_ms=self._remaining_ms(job),
+                                  on_token=stream._push, tenant=job.tenant)
         except (Overloaded, EngineClosed) as e:
             self.metrics.inc("handoff_failures_total")
             stream._finish("error", e)

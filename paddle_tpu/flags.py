@@ -291,6 +291,25 @@ _FLAG_DEFS: Dict[str, Any] = {
     "observability_flight_capacity": 512,
     "observability_dump_dir": "",
     "observability_xla_analysis": False,
+    # fleet observability (observability/fleet.py):
+    # observability_fleet_endpoints seeds the FleetAggregator with a
+    # comma list of worker metrics endpoints ("name=host:port" or bare
+    # "host:port"); observability_fleet_timeout_s is the hard
+    # per-endpoint scrape deadline (a hung backend goes stale, never
+    # stalls the merge). slo_deadline_miss_budget is the error budget
+    # (allowed deadline-miss ratio) the burn rate is measured against;
+    # slo_ttft_p99_ms / slo_itl_p99_ms are latency targets (0 = no
+    # target, gauges still exported); slo_window_s is the sliding
+    # window for miss-ratio/burn math; slo_burn_threshold > 0 arms the
+    # sustained-burn trigger (burn above it for a full window fires
+    # ONE fleet-wide flight dump, latched until the burn recedes)
+    "observability_fleet_endpoints": "",
+    "observability_fleet_timeout_s": 1.0,
+    "slo_deadline_miss_budget": 0.01,
+    "slo_ttft_p99_ms": 0.0,
+    "slo_itl_p99_ms": 0.0,
+    "slo_window_s": 30.0,
+    "slo_burn_threshold": 0.0,
     "eager_delete_tensor_gb": 0.0,     # inert: XLA frees by liveness
     # accepted-but-inert parity flags (reference platform/flags.cc)
     "fraction_of_gpu_memory_to_use": 0.92,

@@ -17,17 +17,30 @@ needs from one place:
 * ``flight`` — an always-on constant-memory flight recorder dumped to
   JSON on NaN rollback, watchdog hang, uncaught loop exception,
   SIGTERM and SIGUSR2.
+* ``propagate`` — the cross-process trace-context codec
+  (traceparent-style headers, page-store wire heads, ``PADDLE_TRACE_*``
+  env for spawned workers) plus the per-process trace index behind
+  ``/v1/admin/trace/<id>``.
+* ``fleet`` — ``FleetAggregator`` merges every worker's ``/metrics``
+  into one ``{worker=,phase=,rank=}``-labeled exposition
+  (``/metrics/fleet`` / ``fleet_snapshot()``); ``SLOMonitor`` computes
+  windowed deadline-miss ratio and error-budget burn over it
+  (``paddle_slo_*`` gauges, fleet-wide flight dump on sustained burn).
 
 Live flags (flags.py): ``observability_metrics``,
 ``observability_tracing``, ``observability_flight``,
 ``observability_flight_capacity``, ``observability_dump_dir``,
-``observability_xla_analysis``. ``tools/obs_bench.py --smoke`` gates
-the enabled-path per-step overhead at <3% of a bare step.
+``observability_xla_analysis``, ``observability_fleet_endpoints``,
+``observability_fleet_timeout_s``, plus the ``slo_*`` family.
+``tools/obs_bench.py --smoke`` gates the enabled-path per-step
+overhead at <3% of a bare step (propagation codec included).
 """
 
 from __future__ import annotations
 
-from . import flight, registry, tracing
+from . import fleet, flight, propagate, registry, tracing
+from .fleet import (FleetAggregator, SLOMonitor, assemble_trace,
+                    configure_fleet, default_aggregator, fleet_snapshot)
 from .flight import dump as flight_dump
 from .flight import install_signal_handlers
 from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
@@ -40,7 +53,9 @@ from .registry import registry as get_registry
 from .tracing import SpanContext, attach, current, span, traced
 
 __all__ = [
-    "registry", "tracing", "flight",
+    "registry", "tracing", "flight", "propagate", "fleet",
+    "FleetAggregator", "SLOMonitor", "configure_fleet",
+    "default_aggregator", "fleet_snapshot", "assemble_trace",
     "MetricsRegistry", "Counter", "Gauge", "Histogram", "get_registry",
     "span", "traced", "attach", "current", "SpanContext",
     "flight_dump", "install_signal_handlers",

@@ -50,6 +50,26 @@ three endpoints a serving deployment actually needs:
                           the one endpoint a router/autoscaler needs
     GET  /metrics      -> Prometheus text: serving counters/quantiles +
                           aggregated predictor bucket stats
+    GET  /metrics/fleet -> the MERGED fleet exposition (every known
+                          worker scraped and re-labeled
+                          {worker=,phase=,rank=} + paddle_slo_* burn
+                          gauges); requires ServingServer(...,
+                          fleet=FleetAggregator(...))
+    GET  /v1/admin/trace/<id> -> this process's completed spans for
+                          one trace id (from the flight ring),
+                          pid-stamped; observability.assemble_trace
+                          merges these across the fleet and
+                          tools/timeline.py renders process lanes
+    POST /v1/admin/flight/dump -> dump the local flight ring now
+                          (the SLO sustained-burn trigger calls this
+                          on every worker)
+
+Correlation: every request adopts the client's ``X-Request-Id`` (or
+mints one) and extracts ``traceparent``/``X-Trace``
+(observability/propagate.py) so handler spans join the caller's
+trace; replies echo both ids as headers, error bodies carry
+``request_id``/``trace_id`` fields, and a streamed /v1/generate
+stamps them on the first NDJSON fragment and the done tail.
 
 With ``ServingServer(engine, traffic=TrafficController(...))`` both
 POST endpoints route through the traffic tier: tenant and priority
@@ -108,6 +128,7 @@ class _Handler(BaseHTTPRequestHandler):
     engine: ServingEngine = None  # set by the subclass ServingServer makes
     gen_engine = None             # generation.GenerationEngine (optional)
     traffic = None                # traffic.TrafficController (optional)
+    fleet = None                  # observability.FleetAggregator (optional)
     phase = None                  # disagg worker phase (optional)
     started_at: float = 0.0       # time.monotonic() at server start
     stream_timeout_s: float = 0.0  # /v1/generate write stall budget
@@ -116,6 +137,10 @@ class _Handler(BaseHTTPRequestHandler):
     active_lock = None
     server_version = "paddle_tpu_serving/1.0"
     protocol_version = "HTTP/1.1"
+    # per-request correlation state (set by _begin_request)
+    _rid = None
+    _ctx = None
+    _trace_id = None
 
     # -- plumbing ------------------------------------------------------------
     def log_message(self, fmt, *args):  # noqa: A003 — quiet by default
@@ -127,16 +152,42 @@ class _Handler(BaseHTTPRequestHandler):
             self.connection.setsockopt(
                 socket.SOL_SOCKET, socket.SO_SNDBUF, int(self.sndbuf))
 
+    def _begin_request(self):
+        """Correlation ids, once per request: adopt the client's
+        ``X-Request-Id`` (or mint one) and extract the incoming trace
+        context (``traceparent``/``X-Trace``) so every span in this
+        handler joins the caller's trace and every reply echoes the
+        ids back."""
+        from ..observability import propagate
+
+        self._rid = (self.headers.get(propagate.REQUEST_ID_HEADER)
+                     or propagate.new_request_id())
+        self._ctx = propagate.extract(self.headers)
+        self._trace_id = (self._ctx.trace_id
+                          if self._ctx is not None else None)
+
     def _reply(self, code: int, body: bytes, ctype: str, headers=None):
         self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
+        if self._rid:
+            self.send_header("X-Request-Id", self._rid)
+        if self._trace_id:
+            self.send_header("X-Trace", self._trace_id)
         for k, v in (headers or {}).items():
             self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
 
     def _reply_json(self, code: int, obj, headers=None):
+        if code >= 400 and isinstance(obj, dict):
+            # every error body is log-correlatable: shed storms,
+            # deadline 504s and adapter 404s all name the request and
+            # (when the caller sent one) the trace they belong to
+            if self._rid:
+                obj.setdefault("request_id", self._rid)
+            if self._trace_id:
+                obj.setdefault("trace_id", self._trace_id)
         self._reply(code, json.dumps(obj, default=_json_default).encode(),
                     "application/json", headers=headers)
 
@@ -167,6 +218,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     # -- endpoints -----------------------------------------------------------
     def do_GET(self):  # noqa: N802 — http.server contract
+        self._begin_request()
         if self.path == "/healthz":
             from .. import version
 
@@ -215,10 +267,36 @@ class _Handler(BaseHTTPRequestHandler):
             text = observability.to_prometheus_text()
             self._reply(200, text.encode(),
                         "text/plain; version=0.0.4; charset=utf-8")
+        elif self.path == "/metrics/fleet":
+            # the FLEET exposition: every known worker's registry
+            # scraped and merged with {worker=,phase=,rank=} labels +
+            # paddle_slo_* burn gauges (observability/fleet.py)
+            if self.fleet is None:
+                self._reply_json(404, {
+                    "error": "no FleetAggregator attached — construct "
+                             "ServingServer(..., fleet=FleetAggregator())"})
+                return
+            try:
+                text = self.fleet.to_prometheus_text()
+            except Exception as e:  # noqa: BLE001 — a scrape must not 500 loop
+                self._reply_json(500, {"error": repr(e)})
+                return
+            self._reply(200, text.encode(),
+                        "text/plain; version=0.0.4; charset=utf-8")
+        elif self.path.startswith("/v1/admin/trace/"):
+            # this process's slice of one trace (spans still in the
+            # flight ring), pid-stamped for process-lane rendering;
+            # fleet.assemble_trace merges these across workers
+            from ..observability import propagate
+
+            tid = self.path.rsplit("/", 1)[-1].strip().lower()
+            payload = propagate.local_trace(tid, phase=self.phase)
+            self._reply_json(200 if payload["spans"] else 404, payload)
         else:
             self._reply_json(404, {"error": f"no such endpoint {self.path}"})
 
     def do_POST(self):  # noqa: N802
+        self._begin_request()
         # in-flight accounting: the rolling-restart drain waits for
         # this to hit zero before the process exits, so no accepted
         # request ever dies with its response half-written
@@ -233,12 +311,26 @@ class _Handler(BaseHTTPRequestHandler):
                 self._adapter_admin(evict=True)
             elif self.path == "/v1/admin/adapters":
                 self._adapter_admin(evict=False)
+            elif self.path == "/v1/admin/flight/dump":
+                self._flight_dump()
             else:
                 self._reply_json(404,
                                  {"error": f"no such endpoint {self.path}"})
         finally:
             with self.active_lock:
                 self.active["n"] -= 1
+
+    def _flight_dump(self):
+        """Dump this process's flight ring on demand — what the SLO
+        monitor's sustained-burn trigger POSTs to every worker so the
+        whole fleet's last-N-events land on disk at the same moment."""
+        from ..observability import flight
+
+        try:
+            path = flight.dump(f"admin:{self._rid}")
+            self._reply_json(200, {"path": path, "request_id": self._rid})
+        except Exception as e:  # noqa: BLE001 — the server must survive
+            self._reply_json(500, {"error": repr(e)})
 
     def _predict(self):
         from ..observability import tracing
@@ -265,9 +357,14 @@ class _Handler(BaseHTTPRequestHandler):
 
         try:
             # span (record_event when tracing is off): the HTTP handler
-            # thread is the trace root; engine.submit's span nests under
-            # it via the ambient thread-local context
-            with tracing.span("serving/http_predict"):
+            # thread is the trace root — or, when the client sent a
+            # traceparent, a child of the caller's span (the router ->
+            # worker hop joins one trace)
+            with tracing.attach(self._ctx), \
+                 tracing.span("serving/http_predict",
+                              {"request_id": self._rid}) as _sctx:
+                if _sctx is not None:
+                    self._trace_id = _sctx.trace_id
                 if self.traffic is not None:
                     tenant, priority, _ = self._meta(payload)
                     outs = self.traffic.predict(
@@ -401,7 +498,11 @@ class _Handler(BaseHTTPRequestHandler):
         ticket = None
         tenant, priority, adapter = self._meta(payload)
         try:
-            with tracing.span("serving/http_generate"):
+            with tracing.attach(self._ctx), \
+                 tracing.span("serving/http_generate",
+                              {"request_id": self._rid}) as _sctx:
+                if _sctx is not None:
+                    self._trace_id = _sctx.trace_id
                 if self.traffic is not None:
                     ticket = self.traffic.submit_generation(
                         tokens, tenant=tenant, priority=priority,
@@ -414,10 +515,14 @@ class _Handler(BaseHTTPRequestHandler):
                         timeout=(deadline_ms / 1e3 + 5.0
                                  if deadline_ms is not None else 600.0))
                 else:
+                    # adapter rides only when named: engine ducks that
+                    # don't host adapters (e.g. disagg.DisaggService)
+                    # keep working behind the same endpoint
+                    kw = {"adapter": adapter} if adapter is not None else {}
                     stream = self.gen_engine.submit(
                         tokens, max_new_tokens=max_new,
                         eos_id=eos_id if eos_id is not None else "default",
-                        deadline_ms=deadline_ms, adapter=adapter)
+                        deadline_ms=deadline_ms, **kw)
         except AdapterMissing as e:
             # the adapter is simply not resident: a 404 tells the
             # router to upload (or place the request elsewhere), where
@@ -479,6 +584,10 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(200)
         self.send_header("Content-Type", "application/x-ndjson")
         self.send_header("Transfer-Encoding", "chunked")
+        if self._rid:
+            self.send_header("X-Request-Id", self._rid)
+        if self._trace_id:
+            self.send_header("X-Trace", self._trace_id)
         self.end_headers()
         # slow-client budget: a client that stops READING eventually
         # fills the socket buffers and blocks our next write; the
@@ -492,8 +601,16 @@ class _Handler(BaseHTTPRequestHandler):
         n = 0
         try:
             for tok in stream:
-                self._write_chunk(json.dumps(
-                    {"index": n, "token": int(tok)}).encode() + b"\n")
+                line = {"index": n, "token": int(tok)}
+                if n == 0:
+                    # the trace/request ids ride the FIRST fragment (at
+                    # time-to-first-token) so a client can correlate a
+                    # stream it later abandons; the tail repeats them
+                    if self._trace_id:
+                        line["trace_id"] = self._trace_id
+                    if self._rid:
+                        line["request_id"] = self._rid
+                self._write_chunk(json.dumps(line).encode() + b"\n")
                 n += 1
             tail = {"done": True, "finish_reason": stream.finish_reason,
                     "n_tokens": n, "usage": usage_fragment()}
@@ -505,6 +622,10 @@ class _Handler(BaseHTTPRequestHandler):
             tail = {"done": True, "finish_reason": stream.finish_reason
                     or "error", "n_tokens": n, "error": str(e),
                     "usage": usage_fragment()}
+        if self._trace_id:
+            tail.setdefault("trace_id", self._trace_id)
+        if self._rid:
+            tail.setdefault("request_id", self._rid)
         try:
             self._write_chunk(json.dumps(tail).encode() + b"\n")
             self.wfile.write(b"0\r\n\r\n")
@@ -558,12 +679,14 @@ class ServingServer:
                  port: int = 0, start: bool = True, generation_engine=None,
                  traffic=None, reuse_port: bool = False,
                  stream_write_timeout_s: Optional[float] = None,
-                 sndbuf: int = 0, phase: Optional[str] = None):
+                 sndbuf: int = 0, phase: Optional[str] = None,
+                 fleet=None):
         from ..flags import flag
 
         self.engine = engine
         self.generation_engine = generation_engine
         self.traffic = traffic
+        self.fleet = fleet
         if phase is None:
             phase = getattr(generation_engine, "phase", None)
         self.phase = str(phase) if phase else None
@@ -574,7 +697,8 @@ class ServingServer:
         self._active_lock = threading.Lock()
         handler = type("_BoundHandler", (_Handler,),
                        {"engine": engine, "gen_engine": generation_engine,
-                        "traffic": traffic, "phase": self.phase,
+                        "traffic": traffic, "fleet": fleet,
+                        "phase": self.phase,
                         "stream_timeout_s": float(stream_write_timeout_s),
                         "sndbuf": int(sndbuf),
                         "active": self._active,

@@ -64,10 +64,19 @@ def _worker_main(spec: Dict[str, Any], conn) -> None:
     # the child must resolve the same backend as the parent; JAX env
     # (JAX_PLATFORMS etc.) rides os.environ through spawn
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # fleet identity + the parent's trace context, stamped at spawn:
+    # PADDLE_WORKER_ID labels every span this process records (the
+    # process-lane key in assembled traces) and PADDLE_TRACE_CONTEXT
+    # parents the boot span under the parent's rollout trace
+    if spec.get("worker_id"):
+        os.environ["PADDLE_WORKER_ID"] = str(spec["worker_id"])
+    for k, v in (spec.get("trace_env") or {}).items():
+        os.environ[k] = str(v)
     import numpy as np
 
     import paddle_tpu as fluid
     from paddle_tpu.inference import Config, create_predictor
+    from paddle_tpu.observability import propagate, tracing
     from paddle_tpu.runtime import dispatch
     from paddle_tpu.serving import ServingEngine, ServingServer
     from paddle_tpu.traffic import TrafficConfig, TrafficController
@@ -77,38 +86,46 @@ def _worker_main(spec: Dict[str, Any], conn) -> None:
             fluid.set_flags({"compile_cache_dir": spec["compile_cache_dir"]})
         if spec.get("flags"):
             fluid.set_flags(dict(spec["flags"]))
-        cfg = Config(spec["model_dir"])
-        if spec.get("batch_buckets"):
-            cfg.enable_shape_bucketing(
-                batch_buckets=tuple(spec["batch_buckets"]))
-        pred = create_predictor(cfg)
-        # measured warmup: one run per batch bucket (or one bare run).
-        # With a populated persistent cache this LOADS executables; on
-        # the first worker it compiles and populates — the delta is the
-        # warm-start proof the pool reports upward.
-        shapes = spec.get("warmup_shapes") or {}
-        t0 = time.perf_counter()
-        if shapes:
-            for b in (spec.get("batch_buckets") or [1]):
-                feed = {name: np.zeros([b] + list(shape[1:]), np.float32)
-                        for name, shape in shapes.items()}
-                pred.run([feed[n] for n in pred.get_input_names()])
-        warmup_ms = (time.perf_counter() - t0) * 1e3
-        engine = ServingEngine(pred, **(spec.get("engine_kwargs") or {}))
-        controller = None
-        if spec.get("traffic", True):
-            controller = TrafficController(
-                engine,
-                config=TrafficConfig.from_flags(
-                    **(spec.get("traffic_kwargs") or {})))
-        server = ServingServer(
-            engine, host=spec["host"], port=spec["port"],
-            traffic=controller, reuse_port=bool(spec.get("reuse_port")),
-            phase=spec.get("phase"))
+        with tracing.attach(propagate.from_env()), \
+             tracing.span("traffic/worker_boot",
+                          {"worker": spec.get("worker_id") or ""}):
+            cfg = Config(spec["model_dir"])
+            if spec.get("batch_buckets"):
+                cfg.enable_shape_bucketing(
+                    batch_buckets=tuple(spec["batch_buckets"]))
+            pred = create_predictor(cfg)
+            # measured warmup: one run per batch bucket (or one bare
+            # run). With a populated persistent cache this LOADS
+            # executables; on the first worker it compiles and
+            # populates — the delta is the warm-start proof the pool
+            # reports upward.
+            shapes = spec.get("warmup_shapes") or {}
+            t0 = time.perf_counter()
+            if shapes:
+                for b in (spec.get("batch_buckets") or [1]):
+                    feed = {name: np.zeros([b] + list(shape[1:]),
+                                           np.float32)
+                            for name, shape in shapes.items()}
+                    pred.run([feed[n] for n in pred.get_input_names()])
+            warmup_ms = (time.perf_counter() - t0) * 1e3
+            engine = ServingEngine(pred, **(spec.get("engine_kwargs")
+                                            or {}))
+            controller = None
+            if spec.get("traffic", True):
+                controller = TrafficController(
+                    engine,
+                    config=TrafficConfig.from_flags(
+                        **(spec.get("traffic_kwargs") or {})))
+            server = ServingServer(
+                engine, host=spec["host"], port=spec["port"],
+                traffic=controller,
+                reuse_port=bool(spec.get("reuse_port")),
+                phase=spec.get("phase"))
         stats = dispatch.cache_stats()
         conn.send(("ready", {
             "pid": os.getpid(),
             "port": server.port,
+            "worker_id": spec.get("worker_id"),
             "warmup_ms": round(warmup_ms, 2),
             "jit_compiles": stats.get("jit_compiles", 0),
             "persistent_cache_dir": stats.get("persistent_cache_dir"),
@@ -154,6 +171,16 @@ def _worker_main(spec: Dict[str, Any], conn) -> None:
             return
         if kind == "ping":
             conn.send(("pong", engine.metrics.snapshot()["requests_total"]))
+            continue
+        if kind == "trace":
+            # live trace re-stamp over the control pipe (the front
+            # port is shared under SO_REUSEPORT, so per-worker HTTP
+            # is impossible): the parent pushes fresh PADDLE_TRACE_*
+            # values and the child acks with the trace id it now holds
+            for k, v in (msg[1] or {}).items():
+                os.environ[k] = str(v)
+            conn.send(("traced",
+                       os.environ.get(propagate.ENV_TRACE_ID)))
             continue
         if kind == "stop":
             server.close()
@@ -324,6 +351,7 @@ class WorkerPool:
         self.workers: List[_Worker] = []
         self.router: Optional[ThinRouter] = None
         self._closed = False
+        self._spawn_n = 0
         if start:
             self.start()
 
@@ -333,7 +361,18 @@ class WorkerPool:
 
     # -- spawning ------------------------------------------------------------
     def _spawn(self) -> _Worker:
+        from ..observability import propagate, tracing
+
         spec = dict(self._spec_base)
+        # fleet identity + the spawner's ambient trace: a worker
+        # spawned inside a rolling_restart span boots INSIDE that
+        # trace (its traffic/worker_boot span parents there), and its
+        # PADDLE_WORKER_ID labels every span it ever records
+        phase = spec.get("phase")
+        spec["worker_id"] = (f"{phase}-{self._spawn_n}" if phase
+                             else f"worker-{self._spawn_n}")
+        self._spawn_n += 1
+        spec["trace_env"] = propagate.to_env(tracing.current())
         if self.use_reuseport:
             spec["port"] = self.port
             spec["reuse_port"] = True
@@ -366,6 +405,53 @@ class WorkerPool:
                 self.host, self.port,
                 [(self.host, w.port) for w in self.workers])
         return self
+
+    # -- fleet observability ---------------------------------------------------
+    def stamp_trace(self, ctx=None) -> List[Optional[str]]:
+        """Push a trace context (default: the caller's ambient span)
+        into every live worker's ``PADDLE_TRACE_*`` environment over
+        the control pipe; returns each worker's acked trace id (None
+        for a worker that did not answer)."""
+        from ..observability import propagate, tracing
+
+        env = propagate.to_env(
+            ctx if ctx is not None else tracing.current())
+        out: List[Optional[str]] = []
+        for w in self.workers:
+            try:
+                w.conn.send(("trace", env))
+                if w.conn.poll(5.0):
+                    kind, tid = w.conn.recv()
+                    out.append(tid if kind == "traced" else None)
+                else:
+                    out.append(None)
+            except (BrokenPipeError, EOFError, OSError):
+                out.append(None)
+        return out
+
+    def metrics_endpoints(self) -> List[Dict[str, Any]]:
+        """The FleetAggregator discovery hook
+        (``aggregator.watch_pool(pool)``): one scrape endpoint per
+        worker, labeled with its worker id and the pool's phase. Under
+        SO_REUSEPORT all workers share ONE front address (the kernel
+        picks a listener per scrape connection), so the pool exposes a
+        single shared endpoint; router mode exposes each worker's own
+        port."""
+        phase = self._spec_base.get("phase")
+        if self.use_reuseport:
+            ep: Dict[str, Any] = {
+                "url": f"http://{self.host}:{self.port}", "worker": "pool"}
+            if phase:
+                ep["phase"] = phase
+            return [ep]
+        out = []
+        for w in self.workers:
+            wid = (w.info or {}).get("worker_id") or f"worker-{w.port}"
+            ep = {"url": f"http://{self.host}:{w.port}", "worker": wid}
+            if phase:
+                ep["phase"] = phase
+            out.append(ep)
+        return out
 
     # -- drain + restart ------------------------------------------------------
     def _drain(self, worker: _Worker,

@@ -1,0 +1,417 @@
+"""Fleet observability (ISSUE 20): cross-process trace propagation,
+fleet metrics aggregation, and SLO burn-rate signals.
+
+Correctness anchors:
+  * codec — traceparent/X-Trace/env round-trips for both internal
+    22-hex and W3C 32-hex ids; garbage never raises, it degrades to
+    "no context";
+  * propagation — an HTTP /v1/generate with an incoming traceparent
+    yields ONE connected trace: the serving span, the disagg handoff
+    (prefill + decode phases) and the page-store wire RPC all share
+    the caller's trace id with ZERO orphan spans, assembled via
+    /v1/admin/trace/<id>;
+  * aggregation — FleetAggregator merges live workers with
+    {worker=,phase=} labels, marks a dead endpoint stale (keeping its
+    last-good text), and a HUNG backend cannot stall the scrape past
+    its timeout;
+  * SLO — burn-rate math on an injected clock: windowed miss ratio,
+    budget burn, exactly ONE latched flight dump per sustained-burn
+    episode, reset on recovery;
+  * rendering — imported spans keep their pid as a process lane and a
+    cross-process parent draws a flow arrow.
+"""
+
+import json
+import re
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.generation.model import GPTConfig, build_lm_program
+from paddle_tpu.inference import Config, create_predictor
+from paddle_tpu.observability import (FleetAggregator, SLOMonitor, flight,
+                                      propagate, tracing)
+from paddle_tpu.observability.fleet import parse_prometheus_text
+
+CFG = GPTConfig(vocab_size=97, hidden_size=32, num_layers=2, num_heads=4,
+                ffn_size=64, max_position=64, hidden_dropout=0.0,
+                attention_dropout=0.0)
+SEQ = 48
+
+
+@pytest.fixture(scope="module")
+def lm_dir(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("fleet_lm"))
+    main, startup, _feeds, fetches = build_lm_program(CFG, SEQ)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        fluid.io.save_inference_model(d, ["tokens"],
+                                      [fetches["logits"]], exe, main)
+    return d
+
+
+class _FlagGuard:
+    def __init__(self, **kv):
+        self._kv = kv
+
+    def __enter__(self):
+        self._old = fluid.get_flags(list(self._kv))
+        fluid.set_flags(self._kv)
+
+    def __exit__(self, *exc):
+        fluid.set_flags(self._old)
+
+
+# -- codec -------------------------------------------------------------------
+
+
+def test_traceparent_round_trip_internal_ids():
+    with _FlagGuard(observability_tracing=True):
+        with tracing.span("codec") as ctx:
+            header = propagate.format_traceparent(ctx)
+            got = propagate.parse_traceparent(header)
+            assert got == propagate.SpanContext(ctx.trace_id, ctx.span_id)
+
+
+def test_traceparent_round_trip_w3c_widths():
+    """A 32-hex trace id / 16-hex span id from a foreign W3C tracer
+    parses and re-formats without truncation."""
+    tid, sid = "0af7651916cd43dd8448eb211c80319c", "b7ad6b7169203331"
+    ctx = propagate.parse_traceparent(f"00-{tid}-{sid}-01")
+    assert ctx == (tid, sid)
+    assert tid in propagate.format_traceparent(ctx)
+
+
+@pytest.mark.parametrize("garbage", [
+    None, "", "zz-nothex", "00-xyz-abc-01", "00--­-01", "0" * 500,
+    "00-" + "g" * 32 + "-" + "b" * 16 + "-01"])
+def test_parse_garbage_degrades_to_none(garbage):
+    assert propagate.parse_traceparent(garbage) is None
+
+
+def test_inject_extract_header_spellings():
+    ctx = propagate.SpanContext("ab" * 11, "cd" * 11)
+    carrier = propagate.inject(ctx)
+    assert propagate.extract(carrier) == ctx
+    # each spelling alone suffices; bare hex in X-Trace still yields
+    # a usable (trace-only) context
+    assert propagate.extract(
+        {"traceparent": carrier["traceparent"]}) == ctx
+    assert propagate.extract({"X-Trace": ctx.trace_id}).trace_id \
+        == ctx.trace_id
+    assert propagate.extract({}) is None
+
+
+def test_env_round_trip():
+    ctx = propagate.SpanContext("12" * 11, "34" * 11)
+    env = propagate.to_env(ctx)
+    assert propagate.from_env(env) == ctx
+    assert propagate.from_env({}) is None
+
+
+def test_orphan_spans():
+    spans = [{"span_id": "a", "parent_id": None},
+             {"span_id": "b", "parent_id": "a"},
+             {"span_id": "c", "parent_id": "missing"}]
+    assert [s["span_id"] for s in propagate.orphan_spans(spans)] == ["c"]
+    assert propagate.orphan_spans(spans,
+                                  known_parents=("missing",)) == []
+
+
+# -- cross-process propagation end to end ------------------------------------
+
+
+@pytest.mark.slow
+def test_http_to_disagg_to_wire_one_trace(lm_dir):
+    """The tentpole proof: a traced HTTP /v1/generate against a split
+    prefill/decode topology over a TCP page store produces ONE
+    connected trace — serving span, handoff, prefill phase, page-store
+    RPC and decode submit all under the caller's trace id, zero
+    orphans — pulled back through /v1/admin/trace/<id>."""
+    from paddle_tpu.disagg import (DecodeWorker, DisaggService,
+                                   PageStoreClient, PageStoreServer,
+                                   PrefillWorker)
+    from paddle_tpu.serving import ServingEngine, ServingServer
+
+    with _FlagGuard(observability_tracing=True,
+                    observability_flight_capacity=2048):
+        flight.clear()
+        store_srv = PageStoreServer(page_size=4)
+        kw = dict(page_size=4, num_pages=64, max_decode_batch=4,
+                  chunk_tokens=6, warmup=False)
+        pf = PrefillWorker(
+            create_predictor(Config(lm_dir)), CFG,
+            PageStoreClient(store_srv.host, store_srv.port, page_size=4),
+            **kw)
+        dw = DecodeWorker(
+            create_predictor(Config(lm_dir)), CFG,
+            PageStoreClient(store_srv.host, store_srv.port, page_size=4),
+            **kw)
+        svc = DisaggService(prefill=[pf], decode=[dw])
+        eng = ServingEngine(create_predictor(Config(lm_dir)),
+                            num_workers=1)
+        srv = ServingServer(eng, port=0, generation_engine=svc)
+        try:
+            client = tracing.SpanContext(tracing._new_id(),
+                                         tracing._new_id())
+            prompt = [3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14]
+            req = urllib.request.Request(
+                srv.address + "/v1/generate",
+                data=json.dumps({"tokens": prompt, "max_new_tokens": 3,
+                                 "eos_id": None}).encode(),
+                headers={"Content-Type": "application/json",
+                         **propagate.inject(client)})
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                assert resp.headers["X-Trace"] == client.trace_id
+                lines = [json.loads(ln) for ln in resp if ln.strip()]
+            # ids ride the FIRST fragment and the tail
+            assert lines[0]["trace_id"] == client.trace_id
+            assert lines[0]["index"] == 0 and "token" in lines[0]
+            assert lines[-1]["trace_id"] == client.trace_id
+            assert lines[-1]["request_id"]
+
+            with urllib.request.urlopen(
+                    srv.address + f"/v1/admin/trace/{client.trace_id}",
+                    timeout=30) as r:
+                local = json.loads(r.read())
+            spans = local["spans"]
+            names = {s["name"] for s in spans}
+            assert {"serving/http_generate", "disagg/handoff",
+                    "disagg/prefill_phase",
+                    "disagg/decode_submit"} <= names
+            assert any(n.startswith("pagestore/") for n in names)
+            assert all(s["trace_id"] == client.trace_id for s in spans)
+            assert all("pid" in s for s in spans)
+            # connected: every parent is another span in the trace or
+            # the client's root span
+            assert propagate.orphan_spans(
+                spans, known_parents=(client.span_id,)) == []
+        finally:
+            srv.close()
+            eng.close()
+            svc.close(drain=True)
+            store_srv.close()
+    for w in svc._prefill + svc._decode:
+        w.engine.cache.check_integrity()
+        assert w.engine.stats()["cache"]["pages_in_use"] == 0
+
+
+def test_unknown_trace_is_404(lm_dir):
+    from paddle_tpu.serving import ServingEngine, ServingServer
+
+    eng = ServingEngine(create_predictor(Config(lm_dir)), num_workers=1)
+    srv = ServingServer(eng, port=0)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                srv.address + "/v1/admin/trace/deadbeef", timeout=30)
+        assert ei.value.code == 404
+        body = json.loads(ei.value.read())
+        # satellite: every error body carries the correlation ids
+        assert body["request_id"]
+    finally:
+        srv.close()
+        eng.close()
+
+
+# -- fleet aggregation -------------------------------------------------------
+
+
+def _serve_text(text, *, delay_s=0.0):
+    """A one-endpoint metrics server; optionally hangs ``delay_s``
+    before answering (the hung-backend case)."""
+    class H(BaseHTTPRequestHandler):
+        def do_GET(self):
+            if delay_s:
+                time.sleep(delay_s)
+            body = text.encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    srv = HTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, f"http://127.0.0.1:{srv.server_address[1]}"
+
+
+def test_parse_prometheus_text():
+    samples = parse_prometheus_text(
+        "# HELP x y\n# TYPE a counter\n"
+        'a_total{cls="interactive",q="a\\"b"} 3\n'
+        "plain 1.5\n"
+        "broken{ 7\n")
+    got = {name: (labels, val) for name, labels, val in samples}
+    assert got["a_total"][0] == {"cls": "interactive", "q": 'a\\"b'}
+    assert got["a_total"][1] == 3.0
+    assert got["plain"] == ({}, 1.5)
+    assert "broken" not in got
+
+
+def test_fleet_merges_labels_and_marks_dead_stale():
+    s1, u1 = _serve_text("paddle_x_total 3\n")
+    s2, u2 = _serve_text("paddle_x_total 5\n")
+    try:
+        agg = FleetAggregator(timeout_s=2.0)
+        agg.add_endpoint(u1, worker="prefill-0", phase="prefill")
+        agg.add_endpoint(u2, worker="decode-0", phase="decode", rank=1)
+        r = agg.scrape()
+        assert r["live"] == 2 and r["stale"] == 0
+        vals = {lb["worker"]: v for lb, v in agg.series("paddle_x_total")}
+        assert vals == {"prefill-0": 3.0, "decode-0": 5.0}
+        text = agg.to_prometheus_text(scrape=False)
+        assert ('paddle_x_total{phase="prefill",worker="prefill-0"} 3.0'
+                in text)
+        # kill one backend: next scrape marks it stale but KEEPS its
+        # last-good samples so the merged view degrades, not vanishes
+        s2.shutdown()
+        s2.server_close()
+        r = agg.scrape()
+        assert r["live"] == 1 and r["stale"] == 1
+        vals = {lb["worker"]: v for lb, v in agg.series("paddle_x_total")}
+        assert vals["decode-0"] == 5.0
+        text = agg.to_prometheus_text(scrape=False)
+        assert re.search(
+            r'paddle_fleet_stale\{[^}]*worker="decode-0"[^}]*\} 1', text)
+    finally:
+        s1.shutdown()
+        s1.server_close()
+
+
+def test_fleet_scrape_bounded_by_hung_backend():
+    s1, u1 = _serve_text("paddle_y 1\n")
+    s2, u2 = _serve_text("paddle_y 2\n", delay_s=30.0)
+    try:
+        agg = FleetAggregator(timeout_s=0.5)
+        agg.add_endpoint(u1, worker="ok")
+        agg.add_endpoint(u2, worker="hung")
+        t0 = time.monotonic()
+        r = agg.scrape()
+        assert time.monotonic() - t0 < 5.0  # NOT 30s: the hang is cut
+        assert r["live"] == 1 and r["stale"] == 1
+        assert {lb["worker"] for lb, _v in agg.series("paddle_y")} \
+            == {"ok"}
+    finally:
+        for s in (s1, s2):
+            s.shutdown()
+            s.server_close()
+
+
+# -- SLO burn rate on a fake clock -------------------------------------------
+
+
+def _gauge(mon, name, cls):
+    for lb, v in mon.gauges()[name]:
+        if lb.get("cls") == cls:
+            return v
+    raise KeyError((name, cls))
+
+
+def test_slo_burn_math_and_latched_dump():
+    clk = {"t": 1000.0}
+    dumps = []
+    mon = SLOMonitor(budget=0.01, window_s=30.0, burn_threshold=10.0,
+                     clock=lambda: clk["t"], on_burn=dumps.append)
+    tot = {"c": 0, "m": 0}
+
+    def tick(completed, missed):
+        clk["t"] += 10
+        tot["c"] += completed
+        tot["m"] += missed
+        mon.record("interactive", completed_total=tot["c"],
+                   deadline_missed_total=tot["m"])
+
+    # healthy: 1000 completed, 1 miss -> ratio 0.001, burn 0.1
+    mon.record("interactive", completed_total=0, deadline_missed_total=0)
+    tick(1000, 1)
+    assert _gauge(mon, "paddle_slo_deadline_miss_ratio", "interactive") \
+        == pytest.approx(0.001)
+    assert _gauge(mon, "paddle_slo_error_budget_burn", "interactive") \
+        == pytest.approx(0.1)
+    assert not dumps
+
+    # sustained burn: 20% misses -> the window ratio climbs past
+    # 10x budget, holds there a FULL window, fires exactly ONE dump
+    for _ in range(6):
+        tick(100, 20)
+    assert _gauge(mon, "paddle_slo_error_budget_burn", "interactive") \
+        == pytest.approx(20.0, rel=0.01)
+    assert _gauge(mon, "paddle_slo_sustained_burn", "interactive") == 1.0
+    assert dumps == ["slo-burn-interactive"]
+
+    # still burning: latched, no second dump
+    tick(100, 20)
+    assert len(dumps) == 1
+
+    # recovery: the burn recedes below threshold, latch resets...
+    for _ in range(5):
+        tick(100, 0)
+    assert _gauge(mon, "paddle_slo_sustained_burn", "interactive") == 0.0
+    # ...so the NEXT sustained episode fires again
+    for _ in range(8):
+        tick(100, 20)
+    assert dumps == ["slo-burn-interactive", "slo-burn-interactive"]
+
+
+def test_slo_latency_targets():
+    clk = {"t": 0.0}
+    mon = SLOMonitor(ttft_p99_ms=200.0, itl_p99_ms=20.0,
+                     clock=lambda: clk["t"])
+    mon.record("all", ttft_p99_ms=150.0, itl_p99_ms=30.0)
+    assert _gauge(mon, "paddle_slo_ttft_target_ratio", "all") \
+        == pytest.approx(0.75)
+    assert _gauge(mon, "paddle_slo_itl_target_ratio", "all") \
+        == pytest.approx(1.5)
+
+
+def test_slo_ingests_fleet_scrape():
+    s1, u1 = _serve_text(
+        'paddle_traffic_completed_total{cls="interactive"} 100\n'
+        'paddle_traffic_deadline_miss_total{cls="interactive"} 4\n'
+        "paddle_generation_ttft_ms_p99 40\n")
+    try:
+        mon = SLOMonitor(budget=0.01, ttft_p99_ms=200.0)
+        agg = FleetAggregator(slo=mon, timeout_s=2.0)
+        agg.add_endpoint(u1, worker="w0", phase="decode")
+        text = agg.to_prometheus_text()  # scrape + ingest + render
+        assert "paddle_slo_deadline_miss_ratio" in text
+        assert "paddle_slo_error_budget_burn" in text
+        assert 'worker="w0"' in text
+    finally:
+        s1.shutdown()
+        s1.server_close()
+
+
+# -- timeline rendering ------------------------------------------------------
+
+
+def test_timeline_pid_lanes_and_cross_process_arrow():
+    from paddle_tpu.tools_timeline import to_chrome_trace
+
+    events = [
+        {"name": "router/http", "ts": 0.0, "dur": 0.01, "tid": 1,
+         "pid": 0, "args": {"span_id": "r1", "worker": "router"}},
+        {"name": "prefill/run", "ts": 0.002, "dur": 0.005, "tid": 7,
+         "pid": 4242, "args": {"span_id": "p1", "parent_id": "r1",
+                               "worker": "prefill-0"}},
+    ]
+    trace = to_chrome_trace(events)
+    evs = trace["traceEvents"]
+    lanes = {e["pid"]: e["args"]["name"] for e in evs
+             if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert lanes[4242] == "prefill-0"
+    assert 0 in lanes
+    flows = [e for e in evs if e.get("ph") in ("s", "f")]
+    assert len(flows) == 2
+    assert {flows[0]["pid"], flows[1]["pid"]} == {0, 4242}

@@ -133,7 +133,37 @@ def measure_loops(reps=5, timed=150):
     out["telemetry_machinery_us_per_step"] = machinery_s * 1e6
     out["bare_step_us"] = bare_step_s * 1e6
     out["overhead_pct"] = machinery_s / bare_step_s * 100.0
+    out.update(measure_propagation(bare_step_s))
     return out
+
+
+def measure_propagation(bare_step_s: float, n: int = 20_000):
+    """Per-boundary cost of the cross-process trace codec
+    (observability/propagate.py) with tracing ON: what one
+    request-hop pays end to end — extract the incoming header, attach
+    it, open a span, and format+inject the outgoing header (the exact
+    work server.py + PageStoreClient add per hop). Gated like the
+    step machinery: propagation_us / bare_step_us < 3%."""
+    import paddle_tpu as fluid
+    from paddle_tpu.observability import propagate, tracing
+
+    fluid.set_flags({"observability_tracing": True})
+    try:
+        with tracing.span("bench/root") as root:
+            header = propagate.format_traceparent(root)
+        carrier = {"traceparent": header}
+        t0 = time.perf_counter()
+        for _ in range(n):
+            ctx = propagate.extract(carrier)
+            with tracing.attach(ctx), tracing.span("bench/hop") as s:
+                propagate.inject(s, {})
+        prop_s = (time.perf_counter() - t0) / n
+    finally:
+        fluid.set_flags({"observability_tracing": False})
+    return {
+        "propagation_us_per_request": prop_s * 1e6,
+        "propagation_overhead_pct": prop_s / bare_step_s * 100.0,
+    }
 
 
 def flight_round_trip(tmp):
@@ -197,6 +227,9 @@ def smoke(out_path=None):
           f"{report['telemetry_machinery_us_per_step']:.2f}us/step = "
           f"{report['overhead_pct']:.3f}% of a bare "
           f"{report['bare_step_us']:.0f}us step")
+    print(f"propagation: {report['propagation_us_per_request']:.2f}us/"
+          f"request-hop = {report['propagation_overhead_pct']:.3f}% of a "
+          "bare step")
 
     tmp = tempfile.mkdtemp(prefix="obs_bench_")
     report["flight_round_trip"] = flight_round_trip(tmp)
@@ -219,9 +252,14 @@ def smoke(out_path=None):
             json.dump(report, f, indent=2)
         print(f"wrote {out_path}")
 
-    # the acceptance gate: enabled telemetry costs <3% of a bare step
+    # the acceptance gates: enabled telemetry costs <3% of a bare
+    # step, and so does one full propagation hop (extract + attach +
+    # span + inject) with tracing ON
     assert report["overhead_pct"] < 3.0, (
         f"observability overhead {report['overhead_pct']:.3f}% >= 3% budget")
+    assert report["propagation_overhead_pct"] < 3.0, (
+        f"trace propagation overhead "
+        f"{report['propagation_overhead_pct']:.3f}% >= 3% budget")
     return 0
 
 

@@ -1,21 +1,105 @@
 """Chrome-trace CLI (reference tools/timeline.py): merge host-event
 JSON logs (written by paddle_tpu.profiler.stop_profiler(profile_path))
-into one chrome://tracing file.
+into one chrome://tracing file — or pull and render a CROSS-PROCESS
+trace assembled from the fleet's ``/v1/admin/trace/<id>`` endpoints.
 
-Usage: python tools/timeline.py --profile_path a.json,b.json \
-           --timeline_path timeline.json
+Usage:
+    # merge chrome-trace files (one process lane per input)
+    python tools/timeline.py --profile_path a.json,b.json \
+        --timeline_path timeline.json
+
+    # pull one trace from the fleet and render process lanes + flow
+    # arrows (router / prefill / page store / decode in one view)
+    python tools/timeline.py --trace <trace_id> \
+        --endpoints http://host:8500,http://host:8600 \
+        --timeline_path trace.json
+
+    # render an already-assembled trace (observability.assemble_trace
+    # output saved to a file)
+    python tools/timeline.py --trace-json assembled.json \
+        --timeline_path trace.json
 """
 
 import argparse
 import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def _render_assembled(assembled, timeline_path: str) -> None:
+    """observability.fleet.assemble_trace output -> chrome trace with
+    one lane per process (pid), named by worker/phase/host."""
+    from paddle_tpu.tools_timeline import to_chrome_trace
+
+    process_names = {}
+    for p in assembled.get("processes", []):
+        label = (p.get("worker") or p.get("phase") or p.get("host")
+                 or p.get("url") or "")
+        process_names[int(p["pid"])] = (
+            f"{label} (pid {p['pid']})" if label else f"pid {p['pid']}")
+    events = []
+    for s in assembled.get("spans", []):
+        events.append({
+            "name": s.get("name", "span"),
+            "ts": float(s.get("ts", 0.0)),
+            "dur": float(s.get("dur", 0.0)),
+            "tid": int(s.get("tid", 0)),
+            "pid": int(s.get("pid", 0)),
+            # everything else (trace_id/span_id/parent_id/worker/...)
+            # becomes span args — parent_id drives the flow arrows
+            "args": {k: v for k, v in s.items()
+                     if k not in ("kind", "t", "name", "ts", "dur",
+                                  "tid", "pid")},
+        })
+    trace = to_chrome_trace(events, process_names=process_names)
+    with open(timeline_path, "w") as f:
+        json.dump(trace, f)
+    pids = {e["pid"] for e in events}
+    print(f"wrote {timeline_path} ({len(events)} spans, "
+          f"{len(pids)} process lanes, "
+          f"trace {assembled.get('trace_id', '?')})")
 
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--profile_path", required=True,
+    ap.add_argument("--profile_path",
                     help="comma-separated chrome-trace json inputs")
+    ap.add_argument("--trace",
+                    help="trace id to pull from the fleet's "
+                         "/v1/admin/trace/<id> endpoints (--endpoints)")
+    ap.add_argument("--endpoints",
+                    help="comma-separated worker base URLs to pull "
+                         "--trace from (e.g. http://host:8500)")
+    ap.add_argument("--trace-json", dest="trace_json",
+                    help="already-assembled trace JSON file "
+                         "(observability.assemble_trace output)")
     ap.add_argument("--timeline_path", default="timeline.json")
     args = ap.parse_args()
+
+    if args.trace_json:
+        with open(args.trace_json) as f:
+            _render_assembled(json.load(f), args.timeline_path)
+        return
+    if args.trace:
+        if not args.endpoints:
+            ap.error("--trace requires --endpoints")
+        from paddle_tpu.observability import assemble_trace
+
+        eps = [e.strip() for e in args.endpoints.split(",") if e.strip()]
+        assembled = assemble_trace(args.trace, eps)
+        if not assembled["spans"]:
+            print(f"no spans for trace {args.trace} on {len(eps)} "
+                  "endpoints (ring rotated, or tracing off?)",
+                  file=sys.stderr)
+            sys.exit(1)
+        _render_assembled(assembled, args.timeline_path)
+        return
+    if not args.profile_path:
+        ap.error("one of --profile_path, --trace, --trace-json required")
 
     merged = {"traceEvents": [], "displayTimeUnit": "ms"}
     for i, p in enumerate(args.profile_path.split(",")):

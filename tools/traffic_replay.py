@@ -76,6 +76,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
 import socket
 import sys
 import tempfile
@@ -841,20 +842,76 @@ def run_shared_prefix(tmp_dir, spec):
 # -- scenario: disaggregated prefill/decode ----------------------------------
 
 
+# a minimal stdlib metrics stub: a SEPARATE python process serving a
+# fixed /metrics exposition — stands in for a remote worker so the
+# fleet-merge gate covers real multi-process scraping without paying
+# three jax imports
+_METRICS_STUB = r"""
+import sys
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+text = sys.argv[1].encode()
+
+
+class H(BaseHTTPRequestHandler):
+    def do_GET(self):
+        body = text if self.path == "/metrics" else b"{}"
+        self.send_response(200 if self.path == "/metrics" else 404)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):
+        pass
+
+
+srv = HTTPServer(("127.0.0.1", 0), H)
+print(srv.server_address[1], flush=True)
+srv.serve_forever()
+"""
+
+
+def _spawn_metrics_stub(text):
+    import subprocess
+
+    proc = subprocess.Popen([sys.executable, "-c", _METRICS_STUB, text],
+                            stdout=subprocess.PIPE, text=True)
+    port = int(proc.stdout.readline())
+    return proc, port
+
+
 def run_disagg(tmp_dir, spec):
     """Shared-prefix flood replayed against a split prefill/decode
-    topology (paddle_tpu.disagg) with a co-located engine as the
-    token-identity oracle. Gates: (1) every split request emits
-    greedy tokens IDENTICAL to the co-located engine's, (2) every
-    request went through a handoff and its pages shipped over the
-    store (handoffs == requests, pages pulled > 0), (3) the phase
-    health fragment exposes both tiers, and (4) drain leaves zero
-    pages on every engine with ``check_integrity`` green."""
+    topology (paddle_tpu.disagg) over a REAL TCP page-store wire
+    (PageStoreServer + one PageStoreClient per worker), with a
+    co-located engine as the token-identity oracle and fleet
+    observability wired end to end. Gates: (1) every split request
+    emits greedy tokens IDENTICAL to the co-located engine's, (2)
+    every request went through a handoff and its pages shipped over
+    the store (handoffs == requests, pages pulled > 0), (3) the phase
+    health fragment exposes both tiers, (4) drain leaves zero pages on
+    every engine with ``check_integrity`` green, (5) one traced HTTP
+    /v1/generate yields ONE connected trace (zero orphan spans)
+    covering the router hop, the disagg handoff (prefill + decode
+    phases) and the page-store wire — assembled via
+    ``/v1/admin/trace/<id>`` and renderable with process lanes, and
+    (6) ``/metrics/fleet`` merges the router plus >=3 live worker
+    processes with ``{worker=,phase=}`` labels and exports
+    ``paddle_slo_*`` gauges."""
     import random
+    import urllib.request
 
+    import paddle_tpu as fluid
     from paddle_tpu.disagg import (DecodeWorker, DisaggService,
-                                   HostPageStore, PrefillWorker)
+                                   PrefillWorker)
+    from paddle_tpu.disagg.pagestore import (PageStoreClient,
+                                             PageStoreServer)
     from paddle_tpu.inference import Config, create_predictor
+    from paddle_tpu.observability import (FleetAggregator, SLOMonitor,
+                                          assemble_trace, propagate,
+                                          tracing)
+    from paddle_tpu.serving import ServingEngine, ServingServer
+    from paddle_tpu.tools_timeline import to_chrome_trace
 
     cfg = _lm_cfg()
     pref_len = int(spec.get("prefix_tokens", 64))
@@ -876,23 +933,105 @@ def run_disagg(tmp_dir, spec):
     finally:
         gen.close(drain=False)
 
-    # split topology: one prefill worker + one decode worker over a
-    # host page store; the same flood arrives as a burst
+    fluid.set_flags({"observability_tracing": True,
+                     "observability_flight_capacity": 4096})
+    # split topology: one prefill worker + one decode worker, each
+    # with its OWN client connection to a TCP page-store server — the
+    # trace-context field in the wire framing is exercised for real
     d = os.path.join(tmp_dir, "lm")
-    store = HostPageStore(page_size=16)
+    store_srv = PageStoreServer(page_size=16)
     kw = dict(page_size=16, num_pages=192, max_decode_batch=4,
               chunk_tokens=16, warmup=False)
-    pf = PrefillWorker(create_predictor(Config(d)), cfg, store, **kw)
-    dw = DecodeWorker(create_predictor(Config(d)), cfg, store, **kw)
+    pf = PrefillWorker(
+        create_predictor(Config(d)), cfg,
+        PageStoreClient(store_srv.host, store_srv.port, page_size=16),
+        **kw)
+    dw = DecodeWorker(
+        create_predictor(Config(d)), cfg,
+        PageStoreClient(store_srv.host, store_srv.port, page_size=16),
+        **kw)
     svc = DisaggService(prefill=[pf], decode=[dw])
+    stubs = []
+    server = eng = None
     try:
         streams = [svc.submit(p, max_new_tokens=max_new, eos_id=None)
                    for p in prompts]
         toks = [list(s.result(timeout=300)) for s in streams]
+
+        # -- cross-process trace: one traced HTTP request ------------------
+        eng = ServingEngine(pred, num_workers=1)
+        server = ServingServer(eng, generation_engine=svc)
+        client_ctx = tracing.SpanContext(tracing._new_id(),
+                                         tracing._new_id())
+        req = urllib.request.Request(
+            f"{server.address}/v1/generate",
+            data=json.dumps({"tokens": prompts[0],
+                             "max_new_tokens": max_new,
+                             "eos_id": None, "stream": True}).encode(),
+            headers={"Content-Type": "application/json",
+                     **propagate.inject(client_ctx)})
+        with urllib.request.urlopen(req, timeout=300) as resp:
+            lines = [json.loads(ln) for ln in resp if ln.strip()]
+        http_toks = [ln["token"] for ln in lines if "token" in ln]
+        first, tail = lines[0], lines[-1]
+        trace_echoed = (first.get("trace_id") == client_ctx.trace_id
+                        and tail.get("trace_id") == client_ctx.trace_id)
+        assembled = assemble_trace(client_ctx.trace_id, [server.address])
+        span_names = {s.get("name") for s in assembled["spans"]}
+        orphans = propagate.orphan_spans(
+            assembled["spans"], known_parents=(client_ctx.span_id,))
+        chrome = to_chrome_trace([
+            {"name": s["name"], "ts": s["ts"], "dur": s["dur"],
+             "tid": s.get("tid", 0), "pid": s.get("pid", 0),
+             "args": {k: v for k, v in s.items()
+                      if k not in ("kind", "t", "name", "ts", "dur",
+                                   "tid", "pid")}}
+            for s in assembled["spans"]])
+        lanes = {e.get("pid") for e in chrome["traceEvents"]
+                 if e.get("ph") == "X"}
+        arrows = sum(1 for e in chrome["traceEvents"]
+                     if e.get("ph") == "s")
+
+        # -- fleet merge: router + 3 REAL worker processes ------------------
+        for i, (worker, phase) in enumerate(
+                (("prefill-0", "prefill"), ("decode-0", "decode"),
+                 ("decode-1", "decode"))):
+            text = (
+                f'paddle_traffic_completed_total{{cls="interactive"}} '
+                f'{100 + i}\n'
+                f'paddle_traffic_deadline_miss_total{{cls="interactive"}} '
+                f'{i}\n'
+                f'paddle_generation_ttft_ms_p99 {40.0 + i}\n')
+            proc, port = _spawn_metrics_stub(text)
+            stubs.append((proc, port, worker, phase))
+        agg = FleetAggregator(slo=SLOMonitor(), timeout_s=2.0)
+        agg.add_endpoint(server.address, worker="router", phase="disagg")
+        for _proc, port, worker, phase in stubs:
+            agg.add_endpoint(f"http://127.0.0.1:{port}", worker=worker,
+                             phase=phase)
+        server._httpd.RequestHandlerClass.fleet = agg
+        agg.scrape()   # two scrapes: the SLO window needs two samples
+        with urllib.request.urlopen(f"{server.address}/metrics/fleet",
+                                    timeout=30) as r:
+            fleet_text = r.read().decode()
+        fleet_workers = {m.group(1) for m in re.finditer(
+            r'worker="([^"]+)"', fleet_text)}
+        m = re.search(r"^paddle_fleet_live (\d+)", fleet_text, re.M)
+        live = int(m.group(1)) if m else 0
+
         stats = svc.stats_numeric()
         phases = {h["phase"] for h in svc.phase_health()}
     finally:
+        fluid.set_flags({"observability_tracing": False,
+                         "observability_flight_capacity": 512})
+        if server is not None:
+            server.close()
+        if eng is not None:
+            eng.close()
         svc.close(drain=True)
+        store_srv.close()
+        for proc, *_rest in stubs:
+            proc.terminate()
     leaked = 0
     for w in svc._prefill + svc._decode:
         w.engine.cache.check_integrity()
@@ -904,6 +1043,7 @@ def run_disagg(tmp_dir, spec):
         "prefix_tokens": pref_len,
         "max_new_tokens": max_new,
         "tokens_identical": bool(identical),
+        "http_tokens_identical": bool(http_toks == oracle[0]),
         "handoffs": int(stats["handoffs_total"]),
         "handoff_failures": int(stats["handoff_failures_total"]),
         "pages_shipped": int(stats["pages_shipped_total"]),
@@ -912,6 +1052,28 @@ def run_disagg(tmp_dir, spec):
         "wire_ratio": stats.get("wire_ratio", 0.0),
         "phases": sorted(phases),
         "leaked_pages": leaked,
+        # trace completeness (acceptance: ONE connected trace spanning
+        # router -> handoff -> page-store wire -> decode)
+        "trace_id_echoed": bool(trace_echoed),
+        "trace_spans": len(assembled["spans"]),
+        "trace_span_names": sorted(span_names),
+        "trace_orphans": len(orphans),
+        "trace_roles_covered": bool(
+            {"serving/http_generate", "disagg/handoff",
+             "disagg/prefill_phase",
+             "disagg/decode_submit"} <= span_names
+            and any(n.startswith("pagestore/") for n in span_names)),
+        "timeline_lanes": len(lanes),
+        "timeline_flow_arrows": int(arrows),
+        # fleet merge (acceptance: >=3 live processes, worker/phase
+        # labels, paddle_slo_* gauges)
+        "fleet_workers": sorted(fleet_workers),
+        "fleet_processes_merged": 1 + len(stubs),
+        "fleet_live": int(live),
+        "fleet_has_slo_gauges": "paddle_slo_error_budget_burn"
+                                in fleet_text,
+        "fleet_has_phase_labels": 'phase="prefill"' in fleet_text
+                                  and 'phase="decode"' in fleet_text,
     }
 
 
@@ -1278,14 +1440,28 @@ def main():
         result["disagg"] = run_disagg(tmp, spec)
         r = result["disagg"]
         gates["disagg_tokens_identical"] = bool(r["tokens_identical"])
+        # the flood plus the one traced HTTP request each hand off
         gates["disagg_every_request_handed_off"] = (
-            r["handoffs"] == r["requests"]
+            r["handoffs"] == r["requests"] + 1
             and r["handoff_failures"] == 0)
         gates["disagg_pages_streamed"] = (
             r["pages_shipped"] > 0 and r["pages_pulled"] > 0)
         gates["disagg_phases_exposed"] = (
             r["phases"] == ["decode", "prefill"])
         gates["disagg_zero_leaked_pages"] = r["leaked_pages"] == 0
+        # ONE connected trace spans router -> handoff -> prefill ->
+        # page-store wire -> decode submit, the trace id is echoed on
+        # the stream, and the timeline renders with flow arrows
+        gates["disagg_trace_connected"] = (
+            r["trace_id_echoed"] and r["http_tokens_identical"]
+            and r["trace_orphans"] == 0 and r["trace_roles_covered"]
+            and r["timeline_flow_arrows"] > 0)
+        # /metrics/fleet on the router merges >=3 live worker
+        # processes with worker/phase labels + paddle_slo_* gauges
+        gates["disagg_fleet_merged"] = (
+            r["fleet_live"] >= 4 and len(r["fleet_workers"]) >= 4
+            and r["fleet_has_slo_gauges"]
+            and r["fleet_has_phase_labels"])
 
     if args.scenario in ("all", "mixed_adapter"):
         spec = {"adapters": 8, "tenants": 3, "max_new_tokens": 6,
